@@ -1,0 +1,7 @@
+"""stoix_tpu — a TPU-native distributed single-agent RL framework.
+
+A ground-up rebuild of the capabilities of EdanToledo/Stoix, designed for
+jax.jit + shard_map over a global TPU mesh instead of single-host pmap.
+"""
+
+__version__ = "0.1.0"

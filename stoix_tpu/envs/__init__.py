@@ -1,0 +1,34 @@
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment, Wrapper
+from stoix_tpu.envs.registry import ENV_REGISTRY, make, make_single, register
+from stoix_tpu.envs.types import Observation, StepType, TimeStep, get_final_step_metrics
+from stoix_tpu.envs.wrappers import (
+    AutoResetWrapper,
+    CachedAutoResetWrapper,
+    EpisodeStepLimit,
+    OptimisticResetVmapWrapper,
+    RecordEpisodeMetrics,
+    VmapWrapper,
+    apply_core_wrappers,
+)
+
+__all__ = [
+    "spaces",
+    "Environment",
+    "Wrapper",
+    "ENV_REGISTRY",
+    "make",
+    "make_single",
+    "register",
+    "Observation",
+    "StepType",
+    "TimeStep",
+    "get_final_step_metrics",
+    "AutoResetWrapper",
+    "CachedAutoResetWrapper",
+    "EpisodeStepLimit",
+    "OptimisticResetVmapWrapper",
+    "RecordEpisodeMetrics",
+    "VmapWrapper",
+    "apply_core_wrappers",
+]

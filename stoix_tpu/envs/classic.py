@@ -1,0 +1,364 @@
+"""Classic-control environments, implemented natively in JAX.
+
+The reference gets these from the external `gymnax` suite
+(reference stoix/utils/make_env.py:420-433 ENV_MAKERS["gymnax"]); this module is
+the first-party TPU-native equivalent. Dynamics follow the standard textbook
+formulations (identical to OpenAI Gym / gymnax), with termination conditions and
+default step limits matching the `-v1`/`-v0` conventions so published solve
+thresholds (e.g. CartPole 500) carry over.
+
+Design notes (TPU-first):
+  - All physics is elementwise fp32 math on tiny states — it fuses into the
+    surrounding rollout scan; there is no per-env Python.
+  - Step limits are emitted as *truncations* (discount stays 1) so GAE
+    bootstraps correctly (see stoix_tpu/ops/multistep.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.types import Observation, TimeStep, restart, select_step, termination, transition, truncation
+
+
+def _full_mask(n: int) -> jax.Array:
+    return jnp.ones((n,), jnp.float32)
+
+
+class PhysicsState(NamedTuple):
+    key: jax.Array
+    physics: jax.Array  # flat fp32 physics vector
+    step_count: jax.Array
+
+
+class _ClassicEnv(Environment):
+    """Shared plumbing: PhysicsState, Observation assembly, truncation handling."""
+
+    _obs_dim: int
+    _num_actions: int
+    _max_steps: int
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((self._obs_dim,), jnp.float32),
+            action_mask=spaces.Array((self._action_mask_dim(),), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def _action_mask_dim(self) -> int:
+        return self._num_actions
+
+    def _observe(self, state: PhysicsState) -> Observation:
+        return Observation(
+            agent_view=self._agent_view(state.physics),
+            action_mask=_full_mask(self._action_mask_dim()),
+            step_count=state.step_count,
+        )
+
+    def _agent_view(self, physics: jax.Array) -> jax.Array:
+        return physics
+
+    def reset(self, key: jax.Array) -> Tuple[PhysicsState, TimeStep]:
+        key, sub = jax.random.split(key)
+        physics = self._init_physics(sub)
+        state = PhysicsState(key, physics, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(state))
+        # Keep reset/step TimeSteps pytree-identical (lax.while_loop carries them).
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: PhysicsState, action: jax.Array) -> Tuple[PhysicsState, TimeStep]:
+        physics, reward, terminated = self._dynamics(state.physics, action)
+        next_state = PhysicsState(state.key, physics, state.step_count + 1)
+        obs = self._observe(next_state)
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts
+
+    # Subclass API -----------------------------------------------------------
+    def _init_physics(self, key: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def _dynamics(self, physics: jax.Array, action: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Returns (next_physics, reward, terminated)."""
+        raise NotImplementedError
+
+
+class CartPole(_ClassicEnv):
+    """CartPole-v1: balance a pole on a cart; +1 per step, 500-step limit."""
+
+    _obs_dim = 4
+    _num_actions = 2
+
+    def __init__(self, max_steps: int = 500):
+        self._max_steps = int(max_steps)
+        self._gravity = 9.8
+        self._masscart = 1.0
+        self._masspole = 0.1
+        self._length = 0.5
+        self._force_mag = 10.0
+        self._tau = 0.02
+        self._theta_threshold = 12 * 2 * jnp.pi / 360
+        self._x_threshold = 2.4
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(2)
+
+    def _init_physics(self, key: jax.Array) -> jax.Array:
+        return jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+
+    def _dynamics(self, physics: jax.Array, action: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        x, x_dot, theta, theta_dot = physics
+        force = jnp.where(action == 1, self._force_mag, -self._force_mag)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        total_mass = self._masscart + self._masspole
+        polemass_length = self._masspole * self._length
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self._gravity * sintheta - costheta * temp) / (
+            self._length * (4.0 / 3.0 - self._masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self._tau * x_dot
+        x_dot = x_dot + self._tau * xacc
+        theta = theta + self._tau * theta_dot
+        theta_dot = theta_dot + self._tau * thetaacc
+        next_physics = jnp.stack([x, x_dot, theta, theta_dot])
+        terminated = jnp.logical_or(jnp.abs(x) > self._x_threshold, jnp.abs(theta) > self._theta_threshold)
+        return next_physics, jnp.ones((), jnp.float32), terminated
+
+
+class Pendulum(_ClassicEnv):
+    """Pendulum-v1: continuous torque control; 200-step episodes, no termination."""
+
+    _obs_dim = 3
+    _num_actions = 1
+
+    def __init__(self, max_steps: int = 200):
+        self._max_steps = int(max_steps)
+        self._max_speed = 8.0
+        self._max_torque = 2.0
+        self._dt = 0.05
+        self._g = 10.0
+        self._m = 1.0
+        self._l = 1.0
+
+    def action_space(self) -> spaces.Box:
+        return spaces.Box(low=-self._max_torque, high=self._max_torque, shape=(1,))
+
+    def _action_mask_dim(self) -> int:
+        return 1
+
+    def _init_physics(self, key: jax.Array) -> jax.Array:
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi)
+        thdot = jax.random.uniform(k2, (), minval=-1.0, maxval=1.0)
+        return jnp.stack([theta, thdot])
+
+    def _agent_view(self, physics: jax.Array) -> jax.Array:
+        theta, thdot = physics
+        return jnp.stack([jnp.cos(theta), jnp.sin(theta), thdot])
+
+    def _dynamics(self, physics: jax.Array, action: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        theta, thdot = physics
+        u = jnp.clip(jnp.reshape(action, ()), -self._max_torque, self._max_torque)
+        angle_norm = ((theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = angle_norm**2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (3 * self._g / (2 * self._l) * jnp.sin(theta) + 3.0 / (self._m * self._l**2) * u) * self._dt
+        newthdot = jnp.clip(newthdot, -self._max_speed, self._max_speed)
+        newtheta = theta + newthdot * self._dt
+        return jnp.stack([newtheta, newthdot]), -cost, jnp.zeros((), bool)
+
+
+class Acrobot(_ClassicEnv):
+    """Acrobot-v1: swing up a two-link pendulum; -1 per step until the goal."""
+
+    _obs_dim = 6
+    _num_actions = 3
+
+    def __init__(self, max_steps: int = 500):
+        self._max_steps = int(max_steps)
+        self._dt = 0.2
+        self._l1 = 1.0
+        self._m1 = 1.0
+        self._m2 = 1.0
+        self._lc1 = 0.5
+        self._lc2 = 0.5
+        self._i1 = 1.0
+        self._i2 = 1.0
+        self._g = 9.8
+        self._max_vel1 = 4 * jnp.pi
+        self._max_vel2 = 9 * jnp.pi
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(3)
+
+    def _init_physics(self, key: jax.Array) -> jax.Array:
+        return jax.random.uniform(key, (4,), minval=-0.1, maxval=0.1)
+
+    def _agent_view(self, physics: jax.Array) -> jax.Array:
+        t1, t2, d1, d2 = physics
+        return jnp.stack([jnp.cos(t1), jnp.sin(t1), jnp.cos(t2), jnp.sin(t2), d1, d2])
+
+    def _dsdt(self, s: jax.Array, torque: jax.Array) -> jax.Array:
+        t1, t2, d1, d2 = s
+        m1, m2, l1, lc1, lc2, i1, i2, g = (
+            self._m1, self._m2, self._l1, self._lc1, self._lc2, self._i1, self._i2, self._g,
+        )
+        d_1 = m1 * lc1**2 + m2 * (l1**2 + lc2**2 + 2 * l1 * lc2 * jnp.cos(t2)) + i1 + i2
+        d_2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(t2)) + i2
+        phi2 = m2 * lc2 * g * jnp.cos(t1 + t2 - jnp.pi / 2.0)
+        phi1 = (
+            -m2 * l1 * lc2 * d2**2 * jnp.sin(t2)
+            - 2 * m2 * l1 * lc2 * d2 * d1 * jnp.sin(t2)
+            + (m1 * lc1 + m2 * l1) * g * jnp.cos(t1 - jnp.pi / 2)
+            + phi2
+        )
+        ddtheta2 = (torque + d_2 / d_1 * phi1 - m2 * l1 * lc2 * d1**2 * jnp.sin(t2) - phi2) / (
+            m2 * lc2**2 + i2 - d_2**2 / d_1
+        )
+        ddtheta1 = -(d_2 * ddtheta2 + phi1) / d_1
+        return jnp.stack([d1, d2, ddtheta1, ddtheta2])
+
+    def _dynamics(self, physics: jax.Array, action: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        torque = jnp.asarray(action, jnp.float32) - 1.0
+        # RK4 over one control interval (matches the gym implementation).
+        s = physics
+        dt = self._dt
+        k1 = self._dsdt(s, torque)
+        k2 = self._dsdt(s + dt / 2 * k1, torque)
+        k3 = self._dsdt(s + dt / 2 * k2, torque)
+        k4 = self._dsdt(s + dt * k3, torque)
+        ns = s + dt / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
+        t1 = ((ns[0] + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        t2 = ((ns[1] + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        d1 = jnp.clip(ns[2], -self._max_vel1, self._max_vel1)
+        d2 = jnp.clip(ns[3], -self._max_vel2, self._max_vel2)
+        next_physics = jnp.stack([t1, t2, d1, d2])
+        terminated = -jnp.cos(t1) - jnp.cos(t2 + t1) > 1.0
+        reward = jnp.where(terminated, 0.0, -1.0)
+        return next_physics, reward, terminated
+
+
+class MountainCar(_ClassicEnv):
+    """MountainCar-v0 (discrete): -1 per step until reaching the flag."""
+
+    _obs_dim = 2
+    _num_actions = 3
+
+    def __init__(self, max_steps: int = 200):
+        self._max_steps = int(max_steps)
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(3)
+
+    def _init_physics(self, key: jax.Array) -> jax.Array:
+        pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)
+        return jnp.stack([pos, jnp.zeros(())])
+
+    def _dynamics(self, physics: jax.Array, action: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        pos, vel = physics
+        force = (jnp.asarray(action, jnp.float32) - 1.0) * 0.001
+        vel = jnp.clip(vel + force + jnp.cos(3 * pos) * (-0.0025), -0.07, 0.07)
+        pos = jnp.clip(pos + vel, -1.2, 0.6)
+        vel = jnp.where(jnp.logical_and(pos <= -1.2, vel < 0), 0.0, vel)
+        terminated = jnp.logical_and(pos >= 0.5, vel >= 0.0)
+        return jnp.stack([pos, vel]), jnp.full((), -1.0), terminated
+
+
+class MountainCarContinuous(_ClassicEnv):
+    """MountainCarContinuous-v0: continuous force, +100 at goal, action cost."""
+
+    _obs_dim = 2
+    _num_actions = 1
+
+    def __init__(self, max_steps: int = 999):
+        self._max_steps = int(max_steps)
+
+    def action_space(self) -> spaces.Box:
+        return spaces.Box(low=-1.0, high=1.0, shape=(1,))
+
+    def _action_mask_dim(self) -> int:
+        return 1
+
+    def _init_physics(self, key: jax.Array) -> jax.Array:
+        pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)
+        return jnp.stack([pos, jnp.zeros(())])
+
+    def _dynamics(self, physics: jax.Array, action: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        pos, vel = physics
+        force = jnp.clip(jnp.reshape(action, ()), -1.0, 1.0)
+        vel = jnp.clip(vel + force * 0.0015 + jnp.cos(3 * pos) * (-0.0025), -0.07, 0.07)
+        pos = jnp.clip(pos + vel, -1.2, 0.6)
+        vel = jnp.where(jnp.logical_and(pos <= -1.2, vel < 0), 0.0, vel)
+        terminated = jnp.logical_and(pos >= 0.45, vel >= 0.0)
+        reward = jnp.where(terminated, 100.0, 0.0) - 0.1 * force**2
+        return jnp.stack([pos, vel]), reward, terminated
+
+
+class CatchState(NamedTuple):
+    key: jax.Array
+    ball_xy: jax.Array  # [2] (row, col)
+    paddle_x: jax.Array  # []
+    step_count: jax.Array
+
+
+class Catch(Environment):
+    """bsuite Catch: a ball falls down a rows×columns board; move the paddle to
+    catch it (+1) or miss (-1). A minimal "pixel" env for the DQN family.
+    """
+
+    def __init__(self, rows: int = 10, columns: int = 5):
+        self._rows = int(rows)
+        self._columns = int(columns)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((self._rows, self._columns, 1), jnp.float32),
+            action_mask=spaces.Array((3,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(3)
+
+    def _observe(self, state: CatchState) -> Observation:
+        board = jnp.zeros((self._rows, self._columns), jnp.float32)
+        board = board.at[state.ball_xy[0], state.ball_xy[1]].set(1.0)
+        board = board.at[self._rows - 1, state.paddle_x].set(1.0)
+        return Observation(
+            agent_view=board[..., None],
+            action_mask=_full_mask(3),
+            step_count=state.step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[CatchState, TimeStep]:
+        key, sub = jax.random.split(key)
+        ball_col = jax.random.randint(sub, (), 0, self._columns)
+        state = CatchState(
+            key,
+            jnp.stack([jnp.zeros((), jnp.int32), ball_col]),
+            jnp.asarray(self._columns // 2, jnp.int32),
+            jnp.zeros((), jnp.int32),
+        )
+        return state, restart(self._observe(state))
+
+    def step(self, state: CatchState, action: jax.Array) -> Tuple[CatchState, TimeStep]:
+        dx = jnp.asarray(action, jnp.int32) - 1
+        paddle_x = jnp.clip(state.paddle_x + dx, 0, self._columns - 1)
+        ball_xy = state.ball_xy + jnp.asarray([1, 0], jnp.int32)
+        next_state = CatchState(state.key, ball_xy, paddle_x, state.step_count + 1)
+        obs = self._observe(next_state)
+        done = ball_xy[0] >= self._rows - 1
+        caught = paddle_x == ball_xy[1]
+        reward = jnp.where(done, jnp.where(caught, 1.0, -1.0), 0.0)
+        return next_state, select_step(done, termination(reward, obs), transition(reward, obs))

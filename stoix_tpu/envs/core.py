@@ -1,0 +1,94 @@
+"""Pure-functional Environment API.
+
+The contract (reference uses the external `stoa` package; see SURVEY.md §1 layer 7):
+
+    state, timestep = env.reset(key)
+    state, timestep = env.step(state, action)
+
+Both are pure functions of their inputs — safe to `jit`, `vmap`, `lax.scan`, and
+`shard_map`. `state` is an arbitrary pytree that the caller threads through; envs
+carry their own PRNG key inside `state` so stepping stays functional.
+
+Environments emit the canonical `Observation(agent_view, action_mask, step_count)`
+struct so every network/system can rely on one observation vocabulary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.types import TimeStep
+
+State = Any
+Action = Any
+
+
+class Environment:
+    """Base class for pure-JAX environments."""
+
+    def reset(self, key: jax.Array) -> Tuple[State, TimeStep]:
+        raise NotImplementedError
+
+    def step(self, state: State, action: Action) -> Tuple[State, TimeStep]:
+        raise NotImplementedError
+
+    def observation_space(self) -> Any:
+        """Pytree of spaces matching the observation pytree."""
+        raise NotImplementedError
+
+    def action_space(self) -> spaces.Space:
+        raise NotImplementedError
+
+    @property
+    def unwrapped(self) -> "Environment":
+        return self
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    # --- convenience -------------------------------------------------------
+    def observation_value(self) -> Any:
+        """A dummy observation for network initialisation."""
+        return spaces.tree_generate_value(self.observation_space())
+
+    def action_value(self) -> Any:
+        return spaces.tree_generate_value(self.action_space())
+
+    @property
+    def num_actions(self) -> int:
+        return spaces.num_actions(self.action_space())
+
+
+class Wrapper(Environment):
+    """Delegating base wrapper."""
+
+    def __init__(self, env: Environment):
+        self._env = env
+
+    def reset(self, key: jax.Array) -> Tuple[State, TimeStep]:
+        return self._env.reset(key)
+
+    def step(self, state: State, action: Action) -> Tuple[State, TimeStep]:
+        return self._env.step(state, action)
+
+    def observation_space(self) -> Any:
+        return self._env.observation_space()
+
+    def action_space(self) -> spaces.Space:
+        return self._env.action_space()
+
+    @property
+    def unwrapped(self) -> Environment:
+        return self._env.unwrapped
+
+    @property
+    def name(self) -> str:
+        return self._env.name
+
+    def __getattr__(self, item: str) -> Any:
+        # Fall through to the wrapped env for env-specific attributes.
+        return getattr(self._env, item)

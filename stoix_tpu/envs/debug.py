@@ -1,0 +1,117 @@
+"""Pure-JAX debug environments for smoke/correctness testing.
+
+Equivalents of the reference's `IdentityGame` / `SequenceGame`
+(reference stoix/utils/debug_env.py:25+, registered via make_env.py:296-304):
+fast, fully deterministic dynamics that a correct learner must solve quickly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.types import Observation, TimeStep, restart, select_step, termination, transition
+
+
+class IdentityState(NamedTuple):
+    key: jax.Array
+    target: jax.Array
+    step_count: jax.Array
+
+
+class IdentityGame(Environment):
+    """Observation is a one-hot target; reward 1 for matching it with the action.
+
+    Optimal return over an episode of length `episode_length` is exactly
+    `episode_length` — a learner failing to reach it has a plumbing bug.
+    """
+
+    def __init__(self, num_actions: int = 4, episode_length: int = 10):
+        self._num_actions = int(num_actions)
+        self._episode_length = int(episode_length)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((self._num_actions,), jnp.float32),
+            action_mask=spaces.Array((self._num_actions,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(self._num_actions)
+
+    def _obs(self, state: IdentityState) -> Observation:
+        return Observation(
+            agent_view=jax.nn.one_hot(state.target, self._num_actions),
+            action_mask=jnp.ones((self._num_actions,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[IdentityState, TimeStep]:
+        key, sub = jax.random.split(key)
+        target = jax.random.randint(sub, (), 0, self._num_actions)
+        state = IdentityState(key, target, jnp.zeros((), jnp.int32))
+        return state, restart(self._obs(state))
+
+    def step(self, state: IdentityState, action: jax.Array) -> Tuple[IdentityState, TimeStep]:
+        reward = jnp.asarray(action == state.target, jnp.float32)
+        key, sub = jax.random.split(state.key)
+        target = jax.random.randint(sub, (), 0, self._num_actions)
+        next_state = IdentityState(key, target, state.step_count + 1)
+        obs = self._obs(next_state)
+        done = next_state.step_count >= self._episode_length
+        return next_state, select_step(done, termination(reward, obs), transition(reward, obs))
+
+
+class SequenceState(NamedTuple):
+    key: jax.Array
+    cue: jax.Array
+    step_count: jax.Array
+
+
+class SequenceGame(Environment):
+    """Memory task: the cue is visible only at the first observation; the agent
+    earns reward 1 at the final step by repeating it. Requires recurrence for
+    `delay` > 0 — the oracle env for rec_* systems.
+    """
+
+    def __init__(self, num_actions: int = 4, delay: int = 4):
+        self._num_actions = int(num_actions)
+        self._delay = int(delay)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((self._num_actions,), jnp.float32),
+            action_mask=spaces.Array((self._num_actions,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(self._num_actions)
+
+    def _obs(self, state: SequenceState) -> Observation:
+        visible = state.step_count == 0
+        view = jnp.where(visible, jax.nn.one_hot(state.cue, self._num_actions), jnp.zeros((self._num_actions,)))
+        return Observation(
+            agent_view=view.astype(jnp.float32),
+            action_mask=jnp.ones((self._num_actions,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[SequenceState, TimeStep]:
+        key, sub = jax.random.split(key)
+        cue = jax.random.randint(sub, (), 0, self._num_actions)
+        state = SequenceState(key, cue, jnp.zeros((), jnp.int32))
+        return state, restart(self._obs(state))
+
+    def step(self, state: SequenceState, action: jax.Array) -> Tuple[SequenceState, TimeStep]:
+        next_count = state.step_count + 1
+        at_end = next_count >= self._delay + 1
+        reward = jnp.asarray(jnp.logical_and(at_end, action == state.cue), jnp.float32)
+        next_state = SequenceState(state.key, state.cue, next_count)
+        obs = self._obs(next_state)
+        return next_state, select_step(at_end, termination(reward, obs), transition(reward, obs))

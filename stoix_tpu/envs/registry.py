@@ -1,0 +1,76 @@
+"""Environment factory — the `make_env.py` equivalent.
+
+The reference dispatches over 14 external suites (reference
+stoix/utils/make_env.py:420-433 `ENV_MAKERS`); this registry dispatches over the
+first-party suites plus optional external ones when present, and applies the
+canonical wrapper stack (reference make_env.py:29-61).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from stoix_tpu.envs import classic, debug
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.wrappers import apply_core_wrappers
+
+# scenario name -> constructor(**env_kwargs)
+ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
+    "CartPole-v1": classic.CartPole,
+    "Pendulum-v1": classic.Pendulum,
+    "Acrobot-v1": classic.Acrobot,
+    "MountainCar-v0": classic.MountainCar,
+    "MountainCarContinuous-v0": classic.MountainCarContinuous,
+    "Catch-bsuite": classic.Catch,
+    "IdentityGame": debug.IdentityGame,
+    "SequenceGame": debug.SequenceGame,
+}
+
+
+def register(name: str, ctor: Callable[..., Environment]) -> None:
+    ENV_REGISTRY[name] = ctor
+
+
+def make_single(scenario: str, **env_kwargs: Any) -> Environment:
+    """Construct a raw (unwrapped, unbatched) environment."""
+    if scenario not in ENV_REGISTRY:
+        raise ValueError(f"Unknown environment '{scenario}'. Known: {sorted(ENV_REGISTRY)}")
+    return ENV_REGISTRY[scenario](**env_kwargs)
+
+
+def make(config: Any) -> Tuple[Environment, Environment]:
+    """Build (train_env, eval_env) from a config with an `env` section.
+
+    Expected config fields (mirrors reference configs/env/**):
+        env.scenario.name        — registry key
+        env.kwargs               — ctor kwargs (optional)
+        env.wrapper              — dict(max_episode_steps, use_optimistic_reset,
+                                   reset_ratio, use_cached_auto_reset) (optional)
+        arch.total_num_envs      — global env count (split across data shards upstream)
+    """
+    env_cfg = config.env
+    kwargs = dict(getattr(env_cfg, "kwargs", {}) or {})
+    scenario = env_cfg.scenario.name if hasattr(env_cfg.scenario, "name") else env_cfg.scenario
+    wrapper_cfg = dict(getattr(env_cfg, "wrapper", {}) or {})
+
+    train_env = make_single(scenario, **kwargs)
+    eval_env = make_single(scenario, **kwargs)
+
+    num_envs = int(config.arch.total_num_envs)
+    train_env = apply_core_wrappers(
+        train_env,
+        num_envs=num_envs,
+        max_episode_steps=wrapper_cfg.get("max_episode_steps"),
+        use_optimistic_reset=bool(wrapper_cfg.get("use_optimistic_reset", False)),
+        reset_ratio=int(wrapper_cfg.get("reset_ratio", 16)),
+        use_cached_auto_reset=bool(wrapper_cfg.get("use_cached_auto_reset", False)),
+    )
+    # Eval env: metrics + step limit only; episodes must genuinely end (no
+    # auto-reset) because the evaluator's while_loop keys off timestep.last()
+    # (reference stoix/evaluator.py:152).
+    from stoix_tpu.envs.wrappers import EpisodeStepLimit, RecordEpisodeMetrics
+
+    if wrapper_cfg.get("max_episode_steps"):
+        eval_env = EpisodeStepLimit(eval_env, wrapper_cfg["max_episode_steps"])
+    eval_env = RecordEpisodeMetrics(eval_env)
+    return train_env, eval_env

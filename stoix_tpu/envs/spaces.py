@@ -1,0 +1,172 @@
+"""Space definitions for environment observation/action specs.
+
+TPU-native design notes: spaces are *static* Python objects (never traced); they
+exist so that networks can be initialised from spec-generated dummy values and so
+that wrappers/systems can interrogate shapes without running the env. Mirrors the
+role of the `stoa` spaces used by the reference (see reference
+stoix/utils/make_env.py and stoix/base_types.py) without depending on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Space:
+    """Base class for all spaces."""
+
+    def generate_value(self) -> Any:
+        """Generate a zero-like value conforming to this space (for network init)."""
+        raise NotImplementedError
+
+    def sample(self, key: jax.Array) -> Any:
+        """Sample a random value from the space."""
+        raise NotImplementedError
+
+    def contains(self, value: Any) -> bool:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Array(Space):
+    """An unbounded array space with fixed shape and dtype."""
+
+    shape: Tuple[int, ...]
+    dtype: Any = jnp.float32
+    name: str = "array"
+
+    def generate_value(self) -> jax.Array:
+        return jnp.zeros(self.shape, dtype=self.dtype)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        if jnp.issubdtype(self.dtype, jnp.integer):
+            return jnp.zeros(self.shape, dtype=self.dtype)
+        return jax.random.normal(key, self.shape, dtype=self.dtype)
+
+    def contains(self, value: Any) -> bool:
+        return tuple(np.shape(value)) == tuple(self.shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class Box(Space):
+    """A bounded continuous space. `low`/`high` may be scalars or arrays."""
+
+    low: Any
+    high: Any
+    shape: Tuple[int, ...] = ()
+    dtype: Any = jnp.float32
+    name: str = "box"
+
+    def __post_init__(self) -> None:
+        if not self.shape:
+            inferred = np.broadcast(np.asarray(self.low), np.asarray(self.high)).shape
+            object.__setattr__(self, "shape", tuple(inferred))
+
+    def generate_value(self) -> jax.Array:
+        mid = (np.asarray(self.low, dtype=np.float64) + np.asarray(self.high, dtype=np.float64)) / 2.0
+        mid = np.where(np.isfinite(mid), mid, 0.0)
+        return jnp.broadcast_to(jnp.asarray(mid, dtype=self.dtype), self.shape)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        low = jnp.broadcast_to(jnp.asarray(self.low, self.dtype), self.shape)
+        high = jnp.broadcast_to(jnp.asarray(self.high, self.dtype), self.shape)
+        u = jax.random.uniform(key, self.shape, dtype=self.dtype)
+        return low + u * (high - low)
+
+    def contains(self, value: Any) -> bool:
+        v = np.asarray(value)
+        return bool(np.all(v >= self.low) and np.all(v <= self.high))
+
+
+@dataclasses.dataclass(frozen=True)
+class Discrete(Space):
+    """A discrete space {0, ..., num_values - 1}."""
+
+    num_values: int
+    dtype: Any = jnp.int32
+    name: str = "discrete"
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return ()
+
+    def generate_value(self) -> jax.Array:
+        return jnp.zeros((), dtype=self.dtype)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jax.random.randint(key, (), 0, self.num_values, dtype=self.dtype)
+
+    def contains(self, value: Any) -> bool:
+        v = int(np.asarray(value))
+        return 0 <= v < self.num_values
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiDiscrete(Space):
+    """A vector of discrete sub-spaces with per-dimension cardinalities."""
+
+    num_values: Tuple[int, ...]
+    dtype: Any = jnp.int32
+    name: str = "multi_discrete"
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "num_values", tuple(int(n) for n in self.num_values))
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (len(self.num_values),)
+
+    def generate_value(self) -> jax.Array:
+        return jnp.zeros(self.shape, dtype=self.dtype)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        maxes = jnp.asarray(self.num_values)
+        u = jax.random.uniform(key, self.shape)
+        return jnp.asarray(jnp.floor(u * maxes), dtype=self.dtype)
+
+    def contains(self, value: Any) -> bool:
+        v = np.asarray(value)
+        return bool(np.all(v >= 0) and np.all(v < np.asarray(self.num_values)))
+
+
+class DictSpace(Space, dict):
+    """A dict of named sub-spaces (pytree-structured observations)."""
+
+    def generate_value(self) -> Any:
+        return {k: v.generate_value() for k, v in self.items()}
+
+    def sample(self, key: jax.Array) -> Any:
+        keys = jax.random.split(key, max(len(self), 1))
+        return {k: v.sample(keys[i]) for i, (k, v) in enumerate(self.items())}
+
+    def contains(self, value: Any) -> bool:
+        return all(k in value and s.contains(value[k]) for k, s in self.items())
+
+
+def tree_generate_value(spec: Any) -> Any:
+    """Generate dummy values for an arbitrary pytree of spaces / typed structs."""
+    if isinstance(spec, Space):
+        return spec.generate_value()
+    if hasattr(spec, "_fields"):  # NamedTuple of spaces
+        return type(spec)(*(tree_generate_value(s) for s in spec))
+    if isinstance(spec, dict):
+        return {k: tree_generate_value(v) for k, v in spec.items()}
+    if isinstance(spec, (list, tuple)):
+        return type(spec)(tree_generate_value(v) for v in spec)
+    raise TypeError(f"Cannot generate value for spec of type {type(spec)}")
+
+
+def num_actions(action_space: Space) -> int:
+    """Flat action dimensionality used for network head sizing."""
+    if isinstance(action_space, Discrete):
+        return int(action_space.num_values)
+    if isinstance(action_space, MultiDiscrete):
+        return int(sum(action_space.num_values))
+    if isinstance(action_space, (Box, Array)):
+        return int(np.prod(action_space.shape)) if action_space.shape else 1
+    raise TypeError(f"Unsupported action space {type(action_space)}")

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax import
+so every test can exercise real multi-device sharding (mesh axes, shard_map,
+collectives) without TPU hardware. This is the fake-device harness the reference
+lacks (SURVEY.md §4 'Multi-node/multi-device without a cluster: not tested')."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,223 @@
+"""Environment core tests: API purity, auto-reset/next_obs semantics,
+truncation discounts, episode metrics, vmap/optimistic-reset batching."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.envs import (
+    AutoResetWrapper,
+    CachedAutoResetWrapper,
+    EpisodeStepLimit,
+    OptimisticResetVmapWrapper,
+    RecordEpisodeMetrics,
+    VmapWrapper,
+    make_single,
+)
+from stoix_tpu.envs.types import StepType
+
+ALL_ENVS = [
+    "CartPole-v1",
+    "Pendulum-v1",
+    "Acrobot-v1",
+    "MountainCar-v0",
+    "MountainCarContinuous-v0",
+    "Catch-bsuite",
+    "IdentityGame",
+    "SequenceGame",
+]
+
+
+@pytest.mark.parametrize("name", ALL_ENVS)
+def test_reset_step_jit_and_shapes(name):
+    env = make_single(name)
+    key = jax.random.PRNGKey(0)
+    state, ts = jax.jit(env.reset)(key)
+    assert ts.step_type.dtype == jnp.int8
+    assert bool(ts.first())
+    obs_spec = env.observation_space()
+    assert ts.observation.agent_view.shape == obs_spec.agent_view.shape
+    action = env.action_space().sample(jax.random.PRNGKey(1))
+    step = jax.jit(env.step)
+    for _ in range(3):
+        state, ts = step(state, action)
+    assert ts.reward.shape == ()
+    assert ts.discount.shape == ()
+
+
+@pytest.mark.parametrize("name", ALL_ENVS)
+def test_determinism(name):
+    env = make_single(name)
+    key = jax.random.PRNGKey(42)
+    s1, t1 = env.reset(key)
+    s2, t2 = env.reset(key)
+    np.testing.assert_allclose(
+        np.asarray(t1.observation.agent_view), np.asarray(t2.observation.agent_view)
+    )
+
+
+def test_cartpole_terminates_and_truncation_discount():
+    env = make_single("CartPole-v1", max_steps=20)
+    state, ts = env.reset(jax.random.PRNGKey(0))
+    step = jax.jit(env.step)
+    # Drive it to the left until termination or truncation.
+    for i in range(600):
+        state, ts = step(state, jnp.asarray(0))
+        if bool(ts.last()):
+            break
+    assert bool(ts.last())
+    if bool(ts.extras["truncation"]):
+        assert float(ts.discount) == 1.0
+    else:
+        assert float(ts.discount) == 0.0
+
+
+def test_pendulum_truncates_with_discount_one():
+    env = make_single("Pendulum-v1", max_steps=5)
+    state, ts = env.reset(jax.random.PRNGKey(0))
+    for _ in range(5):
+        state, ts = env.step(state, jnp.zeros((1,)))
+    assert bool(ts.last())
+    assert float(ts.discount) == 1.0  # truncation must keep bootstrapping
+    assert bool(ts.extras["truncation"])
+
+
+def test_autoreset_next_obs_semantics():
+    env = AutoResetWrapper(make_single("IdentityGame", episode_length=3))
+    state, ts = env.reset(jax.random.PRNGKey(0))
+    step = jax.jit(env.step)
+    for i in range(3):
+        prev_obs = ts.observation
+        state, ts = step(state, jnp.asarray(0))
+    # After 3 steps the episode ended; observation must be a fresh reset obs,
+    # next_obs the true terminal obs (step_count == episode end).
+    assert bool(ts.last())
+    assert int(ts.observation.step_count) == 0  # reset obs
+    assert int(ts.extras["next_obs"].step_count) == 3  # true terminal obs
+
+
+def test_cached_autoreset_restores_initial_state():
+    env = CachedAutoResetWrapper(make_single("IdentityGame", episode_length=2))
+    state, ts0 = env.reset(jax.random.PRNGKey(0))
+    initial_view = np.asarray(ts0.observation.agent_view)
+    for _ in range(2):
+        state, ts = env.step(state, jnp.asarray(1))
+    assert bool(ts.last())
+    np.testing.assert_allclose(np.asarray(ts.observation.agent_view), initial_view)
+
+
+def test_record_episode_metrics():
+    env = RecordEpisodeMetrics(AutoResetWrapperless := make_single("IdentityGame", episode_length=4))
+    state, ts = env.reset(jax.random.PRNGKey(0))
+    total = 0.0
+    for i in range(4):
+        # Always play the displayed target -> reward 1 each step.
+        action = jnp.argmax(ts.observation.agent_view)
+        state, ts = env.step(state, action)
+        total += float(ts.reward)
+    m = ts.extras["episode_metrics"]
+    assert bool(m["is_terminal_step"])
+    assert float(m["episode_return"]) == pytest.approx(total)
+    assert int(m["episode_length"]) == 4
+    assert total == pytest.approx(4.0)
+
+
+def test_vmap_wrapper_batches():
+    env = VmapWrapper(AutoResetWrapper(RecordEpisodeMetrics(make_single("CartPole-v1"))))
+    keys = jax.random.split(jax.random.PRNGKey(0), 6)
+    state, ts = jax.jit(env.reset)(keys)
+    assert ts.reward.shape == (6,)
+    actions = jnp.zeros((6,), jnp.int32)
+    state, ts = jax.jit(env.step)(state, actions)
+    assert ts.observation.agent_view.shape == (6, 4)
+    assert ts.extras["next_obs"].agent_view.shape == (6, 4)
+
+
+def test_optimistic_reset_vmap():
+    env = OptimisticResetVmapWrapper(
+        RecordEpisodeMetrics(make_single("IdentityGame", episode_length=2)), num_envs=8, reset_ratio=4
+    )
+    keys = jax.random.split(jax.random.PRNGKey(0), 8)
+    state, ts = jax.jit(env.reset)(keys)
+    step = jax.jit(env.step)
+    actions = jnp.zeros((8,), jnp.int32)
+    for _ in range(2):
+        state, ts = step(state, actions)
+    assert bool(jnp.all(ts.last()))
+    # All envs restarted: observation step_count back to 0, next_obs at 2.
+    assert bool(jnp.all(ts.observation.step_count == 0))
+    assert bool(jnp.all(ts.extras["next_obs"].step_count == 2))
+
+
+def test_scan_rollout_compiles_once():
+    env = VmapWrapper(AutoResetWrapper(RecordEpisodeMetrics(make_single("CartPole-v1"))))
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    state, ts = env.reset(keys)
+
+    def env_step(carry, _):
+        state, key = carry
+        key, sub = jax.random.split(key)
+        actions = jax.random.randint(sub, (4,), 0, 2)
+        state, ts = env.step(state, actions)
+        return (state, key), ts.reward
+
+    (_, _), rewards = jax.jit(
+        lambda c: jax.lax.scan(env_step, c, None, length=32)
+    )((state, jax.random.PRNGKey(1)))
+    assert rewards.shape == (32, 4)
+    assert float(rewards.sum()) == pytest.approx(32 * 4)  # CartPole: +1 per step
+
+
+def test_eval_env_while_loop_pytree_consistency():
+    # The evaluator carries the TimeStep through lax.while_loop; reset and step
+    # must therefore produce pytree-identical TimeSteps.
+    env = RecordEpisodeMetrics(make_single("CartPole-v1"))
+    key = jax.random.PRNGKey(0)
+
+    def run_episode(key):
+        state, ts = env.reset(key)
+
+        def cond(carry):
+            _, ts = carry
+            return ~ts.last()
+
+        def body(carry):
+            state, ts = carry
+            return env.step(state, jnp.asarray(0))
+
+        _, final_ts = jax.lax.while_loop(cond, body, (state, ts))
+        return final_ts.extras["episode_metrics"]["episode_return"]
+
+    ret = jax.jit(run_episode)(key)
+    assert float(ret) > 0
+
+
+def test_cached_autoreset_reseeds_randomness():
+    # Replayed episodes share the initial state but must NOT replay the same
+    # random target sequence (IdentityGame.step consumes state.key).
+    env = CachedAutoResetWrapper(make_single("IdentityGame", episode_length=6))
+    state, ts = env.reset(jax.random.PRNGKey(0))
+    episodes = []
+    for _ in range(3):
+        seq = []
+        for _ in range(6):
+            state, ts = env.step(state, jnp.asarray(0))
+            seq.append(int(jnp.argmax(ts.extras["next_obs"].agent_view)))
+        episodes.append(tuple(seq))
+    assert len(set(episodes)) > 1, "cached auto-reset must not replay identical episodes"
+
+
+def test_optimistic_reset_rejects_bad_ratio():
+    with pytest.raises(ValueError):
+        OptimisticResetVmapWrapper(make_single("IdentityGame"), num_envs=6, reset_ratio=4)
+
+
+def test_step_limit_wrapper():
+    env = EpisodeStepLimit(make_single("IdentityGame", episode_length=100), max_steps=5)
+    state, ts = env.reset(jax.random.PRNGKey(0))
+    for _ in range(5):
+        state, ts = env.step(state, jnp.asarray(0))
+    assert bool(ts.last())
+    assert float(ts.discount) == 1.0
+    assert bool(ts.extras["truncation"])
