@@ -90,5 +90,9 @@ class Wrapper(Environment):
         return self._env.name
 
     def __getattr__(self, item: str) -> Any:
-        # Fall through to the wrapped env for env-specific attributes.
+        # Fall through to the wrapped env for env-specific attributes. Guard
+        # private names so object reconstruction (deepcopy/pickle) that probes
+        # attributes before __init__ runs cannot recurse on `_env` itself.
+        if item.startswith("_"):
+            raise AttributeError(item)
         return getattr(self._env, item)

@@ -12,7 +12,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from stoix_tpu.envs import classic, debug
 from stoix_tpu.envs.core import Environment
-from stoix_tpu.envs.wrappers import apply_core_wrappers
+from stoix_tpu.envs.wrappers import EpisodeStepLimit, RecordEpisodeMetrics, apply_core_wrappers
 
 # scenario name -> constructor(**env_kwargs)
 ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
@@ -68,8 +68,6 @@ def make(config: Any) -> Tuple[Environment, Environment]:
     # Eval env: metrics + step limit only; episodes must genuinely end (no
     # auto-reset) because the evaluator's while_loop keys off timestep.last()
     # (reference stoix/evaluator.py:152).
-    from stoix_tpu.envs.wrappers import EpisodeStepLimit, RecordEpisodeMetrics
-
     if wrapper_cfg.get("max_episode_steps"):
         eval_env = EpisodeStepLimit(eval_env, wrapper_cfg["max_episode_steps"])
     eval_env = RecordEpisodeMetrics(eval_env)

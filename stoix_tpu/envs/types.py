@@ -19,11 +19,15 @@ import jax.numpy as jnp
 
 
 class StepType:
-    """Integer step-type codes, stored as int8 arrays inside TimeStep."""
+    """Integer step-type codes, stored as int8 arrays inside TimeStep.
 
-    FIRST = jnp.asarray(0, dtype=jnp.int8)
-    MID = jnp.asarray(1, dtype=jnp.int8)
-    LAST = jnp.asarray(2, dtype=jnp.int8)
+    Plain Python ints (not jnp arrays) so importing this module does no
+    device work; comparisons and jnp.where treat them identically.
+    """
+
+    FIRST = 0
+    MID = 1
+    LAST = 2
 
 
 class TimeStep(NamedTuple):

@@ -74,6 +74,9 @@ class RecordEpisodeMetrics(Wrapper):
             "episode_length": jnp.zeros((), jnp.int32),
             "is_terminal_step": jnp.zeros((), bool),
         }
+        # Guarantee the well-known "truncation" key on every wrapped stack so
+        # the extras pytree contract is env-independent.
+        ts.extras["truncation"] = ts.extras.get("truncation", jnp.zeros((), bool))
         return EpisodeMetricsState(state, zero, jnp.zeros((), jnp.int32)), ts
 
     def step(self, state: EpisodeMetricsState, action: Action) -> Tuple[State, TimeStep]:
@@ -86,6 +89,7 @@ class RecordEpisodeMetrics(Wrapper):
             "episode_length": ep_length,
             "is_terminal_step": done,
         }
+        ts.extras["truncation"] = ts.extras.get("truncation", jnp.zeros((), bool))
         # Reset accumulators after a terminal step (auto-reset follows above us).
         next_state = EpisodeMetricsState(
             inner,

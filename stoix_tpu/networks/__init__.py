@@ -1,0 +1,25 @@
+from stoix_tpu.networks import (
+    base,
+    dueling,
+    heads,
+    inputs,
+    layers,
+    model_based,
+    postprocessors,
+    resnet,
+    torso,
+    utils,
+)
+
+__all__ = [
+    "base",
+    "dueling",
+    "heads",
+    "inputs",
+    "layers",
+    "model_based",
+    "postprocessors",
+    "resnet",
+    "torso",
+    "utils",
+]

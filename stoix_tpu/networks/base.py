@@ -1,0 +1,167 @@
+"""Actor/critic shells and recurrent network plumbing
+(reference stoix/networks/base.py:18-252).
+
+A network = input_layer -> torso -> head. Systems instantiate these from config
+(see stoix_tpu.utils.config.instantiate) and use `.init` / `.apply` as usual.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs.types import Observation
+from stoix_tpu.networks.utils import parse_rnn_cell
+
+
+class FeedForwardActor(nn.Module):
+    """input -> torso -> action head, returning a distribution."""
+
+    action_head: nn.Module
+    torso: nn.Module
+    input_layer: nn.Module
+
+    @nn.compact
+    def __call__(self, observation: Any, *head_args: Any, **head_kwargs: Any):
+        embedding = self.torso(self.input_layer(observation))
+        if isinstance(observation, Observation) and _head_takes_mask(self.action_head):
+            head_kwargs.setdefault("action_mask", observation.action_mask)
+        return self.action_head(embedding, *head_args, **head_kwargs)
+
+
+def _head_takes_mask(head: nn.Module) -> bool:
+    import inspect
+
+    try:
+        return "action_mask" in inspect.signature(type(head).__call__).parameters
+    except (ValueError, TypeError):
+        return False
+
+
+class FeedForwardCritic(nn.Module):
+    """input -> torso -> critic head, returning values (or value dists)."""
+
+    critic_head: nn.Module
+    torso: nn.Module
+    input_layer: nn.Module
+
+    @nn.compact
+    def __call__(self, observation: Any, *inputs: Any):
+        embedding = self.torso(self.input_layer(observation, *inputs))
+        return self.critic_head(embedding)
+
+
+class FeedForwardActorCritic(nn.Module):
+    """Shared torso producing (policy distribution, value)."""
+
+    shared_head: nn.Module  # a PolicyValueHead
+    torso: nn.Module
+    input_layer: nn.Module
+
+    @nn.compact
+    def __call__(self, observation: Any):
+        embedding = self.torso(self.input_layer(observation))
+        return self.shared_head(embedding)
+
+
+class CompositeNetwork(nn.Module):
+    """Sequential composition of arbitrary modules (reference base.py:62-84)."""
+
+    layers: Sequence[nn.Module]
+
+    @nn.compact
+    def __call__(self, *args: Any):
+        out = self.layers[0](*args)
+        for layer in self.layers[1:]:
+            out = layer(out)
+        return out
+
+
+class MultiNetwork(nn.Module):
+    """Parallel heads over the same inputs, stacked on a new leading output axis
+    — used for twin-Q critics (reference base.py:87-121)."""
+
+    networks: Sequence[nn.Module]
+
+    @nn.compact
+    def __call__(self, *args: Any) -> jax.Array:
+        outs = [jnp.expand_dims(net(*args), axis=-1) for net in self.networks]
+        return jnp.concatenate(outs, axis=-1)
+
+
+class ScannedRNN(nn.Module):
+    """Time-major RNN unroll via nn.scan with per-step hidden-state reset where
+    `done` is set (reference base.py:124-159). Input: (hstate, (xs, dones))
+    with xs [T, B, F], dones [T, B]. Returns (final_hstate, outputs [T, B, H]).
+    """
+
+    hidden_size: int
+    cell_type: str = "gru"
+
+    @nn.compact
+    def __call__(self, hstate: Any, inputs: Tuple[jax.Array, jax.Array]):
+        cell_cls = parse_rnn_cell(self.cell_type)
+
+        def step(cell: nn.Module, carry: Any, inp: Tuple[jax.Array, jax.Array]):
+            x, done = inp
+            fresh = cell.initialize_carry(jax.random.PRNGKey(0), x.shape)
+            carry = jax.tree.map(
+                lambda f, c: jnp.where(done[..., None], f, c), fresh, carry
+            )
+            carry, out = cell(carry, x)
+            return carry, out
+
+        scan = nn.scan(
+            step,
+            variable_broadcast="params",
+            in_axes=0,
+            out_axes=0,
+            split_rngs={"params": False},
+        )
+        return scan(cell_cls(features=self.hidden_size), hstate, inputs)
+
+    @staticmethod
+    def initialize_carry(cell_type: str, hidden_size: int, batch_shape: Tuple[int, ...]) -> Any:
+        cell = parse_rnn_cell(cell_type)(features=hidden_size)
+        return cell.initialize_carry(jax.random.PRNGKey(0), batch_shape + (hidden_size,))
+
+
+class RecurrentActor(nn.Module):
+    """pre_torso -> RNN -> post_torso -> action head over a time-major sequence
+    (reference base.py:162-192)."""
+
+    action_head: nn.Module
+    rnn: ScannedRNN
+    pre_torso: nn.Module
+    post_torso: nn.Module
+    input_layer: nn.Module
+
+    @nn.compact
+    def __call__(self, hstate: Any, observation_done: Tuple[Any, jax.Array]):
+        observation, done = observation_done
+        x = self.pre_torso(self.input_layer(observation))
+        hstate, x = self.rnn(hstate, (x, done))
+        x = self.post_torso(x)
+        kwargs = {}
+        if isinstance(observation, Observation) and _head_takes_mask(self.action_head):
+            kwargs["action_mask"] = observation.action_mask
+        return hstate, self.action_head(x, **kwargs)
+
+
+class RecurrentCritic(nn.Module):
+    critic_head: nn.Module
+    rnn: ScannedRNN
+    pre_torso: nn.Module
+    post_torso: nn.Module
+    input_layer: nn.Module
+
+    @nn.compact
+    def __call__(self, hstate: Any, observation_done: Tuple[Any, jax.Array]):
+        observation, done = observation_done
+        x = self.pre_torso(self.input_layer(observation))
+        hstate, x = self.rnn(hstate, (x, done))
+        x = self.post_torso(x)
+        return hstate, self.critic_head(x)

@@ -1,0 +1,104 @@
+"""Dueling Q-network heads (reference stoix/networks/dueling.py:15-124)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.networks.torso import MLPTorso, NoisyMLPTorso
+from stoix_tpu.ops import distributions as dists
+
+
+class DuelingQNetwork(nn.Module):
+    """Q(s,a) = V(s) + A(s,a) - mean_a A(s,a)."""
+
+    action_dim: int
+    epsilon: float = 0.1
+    layer_sizes: Sequence[int] = (128,)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(
+        self,
+        embedding: jax.Array,
+        epsilon: Optional[jax.Array] = None,
+        action_mask: Optional[jax.Array] = None,
+    ) -> dists.EpsilonGreedy:
+        value = MLPTorso((*self.layer_sizes, 1), self.activation, activate_final=False)(embedding)
+        adv = MLPTorso((*self.layer_sizes, self.action_dim), self.activation, activate_final=False)(
+            embedding
+        )
+        q_values = value + adv - jnp.mean(adv, axis=-1, keepdims=True)
+        eps = self.epsilon if epsilon is None else epsilon
+        return dists.EpsilonGreedy(q_values, eps, mask=action_mask)
+
+
+class DistributionalDuelingQNetwork(nn.Module):
+    """Dueling C51: atoms for value and advantage combined then softmaxed."""
+
+    action_dim: int
+    num_atoms: int = 51
+    vmin: float = -10.0
+    vmax: float = 10.0
+    epsilon: float = 0.1
+    layer_sizes: Sequence[int] = (128,)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(
+        self,
+        embedding: jax.Array,
+        epsilon: Optional[jax.Array] = None,
+        action_mask: Optional[jax.Array] = None,
+    ) -> Tuple[dists.EpsilonGreedy, jax.Array, jax.Array]:
+        atoms = jnp.linspace(self.vmin, self.vmax, self.num_atoms)
+        value = MLPTorso((*self.layer_sizes, self.num_atoms), self.activation, activate_final=False)(
+            embedding
+        )
+        adv = MLPTorso(
+            (*self.layer_sizes, self.action_dim * self.num_atoms), self.activation, activate_final=False
+        )(embedding)
+        adv = adv.reshape(embedding.shape[:-1] + (self.action_dim, self.num_atoms))
+        logits = value[..., None, :] + adv - jnp.mean(adv, axis=-2, keepdims=True)
+        q_values = jnp.sum(jax.nn.softmax(logits, axis=-1) * atoms, axis=-1)
+        eps = self.epsilon if epsilon is None else epsilon
+        return dists.EpsilonGreedy(q_values, eps, mask=action_mask), logits, atoms
+
+
+class NoisyDistributionalDuelingQNetwork(nn.Module):
+    """Rainbow head: noisy layers + dueling + C51 (reference dueling.py:90-124).
+    Requires the "noise" rng stream during training."""
+
+    action_dim: int
+    num_atoms: int = 51
+    vmin: float = -10.0
+    vmax: float = 10.0
+    epsilon: float = 0.0
+    layer_sizes: Sequence[int] = (128,)
+    activation: str = "relu"
+    sigma_zero: float = 0.5
+
+    @nn.compact
+    def __call__(
+        self,
+        embedding: jax.Array,
+        epsilon: Optional[jax.Array] = None,
+        action_mask: Optional[jax.Array] = None,
+    ) -> Tuple[dists.EpsilonGreedy, jax.Array, jax.Array]:
+        atoms = jnp.linspace(self.vmin, self.vmax, self.num_atoms)
+        value = NoisyMLPTorso(
+            (*self.layer_sizes, self.num_atoms), self.activation, activate_final=False,
+            sigma_zero=self.sigma_zero,
+        )(embedding)
+        adv = NoisyMLPTorso(
+            (*self.layer_sizes, self.action_dim * self.num_atoms), self.activation,
+            activate_final=False, sigma_zero=self.sigma_zero,
+        )(embedding)
+        adv = adv.reshape(embedding.shape[:-1] + (self.action_dim, self.num_atoms))
+        logits = value[..., None, :] + adv - jnp.mean(adv, axis=-2, keepdims=True)
+        q_values = jnp.sum(jax.nn.softmax(logits, axis=-1) * atoms, axis=-1)
+        eps = self.epsilon if epsilon is None else epsilon
+        return dists.EpsilonGreedy(q_values, eps, mask=action_mask), logits, atoms

@@ -1,0 +1,234 @@
+"""Network heads: map torso embeddings to action distributions / value outputs
+(reference stoix/networks/heads.py:30-339). Heads return first-party
+distributions from stoix_tpu.ops.distributions so acting code is uniform.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.ops import distributions as dists
+
+_ORTHO_SMALL = nn.initializers.orthogonal(0.01)
+_ORTHO_ONE = nn.initializers.orthogonal(1.0)
+
+
+class CategoricalHead(nn.Module):
+    """Discrete policy head; applies the observation's action mask if given."""
+
+    num_actions: int
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array, action_mask: Optional[jax.Array] = None) -> dists.Categorical:
+        logits = nn.Dense(self.num_actions, kernel_init=_ORTHO_SMALL)(embedding)
+        return dists.Categorical(logits, mask=action_mask)
+
+
+class NormalAffineTanhDistributionHead(nn.Module):
+    """Squashed-Gaussian policy on [minimum, maximum] (SAC-style)."""
+
+    action_dim: int
+    minimum: float = -1.0
+    maximum: float = 1.0
+    min_scale: float = 1e-3
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> dists.Independent:
+        loc = nn.Dense(self.action_dim, kernel_init=_ORTHO_SMALL)(embedding)
+        scale = (
+            jax.nn.softplus(nn.Dense(self.action_dim, kernel_init=_ORTHO_SMALL)(embedding))
+            + self.min_scale
+        )
+        return dists.Independent(
+            dists.TanhNormal(loc, scale, self.minimum, self.maximum), reinterpreted_batch_ndims=1
+        )
+
+
+class BetaDistributionHead(nn.Module):
+    """Beta policy on [minimum, maximum]."""
+
+    action_dim: int
+    minimum: float = -1.0
+    maximum: float = 1.0
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> dists.AffineBeta:
+        # softplus(+1) keeps alpha, beta > 1 (unimodal).
+        alpha = jax.nn.softplus(nn.Dense(self.action_dim, kernel_init=_ORTHO_SMALL)(embedding)) + 1.0
+        beta = jax.nn.softplus(nn.Dense(self.action_dim, kernel_init=_ORTHO_SMALL)(embedding)) + 1.0
+        return dists.AffineBeta(alpha, beta, self.minimum, self.maximum)
+
+
+class MultivariateNormalDiagHead(nn.Module):
+    """Unsquashed diagonal Gaussian (MPO-style, KL-friendly)."""
+
+    action_dim: int
+    init_scale: float = 0.3
+    min_scale: float = 1e-6
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> dists.MultivariateNormalDiag:
+        loc = nn.Dense(self.action_dim, kernel_init=_ORTHO_SMALL)(embedding)
+        raw_scale = nn.Dense(self.action_dim, kernel_init=_ORTHO_SMALL)(embedding)
+        scale = jax.nn.softplus(raw_scale) * self.init_scale / jax.nn.softplus(jnp.zeros(()))
+        return dists.MultivariateNormalDiag(loc, scale + self.min_scale)
+
+
+class DeterministicHead(nn.Module):
+    """Deterministic policy (DDPG/TD3); output bounded by tanh to [min, max]."""
+
+    action_dim: int
+    minimum: float = -1.0
+    maximum: float = 1.0
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> dists.Deterministic:
+        x = nn.Dense(self.action_dim, kernel_init=_ORTHO_SMALL)(embedding)
+        half_width = (self.maximum - self.minimum) / 2.0
+        mid = (self.maximum + self.minimum) / 2.0
+        return dists.Deterministic(jnp.tanh(x) * half_width + mid)
+
+
+class ScalarCriticHead(nn.Module):
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> jax.Array:
+        return nn.Dense(1, kernel_init=_ORTHO_ONE)(embedding)[..., 0]
+
+
+class CategoricalCriticHead(nn.Module):
+    """Distributional critic over a fixed real support (601 atoms by default,
+    reference heads.py:137-158)."""
+
+    num_atoms: int = 601
+    vmin: float = -300.0
+    vmax: float = 300.0
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> dists.DiscreteValued:
+        logits = nn.Dense(self.num_atoms, kernel_init=_ORTHO_ONE)(embedding)
+        values = jnp.linspace(self.vmin, self.vmax, self.num_atoms)
+        return dists.DiscreteValued(logits, values)
+
+
+class DiscreteQNetworkHead(nn.Module):
+    """Q-values head returning an EpsilonGreedy distribution so value-based
+    acting composes like policy-based acting (reference heads.py:202-217)."""
+
+    action_dim: int
+    epsilon: float = 0.1
+
+    @nn.compact
+    def __call__(
+        self,
+        embedding: jax.Array,
+        epsilon: Optional[jax.Array] = None,
+        action_mask: Optional[jax.Array] = None,
+    ) -> dists.EpsilonGreedy:
+        q_values = nn.Dense(self.action_dim, kernel_init=_ORTHO_ONE)(embedding)
+        eps = self.epsilon if epsilon is None else epsilon
+        return dists.EpsilonGreedy(q_values, eps, mask=action_mask)
+
+
+class PolicyValueHead(nn.Module):
+    """Shared-torso policy + scalar value (IMPALA shared torso, AZ/MZ prediction)."""
+
+    action_head: nn.Module
+    critic_head: nn.Module
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array, *args, **kwargs) -> Tuple[dists.Distribution, jax.Array]:
+        return self.action_head(embedding, *args, **kwargs), self.critic_head(embedding)
+
+
+class DistributionalDiscreteQNetwork(nn.Module):
+    """C51 head: per-action atom logits + fixed support (reference heads.py:235-258).
+
+    Returns (eps_greedy_dist_over_mean_q, atom_logits [..., A, M], atoms [M]).
+    """
+
+    action_dim: int
+    num_atoms: int = 51
+    vmin: float = -10.0
+    vmax: float = 10.0
+    epsilon: float = 0.1
+
+    @nn.compact
+    def __call__(
+        self,
+        embedding: jax.Array,
+        epsilon: Optional[jax.Array] = None,
+        action_mask: Optional[jax.Array] = None,
+    ) -> Tuple[dists.EpsilonGreedy, jax.Array, jax.Array]:
+        atoms = jnp.linspace(self.vmin, self.vmax, self.num_atoms)
+        logits = nn.Dense(self.action_dim * self.num_atoms, kernel_init=_ORTHO_ONE)(embedding)
+        logits = logits.reshape(embedding.shape[:-1] + (self.action_dim, self.num_atoms))
+        q_values = jnp.sum(jax.nn.softmax(logits, axis=-1) * atoms, axis=-1)
+        eps = self.epsilon if epsilon is None else epsilon
+        return dists.EpsilonGreedy(q_values, eps, mask=action_mask), logits, atoms
+
+
+class DistributionalContinuousQNetwork(nn.Module):
+    """D4PG critic: categorical Q-distribution over a fixed support."""
+
+    num_atoms: int = 51
+    vmin: float = -10.0
+    vmax: float = 10.0
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        atoms = jnp.linspace(self.vmin, self.vmax, self.num_atoms)
+        logits = nn.Dense(self.num_atoms, kernel_init=_ORTHO_ONE)(embedding)
+        q_value = jnp.sum(jax.nn.softmax(logits, axis=-1) * atoms, axis=-1)
+        return q_value, logits, atoms
+
+
+class QuantileDiscreteQNetwork(nn.Module):
+    """QR-DQN head: per-action quantile estimates (reference heads.py:277-293).
+
+    Returns (eps_greedy_over_mean_q, quantiles [..., N, A], taus [..., N]).
+    """
+
+    action_dim: int
+    num_quantiles: int = 51
+    epsilon: float = 0.1
+
+    @nn.compact
+    def __call__(
+        self,
+        embedding: jax.Array,
+        epsilon: Optional[jax.Array] = None,
+        action_mask: Optional[jax.Array] = None,
+    ) -> Tuple[dists.EpsilonGreedy, jax.Array, jax.Array]:
+        q_dist = nn.Dense(self.action_dim * self.num_quantiles, kernel_init=_ORTHO_ONE)(embedding)
+        q_dist = q_dist.reshape(embedding.shape[:-1] + (self.num_quantiles, self.action_dim))
+        q_values = jnp.mean(q_dist, axis=-2)
+        tau = (jnp.arange(self.num_quantiles) + 0.5) / self.num_quantiles
+        tau = jnp.broadcast_to(tau, embedding.shape[:-1] + (self.num_quantiles,))
+        eps = self.epsilon if epsilon is None else epsilon
+        return dists.EpsilonGreedy(q_values, eps, mask=action_mask), q_dist, tau
+
+
+class LinearHead(nn.Module):
+    """Raw linear projection (reward/logit heads in world models)."""
+
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> jax.Array:
+        out = nn.Dense(self.output_dim, kernel_init=_ORTHO_ONE)(embedding)
+        return out[..., 0] if self.output_dim == 1 else out
+
+
+class MultiDiscreteHead(nn.Module):
+    """Factorized categorical policy over multiple discrete dims."""
+
+    num_values: Sequence[int]
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> dists.MultiDiscrete:
+        flat = nn.Dense(int(sum(self.num_values)), kernel_init=_ORTHO_SMALL)(embedding)
+        return dists.MultiDiscrete(flat, self.num_values)

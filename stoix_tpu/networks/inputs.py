@@ -1,0 +1,51 @@
+"""Input layers: adapt the Observation struct (or raw arrays) into the tensor a
+torso consumes (reference stoix/networks/inputs.py:7-45)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs.types import Observation
+
+
+class ArrayInput(nn.Module):
+    """Pass a raw array straight through."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return x
+
+
+class ObservationInput(nn.Module):
+    """Select an attribute from the Observation struct (default: agent_view)."""
+
+    feature: str = "agent_view"
+
+    @nn.compact
+    def __call__(self, observation: Observation) -> jax.Array:
+        return getattr(observation, self.feature)
+
+
+class EmbeddingActionInput(nn.Module):
+    """Concatenate observation features with a continuous action — Q(s, a)
+    critics for DDPG/TD3/SAC."""
+
+    feature: str = "agent_view"
+
+    @nn.compact
+    def __call__(self, observation: Observation, action: jax.Array) -> jax.Array:
+        return jnp.concatenate([getattr(observation, self.feature), action], axis=-1)
+
+
+class EmbeddingActionOnehotInput(nn.Module):
+    """Concatenate observation features with a one-hot discrete action."""
+
+    num_actions: int
+    feature: str = "agent_view"
+
+    @nn.compact
+    def __call__(self, observation: Observation, action: jax.Array) -> jax.Array:
+        onehot = jax.nn.one_hot(action, self.num_actions)
+        return jnp.concatenate([getattr(observation, self.feature), onehot], axis=-1)

@@ -1,0 +1,83 @@
+"""Special layers: NoisyLinear (Rainbow) and StackedRNN
+(reference stoix/networks/layers.py:16-169)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.networks.utils import parse_rnn_cell
+
+
+class NoisyLinear(nn.Module):
+    """Factorized Gaussian noisy linear layer (Fortunato et al. 2018).
+
+    y = (μ_w + σ_w ⊙ (f(ε_in) f(ε_out)ᵀ)) x + μ_b + σ_b ⊙ f(ε_out),
+    f(x) = sign(x) sqrt(|x|). Noise comes from the "noise" rng stream; when the
+    stream is absent (evaluation), the layer runs deterministically with μ only.
+    """
+
+    features: int
+    sigma_zero: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_features = x.shape[-1]
+        sigma_init = self.sigma_zero / jnp.sqrt(in_features)
+        bound = 1.0 / jnp.sqrt(in_features)
+
+        mu_w = self.param(
+            "mu_w", nn.initializers.uniform(scale=2 * bound), (in_features, self.features)
+        )
+        mu_b = self.param("mu_b", nn.initializers.uniform(scale=2 * bound), (self.features,))
+        sigma_w = self.param(
+            "sigma_w", nn.initializers.constant(sigma_init), (in_features, self.features)
+        )
+        sigma_b = self.param("sigma_b", nn.initializers.constant(sigma_init), (self.features,))
+        # uniform(scale) yields [0, scale); recenter to [-bound, bound).
+        mu_w = mu_w - bound
+        mu_b = mu_b - bound
+
+        if self.has_rng("noise"):
+            key = self.make_rng("noise")
+            k_in, k_out = jax.random.split(key)
+            f = lambda e: jnp.sign(e) * jnp.sqrt(jnp.abs(e))
+            eps_in = f(jax.random.normal(k_in, (in_features,)))
+            eps_out = f(jax.random.normal(k_out, (self.features,)))
+            w = mu_w + sigma_w * jnp.outer(eps_in, eps_out)
+            b = mu_b + sigma_b * eps_out
+        else:
+            w, b = mu_w, mu_b
+        return x @ w + b
+
+
+class StackedRNN(nn.Module):
+    """A stack of RNN cells applied per step, carrying a tuple of hidden states
+    (used by the MuZero world-model dynamics)."""
+
+    hidden_size: int
+    num_layers: int = 2
+    cell_type: str = "lstm"
+
+    def setup(self) -> None:
+        cell_cls = parse_rnn_cell(self.cell_type)
+        self.cells = [cell_cls(features=self.hidden_size) for _ in range(self.num_layers)]
+
+    def __call__(self, states: Sequence[Any], x: jax.Array) -> Tuple[Tuple[Any, ...], jax.Array]:
+        new_states = []
+        for cell, state in zip(self.cells, states):
+            state, x = cell(state, x)
+            new_states.append(state)
+        return tuple(new_states), x
+
+    def initialize_carry(self, key: jax.Array, input_shape: Tuple[int, ...]) -> Tuple[Any, ...]:
+        # Zero carries built directly (instantiating cells here would register
+        # submodules when called from a bound parent module).
+        del key
+        shape = input_shape[:-1] + (self.hidden_size,)
+        if self.cell_type in ("lstm", "optimised_lstm"):
+            return tuple((jnp.zeros(shape), jnp.zeros(shape)) for _ in range(self.num_layers))
+        return tuple(jnp.zeros(shape) for _ in range(self.num_layers))

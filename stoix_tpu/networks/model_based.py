@@ -1,0 +1,87 @@
+"""World model for MuZero-family systems
+(reference stoix/networks/model_based.py:15-129).
+
+RewardBasedWorldModel: obs encoder -> hidden state; stacked-RNN dynamics over
+embedded actions with residual next-state and min-max hidden normalization;
+reward head on the dynamics output. Hidden RNN carries are packed into a flat
+vector between search steps so the MCTS tree stores one array per node.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.networks.layers import StackedRNN
+from stoix_tpu.networks.postprocessors import min_max_normalize
+
+
+class RewardBasedWorldModel(nn.Module):
+    obs_encoder: nn.Module  # torso over the observation input
+    reward_head: nn.Module  # embedding -> scalar reward
+    action_embedder: nn.Module  # action array -> embedding
+    hidden_size: int = 256
+    num_rnn_layers: int = 2
+    rnn_cell_type: str = "lstm"
+    normalize_hidden: bool = True
+
+    def setup(self) -> None:
+        self.dynamics = StackedRNN(self.hidden_size, self.num_rnn_layers, self.rnn_cell_type)
+        self.obs_to_hidden = nn.Dense(self.hidden_size)
+
+    # --- flat <-> structured RNN-state packing (reference model_based.py:49-75)
+    def _flat_dim(self) -> int:
+        # LSTM carries (c, h); GRU and simple carry one array.
+        per_layer = 2 if self.rnn_cell_type in ("lstm", "optimised_lstm") else 1
+        return self.num_rnn_layers * per_layer * self.hidden_size
+
+    def pack_state(self, states: Tuple[Any, ...]) -> jax.Array:
+        leaves = jax.tree.leaves(states)
+        return jnp.concatenate([leaf for leaf in leaves], axis=-1)
+
+    def unpack_state(self, flat: jax.Array) -> Tuple[Any, ...]:
+        per_layer = 2 if self.rnn_cell_type in ("lstm", "optimised_lstm") else 1
+        chunks = jnp.split(flat, self.num_rnn_layers * per_layer, axis=-1)
+        states = []
+        for i in range(self.num_rnn_layers):
+            if per_layer == 2:
+                states.append((chunks[2 * i], chunks[2 * i + 1]))
+            else:
+                states.append(chunks[i])
+        return tuple(states)
+
+    def initial_state(self, observation: Any) -> jax.Array:
+        """Encode an observation into the flat world-model hidden state."""
+        embedding = self.obs_encoder(observation)
+        batch_shape = embedding.shape[:-1]
+        carry = self.dynamics.initialize_carry(jax.random.PRNGKey(0), batch_shape + (self.hidden_size,))
+        # Seed every layer's hidden output with the embedding projection.
+        proj = self.obs_to_hidden(embedding)
+        if self.rnn_cell_type in ("lstm", "optimised_lstm"):
+            carry = tuple((c, proj) for (c, _h) in carry)
+        else:
+            carry = tuple(proj for _ in carry)
+        flat = self.pack_state(carry)
+        return min_max_normalize(flat) if self.normalize_hidden else flat
+
+    def step(self, flat_state: jax.Array, action: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """One latent dynamics step: returns (next_flat_state, reward)."""
+        states = self.unpack_state(flat_state)
+        a_emb = self.action_embedder(action)
+        new_states, out = self.dynamics(states, a_emb)
+        new_flat = self.pack_state(new_states)
+        # Residual connection then optional min-max normalization
+        # (reference model_based.py:91-97) keeps latent scale bounded.
+        new_flat = new_flat + flat_state
+        if self.normalize_hidden:
+            new_flat = min_max_normalize(new_flat)
+        reward = self.reward_head(out)
+        return new_flat, reward
+
+    def __call__(self, observation: Any, action: jax.Array):
+        """Init-everything path for nn.init: touch all submodules."""
+        flat = self.initial_state(observation)
+        return self.step(flat, action)

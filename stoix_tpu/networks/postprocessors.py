@@ -1,0 +1,64 @@
+"""Distribution post-processors (reference stoix/networks/postprocessors.py:10-81):
+wrap a distribution's sample/mode with a transform WITHOUT correcting log_prob —
+explicitly not a bijector; used for simple action rescaling at act time."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.ops.distributions import Distribution
+
+
+class PostProcessedDistribution(Distribution):
+    def __init__(self, distribution: Distribution, postprocessor: Callable[[jax.Array], jax.Array]):
+        self.distribution = distribution
+        self.postprocessor = postprocessor
+
+    def sample(self, *, seed: jax.Array) -> jax.Array:
+        return self.postprocessor(self.distribution.sample(seed=seed))
+
+    def mode(self) -> jax.Array:
+        return self.postprocessor(self.distribution.mode())
+
+    def mean(self) -> jax.Array:
+        return self.postprocessor(self.distribution.mean())
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.distribution, name)
+
+
+def rescale_to_spec(x: jax.Array, minimum: float, maximum: float) -> jax.Array:
+    """Affine map from [-1, 1] to [minimum, maximum]."""
+    scale = (maximum - minimum) / 2.0
+    offset = (maximum + minimum) / 2.0
+    return x * scale + offset
+
+
+def clip_to_spec(x: jax.Array, minimum: float, maximum: float) -> jax.Array:
+    return jnp.clip(x, minimum, maximum)
+
+
+def tanh_to_spec(x: jax.Array, minimum: float, maximum: float) -> jax.Array:
+    return rescale_to_spec(jnp.tanh(x), minimum, maximum)
+
+
+def min_max_normalize(x: jax.Array, epsilon: float = 1e-5) -> jax.Array:
+    x_min = jnp.min(x, axis=-1, keepdims=True)
+    x_max = jnp.max(x, axis=-1, keepdims=True)
+    return (x - x_min) / jnp.maximum(x_max - x_min, epsilon)
+
+
+class ScalePostProcessor(nn.Module):
+    minimum: float
+    maximum: float
+    scale_fn: Callable[[jax.Array, float, float], jax.Array] = tanh_to_spec
+
+    @nn.compact
+    def __call__(self, distribution: Distribution) -> PostProcessedDistribution:
+        return PostProcessedDistribution(
+            distribution, lambda x: self.scale_fn(x, self.minimum, self.maximum)
+        )

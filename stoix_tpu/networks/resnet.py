@@ -1,0 +1,112 @@
+"""Residual torsos (reference stoix/networks/resnet.py:48-188): IMPALA-style
+visual ResNet and MLP ResNet, with selectable downsampling strategies."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.networks.utils import parse_activation_fn
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    activation: str = "relu"
+    use_layer_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = parse_activation_fn(self.activation)
+        y = x
+        for _ in range(2):
+            if self.use_layer_norm:
+                y = nn.LayerNorm(use_scale=True)(y)
+            y = act(y)
+            y = nn.Conv(self.channels, kernel_size=(3, 3), strides=(1, 1))(y)
+        return x + y
+
+
+class DownsamplingStrategy:
+    CONV_MAX = "conv+max"  # IMPALA: stride-1 conv then 3x3 max-pool stride 2
+    LAYERNORM_RELU_CONV = "layernorm+relu+conv"  # MuZero-style strided conv
+    CONV = "conv"
+
+
+def _downsample(x: jax.Array, channels: int, strategy: str, activation: str) -> jax.Array:
+    act = parse_activation_fn(activation)
+    if strategy == DownsamplingStrategy.CONV_MAX:
+        x = nn.Conv(channels, kernel_size=(3, 3), strides=(1, 1))(x)
+        return nn.max_pool(x, window_shape=(3, 3), strides=(2, 2), padding="SAME")
+    if strategy == DownsamplingStrategy.LAYERNORM_RELU_CONV:
+        x = nn.LayerNorm(use_scale=True)(x)
+        x = act(x)
+        return nn.Conv(channels, kernel_size=(3, 3), strides=(2, 2))(x)
+    if strategy == DownsamplingStrategy.CONV:
+        return nn.Conv(channels, kernel_size=(3, 3), strides=(2, 2))(x)
+    raise ValueError(f"Unknown downsampling strategy '{strategy}'")
+
+
+class VisualResNetTorso(nn.Module):
+    """IMPALA-style conv ResNet over NHWC inputs with arbitrary leading dims."""
+
+    channels_per_group: Sequence[int] = (16, 32, 32)
+    blocks_per_group: Sequence[int] = (2, 2, 2)
+    downsampling_strategy: str = DownsamplingStrategy.CONV_MAX
+    activation: str = "relu"
+    use_layer_norm: bool = False
+    hidden_sizes: Sequence[int] = (256,)
+    channel_first: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = parse_activation_fn(self.activation)
+        lead_shape = x.shape[:-3]
+        x = x.reshape((-1,) + x.shape[-3:])
+        if self.channel_first:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        for channels, blocks in zip(self.channels_per_group, self.blocks_per_group):
+            x = _downsample(x, channels, self.downsampling_strategy, self.activation)
+            for _ in range(blocks):
+                x = ResidualBlock(channels, self.activation, self.use_layer_norm)(x)
+        x = act(x)
+        x = x.reshape(x.shape[0], -1)
+        for size in self.hidden_sizes:
+            x = nn.Dense(size, kernel_init=nn.initializers.orthogonal(jnp.sqrt(2.0)))(x)
+            x = act(x)
+        return x.reshape(lead_shape + x.shape[-1:])
+
+
+class MLPResidualBlock(nn.Module):
+    hidden_size: int
+    activation: str = "relu"
+    use_layer_norm: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = parse_activation_fn(self.activation)
+        y = x
+        for _ in range(2):
+            if self.use_layer_norm:
+                y = nn.LayerNorm(use_scale=True)(y)
+            y = act(y)
+            y = nn.Dense(self.hidden_size)(y)
+        return x + y
+
+
+class MLPResNetTorso(nn.Module):
+    """Dense ResNet for vector observations."""
+
+    num_blocks: int = 2
+    hidden_size: int = 256
+    activation: str = "relu"
+    use_layer_norm: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Dense(self.hidden_size)(x)
+        for _ in range(self.num_blocks):
+            x = MLPResidualBlock(self.hidden_size, self.activation, self.use_layer_norm)(x)
+        return x

@@ -1,0 +1,96 @@
+"""Torso networks (reference stoix/networks/torso.py:12-108).
+
+TPU notes: MLP widths should be multiples of 128 where throughput matters (MXU
+tiling); CNNTorso keeps NHWC layout (XLA's preferred conv layout on TPU) and
+flattens leading batch dims automatically so the same module serves [B, ...]
+and [T, B, ...] inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.networks.layers import NoisyLinear
+from stoix_tpu.networks.utils import parse_activation_fn
+
+
+class MLPTorso(nn.Module):
+    layer_sizes: Sequence[int] = (256, 256)
+    activation: str = "silu"
+    use_layer_norm: bool = False
+    activate_final: bool = True
+    kernel_init: str = "orthogonal"
+    kernel_scale: float = 1.4142135  # sqrt(2)
+
+    def _kernel_init(self):
+        if self.kernel_init == "orthogonal":
+            return nn.initializers.orthogonal(self.kernel_scale)
+        return nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = parse_activation_fn(self.activation)
+        for i, size in enumerate(self.layer_sizes):
+            x = nn.Dense(size, kernel_init=self._kernel_init())(x)
+            if self.use_layer_norm:
+                x = nn.LayerNorm(use_scale=True)(x)
+            if i < len(self.layer_sizes) - 1 or self.activate_final:
+                x = act(x)
+        return x
+
+
+class NoisyMLPTorso(nn.Module):
+    """MLP with factorized-Gaussian noisy linear layers (NoisyNets). Callers
+    must supply an rng stream named "noise" unless sigma_zero == 0."""
+
+    layer_sizes: Sequence[int] = (256, 256)
+    activation: str = "relu"
+    use_layer_norm: bool = False
+    activate_final: bool = True
+    sigma_zero: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = parse_activation_fn(self.activation)
+        for i, size in enumerate(self.layer_sizes):
+            x = NoisyLinear(size, sigma_zero=self.sigma_zero)(x)
+            if self.use_layer_norm:
+                x = nn.LayerNorm(use_scale=True)(x)
+            if i < len(self.layer_sizes) - 1 or self.activate_final:
+                x = act(x)
+        return x
+
+
+class CNNTorso(nn.Module):
+    """NHWC conv stack followed by a flatten + MLP. Accepts inputs with any
+    number of leading batch dims ([B, H, W, C], [T, B, H, W, C], ...)."""
+
+    channel_sizes: Sequence[int] = (32, 64, 64)
+    kernel_sizes: Sequence[int] = (8, 4, 3)
+    strides: Sequence[int] = (4, 2, 1)
+    activation: str = "relu"
+    use_layer_norm: bool = False
+    hidden_sizes: Sequence[int] = (256,)
+    channel_first: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = parse_activation_fn(self.activation)
+        lead_shape = x.shape[:-3]
+        x = x.reshape((-1,) + x.shape[-3:])
+        if self.channel_first:  # NCHW input -> NHWC for TPU-friendly convs
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        for ch, k, s in zip(self.channel_sizes, self.kernel_sizes, self.strides):
+            x = nn.Conv(ch, kernel_size=(k, k), strides=(s, s))(x)
+            if self.use_layer_norm:
+                x = nn.LayerNorm(use_scale=True)(x)
+            x = act(x)
+        x = x.reshape(x.shape[0], -1)
+        for size in self.hidden_sizes:
+            x = nn.Dense(size, kernel_init=nn.initializers.orthogonal(jnp.sqrt(2.0)))(x)
+            x = act(x)
+        return x.reshape(lead_shape + x.shape[-1:])

@@ -1,0 +1,47 @@
+"""Registries mapping config strings to activation / RNN cell constructors
+(reference stoix/networks/utils.py:7-37)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+
+ACTIVATIONS = {
+    "relu": nn.relu,
+    "tanh": nn.tanh,
+    "silu": nn.silu,
+    "swish": nn.silu,
+    "elu": nn.elu,
+    "gelu": nn.gelu,
+    "sigmoid": nn.sigmoid,
+    "softplus": nn.softplus,
+    "leaky_relu": nn.leaky_relu,
+    "identity": lambda x: x,
+    "none": lambda x: x,
+    "normalise": nn.standardize,
+}
+
+
+def parse_activation_fn(name: str) -> Callable[[jax.Array], jax.Array]:
+    if callable(name):
+        return name
+    if name not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[name]
+
+
+RNN_CELLS = {
+    "lstm": nn.LSTMCell,
+    "optimised_lstm": nn.OptimizedLSTMCell,
+    "gru": nn.GRUCell,
+    "mgu": nn.MGUCell,
+    "simple": nn.SimpleCell,
+}
+
+
+def parse_rnn_cell(name: str) -> Callable:
+    if name not in RNN_CELLS:
+        raise ValueError(f"Unknown RNN cell '{name}'. Known: {sorted(RNN_CELLS)}")
+    return RNN_CELLS[name]

@@ -1,0 +1,109 @@
+"""Welford running mean/std for observation normalization, mesh-aware.
+
+Equivalent of the reference's Acme-derived stoix/utils/running_statistics.py
+(559 LoC) with the pmap-era `_psum_over_axes` (reference
+running_statistics.py:62-70) redesigned for the mesh world: `update` takes
+`axis_names` and psums counts/sums over those mesh axes, so it works identically
+under `shard_map` (axis names = mesh axes) and under plain single-shard jit
+(axis_names=()).
+
+Unlike the reference, there is no dynamic NamedTuple field injection
+(`add_field_to_state`, reference :444): systems that normalize observations
+declare the statistics field in their learner-state type explicitly — simpler
+and fully typed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class RunningStatisticsState(NamedTuple):
+    count: Array  # scalar fp32 — total elements folded in (global)
+    mean: Any  # pytree like the observation
+    summed_variance: Any
+    std: Any
+
+
+def init_state(template: Any) -> RunningStatisticsState:
+    """Build zeroed statistics shaped like `template` (a dummy observation)."""
+    zeros = jax.tree.map(lambda x: jnp.zeros(jnp.shape(x), jnp.float32), template)
+    ones = jax.tree.map(lambda x: jnp.ones(jnp.shape(x), jnp.float32), template)
+    return RunningStatisticsState(
+        count=jnp.zeros((), jnp.float32), mean=zeros, summed_variance=zeros, std=ones
+    )
+
+
+def _all_sum(x: Array, axis_names: Sequence[str]) -> Array:
+    for name in axis_names:
+        x = jax.lax.psum(x, axis_name=name)
+    return x
+
+
+def update(
+    state: RunningStatisticsState,
+    batch: Any,
+    *,
+    axis_names: Sequence[str] = (),
+    std_min_value: float = 1e-6,
+    std_max_value: float = 1e6,
+) -> RunningStatisticsState:
+    """Fold a batch of observations into the running statistics.
+
+    `batch` leaves have shape [leading..., *feature_shape] where feature_shape
+    matches the statistics leaves; all leading axes are reduced. When called
+    inside shard_map/vmap with named axes, pass them via `axis_names` to get
+    cross-device-consistent statistics (each shard folds its local batch, psum
+    makes the result global).
+    """
+    mean_leaves, treedef = jax.tree.flatten(state.mean)
+    batch_leaves = treedef.flatten_up_to(batch)
+
+    # All leaves share the same leading batch shape; count it once.
+    feat_ndim = mean_leaves[0].ndim
+    lead_shape = batch_leaves[0].shape[: batch_leaves[0].ndim - feat_ndim]
+    local_count = jnp.prod(jnp.asarray(lead_shape, jnp.float32)) if lead_shape else jnp.asarray(1.0)
+    batch_count = _all_sum(local_count, axis_names)
+    new_count = state.count + batch_count
+
+    new_means, new_vars, new_stds = [], [], []
+    for mean, svar, b in zip(mean_leaves, jax.tree.leaves(state.summed_variance), batch_leaves):
+        reduce_axes = tuple(range(b.ndim - mean.ndim))
+        diff_sum = _all_sum(jnp.sum(b - mean, axis=reduce_axes), axis_names)
+        new_mean = mean + diff_sum / new_count
+        diff2_sum = _all_sum(jnp.sum((b - mean) * (b - new_mean), axis=reduce_axes), axis_names)
+        new_svar = svar + diff2_sum
+        new_std = jnp.clip(jnp.sqrt(new_svar / new_count), std_min_value, std_max_value)
+        new_means.append(new_mean)
+        new_vars.append(new_svar)
+        new_stds.append(new_std)
+
+    return RunningStatisticsState(
+        count=new_count,
+        mean=treedef.unflatten(new_means),
+        summed_variance=treedef.unflatten(new_vars),
+        std=treedef.unflatten(new_stds),
+    )
+
+
+def normalize(batch: Any, state: RunningStatisticsState, max_abs_value: float | None = None) -> Any:
+    def _norm(b: Array, mean: Array, std: Array) -> Array:
+        out = (b - mean) / std
+        if max_abs_value is not None:
+            out = jnp.clip(out, -max_abs_value, max_abs_value)
+        return out
+
+    return jax.tree.map(_norm, batch, state.mean, state.std)
+
+
+def denormalize(batch: Any, state: RunningStatisticsState) -> Any:
+    return jax.tree.map(lambda b, mean, std: b * std + mean, batch, state.mean, state.std)
+
+
+def clip(batch: Any, max_abs_value: float) -> Any:
+    return jax.tree.map(lambda b: jnp.clip(b, -max_abs_value, max_abs_value), batch)

@@ -12,6 +12,11 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The environment may pin JAX_PLATFORMS to a TPU plugin via a site hook that
+# wins over our env var; force CPU again post-import (effective because no
+# backend has been initialised yet at conftest time).
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,249 @@
+"""Network zoo tests: shapes, distribution outputs, RNN reset semantics,
+noisy layers, dueling heads, world model round-trip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stoix_tpu.envs.types import Observation
+from stoix_tpu.networks import base, dueling, heads, inputs, layers, model_based, resnet, torso
+from stoix_tpu.ops import distributions as dists
+
+KEY = jax.random.PRNGKey(0)
+
+
+def make_obs(batch=4, dim=6, num_actions=3):
+    return Observation(
+        agent_view=jnp.ones((batch, dim)),
+        action_mask=jnp.ones((batch, num_actions)),
+        step_count=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def test_feedforward_actor_categorical():
+    net = base.FeedForwardActor(
+        action_head=heads.CategoricalHead(num_actions=3),
+        torso=torso.MLPTorso((32, 32)),
+        input_layer=inputs.ObservationInput(),
+    )
+    obs = make_obs()
+    params = net.init(KEY, obs)
+    dist = net.apply(params, obs)
+    assert isinstance(dist, dists.Categorical)
+    assert dist.logits.shape == (4, 3)
+    a = dist.sample(seed=KEY)
+    assert a.shape == (4,)
+
+
+def test_actor_respects_action_mask():
+    net = base.FeedForwardActor(
+        action_head=heads.CategoricalHead(num_actions=3),
+        torso=torso.MLPTorso((16,)),
+        input_layer=inputs.ObservationInput(),
+    )
+    obs = make_obs()
+    mask = jnp.broadcast_to(jnp.array([1.0, 0.0, 1.0]), (4, 3))
+    obs = obs._replace(action_mask=mask)
+    params = net.init(KEY, obs)
+    dist = net.apply(params, obs)
+    samples = dist.sample_n(100, seed=KEY)
+    assert not np.any(np.asarray(samples) == 1)
+
+
+def test_feedforward_critic_scalar():
+    net = base.FeedForwardCritic(
+        critic_head=heads.ScalarCriticHead(),
+        torso=torso.MLPTorso((32,)),
+        input_layer=inputs.ObservationInput(),
+    )
+    obs = make_obs()
+    params = net.init(KEY, obs)
+    v = net.apply(params, obs)
+    assert v.shape == (4,)
+
+
+def test_continuous_heads():
+    obs = make_obs()
+    for head in [
+        heads.NormalAffineTanhDistributionHead(action_dim=2, minimum=-2, maximum=2),
+        heads.BetaDistributionHead(action_dim=2, minimum=-1, maximum=1),
+        heads.MultivariateNormalDiagHead(action_dim=2),
+        heads.DeterministicHead(action_dim=2),
+    ]:
+        net = base.FeedForwardActor(
+            action_head=head, torso=torso.MLPTorso((16,)), input_layer=inputs.ObservationInput()
+        )
+        params = net.init(KEY, obs)
+        dist = net.apply(params, obs)
+        a = dist.sample(seed=KEY)
+        assert a.shape == (4, 2)
+        lp = dist.log_prob(a)
+        assert lp.shape == (4,)
+
+
+def test_q_action_input_critic():
+    net = base.FeedForwardCritic(
+        critic_head=heads.ScalarCriticHead(),
+        torso=torso.MLPTorso((16,)),
+        input_layer=inputs.EmbeddingActionInput(),
+    )
+    obs = make_obs()
+    action = jnp.zeros((4, 2))
+    params = net.init(KEY, obs, action)
+    q = net.apply(params, obs, action)
+    assert q.shape == (4,)
+
+
+def test_multi_network_twin_q():
+    nets = [
+        base.FeedForwardCritic(
+            critic_head=heads.ScalarCriticHead(),
+            torso=torso.MLPTorso((16,)),
+            input_layer=inputs.EmbeddingActionInput(),
+        )
+        for _ in range(2)
+    ]
+    twin = base.MultiNetwork(nets)
+    obs, action = make_obs(), jnp.zeros((4, 2))
+    params = twin.init(KEY, obs, action)
+    q = twin.apply(params, obs, action)
+    assert q.shape == (4, 2)  # [batch, num_critics]
+
+
+def test_distributional_q_heads():
+    obs = make_obs()
+    net = base.FeedForwardActor(
+        action_head=heads.DistributionalDiscreteQNetwork(action_dim=3, num_atoms=11),
+        torso=torso.MLPTorso((16,)),
+        input_layer=inputs.ObservationInput(),
+    )
+    params = net.init(KEY, obs)
+    dist, logits, atoms = net.apply(params, obs)
+    assert logits.shape == (4, 3, 11)
+    assert atoms.shape == (11,)
+    assert isinstance(dist, dists.EpsilonGreedy)
+
+    qr = base.FeedForwardActor(
+        action_head=heads.QuantileDiscreteQNetwork(action_dim=3, num_quantiles=7),
+        torso=torso.MLPTorso((16,)),
+        input_layer=inputs.ObservationInput(),
+    )
+    params = qr.init(KEY, obs)
+    dist, q_dist, tau = qr.apply(params, obs)
+    assert q_dist.shape == (4, 7, 3)
+    assert tau.shape == (4, 7)
+
+
+def test_dueling_heads():
+    obs_emb = jnp.ones((4, 16))
+    d = dueling.DuelingQNetwork(action_dim=3)
+    params = d.init(KEY, obs_emb)
+    dist = d.apply(params, obs_emb)
+    assert dist.preferences.shape == (4, 3)
+
+    nd = dueling.NoisyDistributionalDuelingQNetwork(action_dim=3, num_atoms=5)
+    params = nd.init({"params": KEY, "noise": KEY}, obs_emb)
+    dist, logits, atoms = nd.apply(params, obs_emb, rngs={"noise": KEY})
+    assert logits.shape == (4, 3, 5)
+    # Without the noise stream the layer must still run (deterministic eval).
+    dist2, logits2, _ = nd.apply(params, obs_emb)
+    assert np.isfinite(np.asarray(logits2)).all()
+
+
+def test_noisy_linear_stochastic_with_noise_stream():
+    layer = layers.NoisyLinear(8)
+    x = jnp.ones((2, 4))
+    params = layer.init({"params": KEY, "noise": KEY}, x)
+    y1 = layer.apply(params, x, rngs={"noise": jax.random.PRNGKey(1)})
+    y2 = layer.apply(params, x, rngs={"noise": jax.random.PRNGKey(2)})
+    y_det = layer.apply(params, x)
+    assert not np.allclose(y1, y2)
+    assert np.isfinite(np.asarray(y_det)).all()
+
+
+def test_cnn_and_resnet_leading_dims():
+    x = jnp.ones((2, 3, 16, 16, 1))  # [T, B, H, W, C]
+    cnn = torso.CNNTorso(channel_sizes=(8, 8), kernel_sizes=(3, 3), strides=(2, 2), hidden_sizes=(32,))
+    params = cnn.init(KEY, x)
+    out = cnn.apply(params, x)
+    assert out.shape == (2, 3, 32)
+
+    rn = resnet.VisualResNetTorso(channels_per_group=(8,), blocks_per_group=(1,), hidden_sizes=(32,))
+    params = rn.init(KEY, x)
+    out = rn.apply(params, x)
+    assert out.shape == (2, 3, 32)
+
+
+def test_scanned_rnn_resets_on_done():
+    rnn = base.ScannedRNN(hidden_size=8, cell_type="gru")
+    T, B, F = 5, 2, 4
+    xs = jnp.ones((T, B, F))
+    dones = jnp.zeros((T, B), bool)
+    h0 = base.ScannedRNN.initialize_carry("gru", 8, (B,))
+    params = rnn.init(KEY, h0, (xs, dones))
+    _, out_nodone = rnn.apply(params, h0, (xs, dones))
+
+    # A done at t=3 must make outputs at t>=3 equal to a fresh-start sequence.
+    dones_mid = dones.at[3].set(True)
+    _, out_done = rnn.apply(params, h0, (xs, dones_mid))
+    _, out_fresh = rnn.apply(params, h0, (xs[3:], jnp.zeros((T - 3, B), bool)))
+    np.testing.assert_allclose(out_done[3:], out_fresh, atol=1e-6)
+    assert not np.allclose(out_done[3], out_nodone[3])
+
+
+def test_recurrent_actor_critic():
+    T, B = 4, 3
+    obs = Observation(
+        agent_view=jnp.ones((T, B, 6)),
+        action_mask=jnp.ones((T, B, 3)),
+        step_count=jnp.zeros((T, B), jnp.int32),
+    )
+    dones = jnp.zeros((T, B), bool)
+    actor = base.RecurrentActor(
+        action_head=heads.CategoricalHead(num_actions=3),
+        rnn=base.ScannedRNN(hidden_size=8),
+        pre_torso=torso.MLPTorso((16,)),
+        post_torso=torso.MLPTorso((16,)),
+        input_layer=inputs.ObservationInput(),
+    )
+    h0 = base.ScannedRNN.initialize_carry("gru", 8, (B,))
+    params = actor.init(KEY, h0, (obs, dones))
+    h1, dist = actor.apply(params, h0, (obs, dones))
+    assert dist.logits.shape == (T, B, 3)
+
+
+def test_world_model_round_trip():
+    wm = model_based.RewardBasedWorldModel(
+        obs_encoder=torso.MLPTorso((32,)),
+        reward_head=heads.LinearHead(output_dim=1),
+        action_embedder=torso.MLPTorso((16,)),
+        hidden_size=32,
+        num_rnn_layers=2,
+        rnn_cell_type="lstm",
+    )
+    obs = jnp.ones((4, 6))
+    action = jnp.ones((4, 2))
+    params = wm.init(KEY, obs, action)
+    flat = wm.apply(params, obs, method=wm.initial_state)
+    assert flat.shape == (4, 2 * 2 * 32)
+    next_flat, reward = wm.apply(params, flat, action, method=wm.step)
+    assert next_flat.shape == flat.shape
+    assert reward.shape == (4,)
+    # Normalized hidden state stays in [0, 1].
+    assert float(jnp.min(next_flat)) >= 0.0 and float(jnp.max(next_flat)) <= 1.0
+
+
+def test_shared_actor_critic():
+    net = base.FeedForwardActorCritic(
+        shared_head=heads.PolicyValueHead(
+            action_head=heads.CategoricalHead(num_actions=3),
+            critic_head=heads.ScalarCriticHead(),
+        ),
+        torso=torso.MLPTorso((16,)),
+        input_layer=inputs.ObservationInput(),
+    )
+    obs = make_obs()
+    params = net.init(KEY, obs)
+    dist, value = net.apply(params, obs)
+    assert value.shape == (4,)
+    assert dist.logits.shape == (4, 3)
