@@ -1,0 +1,90 @@
+"""Benchmark harness: Anakin PPO env-steps/sec on the available devices.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+vs_baseline is measured throughput / BASELINE.json's 1M steps/sec v5e-64 target
+scaled to the local chip count (the target implies 15,625 steps/sec/chip).
+
+Usage: python bench.py [--smoke]  (--smoke: tiny budget for CI wiring checks)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+
+    import jax
+
+    from stoix_tpu.utils import config as config_lib
+
+    n_devices = len(jax.devices())
+
+    overrides = [
+        "arch.total_num_envs=%d" % (2048 * n_devices if not smoke else 8 * n_devices),
+        "system.rollout_length=%d" % (64 if not smoke else 8),
+        "arch.num_evaluation=1",
+        "arch.num_eval_episodes=%d" % max(8, n_devices),
+        "arch.absolute_metric=False",
+        "logger.use_console=False",
+    ]
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml", overrides
+    )
+
+    from stoix_tpu import envs
+    from stoix_tpu.parallel import create_mesh
+    from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
+    from stoix_tpu.utils.timestep_checker import check_total_timesteps
+
+    mesh = create_mesh({"data": -1})
+    # Fix the number of updates per timed call.
+    updates_per_call = 2 if smoke else 8
+    config.arch.num_updates = updates_per_call * (3 if not smoke else 1)
+    config.arch.total_timesteps = None
+    config.arch.num_evaluation = 3 if not smoke else 1
+    config = check_total_timesteps(config, int(mesh.shape["data"]))
+
+    env, _ = envs.make(config)
+    key = jax.random.PRNGKey(0)
+    learn, _, learner_state = learner_setup(env, config, mesh, key)
+
+    steps_per_call = (
+        int(config.system.rollout_length)
+        * int(config.arch.total_num_envs)
+        * int(config.arch.num_updates_per_eval)
+    )
+
+    # Warmup / compile.
+    out = learn(learner_state)
+    jax.block_until_ready(out.learner_state)
+    learner_state = out.learner_state
+
+    times = []
+    for _ in range(3 if not smoke else 1):
+        start = time.perf_counter()
+        out = learn(learner_state)
+        jax.block_until_ready(out.learner_state)
+        learner_state = out.learner_state
+        times.append(time.perf_counter() - start)
+
+    steps_per_sec = steps_per_call / min(times)
+    per_chip = steps_per_sec / n_devices
+    baseline_per_chip = 1_000_000 / 64  # BASELINE.json north star on v5e-64
+    print(
+        json.dumps(
+            {
+                "metric": "anakin_ppo_env_steps_per_sec",
+                "value": round(steps_per_sec, 1),
+                "unit": f"env_steps/sec ({n_devices} devices, CartPole)",
+                "vs_baseline": round(per_chip / baseline_per_chip, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Shared type vocabulary (reference stoix/base_types.py:32-220).
+
+NamedTuple state/transition structs used across systems. All states hold GLOBAL
+(mesh-sharded) arrays; there is no leading [device, update_batch] axis pair as
+in the reference — sharding is carried by the arrays' NamedShardings instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+from stoix_tpu.envs.types import Observation, TimeStep  # noqa: F401  (re-export)
+
+Parameters = Any
+OptStates = Any
+HiddenState = Any
+Metrics = Dict[str, jax.Array]
+
+
+class OnlineAndTarget(NamedTuple):
+    online: Parameters
+    target: Parameters
+
+
+class ActorCriticParams(NamedTuple):
+    actor_params: Parameters
+    critic_params: Parameters
+
+
+class ActorCriticOptStates(NamedTuple):
+    actor_opt_state: OptStates
+    critic_opt_state: OptStates
+
+
+class OnPolicyLearnerState(NamedTuple):
+    params: Any
+    opt_states: Any
+    key: jax.Array
+    env_state: Any
+    timestep: TimeStep
+
+
+class OffPolicyLearnerState(NamedTuple):
+    params: Any
+    opt_states: Any
+    buffer_state: Any
+    key: jax.Array
+    env_state: Any
+    timestep: TimeStep
+
+
+class RNNLearnerState(NamedTuple):
+    params: Any
+    opt_states: Any
+    key: jax.Array
+    env_state: Any
+    timestep: TimeStep
+    done: jax.Array
+    truncated: jax.Array
+    hstates: Any
+
+
+class RNNOffPolicyLearnerState(NamedTuple):
+    params: Any
+    opt_states: Any
+    buffer_state: Any
+    key: jax.Array
+    env_state: Any
+    timestep: TimeStep
+    done: jax.Array
+    truncated: jax.Array
+    hstates: Any
+
+
+class PPOTransition(NamedTuple):
+    done: jax.Array
+    truncated: jax.Array
+    action: jax.Array
+    value: jax.Array
+    reward: jax.Array
+    log_prob: jax.Array
+    obs: Any
+    next_obs: Any
+    info: Dict[str, Any]
+
+
+class Transition(NamedTuple):
+    """Generic off-policy transition (DQN family)."""
+
+    obs: Any
+    action: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    next_obs: Any
+    info: Dict[str, Any]
+
+
+class ExperimentOutput(NamedTuple):
+    learner_state: Any
+    episode_metrics: Metrics
+    train_metrics: Metrics
+
+
+ActorApply = Callable[..., Any]
+CriticApply = Callable[..., jax.Array]
+LearnerFn = Callable[[Any], ExperimentOutput]
+EvalFn = Callable[..., Metrics]

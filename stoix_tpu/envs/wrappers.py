@@ -26,6 +26,12 @@ from stoix_tpu.envs.core import Action, Environment, State, Wrapper
 from stoix_tpu.envs.types import StepType, TimeStep, _bcast
 
 
+def _ensure_truncation(ts: TimeStep) -> None:
+    """Guarantee the well-known extras["truncation"] key so the extras pytree
+    contract is identical for reset/step across every env."""
+    ts.extras["truncation"] = ts.extras.get("truncation", jnp.zeros((), bool))
+
+
 class StepLimitState(NamedTuple):
     inner: Any
     step_count: jax.Array
@@ -40,7 +46,7 @@ class EpisodeStepLimit(Wrapper):
 
     def reset(self, key: jax.Array) -> Tuple[State, TimeStep]:
         state, ts = self._env.reset(key)
-        ts.extras["truncation"] = ts.extras.get("truncation", jnp.zeros((), dtype=bool))
+        _ensure_truncation(ts)
         return StepLimitState(state, jnp.zeros((), jnp.int32)), ts
 
     def step(self, state: StepLimitState, action: Action) -> Tuple[State, TimeStep]:
@@ -74,9 +80,7 @@ class RecordEpisodeMetrics(Wrapper):
             "episode_length": jnp.zeros((), jnp.int32),
             "is_terminal_step": jnp.zeros((), bool),
         }
-        # Guarantee the well-known "truncation" key on every wrapped stack so
-        # the extras pytree contract is env-independent.
-        ts.extras["truncation"] = ts.extras.get("truncation", jnp.zeros((), bool))
+        _ensure_truncation(ts)
         return EpisodeMetricsState(state, zero, jnp.zeros((), jnp.int32)), ts
 
     def step(self, state: EpisodeMetricsState, action: Action) -> Tuple[State, TimeStep]:
@@ -89,7 +93,7 @@ class RecordEpisodeMetrics(Wrapper):
             "episode_length": ep_length,
             "is_terminal_step": done,
         }
-        ts.extras["truncation"] = ts.extras.get("truncation", jnp.zeros((), bool))
+        _ensure_truncation(ts)
         # Reset accumulators after a terminal step (auto-reset follows above us).
         next_state = EpisodeMetricsState(
             inner,

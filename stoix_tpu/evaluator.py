@@ -1,0 +1,183 @@
+"""Evaluator (reference stoix/evaluator.py:87-416).
+
+Runs `num_eval_episodes` episodes to completion (lax.while_loop keyed on
+timestep.last(), reference evaluator.py:152) with episodes vmapped within each
+shard and sharded over the mesh's data axis via shard_map — the TPU-native
+replacement for the reference's pmapped evaluator. The absolute-metric
+evaluator is the same function with eval_multiplier=10.
+
+Caveat preserved from the reference (README.md:197): non-terminating envs make
+the while_loop spin forever — give eval envs a step limit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu.envs.core import Environment
+
+# act_fn(params, observation, key) -> action  (single unbatched observation)
+ActFn = Callable[[Any, Any, jax.Array], jax.Array]
+
+
+class _EvalCarry(NamedTuple):
+    env_state: Any
+    timestep: Any
+    key: jax.Array
+
+
+def get_distribution_act_fn(
+    config: Any,
+    actor_apply: Callable[..., Any],
+    rngs: Optional[Dict[str, jax.Array]] = None,
+) -> ActFn:
+    """Greedy (mode) or sampled acting from a distribution-returning network
+    (reference evaluator.py:48-67)."""
+
+    greedy = bool(config.arch.get("evaluation_greedy", False))
+
+    def act(params: Any, observation: Any, key: jax.Array) -> jax.Array:
+        if rngs is None:
+            dist = actor_apply(params, observation)
+        else:
+            dist = actor_apply(params, observation, rngs=rngs)
+        return dist.mode() if greedy else dist.sample(seed=key)
+
+    return act
+
+
+def get_ff_evaluator_fn(
+    eval_env: Environment,
+    act_fn: ActFn,
+    config: Any,
+    mesh: Mesh,
+    eval_multiplier: int = 1,
+):
+    """Build the sharded evaluator: (params, key) -> episode metrics dict with
+    leaves shaped [global_eval_episodes]."""
+
+    n_shards = int(mesh.shape["data"])
+    episodes_global = int(config.arch.num_eval_episodes) * eval_multiplier
+    if episodes_global % n_shards != 0:
+        episodes_global = ((episodes_global // n_shards) + 1) * n_shards
+    per_shard = episodes_global // n_shards
+
+    def eval_one_episode(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
+        reset_key, act_key = jax.random.split(key)
+        env_state, timestep = eval_env.reset(reset_key)
+
+        def cond(carry: _EvalCarry) -> jax.Array:
+            return ~carry.timestep.last()
+
+        def body(carry: _EvalCarry) -> _EvalCarry:
+            key, act_key = jax.random.split(carry.key)
+            action = act_fn(params, carry.timestep.observation, act_key)
+            env_state, timestep = eval_env.step(carry.env_state, action)
+            return _EvalCarry(env_state, timestep, key)
+
+        final = jax.lax.while_loop(cond, body, _EvalCarry(env_state, timestep, act_key))
+        metrics = final.timestep.extras["episode_metrics"]
+        return {
+            "episode_return": metrics["episode_return"],
+            "episode_length": metrics["episode_length"],
+        }
+
+    def _shard_eval(params: Any, keys: jax.Array) -> Dict[str, jax.Array]:
+        return jax.vmap(eval_one_episode, in_axes=(None, 0))(params, keys)
+
+    sharded = jax.jit(
+        jax.shard_map(
+            _shard_eval,
+            mesh=mesh,
+            in_specs=(P(), P("data")),
+            out_specs=P("data"),
+            check_vma=False,  # while_loop carries mix replicated and varying leaves
+        )
+    )
+
+    def evaluator(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
+        keys = jax.random.split(key, episodes_global)
+        return sharded(params, keys)
+
+    return evaluator
+
+
+def get_rnn_evaluator_fn(
+    eval_env: Environment,
+    rnn_act_fn: Callable[..., Tuple[Any, jax.Array]],
+    config: Any,
+    mesh: Mesh,
+    init_hstate_fn: Callable[[], Any],
+    eval_multiplier: int = 1,
+):
+    """Recurrent evaluator: carries the hidden state through the episode
+    (reference evaluator.py:209-344). rnn_act_fn(params, hstate, obs, done, key)
+    -> (hstate, action)."""
+
+    n_shards = int(mesh.shape["data"])
+    episodes_global = int(config.arch.num_eval_episodes) * eval_multiplier
+    if episodes_global % n_shards != 0:
+        episodes_global = ((episodes_global // n_shards) + 1) * n_shards
+
+    def eval_one_episode(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
+        reset_key, act_key = jax.random.split(key)
+        env_state, timestep = eval_env.reset(reset_key)
+        hstate = init_hstate_fn()
+
+        def cond(carry) -> jax.Array:
+            return ~carry[1].last()
+
+        def body(carry):
+            env_state, timestep, hstate, key = carry
+            key, act_key = jax.random.split(key)
+            hstate, action = rnn_act_fn(
+                params, hstate, timestep.observation, timestep.last(), act_key
+            )
+            env_state, timestep = eval_env.step(env_state, action)
+            return (env_state, timestep, hstate, key)
+
+        final = jax.lax.while_loop(cond, body, (env_state, timestep, hstate, act_key))
+        metrics = final[1].extras["episode_metrics"]
+        return {
+            "episode_return": metrics["episode_return"],
+            "episode_length": metrics["episode_length"],
+        }
+
+    def _shard_eval(params: Any, keys: jax.Array) -> Dict[str, jax.Array]:
+        return jax.vmap(eval_one_episode, in_axes=(None, 0))(params, keys)
+
+    sharded = jax.jit(
+        jax.shard_map(
+            _shard_eval, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+            check_vma=False,
+        )
+    )
+
+    def evaluator(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
+        keys = jax.random.split(key, episodes_global)
+        return sharded(params, keys)
+
+    return evaluator
+
+
+def evaluator_setup(
+    eval_env: Environment,
+    act_fn: ActFn,
+    config: Any,
+    mesh: Mesh,
+) -> Tuple[Any, Any]:
+    """Returns (evaluator, absolute_metric_evaluator) — the latter runs
+    eval_multiplier x episodes (reference evaluator.py:347-416)."""
+    evaluator = get_ff_evaluator_fn(eval_env, act_fn, config, mesh)
+    absolute_evaluator = get_ff_evaluator_fn(
+        eval_env,
+        act_fn,
+        config,
+        mesh,
+        eval_multiplier=int(config.arch.get("absolute_metric_multiplier", 10)),
+    )
+    return evaluator, absolute_evaluator

@@ -28,6 +28,10 @@ class PostProcessedDistribution(Distribution):
         return self.postprocessor(self.distribution.mean())
 
     def __getattr__(self, name: str) -> Any:
+        # Guard private/self-referential names so object reconstruction can't
+        # recurse before __dict__ exists (same fix as envs.core.Wrapper).
+        if name.startswith("_") or name == "distribution":
+            raise AttributeError(name)
         return getattr(self.distribution, name)
 
 

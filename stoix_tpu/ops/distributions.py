@@ -92,30 +92,51 @@ class Categorical(Distribution):
         return jnp.sum(p * jnp.where(p > 0, self.logits - other.logits, 0.0), axis=-1)
 
 
+def _mask_preferences(preferences: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+    if mask is None:
+        return preferences
+    return jnp.where(mask > 0, preferences, jnp.finfo(preferences.dtype).min)
+
+
 class EpsilonGreedy(Categorical):
     """Epsilon-greedy over Q-values — returned by DiscreteQNetworkHead so acting
     is `dist.sample(...)` uniformly across value- and policy-based systems
     (reference stoix/networks/heads.py:202-217 returns distrax.EpsilonGreedy).
+
+    With a mask, the greedy argmax is taken over LEGAL actions only and the
+    epsilon mass is spread uniformly over legal actions.
     """
 
     def __init__(self, preferences: jax.Array, epsilon: float, mask: Optional[jax.Array] = None):
         self.preferences = preferences
         self.epsilon = epsilon
         num = preferences.shape[-1]
-        greedy = jax.nn.one_hot(jnp.argmax(preferences, axis=-1), num)
-        probs = (1.0 - epsilon) * greedy + epsilon / num
-        super().__init__(jnp.log(probs), mask=mask)
+        masked_prefs = _mask_preferences(preferences, mask)
+        self._masked_preferences = masked_prefs
+        greedy = jax.nn.one_hot(jnp.argmax(masked_prefs, axis=-1), num)
+        if mask is None:
+            uniform = jnp.ones_like(preferences) / num
+        else:
+            valid = (mask > 0).astype(preferences.dtype)
+            uniform = valid / jnp.sum(valid, axis=-1, keepdims=True)
+        probs = (1.0 - epsilon) * greedy + epsilon * uniform
+        super().__init__(jnp.log(probs + 1e-12), mask=mask)
 
     def mode(self) -> jax.Array:
-        return jnp.argmax(self.preferences, axis=-1)
+        return jnp.argmax(self._masked_preferences, axis=-1)
 
 
 class Greedy(Categorical):
     def __init__(self, preferences: jax.Array, mask: Optional[jax.Array] = None):
         self.preferences = preferences
+        masked_prefs = _mask_preferences(preferences, mask)
+        self._masked_preferences = masked_prefs
         num = preferences.shape[-1]
-        probs = jax.nn.one_hot(jnp.argmax(preferences, axis=-1), num)
-        super().__init__(jnp.log(probs + 1e-9), mask=mask)
+        probs = jax.nn.one_hot(jnp.argmax(masked_prefs, axis=-1), num)
+        super().__init__(jnp.log(probs + 1e-12), mask=mask)
+
+    def mode(self) -> jax.Array:
+        return jnp.argmax(self._masked_preferences, axis=-1)
 
 
 class Normal(Distribution):

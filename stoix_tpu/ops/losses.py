@@ -193,12 +193,15 @@ def categorical_double_q_learning(
     against the online logits of the taken action (reference loss.py:81-104)."""
     best_a = jnp.argmax(q_t_selector, axis=-1)  # [B]
     num_atoms = q_atoms_tm1.shape[-1]
-    target_z = r_t[..., None] + d_t[..., None] * q_atoms_t  # [B, A_atoms]
+    # Atoms may be shared ([M], as the heads return) or per-batch ([B, M]).
+    z_q = q_atoms_tm1 if q_atoms_tm1.ndim == 1 else q_atoms_tm1[0]
+    target_z = r_t[..., None] + d_t[..., None] * q_atoms_t  # [B, M] via broadcast
+    target_z = jnp.broadcast_to(target_z, r_t.shape + (num_atoms,))
     probs_t = jax.nn.softmax(q_logits_t, axis=-1)  # [B, A, M]
     probs_best = jnp.take_along_axis(probs_t, best_a[..., None, None].repeat(num_atoms, -1), axis=-2)[
         ..., 0, :
     ]  # [B, M]
-    target = categorical_l2_project(target_z, probs_best, q_atoms_tm1[0])
+    target = categorical_l2_project(target_z, probs_best, z_q)
     logits_a = jnp.take_along_axis(
         q_logits_tm1, a_tm1[..., None, None].repeat(num_atoms, -1), axis=-2
     )[..., 0, :]

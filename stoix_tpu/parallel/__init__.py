@@ -1,0 +1,27 @@
+from stoix_tpu.parallel.distributed import (
+    is_coordinator,
+    maybe_initialize_distributed,
+    process_allgather,
+)
+from stoix_tpu.parallel.mesh import (
+    assemble_global_array,
+    axis_size,
+    create_mesh,
+    data_sharding,
+    replicate,
+    replicated_sharding,
+    shard_leading_axis,
+)
+
+__all__ = [
+    "is_coordinator",
+    "maybe_initialize_distributed",
+    "process_allgather",
+    "assemble_global_array",
+    "axis_size",
+    "create_mesh",
+    "data_sharding",
+    "replicate",
+    "replicated_sharding",
+    "shard_leading_axis",
+]

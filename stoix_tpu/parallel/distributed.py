@@ -1,0 +1,64 @@
+"""Multi-host initialisation and host-side coordination.
+
+The reference explicitly does not support multi-host (reference
+sebulba/ff_ppo.py:808-810 asserts local == global devices; README.md:57).
+Here multi-host is first-class: call `maybe_initialize_distributed()` before
+any JAX computation; the global mesh then spans all processes and collectives
+ride ICI within a slice / DCN across slices automatically via shardings.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def maybe_initialize_distributed(config: Optional[Any] = None) -> None:
+    """Initialise jax.distributed when running under a multi-process launcher.
+
+    Controlled by (in priority order) config.arch.distributed fields or the
+    standard env vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID, or a cloud-TPU environment where jax.distributed can
+    auto-detect). No-op for single-process runs.
+    """
+    dist_cfg = None
+    if config is not None:
+        dist_cfg = getattr(getattr(config, "arch", None), "distributed", None)
+
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if dist_cfg and dist_cfg.get("coordinator_address"):
+        coordinator = dist_cfg["coordinator_address"]
+
+    if coordinator is None:
+        return  # single process (or an environment where auto-detect is unsafe)
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(
+            (dist_cfg or {}).get("num_processes", os.environ.get("JAX_NUM_PROCESSES", 1))
+        ),
+        process_id=int(
+            (dist_cfg or {}).get("process_id", os.environ.get("JAX_PROCESS_ID", 0))
+        ),
+    )
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — gate logging/checkpointing/eval-printing on this."""
+    return jax.process_index() == 0
+
+
+def process_allgather(x: Any) -> Any:
+    """Gather host-local values across processes (fully-replicated result).
+
+    Equivalent to jax.experimental.multihost_utils.process_allgather; used for
+    cross-host metric aggregation in the host loop.
+    """
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)

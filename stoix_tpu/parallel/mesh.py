@@ -1,0 +1,96 @@
+"""Global device mesh + sharding helpers — the heart of the TPU-native design.
+
+The reference distributes with single-host `jax.pmap(axis_name="device")` and a
+nested `vmap(axis_name="batch")` (reference ff_ppo.py:361-365,487-489,
+SURVEY.md §2.3). Here there is ONE global `jax.sharding.Mesh` spanning every
+chip in the job (multi-host included) with named axes:
+
+    "data"   — environment / batch sharding; gradients pmean over it, riding
+               ICI within a slice and DCN across slices.
+    (more axes — "model", "sequence" — can be added per system; helpers below
+    are axis-generic.)
+
+Learner steps are written per-shard and wrapped with `jax.shard_map`; inputs
+and learner state live as global arrays with NamedShardings, so checkpointing
+saves globals directly and there is no `unreplicate_*` dance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a Mesh from {axis_name: size}; one size may be -1 (inferred).
+
+    Defaults to a pure data-parallel mesh over all devices in the job
+    (jax.devices() is global across hosts after jax.distributed.initialize).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"data": -1})
+    sizes = list(axes.values())
+    n = len(devices)
+    if sizes.count(-1) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
+        if n % known != 0:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"Mesh axes {dict(zip(axes, sizes))} do not cover {n} devices")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh, axis: str = "data", rank_axis: int = 0) -> NamedSharding:
+    """Shard leading (or given) array axis over a mesh axis."""
+    spec = [None] * (rank_axis + 1)
+    spec[rank_axis] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_leading_axis(tree: Any, mesh: Mesh, axis: str = "data") -> Any:
+    """Device-put a host pytree with its leading axis sharded over `axis`."""
+    sharding = NamedSharding(mesh, P(axis))
+
+    def put(x: Any) -> jax.Array:
+        x = jax.numpy.asarray(x)
+        spec = P(*([axis] + [None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, tree)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(jax.numpy.asarray(x), sharding), tree)
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return int(mesh.shape[axis])
+
+
+def assemble_global_array(
+    per_device_arrays: Sequence[jax.Array], mesh: Mesh, axis: str = "data"
+) -> jax.Array:
+    """Build one global array from per-device shards without host concat —
+    the Sebulba trajectory hand-off primitive (replaces the reference's
+    `jax.device_put_sharded`, sebulba/ff_ppo.py:263; see SURVEY.md §7.1.3).
+    """
+    shard = per_device_arrays[0]
+    global_shape = (shard.shape[0] * len(per_device_arrays),) + shard.shape[1:]
+    spec = P(*([axis] + [None] * (shard.ndim - 1)))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, NamedSharding(mesh, spec), list(per_device_arrays)
+    )

@@ -1,0 +1,459 @@
+"""Anakin PPO (discrete) — THE canonical system template.
+
+Behavioral parity: reference stoix/systems/ppo/anakin/ff_ppo.py (731 LoC) —
+single-file layout with get_learner_fn / learner_setup / run_experiment /
+entry point, truncation-aware GAE from per-step bootstrap values
+(reference ff_ppo.py:96-179), epoch/minibatch SGD scans (:296-334), optional
+observation normalization (:90-94,145-162).
+
+TPU-native redesign (SURVEY.md §7.1):
+  - ONE global `jax.sharding.Mesh` ("data" axis) replaces
+    pmap(axis="device") + replicate/unreplicate. The learner step is written
+    per-shard and wrapped in `jax.shard_map`; gradient sync is an explicit
+    `lax.pmean` over ("batch", "data") riding ICI/DCN.
+  - `arch.update_batch_size` (U) is an in-shard vmap with axis_name "batch"
+    (reference's nested vmap, ff_ppo.py:361), params carrying a leading [U]
+    axis that stays replicated across the mesh.
+  - Bootstrap values for extras["next_obs"] are computed in ONE batched
+    critic apply over the whole [T, E] rollout after the scan instead of per
+    step — bigger matmuls for the MXU, identical math.
+  - Learner state lives as global sharded arrays; checkpointing saves them
+    directly; there is no unreplicate dance.
+
+Layout (S = data shards, U = update batch, E = envs per (shard, batch)):
+  params/opt_states:      [U, ...]        P()        (replicated)
+  key:                    [S, U, 2]       P("data")
+  env_state / timestep:   [U, S*E, ...]   P(None, "data")
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from stoix_tpu import base_types, envs
+from stoix_tpu.base_types import (
+    ActorCriticOptStates,
+    ActorCriticParams,
+    ExperimentOutput,
+    OnPolicyLearnerState,
+    PPOTransition,
+)
+from stoix_tpu.evaluator import evaluator_setup, get_distribution_act_fn
+from stoix_tpu.ops import losses
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.parallel import create_mesh, maybe_initialize_distributed, is_coordinator
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.jax_utils import count_parameters, tree_merge_leading_dims
+from stoix_tpu.utils.logger import LogEvent, StoixLogger
+from stoix_tpu.utils.checkpointing import checkpointer_from_config
+from stoix_tpu.utils.timestep_checker import check_total_timesteps
+from stoix_tpu.utils.training import make_learning_rate
+
+
+def get_learner_fn(
+    env: envs.Environment,
+    apply_fns: Tuple[Callable, Callable],
+    update_fns: Tuple[Callable, Callable],
+    config: Any,
+) -> Callable[[OnPolicyLearnerState], ExperimentOutput]:
+    """Build the PER-SHARD learner function (wrapped in shard_map by setup)."""
+
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+    reward_scale = float(config.system.get("reward_scale", 1.0))
+
+    def _env_step(learner_state: OnPolicyLearnerState, _: Any):
+        params, opt_states, key, env_state, last_timestep = learner_state
+        key, policy_key = jax.random.split(key)
+
+        actor_policy = actor_apply(params.actor_params, last_timestep.observation)
+        value = critic_apply(params.critic_params, last_timestep.observation)
+        action = actor_policy.sample(seed=policy_key)
+        log_prob = actor_policy.log_prob(action)
+
+        env_state, timestep = env.step(env_state, action)
+
+        done = timestep.discount == 0.0
+        truncated = jnp.logical_and(timestep.last(), timestep.discount != 0.0)
+        transition = PPOTransition(
+            done=done,
+            truncated=truncated,
+            action=action,
+            value=value,
+            reward=timestep.reward,
+            log_prob=log_prob,
+            obs=last_timestep.observation,
+            next_obs=timestep.extras["next_obs"],
+            info=timestep.extras["episode_metrics"],
+        )
+        return (
+            OnPolicyLearnerState(params, opt_states, key, env_state, timestep),
+            transition,
+        )
+
+    def _actor_loss_fn(actor_params, obs, action, old_log_prob, gae):
+        actor_policy = actor_apply(actor_params, obs)
+        log_prob = actor_policy.log_prob(action)
+        loss_actor = losses.ppo_clip_loss(
+            log_prob, old_log_prob, gae, float(config.system.clip_eps)
+        )
+        entropy = actor_policy.entropy().mean()
+        total = loss_actor - float(config.system.ent_coef) * entropy
+        return total, (loss_actor, entropy)
+
+    def _critic_loss_fn(critic_params, obs, targets, old_value):
+        value = critic_apply(critic_params, obs)
+        if config.system.get("clip_value", True):
+            value_loss = losses.clipped_value_loss(
+                value, old_value, targets, float(config.system.clip_eps)
+            )
+        else:
+            value_loss = jnp.mean((value - targets) ** 2)
+        return float(config.system.vf_coef) * value_loss, value_loss
+
+    def _update_minibatch(train_state: Tuple, batch_info: Tuple):
+        params, opt_states = train_state
+        traj_batch, advantages, targets = batch_info
+
+        actor_grad_fn = jax.grad(_actor_loss_fn, has_aux=True)
+        actor_grads, (loss_actor, entropy) = actor_grad_fn(
+            params.actor_params,
+            traj_batch.obs,
+            traj_batch.action,
+            traj_batch.log_prob,
+            advantages,
+        )
+        critic_grad_fn = jax.grad(_critic_loss_fn, has_aux=True)
+        critic_grads, value_loss = critic_grad_fn(
+            params.critic_params, traj_batch.obs, targets, traj_batch.value
+        )
+
+        # Gradient sync: mean over the in-shard update-batch vmap axis, then
+        # the mesh data axis (the latter rides ICI/DCN).
+        actor_grads = jax.lax.pmean(actor_grads, axis_name="batch")
+        actor_grads = jax.lax.pmean(actor_grads, axis_name="data")
+        critic_grads = jax.lax.pmean(critic_grads, axis_name="batch")
+        critic_grads = jax.lax.pmean(critic_grads, axis_name="data")
+
+        actor_updates, actor_opt_state = actor_update(
+            actor_grads, opt_states.actor_opt_state
+        )
+        actor_params = optax.apply_updates(params.actor_params, actor_updates)
+        critic_updates, critic_opt_state = critic_update(
+            critic_grads, opt_states.critic_opt_state
+        )
+        critic_params = optax.apply_updates(params.critic_params, critic_updates)
+
+        loss_info = {
+            "total_loss": loss_actor + value_loss,
+            "actor_loss": loss_actor,
+            "value_loss": value_loss,
+            "entropy": entropy,
+        }
+        return (
+            ActorCriticParams(actor_params, critic_params),
+            ActorCriticOptStates(actor_opt_state, critic_opt_state),
+        ), loss_info
+
+    def _update_epoch(update_state: Tuple, _: Any):
+        params, opt_states, traj_batch, advantages, targets, key = update_state
+        key, shuffle_key = jax.random.split(key)
+
+        # Flatten [T, E] -> [T*E] and shuffle across both time and envs.
+        batch_size = advantages.shape[0] * advantages.shape[1]
+        permutation = jax.random.permutation(shuffle_key, batch_size)
+        flat = tree_merge_leading_dims((traj_batch, advantages, targets), 2)
+        shuffled = jax.tree.map(lambda x: jnp.take(x, permutation, axis=0), flat)
+        minibatches = jax.tree.map(
+            lambda x: x.reshape(
+                (int(config.system.num_minibatches), -1) + x.shape[1:]
+            ),
+            shuffled,
+        )
+        (params, opt_states), loss_info = jax.lax.scan(
+            _update_minibatch, (params, opt_states), minibatches
+        )
+        return (params, opt_states, traj_batch, advantages, targets, key), loss_info
+
+    def _update_step(learner_state: OnPolicyLearnerState, _: Any):
+        learner_state, traj_batch = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep = learner_state
+
+        # ONE batched critic apply for all bootstrap values [T, E].
+        v_t = critic_apply(params.critic_params, traj_batch.next_obs)
+
+        d_t = gamma * (1.0 - traj_batch.done.astype(jnp.float32))
+        advantages, targets = truncated_generalized_advantage_estimation(
+            traj_batch.reward * reward_scale,
+            d_t,
+            float(config.system.gae_lambda),
+            v_tm1=traj_batch.value,
+            v_t=v_t,
+            truncation_t=traj_batch.truncated.astype(jnp.float32),
+            standardize_advantages=bool(config.system.get("standardize_advantages", True)),
+        )
+
+        update_state = (params, opt_states, traj_batch, advantages, targets, key)
+        update_state, loss_info = jax.lax.scan(
+            _update_epoch, update_state, None, int(config.system.epochs)
+        )
+        params, opt_states, _, _, _, key = update_state
+        learner_state = OnPolicyLearnerState(
+            params, opt_states, key, env_state, last_timestep
+        )
+        return learner_state, (traj_batch.info, loss_info)
+
+    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+        """Per-shard learner: scans vmapped update steps for one eval period."""
+        key = learner_state.key[0]  # [S=1 slice, U, 2] -> [U, 2]
+        state = learner_state._replace(key=key)
+
+        batched_update_step = jax.vmap(_update_step, axis_name="batch")
+        state, (episode_info, loss_info) = jax.lax.scan(
+            batched_update_step, state, None, int(config.arch.num_updates_per_eval)
+        )
+
+        state = state._replace(key=state.key[None])  # restore [1, U, 2]
+        # Losses are identical across shards post-pmean of grads only in
+        # expectation; reduce them globally so P() outputs are truly replicated.
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(
+            learner_state=state,
+            episode_metrics=episode_info,
+            train_metrics=loss_info,
+        )
+
+    return learner_fn
+
+
+def learner_setup(
+    env: envs.Environment, config: Any, mesh: Mesh, keys: jax.Array
+) -> Tuple[Callable, Callable, OnPolicyLearnerState]:
+    """Instantiate networks/optimizers, build the shard_mapped learner, and
+    initialise the (globally sharded) learner state."""
+
+    num_actions = env.num_actions
+    config.system.action_dim = num_actions
+
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
+
+    net_cfg = config.network
+    actor_network = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head, num_actions=num_actions
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic_network = FeedForwardCritic(
+        critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+        torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+
+    actor_lr = make_learning_rate(
+        float(config.system.actor_lr), config, int(config.system.epochs),
+        int(config.system.num_minibatches),
+    )
+    critic_lr = make_learning_rate(
+        float(config.system.critic_lr), config, int(config.system.epochs),
+        int(config.system.num_minibatches),
+    )
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(actor_lr, eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(critic_lr, eps=1e-5),
+    )
+
+    key, actor_key, critic_key, env_key = jax.random.split(keys, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    actor_params = actor_network.init(actor_key, dummy_obs)
+    critic_params = critic_network.init(critic_key, dummy_obs)
+    actor_opt_state = actor_optim.init(actor_params)
+    critic_opt_state = critic_optim.init(critic_params)
+
+    apply_fns = (actor_network.apply, critic_network.apply)
+    update_fns = (actor_optim.update, critic_optim.update)
+    learn_per_shard = get_learner_fn(env, apply_fns, update_fns, config)
+
+    # ---- Global learner-state construction ---------------------------------
+    n_shards = int(mesh.shape["data"])
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    envs_axis = int(config.arch.total_num_envs) // update_batch  # S * E
+
+    state_specs = OnPolicyLearnerState(
+        params=P(),
+        opt_states=P(),
+        key=P("data"),
+        env_state=P(None, "data"),
+        timestep=P(None, "data"),
+    )
+
+    # Broadcast params over the update-batch axis.
+    broadcast = lambda x: jnp.broadcast_to(x, (update_batch,) + x.shape)
+    params = jax.tree.map(broadcast, ActorCriticParams(actor_params, critic_params))
+    opt_states = jax.tree.map(
+        broadcast, ActorCriticOptStates(actor_opt_state, critic_opt_state)
+    )
+
+    # Reset all envs; shape leaves to [U, S*E, ...].
+    env_keys = jax.random.split(env_key, update_batch * envs_axis)
+    env_state, timestep = env.reset(env_keys)
+    reshape = lambda x: x.reshape((update_batch, envs_axis) + x.shape[1:])
+    env_state = jax.tree.map(reshape, env_state)
+    timestep = jax.tree.map(reshape, timestep)
+
+    step_keys = jax.random.split(key, n_shards * update_batch).reshape(
+        n_shards, update_batch, -1
+    )
+
+    learner_state = OnPolicyLearnerState(
+        params=params,
+        opt_states=opt_states,
+        key=step_keys,
+        env_state=env_state,
+        timestep=timestep,
+    )
+    # Place as global sharded arrays.
+    learner_state = jax.tree.map(
+        lambda x, spec_tree=None: x, learner_state
+    )
+    learner_state = jax.device_put(
+        learner_state,
+        jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            state_specs,
+            is_leaf=lambda s: isinstance(s, P),
+        ),
+    )
+
+    learn = jax.jit(
+        jax.shard_map(
+            learn_per_shard,
+            mesh=mesh,
+            in_specs=(state_specs,),
+            out_specs=ExperimentOutput(
+                learner_state=state_specs,
+                episode_metrics=P(None, None, None, "data"),
+                train_metrics=P(),
+            ),
+            # pmean over the in-shard vmap axis ("batch") trips shard_map's
+            # varying-manual-axes validation; the collectives are correct.
+            check_vma=False,
+        )
+    )
+
+    if is_coordinator():
+        n_params = count_parameters(actor_params) + count_parameters(critic_params)
+        print(f"[setup] {n_params:,} parameters | mesh {dict(mesh.shape)} | "
+              f"{config.arch.total_num_envs} global envs")
+
+    return learn, apply_fns, learner_state
+
+
+def run_experiment(config: Any) -> float:
+    """Train Anakin PPO; returns the final evaluation episode-return mean."""
+    maybe_initialize_distributed(config)
+    mesh = create_mesh(dict(config.arch.get("mesh") or {"data": -1}))
+    config = check_total_timesteps(config, int(mesh.shape["data"]))
+    config.logger.system_name = config.system.system_name
+
+    env, eval_env = envs.make(config)
+
+    key = jax.random.PRNGKey(int(config.arch.seed))
+    key, setup_key, eval_key = jax.random.split(key, 3)
+    learn, apply_fns, learner_state = learner_setup(env, config, mesh, setup_key)
+
+    act_fn = get_distribution_act_fn(config, apply_fns[0])
+    evaluator, absolute_evaluator = evaluator_setup(eval_env, act_fn, config, mesh)
+
+    logger = StoixLogger(config)
+    checkpointer = checkpointer_from_config(config, config.system.system_name)
+
+    steps_per_eval = (
+        int(config.system.rollout_length)
+        * int(config.arch.total_num_envs)
+        * int(config.arch.num_updates_per_eval)
+    )
+
+    best_params = jax.tree.map(lambda x: x[0], learner_state.params.actor_params)
+    best_return = -jnp.inf
+    final_return = 0.0
+
+    for eval_idx in range(int(config.arch.num_evaluation)):
+        start = time.time()
+        output = learn(learner_state)
+        jax.block_until_ready(output.learner_state)
+        learner_state = output.learner_state
+        elapsed = time.time() - start
+        t = (eval_idx + 1) * steps_per_eval
+
+        episode_metrics = envs.get_final_step_metrics(
+            {k: v for k, v in output.episode_metrics.items()}
+        )
+        sps = steps_per_eval / elapsed
+        if is_coordinator():
+            logger.log(
+                {**episode_metrics, "steps_per_second": sps}, t, eval_idx, LogEvent.ACT
+            )
+            logger.log(
+                jax.tree.map(lambda x: jnp.mean(x), output.train_metrics),
+                t,
+                eval_idx,
+                LogEvent.TRAIN,
+            )
+
+        trained_params = jax.tree.map(lambda x: x[0], learner_state.params.actor_params)
+        key, ek = jax.random.split(key)
+        eval_metrics = evaluator(trained_params, ek)
+        jax.block_until_ready(eval_metrics)
+        if is_coordinator():
+            logger.log(eval_metrics, t, eval_idx, LogEvent.EVAL)
+
+        mean_return = float(jnp.mean(eval_metrics["episode_return"]))
+        final_return = mean_return
+        if mean_return >= float(best_return):
+            best_return = mean_return
+            best_params = jax.tree.map(jnp.copy, trained_params)
+
+        if checkpointer is not None and is_coordinator():
+            checkpointer.save(t, learner_state, mean_return)
+
+    if bool(config.arch.get("absolute_metric", True)):
+        key, ek = jax.random.split(key)
+        abs_metrics = absolute_evaluator(best_params, ek)
+        jax.block_until_ready(abs_metrics)
+        if is_coordinator():
+            logger.log(abs_metrics, int(config.arch.total_timesteps),
+                       int(config.arch.num_evaluation), LogEvent.ABSOLUTE)
+        final_return = float(jnp.mean(abs_metrics["episode_return"]))
+
+    logger.close()
+    return final_return
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from stoix_tpu.utils.config import Config, compose, default_config_dir, instantiate
+
+__all__ = ["Config", "compose", "default_config_dir", "instantiate"]

@@ -1,0 +1,97 @@
+"""Orbax-backed checkpointing (reference stoix/utils/checkpointing.py:20-187).
+
+Saves learner state keyed by timestep with best-by-episode-return tracking and
+config-as-metadata with a major-version compatibility check. TPU-native
+difference from the reference: states are GLOBAL (sharded) arrays — orbax
+handles sharded save/restore natively, so there is no unreplicate step
+(SURVEY.md §7.1.1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+CHECKPOINTER_VERSION = 1.0
+
+
+class Checkpointer:
+    def __init__(
+        self,
+        model_name: str,
+        metadata: Optional[dict] = None,
+        rel_dir: str = "checkpoints",
+        checkpoint_uid: Optional[str] = None,
+        save_interval_steps: int = 1,
+        max_to_keep: Optional[int] = 1,
+        keep_period: Optional[int] = None,
+    ):
+        import time
+
+        uid = checkpoint_uid or time.strftime("%Y%m%d%H%M%S")
+        self.directory = os.path.abspath(os.path.join(rel_dir, uid, model_name))
+        options = ocp.CheckpointManagerOptions(
+            save_interval_steps=save_interval_steps,
+            max_to_keep=max_to_keep,
+            keep_period=keep_period,
+            best_fn=lambda m: m["episode_return"],
+            best_mode="max",
+            create=True,
+        )
+        metadata = dict(metadata or {})
+        metadata["checkpointer_version"] = CHECKPOINTER_VERSION
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=options,
+            metadata=json.loads(json.dumps(metadata, default=str)),
+        )
+
+    def save(self, timestep: int, state: Any, episode_return: float = 0.0) -> bool:
+        return self._manager.save(
+            timestep,
+            args=ocp.args.StandardSave(jax.tree.map(jax.numpy.asarray, state)),
+            metrics={"episode_return": float(episode_return)},
+        )
+
+    def restore(self, template: Any, timestep: Optional[int] = None) -> Tuple[Any, int]:
+        """Restore into the shape/sharding of `template`; returns (state, step)."""
+        step = timestep if timestep is not None else self._manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoints under {self.directory}")
+        restored = self._manager.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+        return restored, int(step)
+
+    def get_metadata(self) -> dict:
+        return dict(self._manager.metadata() or {})
+
+    def check_version(self) -> None:
+        meta = self.get_metadata()
+        saved = float(meta.get("checkpointer_version", CHECKPOINTER_VERSION))
+        if int(saved) != int(CHECKPOINTER_VERSION):
+            raise ValueError(
+                f"Checkpoint major version {saved} incompatible with {CHECKPOINTER_VERSION}"
+            )
+
+    def close(self) -> None:
+        self._manager.close()
+
+
+def checkpointer_from_config(config: Any, model_name: str) -> Optional[Checkpointer]:
+    ckpt_cfg = config.logger.checkpointing
+    if not ckpt_cfg.get("save_model", False):
+        return None
+    save_args = ckpt_cfg.get("save_args") or {}
+    return Checkpointer(
+        model_name=model_name,
+        metadata=config.to_dict() if hasattr(config, "to_dict") else dict(config),
+        checkpoint_uid=save_args.get("checkpoint_uid"),
+        save_interval_steps=int(save_args.get("save_interval_steps", 1)),
+        max_to_keep=save_args.get("max_to_keep", 1),
+        keep_period=save_args.get("keep_period"),
+    )

@@ -1,0 +1,44 @@
+"""Small JAX helpers (reference stoix/utils/jax_utils.py:12-115)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def scale_gradient(x: jax.Array, scale: float) -> jax.Array:
+    """Identity forward, gradient scaled by `scale` on the way back."""
+    return x * scale + jax.lax.stop_gradient(x) * (1.0 - scale)
+
+
+def count_parameters(params: Any) -> int:
+    return int(sum(jnp.size(leaf) for leaf in jax.tree.leaves(params)))
+
+
+def merge_leading_dims(x: jax.Array, num_dims: int) -> jax.Array:
+    return x.reshape((-1,) + x.shape[num_dims:])
+
+
+def tree_merge_leading_dims(tree: Any, num_dims: int) -> Any:
+    return jax.tree.map(lambda x: merge_leading_dims(x, num_dims), tree)
+
+
+def select_pytree(pred: jax.Array, on_true: Any, on_false: Any) -> Any:
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)
+
+
+def aot_compile(fn: Any, *example_args: Any) -> Any:
+    """Ahead-of-time trace/lower/compile with a FLOPs estimate printed
+    (reference jax_utils.py:68-115)."""
+    lowered = jax.jit(fn).lower(*example_args)
+    compiled = lowered.compile()
+    try:
+        cost = compiled.cost_analysis()
+        flops = cost.get("flops") if isinstance(cost, dict) else cost[0].get("flops")
+        if flops:
+            print(f"[aot] estimated FLOPs/call: {flops:.3e}")
+    except Exception:
+        pass
+    return compiled

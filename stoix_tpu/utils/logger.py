@@ -1,0 +1,187 @@
+"""Multi-sink experiment logger (reference stoix/utils/logger.py:28-613).
+
+StoixLogger equivalent: thread-safe fan-out to Console / JSON (marl-eval
+layout) / TensorBoard sinks, toggled by config. Events ACT/TRAIN/EVAL/ABSOLUTE/
+MISC; non-TRAIN metrics get mean/std/min/max description; optional solve-rate
+metric from `env.solved_return_threshold`. W&B/Neptune are not bundled in this
+environment — the sink interface below is where they would plug in.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class LogEvent(enum.Enum):
+    ACT = "actor"
+    TRAIN = "trainer"
+    EVAL = "evaluator"
+    ABSOLUTE = "absolute"
+    MISC = "misc"
+
+
+def describe(x: Any) -> Dict[str, float]:
+    arr = np.asarray(x, dtype=np.float32).reshape(-1)
+    if arr.size == 0:
+        return {}
+    return {
+        "mean": float(arr.mean()),
+        "std": float(arr.std()),
+        "min": float(arr.min()),
+        "max": float(arr.max()),
+    }
+
+
+class BaseSink:
+    def write(self, metrics: Dict[str, float], t: int, t_eval: int, event: LogEvent) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class ConsoleSink(BaseSink):
+    _COLOURS = {
+        LogEvent.ACT: "\033[95m",
+        LogEvent.TRAIN: "\033[94m",
+        LogEvent.EVAL: "\033[92m",
+        LogEvent.ABSOLUTE: "\033[93m",
+        LogEvent.MISC: "\033[96m",
+    }
+
+    def write(self, metrics: Dict[str, float], t: int, t_eval: int, event: LogEvent) -> None:
+        colour = self._COLOURS.get(event, "")
+        parts = " | ".join(
+            f"{k.replace('_', ' ').title()}: {v:.3f}" if isinstance(v, float) else f"{k}: {v}"
+            for k, v in sorted(metrics.items())
+        )
+        print(f"{colour}[{event.value.upper()} t={t}]\033[0m {parts}", flush=True)
+
+
+class JsonSink(BaseSink):
+    """marl-eval-compatible JSON logging (reference logger.py:325-386): nested
+    {env}/{task}/{system}/seed_{n} with per-eval-step metric lists, restricted
+    to episode_return / solve-rate / steps_per_second on EVAL/ABSOLUTE events.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        env_name: str,
+        task_name: str,
+        system_name: str,
+        seed: int,
+    ):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        self._keys = (env_name, task_name, system_name, f"seed_{seed}")
+        self._data: Dict[str, Any] = {}
+        node = self._data
+        for k in self._keys[:-1]:
+            node = node.setdefault(k, {})
+        node[self._keys[-1]] = {}
+
+    def _leaf(self) -> Dict[str, Any]:
+        node = self._data
+        for k in self._keys[:-1]:
+            node = node[k]
+        return node[self._keys[-1]]
+
+    def write(self, metrics: Dict[str, float], t: int, t_eval: int, event: LogEvent) -> None:
+        if event not in (LogEvent.EVAL, LogEvent.ABSOLUTE):
+            return
+        leaf = self._leaf()
+        step_key = "absolute_metrics" if event == LogEvent.ABSOLUTE else f"step_{t_eval}"
+        entry = leaf.setdefault(step_key, {"step_count": t})
+        for k, v in metrics.items():
+            if k.startswith("episode_return") or k in ("solve_rate", "steps_per_second"):
+                entry.setdefault(k, []).append(float(v))
+        with open(self._path, "w") as f:
+            json.dump(self._data, f, indent=2)
+
+
+class TensorboardSink(BaseSink):
+    def __init__(self, logdir: str):
+        from torch.utils.tensorboard import SummaryWriter  # torch-cpu is bundled
+
+        self._writer = SummaryWriter(log_dir=logdir)
+
+    def write(self, metrics: Dict[str, float], t: int, t_eval: int, event: LogEvent) -> None:
+        for k, v in metrics.items():
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                self._writer.add_scalar(f"{event.value}/{k}", float(v), t)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class StoixLogger:
+    """Thread-safe fan-out logger. `log` accepts raw (possibly array-valued)
+    metrics; non-TRAIN events are described (mean/std/min/max)."""
+
+    def __init__(self, config: Any):
+        self._lock = threading.Lock()
+        self._sinks: List[BaseSink] = []
+        self._solve_threshold: Optional[float] = None
+        logger_cfg = config.logger
+        env_name = config.env.env_name
+        task_name = config.env.scenario.task_name
+        system_name = logger_cfg.get("system_name") or "system"
+        seed = int(config.arch.seed)
+        stamp = time.strftime("%Y%m%d%H%M%S")
+        exp_dir = os.path.join(
+            logger_cfg.base_exp_path, f"{system_name}", f"{task_name}", f"seed_{seed}_{stamp}"
+        )
+        self.exp_dir = exp_dir
+
+        if logger_cfg.get("use_console", True):
+            self._sinks.append(ConsoleSink())
+        if logger_cfg.get("use_json", False):
+            json_path = (logger_cfg.get("kwargs") or {}).get("json_path") or os.path.join(
+                exp_dir, "metrics.json"
+            )
+            self._sinks.append(JsonSink(json_path, env_name, task_name, system_name, seed))
+        if logger_cfg.get("use_tb", False):
+            self._sinks.append(TensorboardSink(os.path.join(exp_dir, "tb")))
+
+        self._solve_threshold = config.env.get("solved_return_threshold")
+
+    def log(self, metrics: Dict[str, Any], t: int, t_eval: int, event: LogEvent) -> None:
+        processed: Dict[str, float] = {}
+        for k, v in metrics.items():
+            arr = np.asarray(v)
+            if arr.size == 0:
+                continue
+            if event == LogEvent.TRAIN or arr.size == 1:
+                processed[k] = float(arr.mean())
+            else:
+                for stat, val in describe(arr).items():
+                    processed[f"{k}/{stat}"] = val
+
+        # Solve-rate custom metric (reference logger.py:36-74).
+        if (
+            self._solve_threshold is not None
+            and event in (LogEvent.EVAL, LogEvent.ABSOLUTE)
+            and "episode_return" in metrics
+        ):
+            returns = np.asarray(metrics["episode_return"]).reshape(-1)
+            if returns.size:
+                processed["solve_rate"] = float(
+                    (returns >= self._solve_threshold).mean() * 100.0
+                )
+
+        with self._lock:
+            for sink in self._sinks:
+                sink.write(processed, t, t_eval, event)
+
+    def close(self) -> None:
+        with self._lock:
+            for sink in self._sinks:
+                sink.close()

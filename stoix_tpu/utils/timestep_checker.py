@@ -1,0 +1,52 @@
+"""Budget arithmetic: reconcile total_timesteps <-> num_updates and derive
+per-shard env counts (reference stoix/utils/total_timestep_checker.py:9-318).
+
+Anakin accounting (per update):
+    steps_per_update = rollout_length * total_num_envs
+    num_updates      = total_timesteps // steps_per_update
+
+`total_num_envs` is GLOBAL; each data shard runs
+total_num_envs / (num_data_shards * update_batch_size) envs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def check_total_timesteps(config: Any, num_data_shards: int) -> Any:
+    arch = config.arch
+    system = config.system
+
+    update_batch_size = int(arch.get("update_batch_size", 1))
+    total_num_envs = int(arch.total_num_envs)
+    divisor = num_data_shards * update_batch_size
+    if total_num_envs % divisor != 0:
+        raise ValueError(
+            f"arch.total_num_envs ({total_num_envs}) must be divisible by "
+            f"num_data_shards * update_batch_size ({num_data_shards} * {update_batch_size})"
+        )
+    arch.num_envs_per_shard = total_num_envs // divisor
+
+    steps_per_update = int(system.rollout_length) * total_num_envs
+    if arch.get("num_updates") in (None, "~"):
+        assert arch.get("total_timesteps") is not None, (
+            "Set either arch.total_timesteps or arch.num_updates"
+        )
+        arch.num_updates = max(1, int(float(arch.total_timesteps)) // steps_per_update)
+    requested = arch.get("total_timesteps")
+    arch.total_timesteps = int(arch.num_updates) * steps_per_update
+    if requested is not None and int(float(requested)) != arch.total_timesteps:
+        print(
+            f"[timestep-check] total_timesteps adjusted {int(float(requested))} -> "
+            f"{arch.total_timesteps} (num_updates={arch.num_updates}, "
+            f"steps/update={steps_per_update})"
+        )
+
+    num_evaluation = max(1, int(arch.get("num_evaluation", 1)))
+    if int(arch.num_updates) % num_evaluation != 0:
+        num_evaluation = 1
+        print("[timestep-check] num_updates not divisible by num_evaluation; using 1 eval")
+    arch.num_evaluation = num_evaluation
+    arch.num_updates_per_eval = int(arch.num_updates) // num_evaluation
+    return config

@@ -1,0 +1,36 @@
+"""Rolling-window wall-clock timers — the Sebulba profiling backbone
+(reference stoix/utils/timing_utils.py:8-132)."""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+
+class TimingTracker:
+    def __init__(self, maxlen: int = 10):
+        self._maxlen = maxlen
+        self._times: Dict[str, deque] = {}
+
+    @contextmanager
+    def time(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._times.setdefault(name, deque(maxlen=self._maxlen)).append(
+                time.perf_counter() - start
+            )
+
+    def mean(self, name: str) -> float:
+        times = self._times.get(name)
+        return sum(times) / len(times) if times else 0.0
+
+    def latest(self, name: str) -> float:
+        times = self._times.get(name)
+        return times[-1] if times else 0.0
+
+    def all_means(self, prefix: str = "") -> Dict[str, float]:
+        return {f"{prefix}{k}_time": self.mean(k) for k in self._times}

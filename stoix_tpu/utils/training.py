@@ -1,0 +1,21 @@
+"""Optimizer schedules (reference stoix/utils/training.py:6-53)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Union
+
+import optax
+
+
+def make_learning_rate(
+    init_lr: float,
+    config: Any,
+    epochs: int = 1,
+    num_minibatches: int = 1,
+) -> Union[float, Callable[[int], float]]:
+    """Constant LR, or linear decay to 0 over every optimizer step of the run
+    when `system.decay_learning_rates` is set."""
+    if not config.system.get("decay_learning_rates", False):
+        return init_lr
+    total_steps = int(config.arch.num_updates) * int(epochs) * int(num_minibatches)
+    return optax.linear_schedule(init_lr, 0.0, max(1, total_steps))

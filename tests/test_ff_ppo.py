@@ -1,0 +1,60 @@
+"""End-to-end system test: Anakin PPO must LEARN on the 8-device mesh.
+
+IdentityGame (optimal return == episode_length) is the fast correctness
+oracle: PPO reaching near-optimal return proves the full stack — env sharding,
+shard_map learner, GAE bootstrapping, gradient pmean, evaluator — is wired
+correctly. (A plumbing bug anywhere shows up as no learning.)
+"""
+
+import jax
+import pytest
+
+from stoix_tpu.systems.ppo.anakin.ff_ppo import run_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def make_config(overrides):
+    return config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml", overrides
+    )
+
+
+@pytest.mark.slow
+def test_ppo_learns_identity_game(devices):
+    cfg = make_config(
+        [
+            "env=identity_game",
+            "arch.total_num_envs=64",
+            "arch.total_timesteps=65536",
+            "arch.num_evaluation=1",
+            "arch.num_eval_episodes=32",
+            "arch.evaluation_greedy=True",
+            "arch.absolute_metric=False",
+            "system.rollout_length=16",
+            "system.epochs=4",
+            "logger.use_console=False",
+        ]
+    )
+    final_return = run_experiment(cfg)
+    # Optimal is 10.0; an unwired learner scores ~2.5 (random over 4 actions).
+    assert final_return > 8.0, f"PPO failed to learn IdentityGame: {final_return}"
+
+
+@pytest.mark.slow
+def test_ppo_update_batch_size_runs(devices):
+    # update_batch_size > 1 exercises the in-shard "batch" vmap + pmean path.
+    cfg = make_config(
+        [
+            "env=identity_game",
+            "arch.total_num_envs=64",
+            "arch.update_batch_size=2",
+            "arch.total_timesteps=8192",
+            "arch.num_evaluation=1",
+            "arch.num_eval_episodes=8",
+            "arch.absolute_metric=False",
+            "system.rollout_length=8",
+            "logger.use_console=False",
+        ]
+    )
+    final_return = run_experiment(cfg)
+    assert final_return == final_return  # finite, ran to completion

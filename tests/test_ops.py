@@ -247,3 +247,23 @@ def test_running_statistics_psum_over_mesh(devices):
     np.testing.assert_allclose(sharded.mean, batch.mean(0), atol=1e-4)
     np.testing.assert_allclose(sharded.std, batch.std(0), atol=1e-4)
     np.testing.assert_allclose(sharded.count, 64.0, atol=1e-6)
+
+
+def test_epsilon_greedy_respects_mask():
+    # Greedy mass must land on the best LEGAL action; mode must be legal.
+    d = dists.EpsilonGreedy(jnp.array([5.0, 1.0, 2.0]), 0.1, mask=jnp.array([0.0, 1.0, 1.0]))
+    assert int(d.mode()) == 2
+    np.testing.assert_allclose(d.probs, [0.0, 0.05, 0.95], atol=1e-3)
+    g = dists.Greedy(jnp.array([5.0, 1.0, 2.0]), mask=jnp.array([0.0, 1.0, 1.0]))
+    assert int(g.mode()) == 2
+
+
+def test_c51_loss_accepts_head_shaped_atoms():
+    B, A, M = 3, 2, 11
+    atoms = jnp.linspace(-1.0, 1.0, M)  # [M], as the heads return
+    logits = jnp.zeros((B, A, M))
+    loss = losses.categorical_double_q_learning(
+        logits, atoms, jnp.zeros(B, jnp.int32), jnp.zeros(B), jnp.ones(B) * 0.9,
+        logits, atoms, jnp.zeros((B, A)),
+    )
+    assert np.isfinite(float(loss))
