@@ -50,7 +50,8 @@ def main() -> None:
 
     env, _ = envs.make(config)
     key = jax.random.PRNGKey(0)
-    learn, _, learner_state = learner_setup(env, config, mesh, key)
+    setup = learner_setup(env, config, mesh, key)
+    learn, learner_state = setup.learn, setup.learner_state
 
     steps_per_call = (
         int(config.system.rollout_length)

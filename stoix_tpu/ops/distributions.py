@@ -183,6 +183,10 @@ class Independent(Distribution):
     def sample(self, *, seed: jax.Array) -> jax.Array:
         return self.distribution.sample(seed=seed)
 
+    def sample_and_log_prob(self, *, seed: jax.Array):
+        x, lp = self.distribution.sample_and_log_prob(seed=seed)
+        return x, self._reduce(lp)
+
     def log_prob(self, value: jax.Array) -> jax.Array:
         return self._reduce(self.distribution.log_prob(value))
 

@@ -1,0 +1,81 @@
+"""Anakin learner-construction helpers shared by system files.
+
+Encapsulates the mesh/layout conventions every Anakin system uses
+(see ff_ppo.py module docstring for the layout):
+
+  params/opt/buffer:   [U, ...]       P()          (replicated)
+  key:                 [S, U, 2]      P("data")
+  env_state/timestep:  [U, S*E, ...]  P(None, "data")
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import ExperimentOutput
+
+
+def broadcast_to_update_batch(tree: Any, update_batch: int) -> Any:
+    return jax.tree.map(lambda x: jnp.broadcast_to(x, (update_batch,) + x.shape), tree)
+
+
+def reset_envs_for_anakin(
+    env: envs.Environment, config: Any, env_key: jax.Array
+) -> Tuple[Any, Any]:
+    """Reset all global envs and shape leaves to [U, S*E, ...]."""
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    envs_axis = int(config.arch.total_num_envs) // update_batch
+    env_keys = jax.random.split(env_key, update_batch * envs_axis)
+    env_state, timestep = env.reset(env_keys)
+    reshape = lambda x: x.reshape((update_batch, envs_axis) + x.shape[1:])
+    return jax.tree.map(reshape, env_state), jax.tree.map(reshape, timestep)
+
+
+def make_step_keys(key: jax.Array, mesh: Mesh, config: Any) -> jax.Array:
+    n_shards = int(mesh.shape["data"])
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    return jax.random.split(key, n_shards * update_batch).reshape(n_shards, update_batch, -1)
+
+
+def place_learner_state(learner_state: Any, mesh: Mesh, state_specs: Any) -> Any:
+    """Device-put the state pytree with per-subtree PartitionSpecs."""
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), state_specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+    return jax.device_put(learner_state, shardings)
+
+
+def shardmap_learner(
+    learn_per_shard: Callable[[Any], ExperimentOutput],
+    mesh: Mesh,
+    state_specs: Any,
+    episode_metrics_spec: P = P(None, None, None, "data"),
+) -> Callable[[Any], ExperimentOutput]:
+    """Wrap a per-shard learner in shard_map + jit with the standard specs."""
+    return jax.jit(
+        jax.shard_map(
+            learn_per_shard,
+            mesh=mesh,
+            in_specs=(state_specs,),
+            out_specs=ExperimentOutput(
+                learner_state=state_specs,
+                episode_metrics=episode_metrics_spec,
+                train_metrics=P(),
+            ),
+            # pmean over the in-shard "batch" vmap axis and loop carries mixing
+            # replicated/varying leaves trip the VMA validator; collectives are
+            # correct (see ff_ppo).
+            check_vma=False,
+        )
+    )
+
+
+def unbatch_params(params: Any) -> Any:
+    """Strip the [U] update-batch axis (all replicas identical post-pmean)."""
+    return jax.tree.map(lambda x: x[0], params)

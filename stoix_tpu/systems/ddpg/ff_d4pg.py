@@ -1,0 +1,178 @@
+"""Anakin D4PG (reference stoix/systems/ddpg/ff_d4pg.py, 720 LoC).
+
+Distinctives: distributional critic over a fixed categorical support
+(DistributionalContinuousQNetwork head) trained with the categorical
+projection (categorical_td_learning on the bootstrapped support), deterministic
+actor ascending the expected-Q.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import OnlineAndTarget, Transition
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops.losses import categorical_l2_project
+from stoix_tpu.systems import anakin, off_policy_core as core
+from stoix_tpu.systems.ddpg.ff_ddpg import DDPGOptStates, DDPGParams
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.training import make_learning_rate
+
+
+def _build_networks(env: envs.Environment, config: Any):
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
+
+    action_space = env.action_space()
+    action_dim = env.num_actions
+    lo = float(jnp.min(jnp.asarray(action_space.low)))
+    hi = float(jnp.max(jnp.asarray(action_space.high)))
+
+    net_cfg = config.network
+    actor = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head, action_dim=action_dim, minimum=lo, maximum=hi
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic = FeedForwardCritic(
+        critic_head=config_lib.instantiate(
+            net_cfg.critic_network.critic_head,
+            num_atoms=int(config.system.get("num_atoms", 51)),
+            vmin=float(config.system.get("vmin", -100.0)),
+            vmax=float(config.system.get("vmax", 100.0)),
+        ),
+        torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+    return actor, critic, (lo, hi)
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array):
+    actor, critic, (act_lo, act_hi) = _build_networks(env, config)
+    config.system.action_dim = env.num_actions
+    gamma = float(config.system.gamma)
+    tau = float(config.system.tau)
+    noise_sigma = float(config.system.get("exploration_sigma", 0.1))
+
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+    q_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.q_lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+
+    key, actor_key, q_key, env_key = jax.random.split(key, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    dummy_act = jnp.asarray(env.action_value(), jnp.float32)[None]
+    actor_p = actor.init(actor_key, dummy_obs)
+    q_p = critic.init(q_key, dummy_obs, dummy_act)
+    params = DDPGParams(OnlineAndTarget(actor_p, actor_p), OnlineAndTarget(q_p, q_p))
+    opt_states = DDPGOptStates(actor_optim.init(actor_p), q_optim.init(q_p))
+
+    buffer, buffer_state = core.build_buffer(env, config, mesh)
+
+    def q_loss_fn(q_online, obs, action, target_probs):
+        _, logits, _ = critic.apply(q_online, obs, action)
+        ce = -jnp.sum(target_probs * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        loss = jnp.mean(ce)
+        return loss, {"q_loss": loss}
+
+    def actor_loss_fn(actor_online, q_online, obs):
+        action = actor.apply(actor_online, obs).mode()
+        q_value, _, _ = critic.apply(q_online, obs, action)
+        loss = -jnp.mean(q_value)
+        return loss, {"actor_loss": loss}
+
+    def update_from_batch(params: DDPGParams, opt_states: DDPGOptStates, batch: Transition, key):
+        next_action = actor.apply(params.actor_params.target, batch.next_obs).mode()
+        _, next_logits, atoms = critic.apply(
+            params.q_params.target, batch.next_obs, next_action
+        )
+        d_t = gamma * (1.0 - batch.done.astype(jnp.float32))
+        target_z = batch.reward[:, None] + d_t[:, None] * atoms[None, :]
+        target_probs = jax.lax.stop_gradient(
+            categorical_l2_project(target_z, jax.nn.softmax(next_logits, axis=-1), atoms)
+        )
+
+        q_grads, q_metrics = jax.grad(q_loss_fn, has_aux=True)(
+            params.q_params.online, batch.obs, batch.action, target_probs
+        )
+        q_grads = core.pmean_grads(q_grads)
+        q_updates, q_opt_state = q_optim.update(q_grads, opt_states.q_opt_state)
+        q_online = optax.apply_updates(params.q_params.online, q_updates)
+        q_target = optax.incremental_update(q_online, params.q_params.target, tau)
+
+        actor_grads, actor_metrics = jax.grad(actor_loss_fn, has_aux=True)(
+            params.actor_params.online, q_online, batch.obs
+        )
+        actor_grads = core.pmean_grads(actor_grads)
+        actor_updates, actor_opt_state = actor_optim.update(
+            actor_grads, opt_states.actor_opt_state
+        )
+        actor_online = optax.apply_updates(params.actor_params.online, actor_updates)
+        actor_target = optax.incremental_update(actor_online, params.actor_params.target, tau)
+
+        new_params = DDPGParams(
+            OnlineAndTarget(actor_online, actor_target), OnlineAndTarget(q_online, q_target)
+        )
+        return (new_params, DDPGOptStates(actor_opt_state, q_opt_state)), {
+            **q_metrics, **actor_metrics,
+        }
+
+    def act_in_env(params: DDPGParams, observation, key):
+        action = actor.apply(params.actor_params.online, observation).mode()
+        noise = jax.random.normal(key, action.shape) * noise_sigma * (act_hi - act_lo) / 2
+        return jnp.clip(action + noise, act_lo, act_hi)
+
+    learn_per_shard = core.standard_off_policy_learner(
+        env, buffer, config, update_from_batch, act_in_env
+    )
+    warmup_core_fn = core.get_random_warmup_fn(env, config, buffer.add)
+    learner_state, state_specs = core.assemble_off_policy_state(
+        config, mesh, env, params, opt_states, buffer_state, key, env_key
+    )
+    learn, warmup = core.wrap_learn_and_warmup(learn_per_shard, warmup_core_fn, mesh, state_specs)
+
+    setup = AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, actor.apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params.online),
+    )
+    return setup, warmup
+
+
+def run_experiment(config: Any) -> float:
+    holder = {}
+
+    def setup_fn(env, cfg, mesh, key):
+        setup, warmup = learner_setup(env, cfg, mesh, key)
+        holder["warmup"] = warmup
+        return setup
+
+    return run_anakin_experiment(config, setup_fn, warmup_fn=lambda s: holder["warmup"](s))
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_d4pg.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

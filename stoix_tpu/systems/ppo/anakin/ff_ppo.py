@@ -28,8 +28,7 @@ Layout (S = data shards, U = update batch, E = envs per (shard, batch)):
 
 from __future__ import annotations
 
-import time
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,15 +43,13 @@ from stoix_tpu.base_types import (
     OnPolicyLearnerState,
     PPOTransition,
 )
-from stoix_tpu.evaluator import evaluator_setup, get_distribution_act_fn
+from stoix_tpu.evaluator import get_distribution_act_fn
 from stoix_tpu.ops import losses
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
-from stoix_tpu.parallel import create_mesh, maybe_initialize_distributed, is_coordinator
+from stoix_tpu.parallel import is_coordinator
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.jax_utils import count_parameters, tree_merge_leading_dims
-from stoix_tpu.utils.logger import LogEvent, StoixLogger
-from stoix_tpu.utils.checkpointing import checkpointer_from_config
-from stoix_tpu.utils.timestep_checker import check_total_timesteps
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils.training import make_learning_rate
 
 
@@ -327,9 +324,6 @@ def learner_setup(
         timestep=timestep,
     )
     # Place as global sharded arrays.
-    learner_state = jax.tree.map(
-        lambda x, spec_tree=None: x, learner_state
-    )
     learner_state = jax.device_put(
         learner_state,
         jax.tree.map(
@@ -360,88 +354,18 @@ def learner_setup(
         print(f"[setup] {n_params:,} parameters | mesh {dict(mesh.shape)} | "
               f"{config.arch.total_num_envs} global envs")
 
-    return learn, apply_fns, learner_state
+    setup = AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
+        eval_params_fn=lambda s: jax.tree.map(lambda x: x[0], s.params.actor_params),
+    )
+    return setup
 
 
 def run_experiment(config: Any) -> float:
     """Train Anakin PPO; returns the final evaluation episode-return mean."""
-    maybe_initialize_distributed(config)
-    mesh = create_mesh(dict(config.arch.get("mesh") or {"data": -1}))
-    config = check_total_timesteps(config, int(mesh.shape["data"]))
-    config.logger.system_name = config.system.system_name
-
-    env, eval_env = envs.make(config)
-
-    key = jax.random.PRNGKey(int(config.arch.seed))
-    key, setup_key, eval_key = jax.random.split(key, 3)
-    learn, apply_fns, learner_state = learner_setup(env, config, mesh, setup_key)
-
-    act_fn = get_distribution_act_fn(config, apply_fns[0])
-    evaluator, absolute_evaluator = evaluator_setup(eval_env, act_fn, config, mesh)
-
-    logger = StoixLogger(config)
-    checkpointer = checkpointer_from_config(config, config.system.system_name)
-
-    steps_per_eval = (
-        int(config.system.rollout_length)
-        * int(config.arch.total_num_envs)
-        * int(config.arch.num_updates_per_eval)
-    )
-
-    best_params = jax.tree.map(lambda x: x[0], learner_state.params.actor_params)
-    best_return = -jnp.inf
-    final_return = 0.0
-
-    for eval_idx in range(int(config.arch.num_evaluation)):
-        start = time.time()
-        output = learn(learner_state)
-        jax.block_until_ready(output.learner_state)
-        learner_state = output.learner_state
-        elapsed = time.time() - start
-        t = (eval_idx + 1) * steps_per_eval
-
-        episode_metrics = envs.get_final_step_metrics(
-            {k: v for k, v in output.episode_metrics.items()}
-        )
-        sps = steps_per_eval / elapsed
-        if is_coordinator():
-            logger.log(
-                {**episode_metrics, "steps_per_second": sps}, t, eval_idx, LogEvent.ACT
-            )
-            logger.log(
-                jax.tree.map(lambda x: jnp.mean(x), output.train_metrics),
-                t,
-                eval_idx,
-                LogEvent.TRAIN,
-            )
-
-        trained_params = jax.tree.map(lambda x: x[0], learner_state.params.actor_params)
-        key, ek = jax.random.split(key)
-        eval_metrics = evaluator(trained_params, ek)
-        jax.block_until_ready(eval_metrics)
-        if is_coordinator():
-            logger.log(eval_metrics, t, eval_idx, LogEvent.EVAL)
-
-        mean_return = float(jnp.mean(eval_metrics["episode_return"]))
-        final_return = mean_return
-        if mean_return >= float(best_return):
-            best_return = mean_return
-            best_params = jax.tree.map(jnp.copy, trained_params)
-
-        if checkpointer is not None and is_coordinator():
-            checkpointer.save(t, learner_state, mean_return)
-
-    if bool(config.arch.get("absolute_metric", True)):
-        key, ek = jax.random.split(key)
-        abs_metrics = absolute_evaluator(best_params, ek)
-        jax.block_until_ready(abs_metrics)
-        if is_coordinator():
-            logger.log(abs_metrics, int(config.arch.total_timesteps),
-                       int(config.arch.num_evaluation), LogEvent.ABSOLUTE)
-        final_return = float(jnp.mean(abs_metrics["episode_return"]))
-
-    logger.close()
-    return final_return
+    return run_anakin_experiment(config, learner_setup)
 
 
 def main() -> float:

@@ -1,0 +1,49 @@
+"""Anakin Double DQN (reference stoix/systems/q_learning/ff_ddqn.py, 571 LoC):
+the online network selects the bootstrap action, the target network evaluates
+it (double_q_learning, reference stoix/utils/loss.py:127)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from stoix_tpu.base_types import Transition
+from stoix_tpu.ops import losses
+from stoix_tpu.systems.q_learning.q_family import run_q_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def ddqn_loss(online_params: Any, target_params: Any, batch: Transition, q_apply, config):
+    q_tm1 = q_apply(online_params, batch.obs, 0.0).preferences
+    q_t_value = q_apply(target_params, batch.next_obs, 0.0).preferences
+    q_t_selector = q_apply(online_params, batch.next_obs, 0.0).preferences
+    d_t = float(config.system.gamma) * (1.0 - batch.done.astype(jnp.float32))
+    loss = losses.double_q_learning(
+        q_tm1,
+        batch.action,
+        batch.reward,
+        d_t,
+        q_t_value,
+        q_t_selector,
+        use_huber=bool(config.system.get("use_huber", False)),
+        huber_delta=float(config.system.get("huber_loss_parameter", 1.0)),
+    )
+    return loss, {"q_loss": loss, "mean_q": jnp.mean(q_tm1)}
+
+
+def run_experiment(config: Any) -> float:
+    return run_q_experiment(config, ddqn_loss)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_ddqn.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

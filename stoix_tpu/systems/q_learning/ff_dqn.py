@@ -1,0 +1,50 @@
+"""Anakin DQN (reference stoix/systems/q_learning/ff_dqn.py, 577 LoC).
+
+Distinctives: item buffer sharded per (shard, update-batch) slice (reference
+ff_dqn.py:325-345), warmup fill (:37-89), polyak target update (:207),
+OnlineAndTarget params, EpsilonGreedy head. Skeleton in q_family.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from stoix_tpu.base_types import Transition
+from stoix_tpu.ops import losses
+from stoix_tpu.systems.q_learning.q_family import run_q_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def dqn_loss(online_params: Any, target_params: Any, batch: Transition, q_apply, config):
+    q_tm1 = q_apply(online_params, batch.obs, 0.0).preferences
+    q_t = q_apply(target_params, batch.next_obs, 0.0).preferences
+    d_t = float(config.system.gamma) * (1.0 - batch.done.astype(jnp.float32))
+    loss = losses.q_learning(
+        q_tm1,
+        batch.action,
+        batch.reward,
+        d_t,
+        q_t,
+        use_huber=bool(config.system.get("use_huber", False)),
+        huber_delta=float(config.system.get("huber_loss_parameter", 1.0)),
+    )
+    return loss, {"q_loss": loss, "mean_q": jnp.mean(q_tm1)}
+
+
+def run_experiment(config: Any) -> float:
+    return run_q_experiment(config, dqn_loss)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_dqn.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

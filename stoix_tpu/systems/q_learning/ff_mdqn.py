@@ -1,0 +1,50 @@
+"""Anakin Munchausen-DQN (reference stoix/systems/q_learning/ff_mdqn.py, 574
+LoC): adds a scaled log-policy bonus to the reward and a soft backup
+(munchausen_q_learning, reference stoix/utils/loss.py:190)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from stoix_tpu.base_types import Transition
+from stoix_tpu.ops import losses
+from stoix_tpu.systems.q_learning.q_family import run_q_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def mdqn_loss(online_params: Any, target_params: Any, batch: Transition, q_apply, config):
+    q_tm1 = q_apply(online_params, batch.obs, 0.0).preferences
+    q_t_target = q_apply(target_params, batch.next_obs, 0.0).preferences
+    q_tm1_target = q_apply(target_params, batch.obs, 0.0).preferences
+    d_t = float(config.system.gamma) * (1.0 - batch.done.astype(jnp.float32))
+    loss = losses.munchausen_q_learning(
+        q_tm1,
+        batch.action,
+        batch.reward,
+        d_t,
+        q_t_target,
+        q_tm1_target,
+        entropy_temperature=float(config.system.get("entropy_temperature", 0.03)),
+        munchausen_coefficient=float(config.system.get("munchausen_coefficient", 0.9)),
+        clip_value_min=float(config.system.get("clip_value_min", -1e3)),
+    )
+    return loss, {"q_loss": loss, "mean_q": jnp.mean(q_tm1)}
+
+
+def run_experiment(config: Any) -> float:
+    return run_q_experiment(config, mdqn_loss)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_mdqn.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Anakin QR-DQN (reference stoix/systems/q_learning/ff_qr_dqn.py, 602 LoC):
+quantile-regression distributional Q-learning (quantile_q_learning, reference
+stoix/utils/loss.py:268) with the QuantileDiscreteQNetwork head."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from stoix_tpu.base_types import Transition
+from stoix_tpu.ops import losses
+from stoix_tpu.systems.q_learning.q_family import run_q_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def qr_dqn_loss(online_params: Any, target_params: Any, batch: Transition, q_apply, config):
+    _, dist_q_tm1, tau_tm1 = q_apply(online_params, batch.obs, 0.0)
+    _, dist_q_t, _ = q_apply(target_params, batch.next_obs, 0.0)
+    _, dist_q_t_selector, _ = q_apply(online_params, batch.next_obs, 0.0)
+    d_t = float(config.system.gamma) * (1.0 - batch.done.astype(jnp.float32))
+    loss = losses.quantile_q_learning(
+        dist_q_tm1, tau_tm1, batch.action, batch.reward, d_t,
+        dist_q_t_selector, dist_q_t,
+        huber_param=float(config.system.get("huber_loss_parameter", 1.0)),
+    )
+    return loss, {"q_loss": loss}
+
+
+def _head_kwargs(config: Any) -> dict:
+    return dict(num_quantiles=int(config.system.get("num_quantiles", 51)))
+
+
+def run_experiment(config: Any) -> float:
+    return run_q_experiment(config, qr_dqn_loss, head_kwargs=_head_kwargs(config))
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_qr_dqn.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

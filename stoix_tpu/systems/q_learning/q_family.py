@@ -1,0 +1,158 @@
+"""Shared Anakin skeleton for the value-based (DQN) family.
+
+The reference implements each variant as a near-identical 570-680 LoC file
+(reference stoix/systems/q_learning/ff_{dqn,ddqn,dqn_reg,mdqn,c51,qr_dqn}.py);
+the only real differences are the network HEAD and the LOSS. Each system file
+supplies a `QLossFn` plus head kwargs; all scaffolding (buffer, sharding,
+rollout/update loops) comes from off_policy_core.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import OffPolicyLearnerState, OnlineAndTarget, Transition
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.systems import anakin, off_policy_core as core
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.jax_utils import tree_merge_leading_dims
+from stoix_tpu.utils.training import make_learning_rate
+
+# (online_params, target_params, batch, q_apply, config) -> (loss, metrics)
+QLossFn = Callable[[Any, Any, Transition, Callable, Any], Tuple[jax.Array, Dict]]
+
+
+def act_dist(apply_out: Any):
+    """Distribution from a head output (plain heads return the dist; the
+    distributional heads return (dist, logits/quantiles, atoms/taus))."""
+    return apply_out[0] if isinstance(apply_out, tuple) else apply_out
+
+
+def get_discrete_warmup_fn(env: envs.Environment, config: Any, buffer_add: Callable) -> Callable:
+    """Uniform-random discrete-action buffer fill (reference ff_dqn.py:37-89)."""
+
+    def warmup(state: OffPolicyLearnerState) -> OffPolicyLearnerState:
+        def _step(carry, _):
+            env_state, timestep, key = carry
+            key, act_key = jax.random.split(key)
+            n_envs = timestep.reward.shape[0]
+            action = jax.random.randint(act_key, (n_envs,), 0, int(config.system.action_dim))
+            next_env_state, next_timestep = env.step(env_state, action)
+            return (next_env_state, next_timestep, key), core.make_transition(
+                timestep, action, next_timestep
+            )
+
+        key, warmup_key = jax.random.split(state.key)
+        (env_state, timestep, _), traj = jax.lax.scan(
+            _step, (state.env_state, state.timestep, warmup_key), None,
+            int(config.system.warmup_steps),
+        )
+        buffer_state = buffer_add(state.buffer_state, tree_merge_leading_dims(traj, 2))
+        return state._replace(
+            buffer_state=buffer_state, key=key, env_state=env_state, timestep=timestep
+        )
+
+    return warmup
+
+
+def build_q_network(config: Any, num_actions: int, **extra_head_kwargs: Any):
+    from stoix_tpu.networks.base import FeedForwardActor
+
+    net_cfg = config.network.actor_network
+    head_kwargs = dict(
+        action_dim=num_actions, epsilon=float(config.system.evaluation_epsilon)
+    )
+    head_kwargs.update(extra_head_kwargs)
+    return FeedForwardActor(
+        action_head=config_lib.instantiate(net_cfg.action_head, **head_kwargs),
+        torso=config_lib.instantiate(net_cfg.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.input_layer),
+    )
+
+
+def q_learner_setup(
+    env: envs.Environment,
+    config: Any,
+    mesh: Mesh,
+    key: jax.Array,
+    loss_fn: QLossFn,
+    head_kwargs: Dict[str, Any] | None = None,
+) -> Tuple[AnakinSetup, Callable]:
+    num_actions = env.num_actions
+    config.system.action_dim = num_actions
+    tau = float(config.system.tau)
+    train_eps = float(config.system.training_epsilon)
+
+    q_network = build_q_network(config, num_actions, **(head_kwargs or {}))
+    q_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(
+            make_learning_rate(float(config.system.q_lr), config, int(config.system.epochs)),
+            eps=1e-5,
+        ),
+    )
+
+    key, net_key, env_key = jax.random.split(key, 3)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    online_params = q_network.init(net_key, dummy_obs)
+    params = OnlineAndTarget(online_params, online_params)
+    opt_state = q_optim.init(online_params)
+
+    buffer, buffer_state = core.build_buffer(env, config, mesh, discrete_actions=True)
+
+    def update_from_batch(params: OnlineAndTarget, opt_states, batch: Transition, key):
+        del key
+
+        def wrapped_loss(online_params):
+            return loss_fn(online_params, params.target, batch, q_network.apply, config)
+
+        grads, loss_info = jax.grad(wrapped_loss, has_aux=True)(params.online)
+        grads = core.pmean_grads(grads)
+        updates, opt_states = q_optim.update(grads, opt_states)
+        online = optax.apply_updates(params.online, updates)
+        target = optax.incremental_update(online, params.target, tau)
+        return (OnlineAndTarget(online, target), opt_states), loss_info
+
+    def act_in_env(params: OnlineAndTarget, observation, key):
+        dist = act_dist(q_network.apply(params.online, observation, train_eps))
+        return dist.sample(seed=key)
+
+    learn_per_shard = core.standard_off_policy_learner(
+        env, buffer, config, update_from_batch, act_in_env
+    )
+    warmup_core_fn = get_discrete_warmup_fn(env, config, buffer.add)
+    learner_state, state_specs = core.assemble_off_policy_state(
+        config, mesh, env, params, opt_state, buffer_state, key, env_key
+    )
+    learn, warmup = core.wrap_learn_and_warmup(
+        learn_per_shard, warmup_core_fn, mesh, state_specs
+    )
+
+    def eval_apply(params, obs, *a, **kw):
+        return act_dist(q_network.apply(params, obs, *a, **kw))
+
+    setup = AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, eval_apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.online),
+    )
+    return setup, warmup
+
+
+def run_q_experiment(config: Any, loss_fn: QLossFn, head_kwargs: Dict[str, Any] | None = None) -> float:
+    holder = {}
+
+    def setup_fn(env, cfg, mesh, key):
+        setup, warmup = q_learner_setup(env, cfg, mesh, key, loss_fn, head_kwargs)
+        holder["warmup"] = warmup
+        return setup
+
+    return run_anakin_experiment(config, setup_fn, warmup_fn=lambda s: holder["warmup"](s))

@@ -1,0 +1,118 @@
+"""Shared Anakin host loop.
+
+The reference repeats `run_experiment` in every system file (deliberate
+duplication, reference README.md:50-52); here the host loop — the part that is
+genuinely identical across systems — is shared, while each system file keeps
+its full learner (`get_learner_fn`) and setup (`learner_setup`) for
+hackability. The loop matches reference ff_ppo.py:554-705: learn / log /
+evaluate / checkpoint / absolute metric.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu import envs
+from stoix_tpu.evaluator import evaluator_setup
+from stoix_tpu.parallel import create_mesh, is_coordinator, maybe_initialize_distributed
+from stoix_tpu.utils.checkpointing import checkpointer_from_config
+from stoix_tpu.utils.logger import LogEvent, StoixLogger
+from stoix_tpu.utils.timestep_checker import check_total_timesteps
+
+
+class AnakinSetup(NamedTuple):
+    """What a system's learner_setup returns to the shared runner."""
+
+    learn: Callable[[Any], Any]  # jitted shard_mapped learner
+    learner_state: Any
+    eval_act_fn: Callable[..., Any]  # act_fn for the evaluator
+    eval_params_fn: Callable[[Any], Any]  # learner_state -> params for eval
+
+
+SetupFn = Callable[[envs.Environment, Any, Any, jax.Array], AnakinSetup]
+
+
+def run_anakin_experiment(config: Any, setup_fn: SetupFn, warmup_fn: Optional[Callable] = None) -> float:
+    """Generic Anakin experiment: returns final eval episode-return mean."""
+    maybe_initialize_distributed(config)
+    mesh = create_mesh(dict(config.arch.get("mesh") or {"data": -1}))
+    config = check_total_timesteps(config, int(mesh.shape["data"]))
+    config.logger.system_name = config.system.system_name
+
+    env, eval_env = envs.make(config)
+
+    key = jax.random.PRNGKey(int(config.arch.seed))
+    key, setup_key = jax.random.split(key)
+    setup = setup_fn(env, config, mesh, setup_key)
+    learner_state = setup.learner_state
+
+    if warmup_fn is not None:
+        learner_state = warmup_fn(learner_state)
+        jax.block_until_ready(jax.tree.leaves(learner_state)[0])
+
+    evaluator, absolute_evaluator = evaluator_setup(eval_env, setup.eval_act_fn, config, mesh)
+    logger = StoixLogger(config)
+    checkpointer = checkpointer_from_config(config, config.system.system_name)
+
+    steps_per_eval = (
+        int(config.system.rollout_length)
+        * int(config.arch.total_num_envs)
+        * int(config.arch.num_updates_per_eval)
+    )
+
+    best_params = jax.tree.map(jnp.copy, setup.eval_params_fn(learner_state))
+    best_return = -jnp.inf
+    final_return = 0.0
+
+    for eval_idx in range(int(config.arch.num_evaluation)):
+        start = time.time()
+        output = setup.learn(learner_state)
+        jax.block_until_ready(output.learner_state)
+        learner_state = output.learner_state
+        elapsed = time.time() - start
+        t = (eval_idx + 1) * steps_per_eval
+
+        episode_metrics = envs.get_final_step_metrics(dict(output.episode_metrics))
+        sps = steps_per_eval / elapsed
+        if is_coordinator():
+            logger.log({**episode_metrics, "steps_per_second": sps}, t, eval_idx, LogEvent.ACT)
+            logger.log(
+                jax.tree.map(lambda x: jnp.mean(x), dict(output.train_metrics)),
+                t, eval_idx, LogEvent.TRAIN,
+            )
+
+        trained_params = setup.eval_params_fn(learner_state)
+        key, ek = jax.random.split(key)
+        eval_metrics = evaluator(trained_params, ek)
+        jax.block_until_ready(eval_metrics)
+        if is_coordinator():
+            logger.log(eval_metrics, t, eval_idx, LogEvent.EVAL)
+
+        mean_return = float(jnp.mean(eval_metrics["episode_return"]))
+        final_return = mean_return
+        if mean_return >= float(best_return):
+            best_return = mean_return
+            best_params = jax.tree.map(jnp.copy, trained_params)
+
+        if checkpointer is not None and is_coordinator():
+            checkpointer.save(t, learner_state, mean_return)
+
+    if bool(config.arch.get("absolute_metric", True)):
+        key, ek = jax.random.split(key)
+        abs_metrics = absolute_evaluator(best_params, ek)
+        jax.block_until_ready(abs_metrics)
+        if is_coordinator():
+            logger.log(
+                abs_metrics,
+                int(config.arch.total_timesteps),
+                int(config.arch.num_evaluation),
+                LogEvent.ABSOLUTE,
+            )
+        final_return = float(jnp.mean(abs_metrics["episode_return"]))
+
+    logger.close()
+    return final_return

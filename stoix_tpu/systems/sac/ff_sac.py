@@ -1,0 +1,213 @@
+"""Anakin SAC (reference stoix/systems/sac/ff_sac.py, 691 LoC).
+
+Distinctives preserved: learnable `log_alpha` temperature with target entropy
+(reference ff_sac.py:154-171), twin-Q minimum backup (:186), squashed-Gaussian
+actor, polyak critic targets. Anakin scaffolding shared via off_policy_core.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import OnlineAndTarget, Transition
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.systems import anakin, off_policy_core as core
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class SACParams(NamedTuple):
+    actor_params: Any
+    q_params: OnlineAndTarget
+    log_alpha: jax.Array
+
+
+class SACOptStates(NamedTuple):
+    actor_opt_state: Any
+    q_opt_state: Any
+    alpha_opt_state: Any
+
+
+def _build_networks(env: envs.Environment, config: Any):
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic, MultiNetwork
+
+    action_space = env.action_space()
+    action_dim = env.num_actions
+    lo = float(jnp.min(jnp.asarray(action_space.low)))
+    hi = float(jnp.max(jnp.asarray(action_space.high)))
+
+    net_cfg = config.network
+    actor = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head, action_dim=action_dim, minimum=lo, maximum=hi
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    q_network = MultiNetwork(
+        [
+            FeedForwardCritic(
+                critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+                torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+                input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+            )
+            for _ in range(2)
+        ]
+    )
+    return actor, q_network, action_dim
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array):
+    actor, q_network, action_dim = _build_networks(env, config)
+    config.system.action_dim = action_dim
+    gamma = float(config.system.gamma)
+    tau = float(config.system.tau)
+    target_entropy = float(config.system.get("target_entropy_scale", 1.0)) * -action_dim
+    autotune = bool(config.system.get("autotune_alpha", True))
+
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+    q_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.q_lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+    alpha_optim = optax.adam(float(config.system.get("alpha_lr", 3e-4)))
+
+    key, actor_key, q_key, env_key = jax.random.split(key, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    dummy_act = jnp.asarray(env.action_value(), jnp.float32)[None]
+    actor_params = actor.init(actor_key, dummy_obs)
+    q_params = q_network.init(q_key, dummy_obs, dummy_act)
+    log_alpha = jnp.asarray(float(jnp.log(float(config.system.get("init_alpha", 1.0)))))
+
+    params = SACParams(actor_params, OnlineAndTarget(q_params, q_params), log_alpha)
+    opt_states = SACOptStates(
+        actor_optim.init(actor_params), q_optim.init(q_params), alpha_optim.init(log_alpha)
+    )
+
+    buffer, buffer_state = core.build_buffer(env, config, mesh)
+
+    def q_loss_fn(q_online, obs, action, target):
+        q_pred = q_network.apply(q_online, obs, action)  # [B, 2]
+        loss = jnp.mean((q_pred - target[:, None]) ** 2)
+        return loss, {"q_loss": loss, "mean_q": jnp.mean(q_pred)}
+
+    def actor_loss_fn(actor_params, q_online, log_alpha, obs, key):
+        dist = actor.apply(actor_params, obs)
+        action, log_prob = dist.sample_and_log_prob(seed=key)
+        q = jnp.min(q_network.apply(q_online, obs, action), axis=-1)
+        alpha = jnp.exp(log_alpha)
+        loss = jnp.mean(alpha * log_prob - q)
+        return loss, (log_prob, {"actor_loss": loss, "entropy": -jnp.mean(log_prob)})
+
+    def alpha_loss_fn(log_alpha, log_prob):
+        loss = -jnp.mean(log_alpha * jax.lax.stop_gradient(log_prob + target_entropy))
+        return loss, {"alpha_loss": loss, "alpha": jnp.exp(log_alpha)}
+
+    def update_from_batch(params: SACParams, opt_states: SACOptStates, batch: Transition, key):
+        key, next_key, actor_key = jax.random.split(key, 3)
+        # Critic update: twin-target min backup with entropy bonus.
+        next_dist = actor.apply(params.actor_params, batch.next_obs)
+        next_action, next_log_prob = next_dist.sample_and_log_prob(seed=next_key)
+        q_next = jnp.min(
+            q_network.apply(params.q_params.target, batch.next_obs, next_action), axis=-1
+        )
+        alpha = jnp.exp(params.log_alpha)
+        d_t = gamma * (1.0 - batch.done.astype(jnp.float32))
+        target = jax.lax.stop_gradient(
+            batch.reward + d_t * (q_next - alpha * next_log_prob)
+        )
+        q_grads, q_metrics = jax.grad(q_loss_fn, has_aux=True)(
+            params.q_params.online, batch.obs, batch.action, target
+        )
+        q_grads = core.pmean_grads(q_grads)
+        q_updates, q_opt_state = q_optim.update(q_grads, opt_states.q_opt_state)
+        q_online = optax.apply_updates(params.q_params.online, q_updates)
+        q_target = optax.incremental_update(q_online, params.q_params.target, tau)
+
+        # Actor update.
+        actor_grads, (log_prob, actor_metrics) = jax.grad(actor_loss_fn, has_aux=True)(
+            params.actor_params, q_online, params.log_alpha, batch.obs, actor_key
+        )
+        actor_grads = core.pmean_grads(actor_grads)
+        actor_updates, actor_opt_state = actor_optim.update(
+            actor_grads, opt_states.actor_opt_state
+        )
+        actor_params = optax.apply_updates(params.actor_params, actor_updates)
+
+        # Temperature update.
+        if autotune:
+            alpha_grads, alpha_metrics = jax.grad(alpha_loss_fn, has_aux=True)(
+                params.log_alpha, log_prob
+            )
+            alpha_grads = core.pmean_grads(alpha_grads)
+            alpha_updates, alpha_opt_state = alpha_optim.update(
+                alpha_grads, opt_states.alpha_opt_state
+            )
+            log_alpha = optax.apply_updates(params.log_alpha, alpha_updates)
+        else:
+            alpha_metrics = {"alpha_loss": jnp.zeros(()), "alpha": alpha}
+            alpha_opt_state = opt_states.alpha_opt_state
+            log_alpha = params.log_alpha
+
+        new_params = SACParams(actor_params, OnlineAndTarget(q_online, q_target), log_alpha)
+        new_opts = SACOptStates(actor_opt_state, q_opt_state, alpha_opt_state)
+        return (new_params, new_opts), {**q_metrics, **actor_metrics, **alpha_metrics}
+
+    def act_in_env(params: SACParams, observation, key):
+        return actor.apply(params.actor_params, observation).sample(seed=key)
+
+    learn_per_shard = core.standard_off_policy_learner(
+        env, buffer, config, update_from_batch, act_in_env
+    )
+    warmup_core_fn = core.get_random_warmup_fn(env, config, buffer.add)
+
+    learner_state, state_specs = core.assemble_off_policy_state(
+        config, mesh, env, params, opt_states, buffer_state, key, env_key
+    )
+    learn, warmup = core.wrap_learn_and_warmup(
+        learn_per_shard, warmup_core_fn, mesh, state_specs
+    )
+
+    setup = AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, actor.apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+    )
+    return setup, warmup
+
+
+def run_experiment(config: Any) -> float:
+    holder = {}
+
+    def setup_fn(env, cfg, mesh, key):
+        setup, warmup = learner_setup(env, cfg, mesh, key)
+        holder["warmup"] = warmup
+        return setup
+
+    return run_anakin_experiment(config, setup_fn, warmup_fn=lambda s: holder["warmup"](s))
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_sac.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()
