@@ -20,6 +20,39 @@ from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput
 
 
+def head_kwargs_for_env(head_cfg: Any, env: envs.Environment) -> dict:
+    """Infer action-head constructor kwargs from the env's action space, so one
+    network config mechanism serves discrete/continuous/multi-discrete heads.
+    """
+    from stoix_tpu.envs import spaces as env_spaces
+    from stoix_tpu.utils.config import _import_target
+
+    import numpy as np
+
+    target = _import_target(head_cfg["_target_"])
+    fields = getattr(target, "__dataclass_fields__", {})
+    kwargs: dict = {}
+    space = env.action_space()
+
+    def bound(v: Any) -> Any:
+        # Preserve per-dimension bounds (heads broadcast arrays/lists fine).
+        arr = np.asarray(v)
+        return float(arr) if arr.ndim == 0 or np.all(arr == arr.flat[0]) else arr.tolist()
+
+    if "num_actions" in fields:
+        kwargs["num_actions"] = env.num_actions
+    if "action_dim" in fields:
+        kwargs["action_dim"] = env.num_actions
+    if "num_values" in fields and isinstance(space, env_spaces.MultiDiscrete):
+        kwargs["num_values"] = space.num_values
+    if "minimum" in fields and hasattr(space, "low"):
+        kwargs["minimum"] = bound(space.low)
+    if "maximum" in fields and hasattr(space, "high"):
+        kwargs["maximum"] = bound(space.high)
+    # Explicit values in the network YAML win over inferred ones.
+    return {k: v for k, v in kwargs.items() if k not in head_cfg}
+
+
 def broadcast_to_update_batch(tree: Any, update_batch: int) -> Any:
     return jax.tree.map(lambda x: jnp.broadcast_to(x, (update_batch,) + x.shape), tree)
 

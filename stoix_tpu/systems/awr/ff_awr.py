@@ -1,0 +1,239 @@
+"""Anakin AWR (reference stoix/systems/awr/ff_awr.py, 672 LoC).
+
+Advantage-Weighted Regression (Peng et al. 2019): store rollouts in a
+trajectory replay buffer (reference ff_awr.py:431), sample sequences, fit the
+critic to TD(lambda) returns, and regress the policy onto actions weighted by
+exp(advantage / beta) (clipped). Serves discrete and continuous heads
+(ff_awr_continuous shares this learner).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import (
+    ActorCriticOptStates,
+    ActorCriticParams,
+    ExperimentOutput,
+    OffPolicyLearnerState,
+)
+from stoix_tpu.buffers import make_trajectory_buffer
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops.multistep import lambda_returns
+from stoix_tpu.systems import anakin, off_policy_core as core
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.training import make_learning_rate
+
+
+def get_learner_fn(env, apply_fns, update_fns, buffer, config):
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+    lam = float(config.system.get("gae_lambda", 0.95))
+    beta = float(config.system.get("awr_beta", 0.05))
+    w_max = float(config.system.get("weight_clip", 20.0))
+
+    def _env_step(learner_state: OffPolicyLearnerState, _):
+        params, opt_states, buffer_state, key, env_state, last_timestep = learner_state
+        key, act_key = jax.random.split(key)
+        dist = actor_apply(params.actor_params, last_timestep.observation)
+        action = dist.sample(seed=act_key)
+        env_state, timestep = env.step(env_state, action)
+        data = {
+            "obs": last_timestep.observation,
+            "action": action,
+            "reward": timestep.reward,
+            "discount": timestep.discount,
+            "info": timestep.extras["episode_metrics"],
+        }
+        return (
+            OffPolicyLearnerState(params, opt_states, buffer_state, key, env_state, timestep),
+            data,
+        )
+
+    def _update_epoch(carry, _):
+        params, opt_states, buffer_state, key = carry
+        key, sample_key = jax.random.split(key)
+        seq = buffer.sample(buffer_state, sample_key).experience  # [B, L, ...]
+
+        values = critic_apply(params.critic_params, seq["obs"])  # [B, L]
+        returns = lambda_returns(
+            seq["reward"][:, :-1],
+            gamma * seq["discount"][:, :-1],
+            values[:, 1:],
+            lam,
+            batch_major=True,
+        )
+        adv = returns - values[:, :-1]
+
+        def actor_loss_fn(actor_params):
+            dist = actor_apply(actor_params, jax.tree.map(lambda x: x[:, :-1], seq["obs"]))
+            log_prob = dist.log_prob(seq["action"][:, :-1])
+            weights = jnp.minimum(jnp.exp(jax.lax.stop_gradient(adv) / beta), w_max)
+            loss = -jnp.mean(weights * log_prob)
+            return loss, {"actor_loss": loss, "mean_weight": jnp.mean(weights)}
+
+        def critic_loss_fn(critic_params):
+            v = critic_apply(critic_params, jax.tree.map(lambda x: x[:, :-1], seq["obs"]))
+            loss = 0.5 * jnp.mean((v - jax.lax.stop_gradient(returns)) ** 2)
+            return loss, {"value_loss": loss}
+
+        actor_grads, actor_metrics = jax.grad(actor_loss_fn, has_aux=True)(params.actor_params)
+        critic_grads, critic_metrics = jax.grad(critic_loss_fn, has_aux=True)(params.critic_params)
+        actor_grads, critic_grads = jax.lax.pmean(
+            jax.lax.pmean((actor_grads, critic_grads), axis_name="batch"), axis_name="data"
+        )
+        a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
+        c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
+        params = ActorCriticParams(
+            optax.apply_updates(params.actor_params, a_updates),
+            optax.apply_updates(params.critic_params, c_updates),
+        )
+        opt_states = ActorCriticOptStates(a_opt, c_opt)
+        return (params, opt_states, buffer_state, key), {**actor_metrics, **critic_metrics}
+
+    def _update_step(learner_state: OffPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, buffer_state, key, env_state, timestep = learner_state
+        # Trajectory buffer rows are envs: [T, E, ...] -> [E, T, ...]; episode
+        # metrics are host-side only and never sampled, so keep them out of
+        # replay memory.
+        store = {k: v for k, v in traj.items() if k != "info"}
+        batch = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), store)
+        buffer_state = buffer.add(buffer_state, batch)
+
+        (params, opt_states, buffer_state, key), loss_info = jax.lax.scan(
+            _update_epoch, (params, opt_states, buffer_state, key), None,
+            int(config.system.epochs),
+        )
+        learner_state = OffPolicyLearnerState(
+            params, opt_states, buffer_state, key, env_state, timestep
+        )
+        return learner_state, (traj["info"], loss_info)
+
+    def learner_fn(learner_state: OffPolicyLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array):
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
+
+    config.system.action_dim = env.num_actions
+    net_cfg = config.network
+    actor_network = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head,
+            **anakin.head_kwargs_for_env(net_cfg.actor_network.action_head, env),
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic_network = FeedForwardCritic(
+        critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+        torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.critic_lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+
+    key, actor_key, critic_key, env_key = jax.random.split(key, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    actor_params = actor_network.init(actor_key, dummy_obs)
+    critic_params = critic_network.init(critic_key, dummy_obs)
+    params = ActorCriticParams(actor_params, critic_params)
+    opt_states = ActorCriticOptStates(
+        actor_optim.init(actor_params), critic_optim.init(critic_params)
+    )
+
+    n_shards = int(mesh.shape["data"])
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    local_envs = int(config.arch.total_num_envs) // (n_shards * update_batch)
+    discrete = not hasattr(env.action_space(), "low")
+    buffer = make_trajectory_buffer(
+        add_batch_size=local_envs,
+        sample_batch_size=max(1, int(config.system.total_batch_size) // (n_shards * update_batch)),
+        sample_sequence_length=int(config.system.get("sample_sequence_length", 8)),
+        period=int(config.system.get("sample_period", 1)),
+        max_length_time_axis=max(
+            int(config.system.total_buffer_size) // (n_shards * update_batch * local_envs),
+            2 * int(config.system.rollout_length),
+        ),
+    )
+    dummy_item = {
+        "obs": env.observation_value(),
+        "action": jnp.asarray(env.action_value(), jnp.int32 if discrete else jnp.float32),
+        "reward": jnp.zeros((), jnp.float32),
+        "discount": jnp.zeros((), jnp.float32),
+    }
+    buffer_state = buffer.init(dummy_item)
+
+    learn_per_shard = get_learner_fn(
+        env, (actor_network.apply, critic_network.apply),
+        (actor_optim.update, critic_optim.update), buffer, config,
+    )
+    learner_state, state_specs = core.assemble_off_policy_state(
+        config, mesh, env, params, opt_states, buffer_state, key, env_key
+    )
+
+    def per_shard_learn(state):
+        squeezed = state._replace(
+            buffer_state=jax.tree.map(lambda x: x[0], state.buffer_state)
+        )
+        out = learn_per_shard(squeezed)
+        new_state = out.learner_state._replace(
+            buffer_state=jax.tree.map(lambda x: x[None], out.learner_state.buffer_state)
+        )
+        return out._replace(learner_state=new_state)
+
+    learn = anakin.shardmap_learner(per_shard_learn, mesh, state_specs)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_awr.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

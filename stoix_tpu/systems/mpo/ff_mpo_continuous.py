@@ -1,0 +1,30 @@
+"""Continuous-action variant of ff_mpo (reference
+stoix/systems/mpo/ff_mpo_continuous.py) — shares the ff_mpo learner; the
+continuous head comes from the network config."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from stoix_tpu.systems.mpo.ff_mpo import learner_setup  # noqa: F401
+from stoix_tpu.systems.runner import run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_mpo_continuous.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,296 @@
+"""Anakin V-MPO (reference stoix/systems/mpo/ff_vmpo.py, 623 LoC / continuous
+:698) — on-policy MPO: E-step reweights the TOP HALF of advantages through a
+learnable temperature (eta) dual, M-step maximizes weighted log-likelihood
+under a KL trust region enforced by a learnable alpha dual (decoupled
+mean/stddev alphas for Gaussian policies, reference mpo_types.py:23-31).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import ExperimentOutput, OnPolicyLearnerState
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops import distributions as dists
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.systems import anakin
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.jax_utils import tree_merge_leading_dims
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class VMPOParams(NamedTuple):
+    actor_params: Any
+    critic_params: Any
+    log_temperature: jax.Array  # eta dual
+    log_alpha: jax.Array  # KL dual (scalar for categorical; [2] mean/std for Gaussian)
+
+
+class VMPOOptStates(NamedTuple):
+    actor_opt_state: Any
+    critic_opt_state: Any
+    dual_opt_state: Any
+
+
+def _softplus(x):
+    return jax.nn.softplus(x) + 1e-8
+
+
+def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update, dual_update = update_fns
+    gamma = float(config.system.gamma)
+    eps_eta = float(config.system.get("epsilon_eta", 0.01))
+    eps_alpha = float(config.system.get("epsilon_alpha", 0.005))
+    eps_alpha_mean = float(config.system.get("epsilon_alpha_mean", 0.0075))
+    eps_alpha_stddev = float(config.system.get("epsilon_alpha_stddev", 1e-5))
+
+    def _env_step(learner_state: OnPolicyLearnerState, _):
+        params, opt_states, key, env_state, last_timestep = learner_state
+        key, act_key = jax.random.split(key)
+        dist = actor_apply(params.actor_params, last_timestep.observation)
+        action = dist.sample(seed=act_key)
+        env_state, timestep = env.step(env_state, action)
+        # Behavior-policy stats for the KL trust region.
+        if continuous:
+            behavior = {"loc": dist.loc, "scale": dist.scale_diag}
+        else:
+            behavior = {"logits": dist.logits}
+        data = {
+            "obs": last_timestep.observation,
+            "action": action,
+            "reward": timestep.reward,
+            "discount": timestep.discount,
+            "truncated": jnp.logical_and(timestep.last(), timestep.discount != 0.0),
+            "next_obs": timestep.extras["next_obs"],
+            "info": timestep.extras["episode_metrics"],
+            "behavior": behavior,
+        }
+        return OnPolicyLearnerState(params, opt_states, key, env_state, timestep), data
+
+    def _loss_fn(learnable, traj, advantages):
+        actor_params, log_temperature, log_alpha = learnable
+        eta = _softplus(log_temperature)
+
+        flat = tree_merge_leading_dims((traj, advantages), 2)
+        traj_f, adv = flat
+        dist = actor_apply(actor_params, traj_f["obs"])
+        log_prob = dist.log_prob(traj_f["action"])
+
+        # E-step: top-half advantages only (the V-MPO distinctive).
+        n = adv.shape[0]
+        k = n // 2
+        top_idx = jnp.argsort(-adv)[:k]
+        adv_top = adv[top_idx]
+        logw = adv_top / eta
+        weights = jax.nn.softmax(logw)
+
+        # Temperature dual loss (closes the E-step constraint).
+        temperature_loss = eta * eps_eta + eta * (
+            jax.nn.logsumexp(logw, axis=0) - jnp.log(jnp.asarray(k, jnp.float32))
+        )
+
+        # M-step: weighted max-likelihood on the selected samples. Weights are
+        # E-step constants — stop_gradient keeps the policy loss from leaking
+        # gradients into the temperature dual (reference continuous_loss.py:54).
+        policy_loss = -jnp.sum(jax.lax.stop_gradient(weights) * log_prob[top_idx])
+
+        # KL trust region to the behavior policy.
+        if continuous:
+            online = dist
+            b_loc, b_scale = traj_f["behavior"]["loc"], traj_f["behavior"]["scale"]
+            behavior = dists.MultivariateNormalDiag(b_loc, b_scale)
+            # Decoupled mean/stddev KL (reference continuous_loss.py).
+            fixed_scale = dists.MultivariateNormalDiag(online.loc, b_scale)
+            fixed_mean = dists.MultivariateNormalDiag(b_loc, online.scale_diag)
+            kl_mean = jnp.mean(behavior.kl_divergence(fixed_scale))
+            kl_std = jnp.mean(behavior.kl_divergence(fixed_mean))
+            alpha_mean = _softplus(log_alpha[0])
+            alpha_std = _softplus(log_alpha[1])
+            alpha_loss = alpha_mean * (eps_alpha_mean - jax.lax.stop_gradient(kl_mean)) + (
+                alpha_std * (eps_alpha_stddev - jax.lax.stop_gradient(kl_std))
+            )
+            kl_loss = (
+                jax.lax.stop_gradient(alpha_mean) * kl_mean
+                + jax.lax.stop_gradient(alpha_std) * kl_std
+            )
+            kl_metric = kl_mean + kl_std
+        else:
+            behavior = dists.Categorical(traj_f["behavior"]["logits"])
+            kl = jnp.mean(behavior.kl_divergence(dist))
+            alpha = _softplus(log_alpha)
+            alpha_loss = jnp.sum(alpha * (eps_alpha - jax.lax.stop_gradient(kl)))
+            kl_loss = jnp.sum(jax.lax.stop_gradient(alpha) * kl)
+            kl_metric = kl
+
+        total = policy_loss + temperature_loss + alpha_loss + kl_loss
+        metrics = {
+            "policy_loss": policy_loss,
+            "temperature": eta,
+            "kl": kl_metric,
+        }
+        return total, metrics
+
+    def _update_step(learner_state: OnPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep = learner_state
+
+        v_tm1 = critic_apply(params.critic_params, traj["obs"])
+        v_t = critic_apply(params.critic_params, traj["next_obs"])
+        advantages, targets = truncated_generalized_advantage_estimation(
+            traj["reward"],
+            gamma * traj["discount"],
+            float(config.system.get("gae_lambda", 0.95)),
+            v_tm1=v_tm1,
+            v_t=v_t,
+            truncation_t=traj["truncated"].astype(jnp.float32),
+        )
+
+        learnable = (params.actor_params, params.log_temperature, params.log_alpha)
+        grads, metrics = jax.grad(_loss_fn, has_aux=True)(learnable, traj, advantages)
+
+        def critic_loss_fn(critic_params):
+            v = critic_apply(critic_params, traj["obs"])
+            loss = 0.5 * jnp.mean((v - jax.lax.stop_gradient(targets)) ** 2)
+            return loss, {"value_loss": loss}
+
+        critic_grads, critic_metrics = jax.grad(critic_loss_fn, has_aux=True)(
+            params.critic_params
+        )
+        grads, critic_grads = jax.lax.pmean(
+            jax.lax.pmean((grads, critic_grads), axis_name="batch"), axis_name="data"
+        )
+        actor_grads, temp_grads, alpha_grads = grads
+
+        a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
+        actor_params = optax.apply_updates(params.actor_params, a_updates)
+        c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
+        critic_params = optax.apply_updates(params.critic_params, c_updates)
+        d_updates, d_opt = dual_update(
+            (temp_grads, alpha_grads), opt_states.dual_opt_state
+        )
+        log_temperature, log_alpha = optax.apply_updates(
+            (params.log_temperature, params.log_alpha), d_updates
+        )
+
+        learner_state = OnPolicyLearnerState(
+            VMPOParams(actor_params, critic_params, log_temperature, log_alpha),
+            VMPOOptStates(a_opt, c_opt, d_opt),
+            key, env_state, last_timestep,
+        )
+        return learner_state, (traj["info"], {**metrics, **critic_metrics})
+
+    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
+
+    config.system.action_dim = env.num_actions
+    continuous = hasattr(env.action_space(), "low")
+    net_cfg = config.network
+    actor_network = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head,
+            **anakin.head_kwargs_for_env(net_cfg.actor_network.action_head, env),
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic_network = FeedForwardCritic(
+        critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+        torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config), eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.critic_lr), config), eps=1e-5),
+    )
+    dual_optim = optax.adam(float(config.system.get("dual_lr", 1e-2)))
+
+    key, actor_key, critic_key, env_key = jax.random.split(key, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    actor_params = actor_network.init(actor_key, dummy_obs)
+    critic_params = critic_network.init(critic_key, dummy_obs)
+    log_temperature = jnp.asarray(float(config.system.get("init_log_temperature", 1.0)))
+    log_alpha = (
+        jnp.full((2,), float(config.system.get("init_log_alpha", 1.0)))
+        if continuous
+        else jnp.asarray(float(config.system.get("init_log_alpha", 1.0)))
+    )
+    params = VMPOParams(actor_params, critic_params, log_temperature, log_alpha)
+    opt_states = VMPOOptStates(
+        actor_optim.init(actor_params),
+        critic_optim.init(critic_params),
+        dual_optim.init((log_temperature, log_alpha)),
+    )
+
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    state_specs = OnPolicyLearnerState(
+        params=P(), opt_states=P(), key=P("data"),
+        env_state=P(None, "data"), timestep=P(None, "data"),
+    )
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+    learner_state = OnPolicyLearnerState(
+        params=anakin.broadcast_to_update_batch(params, update_batch),
+        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
+        key=anakin.make_step_keys(key, mesh, config),
+        env_state=env_state,
+        timestep=timestep,
+    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+
+    learn_per_shard = get_learner_fn(
+        env, (actor_network.apply, critic_network.apply),
+        (actor_optim.update, critic_optim.update, dual_optim.update), config, continuous,
+    )
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_vmpo.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

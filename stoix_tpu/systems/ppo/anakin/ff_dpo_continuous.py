@@ -1,0 +1,47 @@
+"""Anakin DPO, continuous actions (reference
+stoix/systems/ppo/anakin/ff_dpo_continuous.py, 603 LoC): drift-based surrogate
+replacing the PPO clip (reference loss.py:50)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from stoix_tpu.ops import losses
+from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup as _ppo_learner_setup
+from stoix_tpu.systems.runner import run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def dpo_policy_loss(dist, action, old_log_prob, gae, config):
+    log_prob = dist.log_prob(action)
+    loss = losses.dpo_loss(
+        log_prob,
+        old_log_prob,
+        gae,
+        float(config.system.get("dpo_alpha", 2.0)),
+        float(config.system.get("dpo_beta", 0.6)),
+    )
+    return loss, dist.entropy().mean()
+
+
+def learner_setup(env, config, mesh, key):
+    return _ppo_learner_setup(env, config, mesh, key, policy_loss_fn=dpo_policy_loss)
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_dpo_continuous.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

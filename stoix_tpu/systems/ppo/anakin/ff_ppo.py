@@ -58,8 +58,12 @@ def get_learner_fn(
     apply_fns: Tuple[Callable, Callable],
     update_fns: Tuple[Callable, Callable],
     config: Any,
+    policy_loss_fn: Callable = None,
 ) -> Callable[[OnPolicyLearnerState], ExperimentOutput]:
-    """Build the PER-SHARD learner function (wrapped in shard_map by setup)."""
+    """Build the PER-SHARD learner function (wrapped in shard_map by setup).
+
+    policy_loss_fn(dist, action, old_log_prob, gae, config) -> (loss, entropy)
+    overrides the PPO clip objective (penalty/DPO variants)."""
 
     actor_apply, critic_apply = apply_fns
     actor_update, critic_update = update_fns
@@ -97,11 +101,16 @@ def get_learner_fn(
 
     def _actor_loss_fn(actor_params, obs, action, old_log_prob, gae):
         actor_policy = actor_apply(actor_params, obs)
-        log_prob = actor_policy.log_prob(action)
-        loss_actor = losses.ppo_clip_loss(
-            log_prob, old_log_prob, gae, float(config.system.clip_eps)
-        )
-        entropy = actor_policy.entropy().mean()
+        if policy_loss_fn is not None:
+            loss_actor, entropy = policy_loss_fn(
+                actor_policy, action, old_log_prob, gae, config
+            )
+        else:
+            log_prob = actor_policy.log_prob(action)
+            loss_actor = losses.ppo_clip_loss(
+                log_prob, old_log_prob, gae, float(config.system.clip_eps)
+            )
+            entropy = actor_policy.entropy().mean()
         total = loss_actor - float(config.system.ent_coef) * entropy
         return total, (loss_actor, entropy)
 
@@ -233,10 +242,13 @@ def get_learner_fn(
 
 
 def learner_setup(
-    env: envs.Environment, config: Any, mesh: Mesh, keys: jax.Array
-) -> Tuple[Callable, Callable, OnPolicyLearnerState]:
+    env: envs.Environment, config: Any, mesh: Mesh, keys: jax.Array,
+    policy_loss_fn: Callable = None,
+) -> AnakinSetup:
     """Instantiate networks/optimizers, build the shard_mapped learner, and
     initialise the (globally sharded) learner state."""
+
+    from stoix_tpu.systems import anakin
 
     num_actions = env.num_actions
     config.system.action_dim = num_actions
@@ -246,7 +258,8 @@ def learner_setup(
     net_cfg = config.network
     actor_network = FeedForwardActor(
         action_head=config_lib.instantiate(
-            net_cfg.actor_network.action_head, num_actions=num_actions
+            net_cfg.actor_network.action_head,
+            **anakin.head_kwargs_for_env(net_cfg.actor_network.action_head, env),
         ),
         torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
         input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
@@ -283,13 +296,10 @@ def learner_setup(
 
     apply_fns = (actor_network.apply, critic_network.apply)
     update_fns = (actor_optim.update, critic_optim.update)
-    learn_per_shard = get_learner_fn(env, apply_fns, update_fns, config)
+    learn_per_shard = get_learner_fn(env, apply_fns, update_fns, config, policy_loss_fn)
 
-    # ---- Global learner-state construction ---------------------------------
-    n_shards = int(mesh.shape["data"])
+    # ---- Global learner-state construction (shared anakin conventions) -----
     update_batch = int(config.arch.get("update_batch_size", 1))
-    envs_axis = int(config.arch.total_num_envs) // update_batch  # S * E
-
     state_specs = OnPolicyLearnerState(
         params=P(),
         opt_states=P(),
@@ -297,57 +307,20 @@ def learner_setup(
         env_state=P(None, "data"),
         timestep=P(None, "data"),
     )
-
-    # Broadcast params over the update-batch axis.
-    broadcast = lambda x: jnp.broadcast_to(x, (update_batch,) + x.shape)
-    params = jax.tree.map(broadcast, ActorCriticParams(actor_params, critic_params))
-    opt_states = jax.tree.map(
-        broadcast, ActorCriticOptStates(actor_opt_state, critic_opt_state)
-    )
-
-    # Reset all envs; shape leaves to [U, S*E, ...].
-    env_keys = jax.random.split(env_key, update_batch * envs_axis)
-    env_state, timestep = env.reset(env_keys)
-    reshape = lambda x: x.reshape((update_batch, envs_axis) + x.shape[1:])
-    env_state = jax.tree.map(reshape, env_state)
-    timestep = jax.tree.map(reshape, timestep)
-
-    step_keys = jax.random.split(key, n_shards * update_batch).reshape(
-        n_shards, update_batch, -1
-    )
-
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
     learner_state = OnPolicyLearnerState(
-        params=params,
-        opt_states=opt_states,
-        key=step_keys,
+        params=anakin.broadcast_to_update_batch(
+            ActorCriticParams(actor_params, critic_params), update_batch
+        ),
+        opt_states=anakin.broadcast_to_update_batch(
+            ActorCriticOptStates(actor_opt_state, critic_opt_state), update_batch
+        ),
+        key=anakin.make_step_keys(key, mesh, config),
         env_state=env_state,
         timestep=timestep,
     )
-    # Place as global sharded arrays.
-    learner_state = jax.device_put(
-        learner_state,
-        jax.tree.map(
-            lambda spec: NamedSharding(mesh, spec),
-            state_specs,
-            is_leaf=lambda s: isinstance(s, P),
-        ),
-    )
-
-    learn = jax.jit(
-        jax.shard_map(
-            learn_per_shard,
-            mesh=mesh,
-            in_specs=(state_specs,),
-            out_specs=ExperimentOutput(
-                learner_state=state_specs,
-                episode_metrics=P(None, None, None, "data"),
-                train_metrics=P(),
-            ),
-            # pmean over the in-shard vmap axis ("batch") trips shard_map's
-            # varying-manual-axes validation; the collectives are correct.
-            check_vma=False,
-        )
-    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
 
     if is_coordinator():
         n_params = count_parameters(actor_params) + count_parameters(critic_params)

@@ -1,0 +1,33 @@
+"""Anakin PPO with continuous actions
+(reference stoix/systems/ppo/anakin/ff_ppo_continuous.py, 716 LoC).
+
+Identical learner to ff_ppo; the squashed-Gaussian (or Beta) head comes from
+the network config and head kwargs are inferred from the Box action space.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup  # noqa: F401
+from stoix_tpu.systems.runner import run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo_continuous.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Anakin PPO-penalty (reference stoix/systems/ppo/anakin/ff_ppo_penalty.py,
+602 LoC): KL-penalty surrogate instead of clipping (reference loss.py:35).
+The KL to the behavior policy is estimated with the low-variance
+(ratio - 1 - log ratio) estimator.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.ops import losses
+from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup as _ppo_learner_setup
+from stoix_tpu.systems.runner import run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+
+
+def penalty_policy_loss(dist, action, old_log_prob, gae, config):
+    log_prob = dist.log_prob(action)
+    log_ratio = log_prob - old_log_prob
+    kl_approx = jnp.exp(log_ratio) - 1.0 - log_ratio  # k3 estimator, >= 0
+    loss = losses.ppo_penalty_loss(
+        log_prob, old_log_prob, gae, float(config.system.get("kl_beta", 3.0)), kl_approx
+    )
+    return loss, dist.entropy().mean()
+
+
+def learner_setup(env, config, mesh, key):
+    return _ppo_learner_setup(env, config, mesh, key, policy_loss_fn=penalty_policy_loss)
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo_penalty.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+"""Anakin REINFORCE with a critic baseline
+(reference stoix/systems/vpg/ff_reinforce.py, 492 LoC — the simplest template).
+
+One policy-gradient update per rollout: n-step discounted return targets
+(reference uses n-step returns), advantage = G - V(s), losses
+-log pi(a|s) * adv and 0.5 (V - G)^2. Serves discrete and continuous heads
+(ff_reinforce_continuous shares this learner, as the reference's twin file).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import (
+    ActorCriticOptStates,
+    ActorCriticParams,
+    ExperimentOutput,
+    OnPolicyLearnerState,
+)
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.systems import anakin
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.training import make_learning_rate
+
+
+def get_learner_fn(env, apply_fns, update_fns, config):
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+
+    def _env_step(learner_state: OnPolicyLearnerState, _):
+        params, opt_states, key, env_state, last_timestep = learner_state
+        key, policy_key = jax.random.split(key)
+        dist = actor_apply(params.actor_params, last_timestep.observation)
+        action = dist.sample(seed=policy_key)
+        log_prob = dist.log_prob(action)
+        env_state, timestep = env.step(env_state, action)
+        data = {
+            "obs": last_timestep.observation,
+            "action": action,
+            "log_prob": log_prob,
+            "reward": timestep.reward,
+            "discount": timestep.discount,
+            "truncated": jnp.logical_and(timestep.last(), timestep.discount != 0.0),
+            "next_obs": timestep.extras["next_obs"],
+            "info": timestep.extras["episode_metrics"],
+        }
+        return OnPolicyLearnerState(params, opt_states, key, env_state, timestep), data
+
+    def _update_step(learner_state: OnPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep = learner_state
+
+        # Returns must not bleed across auto-reset boundaries: use the
+        # truncation-aware recursion (GAE with lambda=1 gives
+        # G_t = r + gamma*discount*G_{t+1}, resetting to the bootstrap value of
+        # the TRUE next obs at truncations). Terminations cut via discount=0.
+        v_tm1 = jax.lax.stop_gradient(critic_apply(params.critic_params, traj["obs"]))
+        v_t = jax.lax.stop_gradient(critic_apply(params.critic_params, traj["next_obs"]))
+        _, g_t = truncated_generalized_advantage_estimation(
+            traj["reward"],
+            gamma * traj["discount"],
+            1.0,
+            v_tm1=v_tm1,
+            v_t=v_t,
+            truncation_t=traj["truncated"].astype(jnp.float32),
+        )
+
+        def actor_loss_fn(actor_params):
+            dist = actor_apply(actor_params, traj["obs"])
+            log_prob = dist.log_prob(traj["action"])
+            adv = g_t - v_tm1
+            loss = -jnp.mean(log_prob * jax.lax.stop_gradient(adv))
+            entropy = dist.entropy().mean()
+            total = loss - float(config.system.get("ent_coef", 0.0)) * entropy
+            return total, {"actor_loss": loss, "entropy": entropy}
+
+        def critic_loss_fn(critic_params):
+            value = critic_apply(critic_params, traj["obs"])
+            loss = 0.5 * jnp.mean((value - jax.lax.stop_gradient(g_t)) ** 2)
+            return loss, {"value_loss": loss}
+
+        actor_grads, actor_metrics = jax.grad(actor_loss_fn, has_aux=True)(
+            params.actor_params
+        )
+        critic_grads, critic_metrics = jax.grad(critic_loss_fn, has_aux=True)(
+            params.critic_params
+        )
+        for_sync = (actor_grads, critic_grads)
+        for_sync = jax.lax.pmean(for_sync, axis_name="batch")
+        actor_grads, critic_grads = jax.lax.pmean(for_sync, axis_name="data")
+
+        a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
+        actor_params = optax.apply_updates(params.actor_params, a_updates)
+        c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
+        critic_params = optax.apply_updates(params.critic_params, c_updates)
+
+        learner_state = OnPolicyLearnerState(
+            ActorCriticParams(actor_params, critic_params),
+            ActorCriticOptStates(a_opt, c_opt),
+            key, env_state, last_timestep,
+        )
+        return learner_state, (traj["info"], {**actor_metrics, **critic_metrics})
+
+    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
+
+    config.system.action_dim = env.num_actions
+    net_cfg = config.network
+    actor_network = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head,
+            **anakin.head_kwargs_for_env(net_cfg.actor_network.action_head, env),
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic_network = FeedForwardCritic(
+        critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+        torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config), eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.critic_lr), config), eps=1e-5),
+    )
+
+    key, actor_key, critic_key, env_key = jax.random.split(key, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    actor_params = actor_network.init(actor_key, dummy_obs)
+    critic_params = critic_network.init(critic_key, dummy_obs)
+    params = ActorCriticParams(actor_params, critic_params)
+    opt_states = ActorCriticOptStates(
+        actor_optim.init(actor_params), critic_optim.init(critic_params)
+    )
+
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    state_specs = OnPolicyLearnerState(
+        params=P(), opt_states=P(), key=P("data"),
+        env_state=P(None, "data"), timestep=P(None, "data"),
+    )
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+    learner_state = OnPolicyLearnerState(
+        params=anakin.broadcast_to_update_batch(params, update_batch),
+        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
+        key=anakin.make_step_keys(key, mesh, config),
+        env_state=env_state,
+        timestep=timestep,
+    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+
+    learn_per_shard = get_learner_fn(
+        env, (actor_network.apply, critic_network.apply),
+        (actor_optim.update, critic_optim.update), config,
+    )
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_reinforce.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Anakin REINFORCE, continuous actions (reference
+stoix/systems/vpg/ff_reinforce_continuous.py, 495 LoC) — shares the
+ff_reinforce learner; the continuous head comes from the network config."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from stoix_tpu.systems.runner import run_anakin_experiment
+from stoix_tpu.systems.vpg.ff_reinforce import learner_setup  # noqa: F401
+from stoix_tpu.utils import config as config_lib
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_reinforce_continuous.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""CI algorithm sweep: every registered system must run end-to-end on a tiny
+budget without crashing (the reference's integration-test strategy,
+reference bash_scripts/run-algorithms.sh + .github/workflows/run_algs.yaml).
+"""
+
+import importlib
+
+import pytest
+
+from stoix_tpu.utils import config as config_lib
+
+BASE = [
+    "arch.total_num_envs=16",
+    "arch.total_timesteps=2048",
+    "arch.num_evaluation=1",
+    "arch.num_eval_episodes=8",
+    "arch.absolute_metric=False",
+    "system.rollout_length=8",
+    "logger.use_console=False",
+]
+BUFFER = ["system.total_buffer_size=4096", "system.total_batch_size=32"]
+
+# (module, default yaml, extra overrides)
+SYSTEMS = [
+    ("stoix_tpu.systems.ppo.anakin.ff_ppo", "default_ff_ppo", ["env=identity_game"]),
+    ("stoix_tpu.systems.ppo.anakin.ff_ppo_continuous", "default_ff_ppo_continuous", []),
+    ("stoix_tpu.systems.ppo.anakin.ff_ppo_penalty", "default_ff_ppo_penalty", ["env=identity_game"]),
+    ("stoix_tpu.systems.ppo.anakin.ff_ppo_penalty_continuous", "default_ff_ppo_penalty_continuous", []),
+    ("stoix_tpu.systems.ppo.anakin.ff_dpo_continuous", "default_ff_dpo_continuous", []),
+    ("stoix_tpu.systems.vpg.ff_reinforce", "default_ff_reinforce", ["env=identity_game"]),
+    ("stoix_tpu.systems.vpg.ff_reinforce_continuous", "default_ff_reinforce_continuous", []),
+    ("stoix_tpu.systems.q_learning.ff_dqn", "default_ff_dqn", ["env=identity_game"] + BUFFER),
+    ("stoix_tpu.systems.q_learning.ff_ddqn", "default_ff_ddqn", ["env=identity_game"] + BUFFER),
+    ("stoix_tpu.systems.q_learning.ff_dqn_reg", "default_ff_dqn_reg", ["env=identity_game"] + BUFFER),
+    ("stoix_tpu.systems.q_learning.ff_mdqn", "default_ff_mdqn", ["env=identity_game"] + BUFFER),
+    ("stoix_tpu.systems.q_learning.ff_c51", "default_ff_c51", ["env=identity_game", "system.vmin=0.0", "system.vmax=10.0"] + BUFFER),
+    ("stoix_tpu.systems.q_learning.ff_qr_dqn", "default_ff_qr_dqn", ["env=identity_game"] + BUFFER),
+    ("stoix_tpu.systems.q_learning.ff_pqn", "default_ff_pqn", ["env=identity_game"]),
+    ("stoix_tpu.systems.sac.ff_sac", "default_ff_sac", BUFFER),
+    ("stoix_tpu.systems.ddpg.ff_ddpg", "default_ff_ddpg", BUFFER),
+    ("stoix_tpu.systems.ddpg.ff_td3", "default_ff_td3", BUFFER),
+    ("stoix_tpu.systems.ddpg.ff_d4pg", "default_ff_d4pg", BUFFER),
+    ("stoix_tpu.systems.awr.ff_awr", "default_ff_awr", ["env=identity_game"] + BUFFER),
+    ("stoix_tpu.systems.awr.ff_awr_continuous", "default_ff_awr_continuous", BUFFER),
+    ("stoix_tpu.systems.mpo.ff_vmpo", "default_ff_vmpo", ["env=identity_game"]),
+    ("stoix_tpu.systems.mpo.ff_vmpo_continuous", "default_ff_vmpo_continuous", []),
+    ("stoix_tpu.systems.mpo.ff_mpo", "default_ff_mpo", ["env=identity_game"] + BUFFER),
+    ("stoix_tpu.systems.mpo.ff_mpo_continuous", "default_ff_mpo_continuous", BUFFER),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("module,default,extra", SYSTEMS, ids=[s[1] for s in SYSTEMS])
+def test_system_smoke(module, default, extra, devices):
+    mod = importlib.import_module(module)
+    config = config_lib.compose(
+        config_lib.default_config_dir(), f"default/anakin/{default}.yaml", extra + BASE
+    )
+    final_return = mod.run_experiment(config)
+    assert final_return == final_return  # finite; ran end-to-end
