@@ -1,0 +1,329 @@
+"""Batched on-device MCTS — the mctx equivalent.
+
+The reference drives AlphaZero/MuZero through the external `mctx` package
+(reference stoix/systems/search/ff_az.py:377-379). This module provides the
+needed API surface natively:
+
+    muzero_policy(params, rng_key, root, recurrent_fn, num_simulations, ...)
+    gumbel_muzero_policy(...)
+
+TPU-first design: the search tree is a fixed-shape struct-of-arrays
+([num_nodes] per stat, [num_nodes, A] per child stat, a pytree of embeddings
+with leading [num_nodes]) so the entire search — simulate (PUCT descent via
+while_loop), expand (one recurrent_fn call per simulation), backup (masked
+reverse walk) — compiles into one XLA program under vmap over the batch.
+No dynamic allocation, no host round-trips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+NO_PARENT = jnp.int32(-1)
+UNVISITED = jnp.int32(-1)
+
+
+class RootFnOutput(NamedTuple):
+    prior_logits: Array  # [B, A]
+    value: Array  # [B]
+    embedding: Any  # pytree, leaves [B, ...]
+
+
+class RecurrentFnOutput(NamedTuple):
+    reward: Array  # [B]
+    discount: Array  # [B]
+    prior_logits: Array  # [B, A]
+    value: Array  # [B]
+
+
+# recurrent_fn(params, rng, action [B], embedding) -> (RecurrentFnOutput, new_embedding)
+RecurrentFn = Callable[[Any, Array, Array, Any], Tuple[RecurrentFnOutput, Any]]
+
+
+class PolicyOutput(NamedTuple):
+    action: Array  # [B]
+    action_weights: Array  # [B, A] — visit distribution (or completed-Q softmax)
+    search_value: Array  # [B] — root value after search
+
+
+class _Tree(NamedTuple):
+    visits: Array  # [N] int32
+    values: Array  # [N] f32 — running mean of backups
+    priors: Array  # [N, A]
+    rewards: Array  # [N] — reward received entering the node
+    discounts: Array  # [N]
+    parent: Array  # [N] int32
+    action_from_parent: Array  # [N] int32
+    children: Array  # [N, A] int32 node index or UNVISITED
+    embeddings: Any  # pytree [N, ...]
+
+
+def _init_tree(root: "RootFnOutput", num_nodes: int) -> _Tree:
+    num_actions = root.prior_logits.shape[-1]
+    embeddings = jax.tree.map(
+        lambda x: jnp.zeros((num_nodes,) + x.shape, x.dtype).at[0].set(x), root.embedding
+    )
+    return _Tree(
+        visits=jnp.zeros((num_nodes,), jnp.int32).at[0].set(1),
+        values=jnp.zeros((num_nodes,), jnp.float32).at[0].set(root.value),
+        priors=jnp.zeros((num_nodes, num_actions)).at[0].set(
+            jax.nn.softmax(root.prior_logits)
+        ),
+        rewards=jnp.zeros((num_nodes,)),
+        discounts=jnp.ones((num_nodes,)),
+        parent=jnp.full((num_nodes,), NO_PARENT),
+        action_from_parent=jnp.full((num_nodes,), NO_PARENT),
+        children=jnp.full((num_nodes, num_actions), UNVISITED),
+        embeddings=embeddings,
+    )
+
+
+def _puct_scores(
+    tree: _Tree, node: Array, value_min: Array, value_max: Array,
+    pb_c_init: float, pb_c_base: float,
+) -> Array:
+    """PUCT over one node's children with min-max normalized Q."""
+    children = tree.children[node]  # [A]
+    child_visits = jnp.where(children >= 0, tree.visits[children], 0)
+    child_values = jnp.where(children >= 0, tree.values[children], 0.0)
+    child_rewards = jnp.where(children >= 0, tree.rewards[children], 0.0)
+    child_discounts = jnp.where(children >= 0, tree.discounts[children], 0.0)
+    q_raw = child_rewards + child_discounts * child_values
+    scale = jnp.maximum(value_max - value_min, 1e-8)
+    q_norm = jnp.where(child_visits > 0, (q_raw - value_min) / scale, 0.0)
+
+    parent_visits = tree.visits[node]
+    pb_c = pb_c_init + jnp.log((parent_visits + pb_c_base + 1.0) / pb_c_base)
+    exploration = pb_c * tree.priors[node] * jnp.sqrt(parent_visits.astype(jnp.float32)) / (
+        1.0 + child_visits.astype(jnp.float32)
+    )
+    return q_norm + exploration
+
+
+def _search_one(
+    params: Any,
+    rng: Array,
+    root: RootFnOutput,
+    recurrent_fn: RecurrentFn,
+    num_simulations: int,
+    max_depth: int,
+    pb_c_init: float,
+    pb_c_base: float,
+) -> Tuple[_Tree, Array]:
+    """Search for ONE batch element (vmapped by callers)."""
+    num_nodes = num_simulations + 1
+    tree = _init_tree(root, num_nodes)
+
+    def simulate(sim: int, carry):
+        tree, rng = carry
+        rng, step_rng = jax.random.split(rng)
+        new_node = sim + 1
+
+        value_min = jnp.min(jnp.where(tree.visits > 0, tree.values, jnp.inf))
+        value_max = jnp.max(jnp.where(tree.visits > 0, tree.values, -jnp.inf))
+
+        # --- Descend: PUCT until an unexpanded edge (or max depth). ----------
+        def desc_cond(state):
+            node, action, depth, done = state
+            return ~done
+
+        def desc_body(state):
+            node, _, depth, _ = state
+            scores = _puct_scores(tree, node, value_min, value_max, pb_c_init, pb_c_base)
+            action = jnp.argmax(scores)
+            child = tree.children[node, action]
+            at_leaf = child == UNVISITED
+            too_deep = depth + 1 >= max_depth
+            done = jnp.logical_or(at_leaf, too_deep)
+            next_node = jnp.where(at_leaf, node, child)
+            return (next_node, action, depth + 1, done)
+
+        leaf_parent, action, _, _ = jax.lax.while_loop(
+            desc_cond, desc_body, (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+        )
+
+        # The selected edge is unexpanded (true leaf) or hit the depth limit on
+        # an already-expanded child; only the former allocates a node — the
+        # latter backs up the existing child's value (no overwrite/orphaning).
+        existing_child = tree.children[leaf_parent, action]
+        is_leaf = existing_child == UNVISITED
+
+        # --- Expand: one recurrent step from the leaf edge. ------------------
+        parent_embedding = jax.tree.map(lambda x: x[leaf_parent], tree.embeddings)
+        out, new_embedding = recurrent_fn(
+            params,
+            step_rng,
+            action[None],
+            jax.tree.map(lambda x: x[None], parent_embedding),
+        )
+        out = jax.tree.map(lambda x: x[0], out)
+        new_embedding = jax.tree.map(lambda x: x[0], new_embedding)
+
+        # Slot `new_node` is written unconditionally but only LINKED when the
+        # edge was a true leaf; unlinked slots stay unreachable with 0 visits.
+        tree = tree._replace(
+            priors=tree.priors.at[new_node].set(jax.nn.softmax(out.prior_logits)),
+            rewards=tree.rewards.at[new_node].set(out.reward),
+            discounts=tree.discounts.at[new_node].set(out.discount),
+            parent=tree.parent.at[new_node].set(leaf_parent),
+            action_from_parent=tree.action_from_parent.at[new_node].set(action),
+            children=tree.children.at[leaf_parent, action].set(
+                jnp.where(is_leaf, new_node, existing_child)
+            ),
+            embeddings=jax.tree.map(
+                lambda buf, e: buf.at[new_node].set(e), tree.embeddings, new_embedding
+            ),
+        )
+        start_node = jnp.where(is_leaf, new_node, existing_child)
+        start_value = jnp.where(is_leaf, out.value, tree.values[existing_child])
+
+        # --- Backup: walk parents to the root, averaging values. -------------
+        def back_cond(state):
+            node, _, tree_ = state
+            return node != NO_PARENT
+
+        def back_body(state):
+            node, g, tree_ = state
+            visits = tree_.visits[node]
+            new_value = (tree_.values[node] * visits + g) / (visits + 1)
+            tree_ = tree_._replace(
+                visits=tree_.visits.at[node].add(1),
+                values=tree_.values.at[node].set(
+                    jnp.where(node == 0, new_value, jnp.where(visits == 0, g, new_value))
+                ),
+            )
+            g = tree_.rewards[node] + tree_.discounts[node] * g
+            return (tree_.parent[node], g, tree_)
+
+        _, _, tree = jax.lax.while_loop(
+            back_cond, back_body, (start_node, start_value, tree)
+        )
+        return (tree, rng)
+
+    tree, _ = jax.lax.fori_loop(0, num_simulations, simulate, (tree, rng))
+    root_value = tree.values[0]
+    return tree, root_value
+
+
+def _root_with_noise(
+    root: RootFnOutput, rng: Array, dirichlet_fraction: float, dirichlet_alpha: float
+) -> RootFnOutput:
+    if dirichlet_fraction <= 0.0:
+        return root
+    num_actions = root.prior_logits.shape[-1]
+    noise = jax.random.dirichlet(rng, jnp.full((num_actions,), dirichlet_alpha),
+                                 shape=root.prior_logits.shape[:-1])
+    probs = jax.nn.softmax(root.prior_logits)
+    mixed = (1.0 - dirichlet_fraction) * probs + dirichlet_fraction * noise
+    return root._replace(prior_logits=jnp.log(mixed + 1e-9))
+
+
+def muzero_policy(
+    params: Any,
+    rng_key: Array,
+    root: RootFnOutput,
+    recurrent_fn: RecurrentFn,
+    num_simulations: int,
+    max_depth: Optional[int] = None,
+    dirichlet_fraction: float = 0.25,
+    dirichlet_alpha: float = 0.3,
+    pb_c_init: float = 1.25,
+    pb_c_base: float = 19652.0,
+    temperature: float = 1.0,
+) -> PolicyOutput:
+    """AlphaZero/MuZero search: PUCT with Dirichlet root noise; the returned
+    action is sampled from the visit distribution raised to 1/temperature."""
+    max_depth = int(max_depth or num_simulations)
+    noise_key, search_key, action_key = jax.random.split(rng_key, 3)
+    root = _root_with_noise(root, noise_key, dirichlet_fraction, dirichlet_alpha)
+
+    batch = root.value.shape[0]
+    search_keys = jax.random.split(search_key, batch)
+    trees, root_values = jax.vmap(
+        lambda r, k: _search_one(
+            params, k, r, recurrent_fn, num_simulations, max_depth, pb_c_init, pb_c_base
+        )
+    )(root, search_keys)
+
+    root_children = trees.children[:, 0]  # [B, A]
+    child_visits = jnp.where(
+        root_children >= 0,
+        jnp.take_along_axis(trees.visits, jnp.maximum(root_children, 0), axis=1),
+        0,
+    )
+    visit_probs = child_visits / jnp.maximum(child_visits.sum(-1, keepdims=True), 1)
+
+    logits = jnp.log(visit_probs + 1e-9) / jnp.maximum(temperature, 1e-9)
+    action = jax.random.categorical(action_key, logits, axis=-1)
+    return PolicyOutput(action=action, action_weights=visit_probs, search_value=root_values)
+
+
+def gumbel_muzero_policy(
+    params: Any,
+    rng_key: Array,
+    root: RootFnOutput,
+    recurrent_fn: RecurrentFn,
+    num_simulations: int,
+    max_depth: Optional[int] = None,
+    max_num_considered_actions: int = 16,
+    qtransform_c_visit: float = 50.0,
+    qtransform_c_scale: float = 0.1,
+    **_: Any,
+) -> PolicyOutput:
+    """Gumbel MuZero (Danihelka et al. 2022), simplified: one PUCT-driven tree
+    (no root noise), final action = argmax(gumbel + logits + sigma(Q)) over the
+    root actions, action_weights = softmax(logits + sigma(completed Q)).
+    """
+    max_depth = int(max_depth or num_simulations)
+    gumbel_key, search_key = jax.random.split(rng_key)
+
+    # Restrict the root to the top-k gumbel-perturbed actions (the Sequential
+    # Halving support); other root actions get -inf priors so PUCT never
+    # explores them.
+    gumbel = jax.random.gumbel(gumbel_key, root.prior_logits.shape)
+    num_actions = root.prior_logits.shape[-1]
+    k = min(int(max_num_considered_actions), num_actions)
+    perturbed = gumbel + root.prior_logits
+    threshold = jnp.sort(perturbed, axis=-1)[..., -k][..., None]
+    considered = perturbed >= threshold
+    restricted_logits = jnp.where(considered, root.prior_logits, -jnp.inf)
+    root = root._replace(prior_logits=restricted_logits)
+
+    batch = root.value.shape[0]
+    search_keys = jax.random.split(search_key, batch)
+    trees, root_values = jax.vmap(
+        lambda r, k_: _search_one(
+            params, k_, r, recurrent_fn, num_simulations, max_depth, 1.25, 19652.0
+        )
+    )(root, search_keys)
+
+    root_children = trees.children[:, 0]
+    safe_children = jnp.maximum(root_children, 0)
+    child_visits = jnp.where(
+        root_children >= 0, jnp.take_along_axis(trees.visits, safe_children, axis=1), 0
+    )
+    child_values = jnp.where(
+        root_children >= 0, jnp.take_along_axis(trees.values, safe_children, axis=1), 0.0
+    )
+    child_rewards = jnp.where(
+        root_children >= 0, jnp.take_along_axis(trees.rewards, safe_children, axis=1), 0.0
+    )
+    child_discounts = jnp.where(
+        root_children >= 0, jnp.take_along_axis(trees.discounts, safe_children, axis=1), 0.0
+    )
+    q = child_rewards + child_discounts * child_values
+    # Completed Q: unvisited actions take the root value.
+    q_completed = jnp.where(child_visits > 0, q, root_values[:, None])
+    max_visits = jnp.max(child_visits, axis=-1, keepdims=True).astype(jnp.float32)
+    sigma_q = (qtransform_c_visit + max_visits) * qtransform_c_scale * q_completed
+
+    # `gumbel`/`root.prior_logits` here are the restricted values from above.
+    action = jnp.argmax(gumbel + root.prior_logits + sigma_q, axis=-1)
+    action_weights = jax.nn.softmax(root.prior_logits + sigma_q)
+    return PolicyOutput(action=action, action_weights=action_weights, search_value=root_values)

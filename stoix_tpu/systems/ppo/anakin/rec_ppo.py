@@ -1,0 +1,327 @@
+"""Anakin Recurrent PPO (reference stoix/systems/ppo/anakin/rec_ppo.py, 769 LoC).
+
+Distinctives preserved: time-major RNN unroll via ScannedRNN with per-step
+hidden reset on done|truncated (reference rec_ppo.py:90-94), hidden states
+stored in the trajectory so minibatches can re-unroll from true initial
+carries, minibatching shuffles over ENVS (keeping time contiguous, reference
+rec_ppo minibatch scheme), truncation-aware GAE from per-step bootstrap values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import (
+    ActorCriticOptStates,
+    ActorCriticParams,
+    ExperimentOutput,
+    RNNLearnerState,
+)
+from stoix_tpu.ops import losses
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.systems import anakin
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class RNNPPOTransition(NamedTuple):
+    done: jax.Array
+    truncated: jax.Array
+    entering_done: jax.Array  # reset flag fed to the RNN at this step
+    action: jax.Array
+    value: jax.Array
+    reward: jax.Array
+    bootstrap_value: jax.Array
+    log_prob: jax.Array
+    obs: Any
+    hstates: Tuple[Any, Any]  # (actor, critic) carries at the START of the step
+    info: Dict[str, Any]
+
+
+def get_learner_fn(env, apply_fns, update_fns, config):
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+
+    def _env_step(learner_state: RNNLearnerState, _):
+        params, opt_states, key, env_state, last_timestep, done, truncated, hstates = (
+            learner_state
+        )
+        key, policy_key = jax.random.split(key)
+        actor_hstate, critic_hstate = hstates
+
+        # Single-step time-major unroll: [1, E, ...]. Hidden states reset on
+        # done OR truncation (both start a fresh episode).
+        reset_flag = jnp.logical_or(done, truncated)
+        obs_t = jax.tree.map(lambda x: x[None], last_timestep.observation)
+        done_t = reset_flag[None]
+        new_actor_hstate, dist = actor_apply(params.actor_params, actor_hstate, (obs_t, done_t))
+        new_critic_hstate, value = critic_apply(
+            params.critic_params, critic_hstate, (obs_t, done_t)
+        )
+        action = dist.sample(seed=policy_key)
+        log_prob = dist.log_prob(action)
+
+        env_state, timestep = env.step(env_state, action[0])
+        next_done = timestep.discount == 0.0
+        next_trunc = jnp.logical_and(timestep.last(), timestep.discount != 0.0)
+
+        # Bootstrap value of the TRUE next obs using the post-step critic carry
+        # (carry itself is not advanced by this evaluation).
+        next_obs_t = jax.tree.map(lambda x: x[None], timestep.extras["next_obs"])
+        _, bootstrap_value = critic_apply(
+            params.critic_params, new_critic_hstate, (next_obs_t, jnp.zeros_like(done_t))
+        )
+
+        transition = RNNPPOTransition(
+            done=next_done,
+            truncated=next_trunc,
+            entering_done=reset_flag,
+            action=action[0],
+            value=value[0],
+            reward=timestep.reward,
+            bootstrap_value=bootstrap_value[0],
+            log_prob=log_prob[0],
+            obs=last_timestep.observation,
+            hstates=(actor_hstate, critic_hstate),
+            info=timestep.extras["episode_metrics"],
+        )
+        new_state = RNNLearnerState(
+            params, opt_states, key, env_state, timestep, next_done, next_trunc,
+            (new_actor_hstate, new_critic_hstate),
+        )
+        return new_state, transition
+
+    def _actor_loss_fn(actor_params, traj: RNNPPOTransition, advantages):
+        # Re-unroll from the stored initial carry with the SAME reset flags the
+        # rollout fed the RNN (entering_done), so recomputed log-probs match
+        # the behavior policy exactly.
+        init_hstate = jax.tree.map(lambda x: x[0], traj.hstates[0])
+        _, dist = actor_apply(actor_params, init_hstate, (traj.obs, traj.entering_done))
+        log_prob = dist.log_prob(traj.action)
+        loss_actor = losses.ppo_clip_loss(
+            log_prob, traj.log_prob, advantages, float(config.system.clip_eps)
+        )
+        entropy = dist.entropy().mean()
+        total = loss_actor - float(config.system.ent_coef) * entropy
+        return total, (loss_actor, entropy)
+
+    def _critic_loss_fn(critic_params, traj: RNNPPOTransition, targets):
+        init_hstate = jax.tree.map(lambda x: x[0], traj.hstates[1])
+        _, value = critic_apply(critic_params, init_hstate, (traj.obs, traj.entering_done))
+        if config.system.get("clip_value", True):
+            value_loss = losses.clipped_value_loss(
+                value, traj.value, targets, float(config.system.clip_eps)
+            )
+        else:
+            value_loss = jnp.mean((value - targets) ** 2)
+        return float(config.system.vf_coef) * value_loss, value_loss
+
+    def _update_minibatch(train_state: Tuple, batch_info: Tuple):
+        params, opt_states = train_state
+        traj_batch, advantages, targets = batch_info
+        actor_grads, (loss_actor, entropy) = jax.grad(_actor_loss_fn, has_aux=True)(
+            params.actor_params, traj_batch, advantages
+        )
+        critic_grads, value_loss = jax.grad(_critic_loss_fn, has_aux=True)(
+            params.critic_params, traj_batch, targets
+        )
+        actor_grads, critic_grads = jax.lax.pmean(
+            jax.lax.pmean((actor_grads, critic_grads), axis_name="batch"), axis_name="data"
+        )
+        a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
+        c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
+        params = ActorCriticParams(
+            optax.apply_updates(params.actor_params, a_updates),
+            optax.apply_updates(params.critic_params, c_updates),
+        )
+        loss_info = {
+            "actor_loss": loss_actor,
+            "value_loss": value_loss,
+            "entropy": entropy,
+        }
+        return (params, ActorCriticOptStates(a_opt, c_opt)), loss_info
+
+    def _update_epoch(update_state: Tuple, _):
+        params, opt_states, traj, advantages, targets, key = update_state
+        key, shuffle_key = jax.random.split(key)
+        # Shuffle over ENV axis only; sequences stay time-contiguous.
+        n_envs = advantages.shape[1]
+        perm = jax.random.permutation(shuffle_key, n_envs)
+        shuffled = jax.tree.map(lambda x: jnp.take(x, perm, axis=1), (traj, advantages, targets))
+        minibatches = jax.tree.map(
+            lambda x: jnp.stack(
+                jnp.split(x, int(config.system.num_minibatches), axis=1)
+            ),
+            shuffled,
+        )
+        (params, opt_states), loss_info = jax.lax.scan(
+            _update_minibatch, (params, opt_states), minibatches
+        )
+        return (params, opt_states, traj, advantages, targets, key), loss_info
+
+    def _update_step(learner_state: RNNLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep, done, truncated, hstates = (
+            learner_state
+        )
+        advantages, targets = truncated_generalized_advantage_estimation(
+            traj.reward,
+            gamma * (1.0 - traj.done.astype(jnp.float32)),
+            float(config.system.gae_lambda),
+            v_tm1=traj.value,
+            v_t=traj.bootstrap_value,
+            truncation_t=traj.truncated.astype(jnp.float32),
+            standardize_advantages=bool(config.system.get("standardize_advantages", True)),
+        )
+        update_state = (params, opt_states, traj, advantages, targets, key)
+        update_state, loss_info = jax.lax.scan(
+            _update_epoch, update_state, None, int(config.system.epochs)
+        )
+        params, opt_states, _, _, _, key = update_state
+        learner_state = RNNLearnerState(
+            params, opt_states, key, env_state, last_timestep, done, truncated, hstates
+        )
+        return learner_state, (traj.info, loss_info)
+
+    def learner_fn(learner_state: RNNLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
+    from stoix_tpu.networks.base import (
+        RecurrentActor,
+        RecurrentCritic,
+        ScannedRNN,
+    )
+
+    config.system.action_dim = env.num_actions
+    net_cfg = config.network
+    hidden_size = int(config.network.get("rnn_hidden_size", 128))
+    cell_type = str(config.network.get("rnn_cell_type", "gru"))
+
+    actor_network = RecurrentActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head,
+            **anakin.head_kwargs_for_env(net_cfg.actor_network.action_head, env),
+        ),
+        rnn=ScannedRNN(hidden_size=hidden_size, cell_type=cell_type),
+        pre_torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        post_torso=config_lib.instantiate(net_cfg.actor_network.post_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic_network = RecurrentCritic(
+        critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+        rnn=ScannedRNN(hidden_size=hidden_size, cell_type=cell_type),
+        pre_torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        post_torso=config_lib.instantiate(net_cfg.critic_network.post_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config,
+                                      int(config.system.epochs),
+                                      int(config.system.num_minibatches)), eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.critic_lr), config,
+                                      int(config.system.epochs),
+                                      int(config.system.num_minibatches)), eps=1e-5),
+    )
+
+    key, actor_key, critic_key, env_key = jax.random.split(key, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None, None], env.observation_value())  # [T=1, B=1]
+    dummy_done = jnp.zeros((1, 1), bool)
+    dummy_h = ScannedRNN.initialize_carry(cell_type, hidden_size, (1,))
+    actor_params = actor_network.init(actor_key, dummy_h, (dummy_obs, dummy_done))
+    critic_params = critic_network.init(critic_key, dummy_h, (dummy_obs, dummy_done))
+    params = ActorCriticParams(actor_params, critic_params)
+    opt_states = ActorCriticOptStates(
+        actor_optim.init(actor_params), critic_optim.init(critic_params)
+    )
+
+    n_shards = int(mesh.shape["data"])
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    envs_axis = int(config.arch.total_num_envs) // update_batch
+
+    state_specs = RNNLearnerState(
+        params=P(), opt_states=P(), key=P("data"),
+        env_state=P(None, "data"), timestep=P(None, "data"),
+        done=P(None, "data"), truncated=P(None, "data"),
+        hstates=P(None, "data"),
+    )
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+    init_h = lambda: ScannedRNN.initialize_carry(cell_type, hidden_size, (update_batch, envs_axis))
+    learner_state = RNNLearnerState(
+        params=anakin.broadcast_to_update_batch(params, update_batch),
+        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
+        key=anakin.make_step_keys(key, mesh, config),
+        env_state=env_state,
+        timestep=timestep,
+        done=jnp.zeros((update_batch, envs_axis), bool),
+        truncated=jnp.zeros((update_batch, envs_axis), bool),
+        hstates=(init_h(), init_h()),
+    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+
+    learn_per_shard = get_learner_fn(
+        env, (actor_network.apply, critic_network.apply),
+        (actor_optim.update, critic_optim.update), config,
+    )
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+
+    def rnn_act_fn(params, hstate, observation, done, act_key):
+        obs_t = jax.tree.map(lambda x: x[None, None], observation)
+        done_t = jnp.asarray(done).reshape(1, 1)
+        hstate, dist = actor_network.apply(params, hstate, (obs_t, done_t))
+        greedy = bool(config.arch.get("evaluation_greedy", False))
+        action = dist.mode() if greedy else dist.sample(seed=act_key)
+        return hstate, action[0, 0]
+
+    setup = AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=rnn_act_fn,  # consumed by the RNN evaluator below
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+    )
+    return setup
+
+
+def run_experiment(config: Any) -> float:
+    from stoix_tpu.systems.runner import run_rnn_anakin_experiment
+
+    return run_rnn_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_rec_ppo.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from stoix_tpu import envs
-from stoix_tpu.evaluator import evaluator_setup
+from stoix_tpu.evaluator import evaluator_setup, get_rnn_evaluator_fn
 from stoix_tpu.parallel import create_mesh, is_coordinator, maybe_initialize_distributed
 from stoix_tpu.utils.checkpointing import checkpointer_from_config
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
@@ -116,3 +116,30 @@ def run_anakin_experiment(config: Any, setup_fn: SetupFn, warmup_fn: Optional[Ca
 
     logger.close()
     return final_return
+
+
+def run_rnn_anakin_experiment(config: Any, setup_fn: SetupFn) -> float:
+    """Anakin host loop for recurrent systems: identical to
+    run_anakin_experiment but evaluates with the hidden-state-carrying RNN
+    evaluator (setup_fn's eval_act_fn must have the rnn_act_fn signature)."""
+    from stoix_tpu.networks.base import ScannedRNN
+
+    hidden_size = int(config.network.get("rnn_hidden_size", 128))
+    cell_type = str(config.network.get("rnn_cell_type", "gru"))
+
+    def rnn_evaluator_setup(eval_env, act_fn, cfg, mesh):
+        init_h = lambda: ScannedRNN.initialize_carry(cell_type, hidden_size, (1,))
+        evaluator = get_rnn_evaluator_fn(eval_env, act_fn, cfg, mesh, init_h)
+        absolute = get_rnn_evaluator_fn(
+            eval_env, act_fn, cfg, mesh, init_h,
+            eval_multiplier=int(cfg.arch.get("absolute_metric_multiplier", 10)),
+        )
+        return evaluator, absolute
+
+    global evaluator_setup
+    original = evaluator_setup
+    evaluator_setup = rnn_evaluator_setup
+    try:
+        return run_anakin_experiment(config, setup_fn)
+    finally:
+        evaluator_setup = original

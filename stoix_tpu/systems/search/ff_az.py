@@ -1,0 +1,304 @@
+"""Anakin AlphaZero (reference stoix/systems/search/ff_az.py, 732 LoC).
+
+Expert-iteration with the REAL environment as the search simulator: the
+recurrent_fn steps a pristine (non-resetting) copy of the env from unwrapped
+states (reference make_recurrent_fn:74-102 uses env_state.unwrapped_state),
+`mcts.muzero_policy` / `gumbel_muzero_policy` selected by config
+(reference :377-379). The actor trains on search visit-weights (CE) and the
+critic on truncation-aware GAE targets.
+
+Round-1 deviation from the reference: training is on-policy over the fresh
+rollout (epochs of shuffled minibatches, PPO-style) instead of a trajectory
+replay buffer; the replay variant lands with the sampled-search systems.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import (
+    ActorCriticOptStates,
+    ActorCriticParams,
+    ExperimentOutput,
+    OnPolicyLearnerState,
+)
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.search import mcts
+from stoix_tpu.systems import anakin
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.jax_utils import tree_merge_leading_dims
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class ExItTransition(NamedTuple):
+    done: jax.Array
+    truncated: jax.Array
+    action: jax.Array
+    value: jax.Array
+    reward: jax.Array
+    search_policy: jax.Array  # [A] visit weights — the policy target
+    search_value: jax.Array
+    obs: Any
+    next_obs: Any
+    info: Dict[str, Any]
+
+
+def unwrap_env_state(state: Any) -> Any:
+    """Descend wrapper states' `inner` fields to the core env state."""
+    while hasattr(state, "inner"):
+        state = state.inner
+    return state
+
+
+def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+    num_simulations = int(config.system.get("num_simulations", 16))
+    search_method = str(config.system.get("search_method", "muzero"))
+    policy_fn = (
+        mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
+    )
+
+    def make_recurrent_fn():
+        def recurrent_fn(params, rng, action, embedding):
+            # embedding: {"state": core env state, "obs": Observation} [B=1,...]
+            state = jax.tree.map(lambda x: x[0], embedding["state"])
+            new_state, ts = sim_env.step(state, action[0])
+            prior = actor_apply(params.actor_params, ts.observation)
+            value = critic_apply(params.critic_params, ts.observation)
+            out = mcts.RecurrentFnOutput(
+                reward=ts.reward[None],
+                discount=gamma * ts.discount[None],
+                prior_logits=prior.logits[None],
+                value=value[None],
+            )
+            new_embedding = {"state": jax.tree.map(lambda x: x[None], new_state)}
+            return out, new_embedding
+
+        return recurrent_fn
+
+    recurrent_fn = make_recurrent_fn()
+
+    def _env_step(learner_state: OnPolicyLearnerState, _):
+        params, opt_states, key, env_state, last_timestep = learner_state
+        key, search_key = jax.random.split(key)
+
+        prior = actor_apply(params.actor_params, last_timestep.observation)
+        value = critic_apply(params.critic_params, last_timestep.observation)
+        root = mcts.RootFnOutput(
+            prior_logits=prior.logits,
+            value=value,
+            embedding={"state": unwrap_env_state(env_state)},
+        )
+        search_out = policy_fn(
+            params, search_key, root, recurrent_fn, num_simulations,
+            max_depth=int(config.system.get("max_depth", num_simulations)),
+        )
+        action = search_out.action
+        env_state_new, timestep = env.step(env_state, action)
+
+        transition = ExItTransition(
+            done=timestep.discount == 0.0,
+            truncated=jnp.logical_and(timestep.last(), timestep.discount != 0.0),
+            action=action,
+            value=value,
+            reward=timestep.reward,
+            search_policy=search_out.action_weights,
+            search_value=search_out.search_value,
+            obs=last_timestep.observation,
+            next_obs=timestep.extras["next_obs"],
+            info=timestep.extras["episode_metrics"],
+        )
+        return (
+            OnPolicyLearnerState(params, opt_states, key, env_state_new, timestep),
+            transition,
+        )
+
+    def _actor_loss_fn(actor_params, obs, search_policy):
+        dist = actor_apply(actor_params, obs)
+        ce = -jnp.sum(search_policy * jax.nn.log_softmax(dist.logits, axis=-1), axis=-1)
+        loss = jnp.mean(ce)
+        entropy = dist.entropy().mean()
+        return loss - float(config.system.get("ent_coef", 0.0)) * entropy, (loss, entropy)
+
+    def _critic_loss_fn(critic_params, obs, targets):
+        value = critic_apply(critic_params, obs)
+        loss = 0.5 * jnp.mean((value - targets) ** 2)
+        return float(config.system.get("vf_coef", 0.5)) * loss, loss
+
+    def _update_minibatch(train_state, batch):
+        params, opt_states = train_state
+        obs, search_policy, targets = batch
+        actor_grads, (actor_loss, entropy) = jax.grad(_actor_loss_fn, has_aux=True)(
+            params.actor_params, obs, search_policy
+        )
+        critic_grads, value_loss = jax.grad(_critic_loss_fn, has_aux=True)(
+            params.critic_params, obs, targets
+        )
+        actor_grads, critic_grads = jax.lax.pmean(
+            jax.lax.pmean((actor_grads, critic_grads), axis_name="batch"), axis_name="data"
+        )
+        a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
+        c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
+        params = ActorCriticParams(
+            optax.apply_updates(params.actor_params, a_updates),
+            optax.apply_updates(params.critic_params, c_updates),
+        )
+        loss_info = {"actor_loss": actor_loss, "value_loss": value_loss, "entropy": entropy}
+        return (params, ActorCriticOptStates(a_opt, c_opt)), loss_info
+
+    def _update_step(learner_state: OnPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep = learner_state
+
+        v_t = critic_apply(params.critic_params, traj.next_obs)
+        _, targets = truncated_generalized_advantage_estimation(
+            traj.reward,
+            gamma * (1.0 - traj.done.astype(jnp.float32)),
+            float(config.system.get("gae_lambda", 0.95)),
+            v_tm1=traj.value,
+            v_t=v_t,
+            truncation_t=traj.truncated.astype(jnp.float32),
+        )
+
+        def _update_epoch(carry, _):
+            params, opt_states, key = carry
+            key, shuffle_key = jax.random.split(key)
+            batch_size = targets.shape[0] * targets.shape[1]
+            perm = jax.random.permutation(shuffle_key, batch_size)
+            flat = tree_merge_leading_dims((traj.obs, traj.search_policy, targets), 2)
+            shuffled = jax.tree.map(lambda x: jnp.take(x, perm, axis=0), flat)
+            minibatches = jax.tree.map(
+                lambda x: x.reshape((int(config.system.num_minibatches), -1) + x.shape[1:]),
+                shuffled,
+            )
+            (params, opt_states), loss_info = jax.lax.scan(
+                _update_minibatch, (params, opt_states), minibatches
+            )
+            return (params, opt_states, key), loss_info
+
+        (params, opt_states, key), loss_info = jax.lax.scan(
+            _update_epoch, (params, opt_states, key), None, int(config.system.epochs)
+        )
+        learner_state = OnPolicyLearnerState(params, opt_states, key, env_state, last_timestep)
+        return learner_state, (traj.info, loss_info)
+
+    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
+
+    config.system.action_dim = env.num_actions
+    net_cfg = config.network
+    actor_network = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head,
+            **anakin.head_kwargs_for_env(net_cfg.actor_network.action_head, env),
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic_network = FeedForwardCritic(
+        critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+        torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config,
+                                      int(config.system.epochs),
+                                      int(config.system.num_minibatches)), eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.critic_lr), config,
+                                      int(config.system.epochs),
+                                      int(config.system.num_minibatches)), eps=1e-5),
+    )
+
+    key, actor_key, critic_key, env_key = jax.random.split(key, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    actor_params = actor_network.init(actor_key, dummy_obs)
+    critic_params = critic_network.init(critic_key, dummy_obs)
+    params = ActorCriticParams(actor_params, critic_params)
+    opt_states = ActorCriticOptStates(
+        actor_optim.init(actor_params), critic_optim.init(critic_params)
+    )
+
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    state_specs = OnPolicyLearnerState(
+        params=P(), opt_states=P(), key=P("data"),
+        env_state=P(None, "data"), timestep=P(None, "data"),
+    )
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+    learner_state = OnPolicyLearnerState(
+        params=anakin.broadcast_to_update_batch(params, update_batch),
+        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
+        key=anakin.make_step_keys(key, mesh, config),
+        env_state=env_state,
+        timestep=timestep,
+    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+
+    # Pristine simulator env: raw dynamics only (no metrics/auto-reset), so the
+    # search never resets mid-rollout (reference ff_az.py:74-102).
+    sim_env = envs.make_single(
+        config.env.scenario.name
+        if hasattr(config.env.scenario, "name")
+        else config.env.scenario,
+        **dict(config.env.get("kwargs", {}) or {}),
+    )
+
+    learn_per_shard = get_learner_fn(
+        env, sim_env, (actor_network.apply, critic_network.apply),
+        (actor_optim.update, critic_optim.update), config,
+    )
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_az.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,310 @@
+"""Anakin MuZero (reference stoix/systems/search/ff_mz.py, 845 LoC).
+
+Search in a LEARNED model: the RewardBasedWorldModel encodes observations to a
+flat latent, the dynamics RNN rolls latents forward under embedded actions
+(reference networks/model_based.py), and prediction heads give priors/values on
+latents. Training is unroll-k (reference scale_gradient usage): from each
+window, the policy head matches search visit-weights, the value head matches
+GAE targets, the reward head matches observed rewards, with latent gradients
+scaled 0.5 between steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import ExperimentOutput, OnPolicyLearnerState
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.search import mcts
+from stoix_tpu.systems import anakin
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.jax_utils import scale_gradient
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class MZParams(NamedTuple):
+    world_model: Any
+    policy_head: Any
+    value_head: Any
+
+
+class MZOptStates(NamedTuple):
+    opt_state: Any
+
+
+class MZTransition(NamedTuple):
+    done: jax.Array
+    truncated: jax.Array
+    action: jax.Array
+    value: jax.Array
+    reward: jax.Array
+    search_policy: jax.Array
+    obs: Any
+    next_obs: Any
+    info: Dict[str, Any]
+
+
+def get_learner_fn(env, networks, optim_update, config):
+    wm, policy_net, value_net = networks
+    gamma = float(config.system.gamma)
+    num_simulations = int(config.system.get("num_simulations", 16))
+    unroll_k = int(config.system.get("unroll_steps", 4))
+
+    def _predict(params: MZParams, latent):
+        prior = policy_net.apply(params.policy_head, latent)
+        value = value_net.apply(params.value_head, latent)
+        return prior, value
+
+    def recurrent_fn(params: MZParams, rng, action, latent):
+        new_latent, reward = wm.apply(params.world_model, latent, action, method="step")
+        prior, value = _predict(params, new_latent)
+        out = mcts.RecurrentFnOutput(
+            reward=reward,
+            discount=jnp.full_like(reward, gamma),
+            prior_logits=prior.logits,
+            value=value,
+        )
+        return out, new_latent
+
+    def _env_step(learner_state: OnPolicyLearnerState, _):
+        params, opt_states, key, env_state, last_timestep = learner_state
+        key, search_key = jax.random.split(key)
+
+        latent = wm.apply(
+            params.world_model, last_timestep.observation.agent_view, method="initial_state"
+        )
+        prior, value = _predict(params, latent)
+        root = mcts.RootFnOutput(
+            prior_logits=prior.logits, value=value, embedding=latent
+        )
+        search_out = mcts.muzero_policy(
+            params, search_key, root, recurrent_fn, num_simulations,
+            max_depth=int(config.system.get("max_depth", num_simulations)),
+        )
+        action = search_out.action
+        env_state_new, timestep = env.step(env_state, action)
+
+        transition = MZTransition(
+            done=timestep.discount == 0.0,
+            truncated=jnp.logical_and(timestep.last(), timestep.discount != 0.0),
+            action=action,
+            value=value,
+            reward=timestep.reward,
+            search_policy=search_out.action_weights,
+            obs=last_timestep.observation,
+            next_obs=timestep.extras["next_obs"],
+            info=timestep.extras["episode_metrics"],
+        )
+        return (
+            OnPolicyLearnerState(params, opt_states, key, env_state_new, timestep),
+            transition,
+        )
+
+    def _loss_fn(params: MZParams, traj: MZTransition, targets):
+        T = targets.shape[0]
+        T_train = T - unroll_k + 1
+
+        # Windows: index i covers steps [i, i + T_train).
+        def window(x, i):
+            return jax.lax.dynamic_slice_in_dim(x, i, T_train, axis=0)
+
+        latent = wm.apply(
+            params.world_model,
+            jax.tree.map(lambda x: x[:T_train], traj.obs.agent_view),
+            method="initial_state",
+        )  # [T_train, E, D]
+
+        def unroll_step(carry, i):
+            latent, total_loss = carry
+            prior = policy_net.apply(params.policy_head, latent)
+            value = value_net.apply(params.value_head, latent)
+            pol_target = window(traj.search_policy, i)
+            val_target = window(targets, i)
+            rew_target = window(traj.reward, i)
+
+            policy_loss = -jnp.mean(
+                jnp.sum(pol_target * jax.nn.log_softmax(prior.logits, axis=-1), axis=-1)
+            )
+            value_loss = 0.5 * jnp.mean((value - val_target) ** 2)
+
+            action = window(traj.action, i)
+            new_latent, pred_reward = wm.apply(
+                params.world_model, latent, action, method="step"
+            )
+            reward_loss = 0.5 * jnp.mean((pred_reward - rew_target) ** 2)
+            # Scale latent gradients between unroll steps (MuZero trick).
+            new_latent = scale_gradient(new_latent, 0.5)
+            step_loss = policy_loss + value_loss + reward_loss
+            return (new_latent, total_loss + step_loss), {
+                "policy_loss": policy_loss,
+                "value_loss": value_loss,
+                "reward_loss": reward_loss,
+            }
+
+        (final_latent, total_loss), metrics = jax.lax.scan(
+            unroll_step, (latent, jnp.zeros(())), jnp.arange(unroll_k)
+        )
+        metrics = jax.tree.map(jnp.mean, metrics)
+        return total_loss / unroll_k, metrics
+
+    def _update_step(learner_state: OnPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep = learner_state
+
+        latent_next = wm.apply(
+            params.world_model, traj.next_obs.agent_view, method="initial_state"
+        )
+        v_t = value_net.apply(params.value_head, latent_next)
+        latent_cur = wm.apply(
+            params.world_model, traj.obs.agent_view, method="initial_state"
+        )
+        v_tm1 = value_net.apply(params.value_head, latent_cur)
+        _, targets = truncated_generalized_advantage_estimation(
+            traj.reward,
+            gamma * (1.0 - traj.done.astype(jnp.float32)),
+            float(config.system.get("gae_lambda", 0.95)),
+            v_tm1=jax.lax.stop_gradient(v_tm1),
+            v_t=jax.lax.stop_gradient(v_t),
+            truncation_t=traj.truncated.astype(jnp.float32),
+        )
+
+        def _epoch(carry, _):
+            params, opt_states, key = carry
+            grads, metrics = jax.grad(_loss_fn, has_aux=True)(params, traj, targets)
+            grads = jax.lax.pmean(jax.lax.pmean(grads, axis_name="batch"), axis_name="data")
+            updates, opt_state = optim_update(grads, opt_states.opt_state)
+            params = optax.apply_updates(params, updates)
+            return (params, MZOptStates(opt_state), key), metrics
+
+        (params, opt_states, key), loss_info = jax.lax.scan(
+            _epoch, (params, opt_states, key), None, int(config.system.epochs)
+        )
+        learner_state = OnPolicyLearnerState(params, opt_states, key, env_state, last_timestep)
+        return learner_state, (traj.info, loss_info)
+
+    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
+    import flax.linen as nn
+
+    from stoix_tpu.networks import heads as heads_lib, torso as torso_lib
+    from stoix_tpu.networks.model_based import RewardBasedWorldModel
+
+    config.system.action_dim = env.num_actions
+    num_actions = env.num_actions
+    hidden = int(config.system.get("wm_hidden_size", 64))
+
+    class ActionOneHot(nn.Module):
+        num_actions: int
+
+        @nn.compact
+        def __call__(self, action):
+            return jax.nn.one_hot(action, self.num_actions)
+
+    wm = RewardBasedWorldModel(
+        obs_encoder=torso_lib.MLPTorso((hidden,)),
+        reward_head=heads_lib.LinearHead(output_dim=1),
+        action_embedder=ActionOneHot(num_actions=num_actions),
+        hidden_size=hidden,
+        num_rnn_layers=int(config.system.get("wm_rnn_layers", 1)),
+        rnn_cell_type=str(config.system.get("wm_cell_type", "lstm")),
+    )
+
+    class LatentPolicy(nn.Module):
+        @nn.compact
+        def __call__(self, latent):
+            x = torso_lib.MLPTorso((hidden,))(latent)
+            return heads_lib.CategoricalHead(num_actions=num_actions)(x)
+
+    class LatentValue(nn.Module):
+        @nn.compact
+        def __call__(self, latent):
+            x = torso_lib.MLPTorso((hidden,))(latent)
+            return heads_lib.ScalarCriticHead()(x)
+
+    policy_net, value_net = LatentPolicy(), LatentValue()
+
+    key, wm_key, p_key, v_key, env_key = jax.random.split(key, 5)
+    dummy_view = env.observation_value().agent_view[None]
+    dummy_action = jnp.zeros((1,), jnp.int32)
+    wm_params = wm.init(wm_key, dummy_view, dummy_action)
+    dummy_latent = wm.apply(wm_params, dummy_view, method="initial_state")
+    params = MZParams(
+        world_model=wm_params,
+        policy_head=policy_net.init(p_key, dummy_latent),
+        value_head=value_net.init(v_key, dummy_latent),
+    )
+    optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+    opt_states = MZOptStates(optim.init(params))
+
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    state_specs = OnPolicyLearnerState(
+        params=P(), opt_states=P(), key=P("data"),
+        env_state=P(None, "data"), timestep=P(None, "data"),
+    )
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+    learner_state = OnPolicyLearnerState(
+        params=anakin.broadcast_to_update_batch(params, update_batch),
+        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
+        key=anakin.make_step_keys(key, mesh, config),
+        env_state=env_state,
+        timestep=timestep,
+    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+
+    learn_per_shard = get_learner_fn(env, (wm, policy_net, value_net), optim.update, config)
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+
+    def eval_apply(params: MZParams, observation):
+        latent = wm.apply(params.world_model, observation.agent_view, method="initial_state")
+        return policy_net.apply(params.policy_head, latent)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, eval_apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params),
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_mz.yaml", sys.argv[1:]
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

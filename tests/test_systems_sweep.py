@@ -46,6 +46,16 @@ SYSTEMS = [
     ("stoix_tpu.systems.mpo.ff_vmpo_continuous", "default_ff_vmpo_continuous", []),
     ("stoix_tpu.systems.mpo.ff_mpo", "default_ff_mpo", ["env=identity_game"] + BUFFER),
     ("stoix_tpu.systems.mpo.ff_mpo_continuous", "default_ff_mpo_continuous", BUFFER),
+    ("stoix_tpu.systems.ppo.anakin.rec_ppo", "default_rec_ppo",
+     ["env=identity_game", "system.num_minibatches=2"]),
+    ("stoix_tpu.systems.q_learning.rec_r2d2", "default_rec_r2d2",
+     ["env=identity_game", "system.total_buffer_size=4096", "system.total_batch_size=16"]),
+    ("stoix_tpu.systems.q_learning.ff_rainbow", "default_ff_rainbow",
+     ["env=identity_game", "system.vmin=0.0", "system.vmax=10.0"] + BUFFER),
+    ("stoix_tpu.systems.search.ff_az", "default_ff_az",
+     ["env=identity_game", "system.num_simulations=8", "system.num_minibatches=2"]),
+    ("stoix_tpu.systems.search.ff_mz", "default_ff_mz",
+     ["env=identity_game", "system.num_simulations=8", "system.unroll_steps=2"]),
 ]
 
 
