@@ -1,0 +1,149 @@
+"""ctypes adapter for the native C++ vectorized env pool (envs/native/cvec.cpp)
+— the first-party EnvPool equivalent behind the Sebulba EnvFactory seam
+(reference stoix/wrappers/envpool.py adapts EnvPool's API the same way: manual
+auto-reset bookkeeping, numpy episode metrics, stoa-style TimeSteps).
+
+The shared library is compiled on first use with g++ and cached next to the
+source; no Python-level per-env loops exist anywhere on the hot path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.factory import EnvFactory
+from stoix_tpu.envs.types import Observation, TimeStep
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libcvec.so")
+_BUILD_LOCK = threading.Lock()
+
+
+def _ensure_built() -> str:
+    src = os.path.join(_NATIVE_DIR, "cvec.cpp")
+    with _BUILD_LOCK:
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", src, "-o", _LIB_PATH],
+                check=True,
+                capture_output=True,
+            )
+    return _LIB_PATH
+
+
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_ensure_built())
+    lib.cvec_create.restype = ctypes.c_void_p
+    lib.cvec_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.cvec_reset.argtypes = [ctypes.c_void_p, f32p]
+    lib.cvec_step.argtypes = [ctypes.c_void_p, i32p, f32p, f32p, f32p, u8p, u8p, f32p, i32p]
+    lib.cvec_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class CVecCartPole:
+    """Stateful Sebulba env backed by the native pool: numpy in, TimeStep out."""
+
+    def __init__(self, num_envs: int, seed: int, max_steps: int = 500):
+        self._lib = _load_lib()
+        self._handle = self._lib.cvec_create(num_envs, max_steps, seed)
+        self._n = num_envs
+        self._obs = np.zeros((num_envs, 4), np.float32)
+        self._next_obs = np.zeros((num_envs, 4), np.float32)
+        self._reward = np.zeros((num_envs,), np.float32)
+        self._done = np.zeros((num_envs,), np.uint8)
+        self._trunc = np.zeros((num_envs,), np.uint8)
+        self._ep_return = np.zeros((num_envs,), np.float32)
+        self._ep_length = np.zeros((num_envs,), np.int32)
+
+    @property
+    def num_envs(self) -> int:
+        return self._n
+
+    @property
+    def num_actions(self) -> int:
+        return 2
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((4,), np.float32),
+            action_mask=spaces.Array((2,), np.float32),
+            step_count=spaces.Array((), np.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(2)
+
+    def _observation(self, view: np.ndarray, counts: np.ndarray) -> Observation:
+        return Observation(
+            agent_view=view.copy(),
+            action_mask=np.ones((self._n, 2), np.float32),
+            step_count=counts.astype(np.int32),
+        )
+
+    def _timestep(self, first: bool) -> TimeStep:
+        done = self._done.astype(bool)
+        trunc = self._trunc.astype(bool)
+        last = done | trunc
+        counts = np.where(last, 0, self._ep_length)
+        return TimeStep(
+            step_type=np.where(
+                np.zeros((self._n,), bool) if not first else np.ones((self._n,), bool),
+                np.int8(0),
+                np.where(last, np.int8(2), np.int8(1)),
+            ),
+            reward=self._reward.copy(),
+            discount=np.where(done, 0.0, 1.0).astype(np.float32),
+            observation=self._observation(self._obs, counts),
+            extras={
+                "next_obs": self._observation(self._next_obs, self._ep_length),
+                "truncation": trunc.copy(),
+                "episode_metrics": {
+                    "episode_return": self._ep_return.copy(),
+                    "episode_length": self._ep_length.copy(),
+                    "is_terminal_step": last.copy(),
+                },
+            },
+        )
+
+    def reset(self, *, seed: Optional[int] = None) -> TimeStep:
+        del seed  # seeding fixed at construction (thread-unique via factory)
+        self._lib.cvec_reset(self._handle, self._obs)
+        self._reward[:] = 0
+        self._done[:] = 0
+        self._trunc[:] = 0
+        self._ep_return[:] = 0
+        self._ep_length[:] = 0
+        self._next_obs[:] = self._obs
+        return self._timestep(first=True)
+
+    def step(self, action: Any) -> TimeStep:
+        actions = np.ascontiguousarray(np.asarray(action, np.int32))
+        self._lib.cvec_step(
+            self._handle, actions, self._obs, self._next_obs, self._reward,
+            self._done, self._trunc, self._ep_return, self._ep_length,
+        )
+        return self._timestep(first=False)
+
+    def __del__(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.cvec_destroy(self._handle)
+            self._handle = None
+
+
+class CVecEnvFactory(EnvFactory):
+    """Factory for the native pool (CartPole-v1 is the only scenario so far)."""
+
+    def __call__(self, num_envs: int) -> CVecCartPole:
+        seed = self._next_seed(num_envs)
+        return CVecCartPole(num_envs, seed, **self._kwargs)

@@ -141,7 +141,10 @@ def _search_one(
             at_leaf = child == UNVISITED
             too_deep = depth + 1 >= max_depth
             done = jnp.logical_or(at_leaf, too_deep)
-            next_node = jnp.where(at_leaf, node, child)
+            # Stay at the PARENT when stopping: (node, action) is then always a
+            # PUCT-selected edge — expanded if unvisited, else its existing
+            # child's value is backed up below.
+            next_node = jnp.where(done, node, child)
             return (next_node, action, depth + 1, done)
 
         leaf_parent, action, _, _ = jax.lax.while_loop(

@@ -1,0 +1,121 @@
+"""Sebulba host-side plumbing (reference stoix/utils/sebulba_utils.py, 394 LoC).
+
+Threads + bounded queues connect actor devices to learner devices:
+  - ThreadLifetime: cooperative stop signal (:20-45)
+  - OnPolicyPipeline: one queue.Queue(maxsize=1) per actor; the learner must
+    collect from ALL actors each update — backpressure by construction (:48-96)
+  - ParameterServer: pushes fresh params to per-actor queues, device_put onto
+    each actor's device; `None` is the shutdown sentinel (:99-259)
+  - AsyncEvaluator: background evaluation requests with best-params tracking
+    (:262-367)
+
+TPU-native difference (SURVEY.md §7.1.3): trajectory hand-off builds GLOBAL
+arrays with jax.make_array_from_single_device_arrays via
+parallel.assemble_global_array, so the learner's jit consumes a correctly
+sharded batch with no host concat.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+import jax
+
+
+class ThreadLifetime:
+    def __init__(self) -> None:
+        self._stop = threading.Event()
+
+    def should_stop(self) -> bool:
+        return self._stop.is_set()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class OnPolicyPipeline:
+    """Bounded rollout queues, one per actor thread."""
+
+    def __init__(self, num_actors: int, max_size: int = 1):
+        self._queues: List[queue.Queue] = [queue.Queue(maxsize=max_size) for _ in range(num_actors)]
+
+    def send_rollout(self, actor_id: int, payload: Any, timeout: Optional[float] = None) -> None:
+        self._queues[actor_id].put(payload, timeout=timeout)
+
+    def collect_rollouts(self, timeout: float = 180.0) -> List[Any]:
+        """Blocks until every actor has contributed one rollout; an actor that
+        died surfaces here as Empty (reference sebulba_utils.py:85)."""
+        return [q.get(timeout=timeout) for q in self._queues]
+
+
+class ParameterServer:
+    """Latest-params distribution to actor devices."""
+
+    def __init__(self, actor_devices: List[jax.Device], actors_per_device: int):
+        self._devices = [d for d in actor_devices for _ in range(actors_per_device)]
+        self._queues: List[queue.Queue] = [queue.Queue(maxsize=1) for _ in self._devices]
+
+    @property
+    def num_actors(self) -> int:
+        return len(self._queues)
+
+    def distribute_params(self, params: Any) -> None:
+        for device, q in zip(self._devices, self._queues):
+            local = jax.device_put(params, device)
+            # Keep only the freshest params: drop a stale entry if present.
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            q.put(local)
+
+    def get_params(self, actor_id: int, timeout: Optional[float] = None) -> Any:
+        """Returns fresh params, or None (shutdown sentinel)."""
+        return self._queues[actor_id].get(timeout=timeout)
+
+    def shutdown(self) -> None:
+        for q in self._queues:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            q.put(None)
+
+
+class AsyncEvaluator:
+    """Runs evaluations off the critical path on a dedicated device."""
+
+    def __init__(
+        self,
+        evaluate: Callable[[Any, jax.Array], dict],
+        lifetime: ThreadLifetime,
+        on_result: Callable[[dict, Any, int], None],
+    ):
+        self._evaluate = evaluate
+        self._lifetime = lifetime
+        self._on_result = on_result
+        self._requests: queue.Queue = queue.Queue()
+        self._idle = threading.Event()
+        self._idle.set()
+        self.thread = threading.Thread(target=self._run, name="async-evaluator", daemon=True)
+
+    def submit(self, params: Any, key: jax.Array, t: int) -> None:
+        self._idle.clear()
+        self._requests.put((params, key, t))
+
+    def _run(self) -> None:
+        while not self._lifetime.should_stop():
+            try:
+                params, key, t = self._requests.get(timeout=1.0)
+            except queue.Empty:
+                self._idle.set()
+                continue
+            metrics = self._evaluate(params, key)
+            self._on_result(metrics, params, t)
+            if self._requests.empty():
+                self._idle.set()
+
+    def wait_until_idle(self, timeout: float = 600.0) -> None:
+        self._idle.wait(timeout=timeout)

@@ -1,0 +1,109 @@
+"""Sebulba IMPALA (reference stoix/systems/impala/sebulba/ff_impala.py, 1054 LoC).
+
+Off-policy actor-critic with V-trace corrections (Espeholt et al. 2018): the
+actor threads' stored log-probs are the behavior policy; the learner computes
+V-trace value targets and policy-gradient advantages
+(stoix_tpu.ops.multistep.vtrace_td_error_and_advantage, replacing the
+reference's rlax vmap at :426-439) in one pass per rollout. Shares the Sebulba
+scaffolding (threads/pipeline/param-server/async-eval) with sebulba ff_ppo.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
+from stoix_tpu.ops.multistep import vtrace_td_error_and_advantage
+from stoix_tpu.systems.ppo.sebulba.ff_ppo import CoreLearnerState, run_experiment as _run
+from stoix_tpu.utils import config as config_lib
+
+
+def get_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+
+    def per_shard(state: CoreLearnerState, traj: PPOTransition):
+        def loss_fn(params: ActorCriticParams):
+            dist = actor_apply(params.actor_params, traj.obs)
+            online_log_prob = dist.log_prob(traj.action)  # [T, E]
+            values = critic_apply(params.critic_params, traj.obs)  # [T, E]
+            bootstrap = critic_apply(params.critic_params, traj.next_obs)  # [T, E]
+
+            rhos = jnp.exp(jax.lax.stop_gradient(online_log_prob) - traj.log_prob)
+            d_t = gamma * (1.0 - traj.done.astype(jnp.float32))
+            lam = float(config.system.get("vtrace_lambda", 1.0))
+            errors, pg_adv, _ = jax.vmap(
+                lambda v, b, r, d, rho: vtrace_td_error_and_advantage(v, b, r, d, rho, lam),
+                in_axes=1,
+                out_axes=1,
+            )(
+                jax.lax.stop_gradient(values),
+                jax.lax.stop_gradient(bootstrap),
+                traj.reward,
+                d_t,
+                rhos,
+            )
+            pg_loss = -jnp.mean(pg_adv * online_log_prob)
+            value_targets = jax.lax.stop_gradient(errors + values)
+            value_loss = 0.5 * jnp.mean((values - value_targets) ** 2)
+            entropy = dist.entropy().mean()
+            total = (
+                pg_loss
+                + float(config.system.get("vf_coef", 0.5)) * value_loss
+                - float(config.system.get("ent_coef", 0.01)) * entropy
+            )
+            return total, {
+                "actor_loss": pg_loss,
+                "value_loss": value_loss,
+                "entropy": entropy,
+                "mean_rho": jnp.mean(rhos),
+            }
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        grads = jax.lax.pmean(grads, axis_name="data")
+        a_updates, a_opt = actor_update(
+            grads.actor_params, state.opt_states.actor_opt_state
+        )
+        c_updates, c_opt = critic_update(
+            grads.critic_params, state.opt_states.critic_opt_state
+        )
+        params = ActorCriticParams(
+            optax.apply_updates(state.params.actor_params, a_updates),
+            optax.apply_updates(state.params.critic_params, c_updates),
+        )
+        metrics = jax.lax.pmean(metrics, axis_name="data")
+        return CoreLearnerState(params, ActorCriticOptStates(a_opt, c_opt), state.key), metrics
+
+    return jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(CoreLearnerState(P(), P(), P()), P(None, "data")),
+            out_specs=(CoreLearnerState(P(), P(), P()), P()),
+            check_vma=False,
+        )
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return _run(config, learn_step_builder=get_impala_learn_step)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/sebulba/default_ff_impala.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,486 @@
+"""Sebulba PPO (reference stoix/systems/ppo/sebulba/ff_ppo.py, 1046 LoC).
+
+Actor/learner disaggregation for non-pure-JAX environments: actor THREADS pin
+jitted inference to actor devices and step stateful envs (EnvPool/C++/JAX
+adapters behind the EnvFactory seam); trajectories flow through bounded queues
+(OnPolicyPipeline) to a learner thread running the PPO update over a learner-
+device mesh; fresh params return via the ParameterServer; evaluation runs
+asynchronously on its own device.
+
+TPU-native differences from the reference (SURVEY.md §7.1.3):
+  - the learner consumes GLOBAL arrays assembled with
+    jax.make_array_from_single_device_arrays (no host concat, no
+    device_put_sharded), and the update itself is jit+shard_map over the
+    learner mesh rather than pmap.
+  - actor->learner backpressure (queue maxsize=1) and the skip-fetch-on-first-
+    rollout pipelining (reference :202-214) are preserved.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
+from stoix_tpu.envs.factory import make_factory
+from stoix_tpu.evaluator import get_distribution_act_fn, get_ff_evaluator_fn
+from stoix_tpu.ops import losses
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.parallel import assemble_global_array
+from stoix_tpu.sebulba.core import (
+    AsyncEvaluator,
+    OnPolicyPipeline,
+    ParameterServer,
+    ThreadLifetime,
+)
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.logger import LogEvent, StoixLogger
+from stoix_tpu.utils.timing import TimingTracker
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class CoreLearnerState(NamedTuple):
+    params: ActorCriticParams
+    opt_states: ActorCriticOptStates
+    key: jax.Array
+
+
+def _build_networks(config: Any, num_actions: int, obs_value: Any):
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
+
+    net_cfg = config.network
+    actor = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head, num_actions=num_actions
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic = FeedForwardCritic(
+        critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+        torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+    return actor, critic
+
+
+def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
+    """jit+shard_map PPO update over the learner mesh; batch arrives as global
+    arrays sharded on the env axis."""
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+
+    def per_shard(state: CoreLearnerState, traj: PPOTransition):
+        v_t = critic_apply(state.params.critic_params, traj.next_obs)
+        d_t = gamma * (1.0 - traj.done.astype(jnp.float32))
+        advantages, targets = truncated_generalized_advantage_estimation(
+            traj.reward, d_t, float(config.system.gae_lambda),
+            v_tm1=traj.value, v_t=v_t,
+            truncation_t=traj.truncated.astype(jnp.float32),
+            standardize_advantages=bool(config.system.get("standardize_advantages", True)),
+        )
+
+        def _minibatch(carry, batch):
+            params, opt_states = carry
+            mb_traj, mb_adv, mb_tgt = batch
+
+            def actor_loss_fn(p):
+                dist = actor_apply(p, mb_traj.obs)
+                log_prob = dist.log_prob(mb_traj.action)
+                loss = losses.ppo_clip_loss(
+                    log_prob, mb_traj.log_prob, mb_adv, float(config.system.clip_eps)
+                )
+                entropy = dist.entropy().mean()
+                return loss - float(config.system.ent_coef) * entropy, (loss, entropy)
+
+            def critic_loss_fn(p):
+                value = critic_apply(p, mb_traj.obs)
+                loss = losses.clipped_value_loss(
+                    value, mb_traj.value, mb_tgt, float(config.system.clip_eps)
+                )
+                return float(config.system.vf_coef) * loss, loss
+
+            a_grads, (a_loss, entropy) = jax.grad(actor_loss_fn, has_aux=True)(
+                params.actor_params
+            )
+            c_grads, v_loss = jax.grad(critic_loss_fn, has_aux=True)(params.critic_params)
+            a_grads, c_grads = jax.lax.pmean((a_grads, c_grads), axis_name="data")
+            a_updates, a_opt = actor_update(a_grads, opt_states.actor_opt_state)
+            c_updates, c_opt = critic_update(c_grads, opt_states.critic_opt_state)
+            params = ActorCriticParams(
+                optax.apply_updates(params.actor_params, a_updates),
+                optax.apply_updates(params.critic_params, c_updates),
+            )
+            return (params, ActorCriticOptStates(a_opt, c_opt)), {
+                "actor_loss": a_loss, "value_loss": v_loss, "entropy": entropy,
+            }
+
+        def _epoch(carry, _):
+            params, opt_states, key = carry
+            key, shuffle_key = jax.random.split(key)
+            batch_size = advantages.shape[0] * advantages.shape[1]
+            perm = jax.random.permutation(shuffle_key, batch_size)
+            flat = jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), (traj, advantages, targets)
+            )
+            shuffled = jax.tree.map(lambda x: jnp.take(x, perm, axis=0), flat)
+            minibatches = jax.tree.map(
+                lambda x: x.reshape(
+                    (int(config.system.num_minibatches), -1) + x.shape[1:]
+                ),
+                shuffled,
+            )
+            (params, opt_states), metrics = jax.lax.scan(
+                _minibatch, (params, opt_states), minibatches
+            )
+            return (params, opt_states, key), metrics
+
+        (params, opt_states, key), metrics = jax.lax.scan(
+            _epoch, (state.params, state.opt_states, state.key), None,
+            int(config.system.epochs),
+        )
+        metrics = jax.lax.pmean(metrics, axis_name="data")
+        return CoreLearnerState(params, opt_states, key), metrics
+
+    return jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(CoreLearnerState(P(), P(), P()), P(None, "data")),
+            out_specs=(CoreLearnerState(P(), P(), P()), P()),
+            check_vma=False,
+        )
+    )
+
+
+def rollout_thread(
+    actor_id: int,
+    actor_device: jax.Device,
+    env_factory,
+    actor_apply,
+    critic_apply,
+    config: Any,
+    pipeline: OnPolicyPipeline,
+    param_server: ParameterServer,
+    learner_devices: List[jax.Device],
+    learner_mesh: Mesh,
+    lifetime: ThreadLifetime,
+    seed: int,
+    metrics_sink: "queue.Queue",
+) -> None:
+    envs_per_actor = int(config.arch.actor.envs_per_actor)
+    rollout_length = int(config.system.rollout_length)
+    timer = TimingTracker()
+
+    try:
+        _rollout_body(
+            actor_id, actor_device, env_factory, actor_apply, critic_apply,
+            config, pipeline, param_server, learner_devices, learner_mesh,
+            lifetime, seed, metrics_sink, envs_per_actor, rollout_length, timer,
+        )
+    except Exception:
+        import traceback
+
+        print(f"[actor-{actor_id}] CRASHED:", flush=True)
+        traceback.print_exc()
+        lifetime.stop()
+
+
+def _rollout_body(
+    actor_id, actor_device, env_factory, actor_apply, critic_apply, config,
+    pipeline, param_server, learner_devices, learner_mesh, lifetime, seed,
+    metrics_sink, envs_per_actor, rollout_length, timer,
+):
+    envs = env_factory(envs_per_actor)
+    timestep = envs.reset(seed=seed)
+
+    @jax.jit
+    def act_fn(params: ActorCriticParams, observation, key):
+        dist = actor_apply(params.actor_params, observation)
+        value = critic_apply(params.critic_params, observation)
+        action = dist.sample(seed=key)
+        return action, dist.log_prob(action), value
+
+    with jax.default_device(actor_device):
+        key = jax.random.PRNGKey(seed)
+        params = param_server.get_params(actor_id)
+        rollout_idx = 0
+        while not lifetime.should_stop():
+            # Pipelining: skip the param fetch on the second rollout so actors
+            # run ahead while the learner computes (reference :202-214).
+            if rollout_idx > 1:
+                with timer.time("get_params"):
+                    fetched = param_server.get_params(actor_id)
+                    if fetched is None:
+                        break
+                    params = fetched
+            traj: List[PPOTransition] = []
+            with timer.time("rollout"):
+                for _ in range(rollout_length):
+                    key, act_key = jax.random.split(key)
+                    with timer.time("inference"):
+                        # Envs may live on a different device (e.g. CPU for
+                        # C++/EnvPool backends); stage observations onto the
+                        # actor device for inference.
+                        obs_local = jax.device_put(timestep.observation, actor_device)
+                        action, log_prob, value = act_fn(params, obs_local, act_key)
+                    with timer.time("env_step"):
+                        next_timestep = envs.step(action)
+                    traj.append(
+                        PPOTransition(
+                            done=next_timestep.discount == 0.0,
+                            truncated=jnp.logical_and(
+                                next_timestep.last(), next_timestep.discount != 0.0
+                            ),
+                            action=action,
+                            value=value,
+                            reward=next_timestep.reward,
+                            log_prob=log_prob,
+                            obs=obs_local,
+                            next_obs=next_timestep.extras["next_obs"],
+                            info=next_timestep.extras["episode_metrics"],
+                        )
+                    )
+                    timestep = next_timestep
+
+            with timer.time("prepare_data"):
+                # Stack [T, E] then split the env axis across learner devices
+                # as single-device shards for global-array assembly.
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *traj)
+                n_learners = len(learner_devices)
+                payload = jax.tree.map(
+                    lambda x: [
+                        jax.device_put(s, d)
+                        for s, d in zip(jnp.split(x, n_learners, axis=1), learner_devices)
+                    ],
+                    stacked,
+                )
+            with timer.time("queue_put"):
+                try:
+                    pipeline.send_rollout(actor_id, payload, timeout=60.0)
+                except queue.Full:
+                    if lifetime.should_stop():
+                        break
+                    raise
+            metrics_sink.put(
+                {
+                    "episode_metrics": jax.tree.map(np.asarray, stacked.info),
+                    "timings": timer.all_means(prefix=f"actor{actor_id}_"),
+                }
+            )
+            rollout_idx += 1
+
+
+def run_experiment(config: Any, learn_step_builder: Callable = None) -> float:
+    devices = jax.devices()
+    actor_devices = [devices[i] for i in config.arch.actor.device_ids]
+    learner_devices = [devices[i] for i in config.arch.learner.device_ids]
+    evaluator_device = devices[int(config.arch.evaluator_device_id)]
+    learner_mesh = Mesh(np.array(learner_devices), ("data",))
+    eval_mesh = Mesh(np.array([evaluator_device]), ("data",))
+
+    actors_per_device = int(config.arch.actor.actor_per_device)
+    num_actors = len(actor_devices) * actors_per_device
+    config.arch.actor.envs_per_actor = int(config.arch.total_num_envs) // num_actors
+
+    # Budget accounting (reference total_timestep_checker sebulba branch).
+    steps_per_update = int(config.system.rollout_length) * int(config.arch.total_num_envs)
+    if config.arch.get("num_updates") in (None, "~"):
+        config.arch.num_updates = max(
+            1, int(float(config.arch.total_timesteps)) // steps_per_update
+        )
+    config.arch.total_timesteps = int(config.arch.num_updates) * steps_per_update
+    num_evaluation = max(1, int(config.arch.get("num_evaluation", 1)))
+    config.arch.num_updates_per_eval = max(1, int(config.arch.num_updates) // num_evaluation)
+    config.logger.system_name = config.system.system_name
+
+    env_factory = make_factory(config)
+    probe_envs = env_factory(1)
+    num_actions = probe_envs.num_actions
+    config.system.action_dim = num_actions
+    dummy_obs = jax.tree.map(
+        lambda x: np.asarray(x)[None], probe_envs.observation_space().generate_value()
+        if hasattr(probe_envs.observation_space(), "generate_value")
+        else probe_envs.reset(seed=0).observation,
+    )
+
+    actor, critic = _build_networks(config, num_actions, dummy_obs)
+    key = jax.random.PRNGKey(int(config.arch.seed))
+    key, a_key, c_key = jax.random.split(key, 3)
+    obs0 = jax.tree.map(lambda x: jnp.asarray(x), probe_envs.reset(seed=0).observation)
+    actor_params = actor.init(a_key, obs0)
+    critic_params = critic.init(c_key, obs0)
+
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config,
+                                      int(config.system.epochs),
+                                      int(config.system.num_minibatches)), eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.critic_lr), config,
+                                      int(config.system.epochs),
+                                      int(config.system.num_minibatches)), eps=1e-5),
+    )
+    params = ActorCriticParams(actor_params, critic_params)
+    opt_states = ActorCriticOptStates(
+        actor_optim.init(actor_params), critic_optim.init(critic_params)
+    )
+    key, learn_key = jax.random.split(key)
+    learner_state = jax.device_put(
+        CoreLearnerState(params, opt_states, learn_key),
+        NamedSharding(learner_mesh, P()),
+    )
+
+    builder = learn_step_builder or get_learn_step
+    learn_step = builder(
+        actor.apply, critic.apply, (actor_optim.update, critic_optim.update),
+        config, learner_mesh,
+    )
+
+    # Evaluation on the dedicated device via the standard sharded evaluator.
+    from stoix_tpu.envs.registry import make_single
+    from stoix_tpu.envs.wrappers import RecordEpisodeMetrics
+
+    eval_env = RecordEpisodeMetrics(
+        make_single(
+            config.env.scenario.name
+            if hasattr(config.env.scenario, "name")
+            else config.env.scenario,
+            **dict(config.env.get("kwargs", {}) or {}),
+        )
+    )
+    eval_fn = get_ff_evaluator_fn(
+        eval_env, get_distribution_act_fn(config, actor.apply), config, eval_mesh
+    )
+
+    logger = StoixLogger(config)
+    lifetime = ThreadLifetime()
+    pipeline = OnPolicyPipeline(num_actors)
+    param_server = ParameterServer(actor_devices, actors_per_device)
+    metrics_sink: "queue.Queue" = queue.Queue()
+
+    eval_results: List[float] = []
+
+    def on_eval_result(metrics, params_used, t):
+        logger.log(metrics, t, len(eval_results), LogEvent.EVAL)
+        eval_results.append(float(jnp.mean(metrics["episode_return"])))
+
+    async_evaluator = AsyncEvaluator(eval_fn, lifetime, on_eval_result)
+    async_evaluator.thread.start()
+
+    param_server.distribute_params(params)
+
+    actor_threads = []
+    for d_idx, device in enumerate(actor_devices):
+        for a_idx in range(actors_per_device):
+            actor_id = d_idx * actors_per_device + a_idx
+            t = threading.Thread(
+                target=rollout_thread,
+                args=(
+                    actor_id, device, env_factory, actor.apply, critic.apply,
+                    config, pipeline, param_server, learner_devices, learner_mesh,
+                    lifetime, int(config.arch.seed) + 7919 * actor_id, metrics_sink,
+                ),
+                name=f"actor-{actor_id}",
+                daemon=True,
+            )
+            t.start()
+            actor_threads.append(t)
+
+    timer = TimingTracker()
+    t_steps = 0
+    try:
+        for update_idx in range(int(config.arch.num_updates)):
+            with timer.time("rollout_get"):
+                payloads = pipeline.collect_rollouts()
+            with timer.time("assemble"):
+                # Per learner device: concat all actors' shards, then build one
+                # global array per leaf.
+                def to_global(*leaves):
+                    per_device = []
+                    for d in range(len(learner_devices)):
+                        shards = [leaf[d] for leaf in leaves]
+                        with jax.default_device(learner_devices[d]):
+                            per_device.append(jnp.concatenate(shards, axis=1))
+                    return assemble_global_array(per_device, learner_mesh, axis="data") \
+                        if len(per_device) > 1 else per_device[0]
+
+                # leaves are lists of per-device arrays; traverse manually.
+                flat_payloads = [jax.tree.flatten(p, is_leaf=lambda x: isinstance(x, list))
+                                 for p in payloads]
+                treedef = flat_payloads[0][1]
+                merged_leaves = [
+                    to_global(*(fp[0][i] for fp in flat_payloads))
+                    for i in range(len(flat_payloads[0][0]))
+                ]
+                batch = jax.tree.unflatten(treedef, merged_leaves)
+
+            with timer.time("learn"):
+                learner_state, train_metrics = learn_step(learner_state, batch)
+                jax.block_until_ready(train_metrics)
+            param_server.distribute_params(learner_state.params)
+            t_steps += steps_per_update
+
+            if (update_idx + 1) % int(config.arch.num_updates_per_eval) == 0:
+                # Drain actor metrics and log.
+                ep_returns, timings = [], {}
+                while not metrics_sink.empty():
+                    m = metrics_sink.get_nowait()
+                    em = m["episode_metrics"]
+                    mask = em["is_terminal_step"].reshape(-1)
+                    if mask.any():
+                        ep_returns.extend(em["episode_return"].reshape(-1)[mask].tolist())
+                    timings.update(m["timings"])
+                if ep_returns:
+                    logger.log({"episode_return": np.asarray(ep_returns)}, t_steps,
+                               update_idx, LogEvent.ACT)
+                logger.log(jax.tree.map(lambda x: jnp.mean(x), train_metrics),
+                           t_steps, update_idx, LogEvent.TRAIN)
+                logger.log({**timings, **timer.all_means(prefix="learner_")},
+                           t_steps, update_idx, LogEvent.MISC)
+                key, ek = jax.random.split(key)
+                eval_params = jax.device_put(
+                    jax.tree.map(np.asarray, learner_state.params.actor_params),
+                    evaluator_device,
+                )
+                async_evaluator.submit(eval_params, ek, t_steps)
+    finally:
+        lifetime.stop()
+        param_server.shutdown()
+        # Unblock actors waiting to enqueue.
+        for _ in range(2):
+            try:
+                pipeline.collect_rollouts(timeout=0.5)
+            except Exception:
+                break
+        for t in actor_threads:
+            t.join(timeout=10.0)
+        async_evaluator.wait_until_idle(timeout=120.0)
+
+    logger.close()
+    return eval_results[-1] if eval_results else 0.0
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/sebulba/default_ff_ppo.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

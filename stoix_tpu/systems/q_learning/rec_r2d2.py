@@ -46,9 +46,12 @@ def get_learner_fn(env, q_network, q_update, buffer, config, cell_type, hidden_s
         (params, opt_states, buffer_state, key, env_state, last_timestep,
          done, truncated, hstate) = learner_state
         key, act_key = jax.random.split(key)
+        # Hidden state resets on done OR truncation, matching the flags the
+        # training replay uses (a mismatch desynchronizes stored hstates).
+        reset_flag = jnp.logical_or(done, truncated)
         obs_t = jax.tree.map(lambda x: x[None], last_timestep.observation)
         new_hstate, dist = q_network.apply(
-            params.online, hstate, (obs_t, done[None]), train_eps
+            params.online, hstate, (obs_t, reset_flag[None]), train_eps
         )
         action = dist.sample(seed=act_key)[0]
         env_state, timestep = env.step(env_state, action)
@@ -59,7 +62,7 @@ def get_learner_fn(env, q_network, q_update, buffer, config, cell_type, hidden_s
             "action": action,
             "reward": timestep.reward,
             "discount": timestep.discount,
-            "done": jnp.logical_or(done, truncated),  # done flag ENTERING the step
+            "done": reset_flag,  # reset flag ENTERING the step
             "hstate": jax.tree.map(lambda x: x, hstate),  # carry at step start
             "info": timestep.extras["episode_metrics"],
         }
@@ -292,7 +295,9 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     def rnn_act_fn(params, hstate, observation, done, act_key):
         obs_t = jax.tree.map(lambda x: x[None, None], observation)
         done_t = jnp.asarray(done).reshape(1, 1)
-        hstate, dist = q_network.apply(params, hstate, (obs_t, done_t), 0.0)
+        hstate, dist = q_network.apply(
+            params, hstate, (obs_t, done_t), float(config.system.evaluation_epsilon)
+        )
         greedy = bool(config.arch.get("evaluation_greedy", False))
         action = dist.mode() if greedy else dist.sample(seed=act_key)
         return hstate, action[0, 0]

@@ -36,7 +36,12 @@ class AnakinSetup(NamedTuple):
 SetupFn = Callable[[envs.Environment, Any, Any, jax.Array], AnakinSetup]
 
 
-def run_anakin_experiment(config: Any, setup_fn: SetupFn, warmup_fn: Optional[Callable] = None) -> float:
+def run_anakin_experiment(
+    config: Any,
+    setup_fn: SetupFn,
+    warmup_fn: Optional[Callable] = None,
+    evaluator_setup_fn: Callable = None,
+) -> float:
     """Generic Anakin experiment: returns final eval episode-return mean."""
     maybe_initialize_distributed(config)
     mesh = create_mesh(dict(config.arch.get("mesh") or {"data": -1}))
@@ -54,7 +59,8 @@ def run_anakin_experiment(config: Any, setup_fn: SetupFn, warmup_fn: Optional[Ca
         learner_state = warmup_fn(learner_state)
         jax.block_until_ready(jax.tree.leaves(learner_state)[0])
 
-    evaluator, absolute_evaluator = evaluator_setup(eval_env, setup.eval_act_fn, config, mesh)
+    make_evaluators = evaluator_setup_fn or evaluator_setup
+    evaluator, absolute_evaluator = make_evaluators(eval_env, setup.eval_act_fn, config, mesh)
     logger = StoixLogger(config)
     checkpointer = checkpointer_from_config(config, config.system.system_name)
 
@@ -136,10 +142,4 @@ def run_rnn_anakin_experiment(config: Any, setup_fn: SetupFn) -> float:
         )
         return evaluator, absolute
 
-    global evaluator_setup
-    original = evaluator_setup
-    evaluator_setup = rnn_evaluator_setup
-    try:
-        return run_anakin_experiment(config, setup_fn)
-    finally:
-        evaluator_setup = original
+    return run_anakin_experiment(config, setup_fn, evaluator_setup_fn=rnn_evaluator_setup)
