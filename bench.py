@@ -59,16 +59,24 @@ def main() -> None:
         * int(config.arch.num_updates_per_eval)
     )
 
+    import numpy as np
+
+    def force(out):
+        # Materialize a scalar on the host: block_until_ready alone can be a
+        # no-op through remote-platform tunnels, which fakes the timing.
+        leaf = jax.tree.leaves(out.learner_state.params)[0]
+        return float(np.asarray(jax.numpy.sum(leaf)))
+
     # Warmup / compile.
     out = learn(learner_state)
-    jax.block_until_ready(out.learner_state)
+    force(out)
     learner_state = out.learner_state
 
     times = []
     for _ in range(3 if not smoke else 1):
         start = time.perf_counter()
         out = learn(learner_state)
-        jax.block_until_ready(out.learner_state)
+        force(out)
         learner_state = out.learner_state
         times.append(time.perf_counter() - start)
 
