@@ -44,7 +44,7 @@ from stoix_tpu.base_types import (
     PPOTransition,
 )
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops import losses
+from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.parallel import is_coordinator
 from stoix_tpu.utils import config as config_lib
@@ -53,13 +53,28 @@ from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils.training import make_learning_rate
 
 
+from typing import NamedTuple
+
+
+class PPOLearnerState(NamedTuple):
+    """OnPolicyLearnerState + observation running statistics (the reference
+    injects this field dynamically, ff_ppo.py:90-94; here it is explicit)."""
+
+    params: Any
+    opt_states: Any
+    key: jax.Array
+    env_state: Any
+    timestep: Any
+    obs_stats: Any
+
+
 def get_learner_fn(
     env: envs.Environment,
     apply_fns: Tuple[Callable, Callable],
     update_fns: Tuple[Callable, Callable],
     config: Any,
     policy_loss_fn: Callable = None,
-) -> Callable[[OnPolicyLearnerState], ExperimentOutput]:
+) -> Callable[[PPOLearnerState], ExperimentOutput]:
     """Build the PER-SHARD learner function (wrapped in shard_map by setup).
 
     policy_loss_fn(dist, action, old_log_prob, gae, config) -> (loss, entropy)
@@ -69,13 +84,24 @@ def get_learner_fn(
     actor_update, critic_update = update_fns
     gamma = float(config.system.gamma)
     reward_scale = float(config.system.get("reward_scale", 1.0))
+    normalize_obs = bool(config.system.get("normalize_observations", False))
 
-    def _env_step(learner_state: OnPolicyLearnerState, _: Any):
-        params, opt_states, key, env_state, last_timestep = learner_state
+    def _maybe_normalize(observation, obs_stats):
+        if not normalize_obs:
+            return observation
+        return observation._replace(
+            agent_view=running_statistics.normalize(
+                observation.agent_view, obs_stats, max_abs_value=10.0
+            )
+        )
+
+    def _env_step(learner_state: PPOLearnerState, _: Any):
+        params, opt_states, key, env_state, last_timestep, obs_stats = learner_state
         key, policy_key = jax.random.split(key)
 
-        actor_policy = actor_apply(params.actor_params, last_timestep.observation)
-        value = critic_apply(params.critic_params, last_timestep.observation)
+        observation = _maybe_normalize(last_timestep.observation, obs_stats)
+        actor_policy = actor_apply(params.actor_params, observation)
+        value = critic_apply(params.critic_params, observation)
         action = actor_policy.sample(seed=policy_key)
         log_prob = actor_policy.log_prob(action)
 
@@ -90,12 +116,12 @@ def get_learner_fn(
             value=value,
             reward=timestep.reward,
             log_prob=log_prob,
-            obs=last_timestep.observation,
-            next_obs=timestep.extras["next_obs"],
+            obs=observation,  # normalized with the PRE-update statistics
+            next_obs=timestep.extras["next_obs"],  # raw; normalized at use
             info=timestep.extras["episode_metrics"],
         )
         return (
-            OnPolicyLearnerState(params, opt_states, key, env_state, timestep),
+            PPOLearnerState(params, opt_states, key, env_state, timestep, obs_stats),
             transition,
         )
 
@@ -188,11 +214,28 @@ def get_learner_fn(
         )
         return (params, opt_states, traj_batch, advantages, targets, key), loss_info
 
-    def _update_step(learner_state: OnPolicyLearnerState, _: Any):
+    def _update_step(learner_state: PPOLearnerState, _: Any):
         learner_state, traj_batch = jax.lax.scan(
             _env_step, learner_state, None, int(config.system.rollout_length)
         )
-        params, opt_states, key, env_state, last_timestep = learner_state
+        params, opt_states, key, env_state, last_timestep, obs_stats = learner_state
+
+        # Statistics fold the RAW batch (psummed over the vmap + mesh axes so
+        # every replica stays in sync, reference ff_ppo.py:145-162); bootstrap
+        # obs are normalized with the same PRE-update statistics the rollout
+        # used.
+        raw_next_obs = traj_batch.next_obs
+        traj_batch = traj_batch._replace(
+            next_obs=_maybe_normalize(raw_next_obs, obs_stats)
+        )
+        if normalize_obs:
+            obs_stats = running_statistics.update(
+                obs_stats,
+                raw_next_obs.agent_view,
+                axis_names=("batch", "data"),
+                std_min_value=5e-4,
+                std_max_value=5e4,
+            )
 
         # ONE batched critic apply for all bootstrap values [T, E].
         v_t = critic_apply(params.critic_params, traj_batch.next_obs)
@@ -213,12 +256,12 @@ def get_learner_fn(
             _update_epoch, update_state, None, int(config.system.epochs)
         )
         params, opt_states, _, _, _, key = update_state
-        learner_state = OnPolicyLearnerState(
-            params, opt_states, key, env_state, last_timestep
+        learner_state = PPOLearnerState(
+            params, opt_states, key, env_state, last_timestep, obs_stats
         )
         return learner_state, (traj_batch.info, loss_info)
 
-    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+    def learner_fn(learner_state: PPOLearnerState) -> ExperimentOutput:
         """Per-shard learner: scans vmapped update steps for one eval period."""
         key = learner_state.key[0]  # [S=1 slice, U, 2] -> [U, 2]
         state = learner_state._replace(key=key)
@@ -300,15 +343,17 @@ def learner_setup(
 
     # ---- Global learner-state construction (shared anakin conventions) -----
     update_batch = int(config.arch.get("update_batch_size", 1))
-    state_specs = OnPolicyLearnerState(
+    state_specs = PPOLearnerState(
         params=P(),
         opt_states=P(),
         key=P("data"),
         env_state=P(None, "data"),
         timestep=P(None, "data"),
+        obs_stats=P(),
     )
     env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
-    learner_state = OnPolicyLearnerState(
+    obs_stats = running_statistics.init_state(env.observation_value().agent_view)
+    learner_state = PPOLearnerState(
         params=anakin.broadcast_to_update_batch(
             ActorCriticParams(actor_params, critic_params), update_batch
         ),
@@ -318,6 +363,7 @@ def learner_setup(
         key=anakin.make_step_keys(key, mesh, config),
         env_state=env_state,
         timestep=timestep,
+        obs_stats=anakin.broadcast_to_update_batch(obs_stats, update_batch),
     )
     learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
     learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
@@ -327,11 +373,32 @@ def learner_setup(
         print(f"[setup] {n_params:,} parameters | mesh {dict(mesh.shape)} | "
               f"{config.arch.total_num_envs} global envs")
 
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+    if normalize_obs:
+        # Eval params bundle the actor params with the current statistics.
+        def eval_apply(bundle, observation):
+            params, stats = bundle
+            observation = observation._replace(
+                agent_view=running_statistics.normalize(
+                    observation.agent_view, stats, max_abs_value=10.0
+                )
+            )
+            return actor_network.apply(params, observation)
+
+        eval_act_fn = get_distribution_act_fn(config, eval_apply)
+        eval_params_fn = lambda s: (
+            jax.tree.map(lambda x: x[0], s.params.actor_params),
+            jax.tree.map(lambda x: x[0], s.obs_stats),
+        )
+    else:
+        eval_act_fn = get_distribution_act_fn(config, actor_network.apply)
+        eval_params_fn = lambda s: jax.tree.map(lambda x: x[0], s.params.actor_params)
+
     setup = AnakinSetup(
         learn=learn,
         learner_state=learner_state,
-        eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
-        eval_params_fn=lambda s: jax.tree.map(lambda x: x[0], s.params.actor_params),
+        eval_act_fn=eval_act_fn,
+        eval_params_fn=eval_params_fn,
     )
     return setup
 
