@@ -28,7 +28,7 @@ Layout (S = data shards, U = update batch, E = envs per (shard, batch)):
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,7 +40,6 @@ from stoix_tpu.base_types import (
     ActorCriticOptStates,
     ActorCriticParams,
     ExperimentOutput,
-    OnPolicyLearnerState,
     PPOTransition,
 )
 from stoix_tpu.evaluator import get_distribution_act_fn
@@ -51,9 +50,6 @@ from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.jax_utils import count_parameters, tree_merge_leading_dims
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils.training import make_learning_rate
-
-
-from typing import NamedTuple
 
 
 class PPOLearnerState(NamedTuple):
@@ -116,8 +112,8 @@ def get_learner_fn(
             value=value,
             reward=timestep.reward,
             log_prob=log_prob,
-            obs=observation,  # normalized with the PRE-update statistics
-            next_obs=timestep.extras["next_obs"],  # raw; normalized at use
+            obs=last_timestep.observation,  # RAW; normalized at use
+            next_obs=timestep.extras["next_obs"],  # RAW; normalized at use
             info=timestep.extras["episode_metrics"],
         )
         return (
@@ -220,18 +216,20 @@ def get_learner_fn(
         )
         params, opt_states, key, env_state, last_timestep, obs_stats = learner_state
 
-        # Statistics fold the RAW batch (psummed over the vmap + mesh axes so
-        # every replica stays in sync, reference ff_ppo.py:145-162); bootstrap
-        # obs are normalized with the same PRE-update statistics the rollout
-        # used.
-        raw_next_obs = traj_batch.next_obs
+        # Trajectory obs are stored RAW; normalize them with the PRE-update
+        # statistics (identical to what the rollout's log_probs/values used),
+        # THEN fold the raw policy-consumed observations into the statistics
+        # (psummed over the vmap + mesh axes so every replica stays in sync —
+        # reference ff_ppo.py:145-162).
+        raw_obs = traj_batch.obs
         traj_batch = traj_batch._replace(
-            next_obs=_maybe_normalize(raw_next_obs, obs_stats)
+            obs=_maybe_normalize(raw_obs, obs_stats),
+            next_obs=_maybe_normalize(traj_batch.next_obs, obs_stats),
         )
         if normalize_obs:
             obs_stats = running_statistics.update(
                 obs_stats,
-                raw_next_obs.agent_view,
+                raw_obs.agent_view,
                 axis_names=("batch", "data"),
                 std_min_value=5e-4,
                 std_max_value=5e4,
