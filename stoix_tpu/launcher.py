@@ -1,0 +1,114 @@
+"""Batch launcher: fan out (system x env x seed) runs to SLURM or local shells.
+
+The reference uses a submitit-based SLURM launcher
+(reference stoix/slurm_launcher.py:40-83, configs/launcher/slurm.yaml) taking
+the cartesian product of algorithm files, environments, and seeds. submitit is
+not a dependency here; this launcher emits/submits plain `sbatch` scripts (or
+runs locally with `--local`), and for multi-host TPU pods it injects the
+`jax.distributed` coordination env vars consumed by
+stoix_tpu.parallel.maybe_initialize_distributed.
+
+Usage:
+    python -m stoix_tpu.launcher \
+        --systems stoix_tpu.systems.ppo.anakin.ff_ppo stoix_tpu.systems.sac.ff_sac \
+        --envs cartpole pendulum --seeds 0 1 2 \
+        [--local | --submit] [--nodes 1] [--time 04:00:00] [--partition tpu] \
+        [overrides...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import subprocess
+import sys
+from typing import List
+
+SBATCH_TEMPLATE = """#!/bin/bash
+#SBATCH --job-name={job_name}
+#SBATCH --output={log_dir}/{job_name}_%j.out
+#SBATCH --nodes={nodes}
+#SBATCH --ntasks-per-node=1
+#SBATCH --time={time}
+{partition_line}{extra_lines}
+# Multi-host JAX coordination: process 0's host is the coordinator. The
+# per-task process id must be read INSIDE the srun'd command (the batch shell's
+# SLURM_PROCID is always 0).
+export JAX_COORDINATOR_ADDRESS="$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1):12345"
+export JAX_NUM_PROCESSES="$SLURM_NNODES"
+
+srun bash -c 'JAX_PROCESS_ID="$SLURM_PROCID" python -m {module} {overrides}' 
+"""
+
+
+def build_jobs(args: argparse.Namespace) -> List[dict]:
+    jobs = []
+    for module, env, seed in itertools.product(args.systems, args.envs, args.seeds):
+        name = f"{module.rsplit('.', 1)[-1]}_{env}_s{seed}"
+        overrides = [f"env={env}", f"arch.seed={seed}", *args.overrides]
+        jobs.append({"name": name, "module": module, "overrides": overrides})
+    return jobs
+
+
+def main(argv: List[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--systems", nargs="+", required=True, help="module paths")
+    parser.add_argument("--envs", nargs="+", required=True, help="env group names")
+    parser.add_argument("--seeds", nargs="+", type=int, default=[0])
+    parser.add_argument("--local", action="store_true", help="run sequentially here")
+    parser.add_argument("--submit", action="store_true", help="sbatch immediately")
+    parser.add_argument("--nodes", type=int, default=1)
+    parser.add_argument("--time", default="04:00:00")
+    parser.add_argument("--partition", default=None)
+    parser.add_argument("--sbatch-extra", nargs="*", default=[], help="raw #SBATCH lines")
+    parser.add_argument("--script-dir", default="launcher_scripts")
+    parser.add_argument("--log-dir", default="launcher_logs")
+    parser.add_argument("overrides", nargs="*", help="shared key=value overrides")
+    args = parser.parse_args(argv)
+
+    jobs = build_jobs(args)
+    print(f"[launcher] {len(jobs)} jobs: "
+          f"{len(args.systems)} systems x {len(args.envs)} envs x {len(args.seeds)} seeds")
+
+    if args.local:
+        # Make the repo importable from any working directory.
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        for job in jobs:
+            print(f"[launcher] running {job['name']}")
+            subprocess.run(
+                [sys.executable, "-m", job["module"], *job["overrides"]],
+                check=True,
+                env=env,
+            )
+        return
+
+    os.makedirs(args.script_dir, exist_ok=True)
+    os.makedirs(args.log_dir, exist_ok=True)
+    partition_line = f"#SBATCH --partition={args.partition}\n" if args.partition else ""
+    extra_lines = "".join(f"#SBATCH {line}\n" for line in args.sbatch_extra)
+    for job in jobs:
+        script = SBATCH_TEMPLATE.format(
+            job_name=job["name"],
+            log_dir=args.log_dir,
+            nodes=args.nodes,
+            time=args.time,
+            partition_line=partition_line,
+            extra_lines=extra_lines,
+            module=job["module"],
+            overrides=" ".join(job["overrides"]),
+        )
+        path = os.path.join(args.script_dir, f"{job['name']}.sbatch")
+        with open(path, "w") as f:
+            f.write(script)
+        if args.submit:
+            subprocess.run(["sbatch", path], check=True)
+            print(f"[launcher] submitted {path}")
+        else:
+            print(f"[launcher] wrote {path} (pass --submit to sbatch)")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,7 +41,10 @@ def maybe_initialize_distributed(config: Optional[Any] = None) -> None:
             (dist_cfg or {}).get("num_processes", os.environ.get("JAX_NUM_PROCESSES", 1))
         ),
         process_id=int(
-            (dist_cfg or {}).get("process_id", os.environ.get("JAX_PROCESS_ID", 0))
+            (dist_cfg or {}).get(
+                "process_id",
+                os.environ.get("JAX_PROCESS_ID", os.environ.get("SLURM_PROCID", 0)),
+            )
         ),
     )
 

@@ -1,0 +1,133 @@
+"""Sebulba IMPALA with a shared torso (reference
+stoix/systems/impala/sebulba/ff_impala_shared_torso.py, 1018 LoC): ONE network
+with a PolicyValueHead serves both the policy and the value function
+(reference uses a single net + PolicyValueHead). Implemented as two views over
+the same module: the actor view returns the distribution, the critic view the
+value; both views share parameters and the combined V-trace loss updates them
+once through the actor optimizer (the critic optimizer sees an empty tree).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
+from stoix_tpu.ops.multistep import vtrace_td_error_and_advantage
+from stoix_tpu.systems.ppo.sebulba.ff_ppo import CoreLearnerState, run_experiment as _run
+from stoix_tpu.utils import config as config_lib
+
+
+class _SharedView(nn.Module):
+    """Callable view over a shared actor-critic module selecting one output."""
+
+    net: nn.Module
+    index: int
+
+    @nn.compact
+    def __call__(self, observation):
+        return self.net(observation)[self.index]
+
+
+def build_shared_networks(config: Any, num_actions: int, dummy_obs: Any):
+    from stoix_tpu.networks.base import FeedForwardActorCritic
+    from stoix_tpu.networks.heads import CategoricalHead, PolicyValueHead, ScalarCriticHead
+
+    net_cfg = config.network
+    shared = FeedForwardActorCritic(
+        shared_head=PolicyValueHead(
+            action_head=CategoricalHead(num_actions=num_actions),
+            critic_head=ScalarCriticHead(),
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    actor_view = _SharedView(net=shared, index=0)
+    critic_view = _SharedView(net=shared, index=1)
+    return actor_view, critic_view
+
+
+def get_shared_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
+    """V-trace update through the shared parameters only (actor slot)."""
+    actor_update, _ = update_fns
+    gamma = float(config.system.gamma)
+
+    def per_shard(state: CoreLearnerState, traj: PPOTransition):
+        def loss_fn(shared_params):
+            dist = actor_apply(shared_params, traj.obs)
+            online_log_prob = dist.log_prob(traj.action)
+            values = critic_apply(shared_params, traj.obs)
+            bootstrap = critic_apply(shared_params, traj.next_obs)
+
+            rhos = jnp.exp(jax.lax.stop_gradient(online_log_prob) - traj.log_prob)
+            d_t = gamma * (1.0 - traj.done.astype(jnp.float32))
+            lam = float(config.system.get("vtrace_lambda", 1.0))
+            errors, pg_adv, _ = jax.vmap(
+                lambda v, b, r, d, rho: vtrace_td_error_and_advantage(v, b, r, d, rho, lam),
+                in_axes=1, out_axes=1,
+            )(
+                jax.lax.stop_gradient(values),
+                jax.lax.stop_gradient(bootstrap),
+                traj.reward, d_t, rhos,
+            )
+            pg_loss = -jnp.mean(pg_adv * online_log_prob)
+            value_targets = jax.lax.stop_gradient(errors + values)
+            value_loss = 0.5 * jnp.mean((values - value_targets) ** 2)
+            entropy = dist.entropy().mean()
+            total = (
+                pg_loss
+                + float(config.system.get("vf_coef", 0.5)) * value_loss
+                - float(config.system.get("ent_coef", 0.01)) * entropy
+            )
+            return total, {
+                "actor_loss": pg_loss, "value_loss": value_loss, "entropy": entropy,
+            }
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params.actor_params)
+        grads = jax.lax.pmean(grads, axis_name="data")
+        updates, a_opt = actor_update(grads, state.opt_states.actor_opt_state)
+        shared = optax.apply_updates(state.params.actor_params, updates)
+        # Keep both param slots in sync (the rollout's critic view reads the
+        # critic slot).
+        params = ActorCriticParams(shared, shared)
+        metrics = jax.lax.pmean(metrics, axis_name="data")
+        new_opts = ActorCriticOptStates(a_opt, state.opt_states.critic_opt_state)
+        return CoreLearnerState(params, new_opts, state.key), metrics
+
+    return jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(CoreLearnerState(P(), P(), P()), P(None, "data")),
+            out_specs=(CoreLearnerState(P(), P(), P()), P()),
+            check_vma=False,
+        )
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return _run(
+        config,
+        learn_step_builder=get_shared_impala_learn_step,
+        networks_builder=build_shared_networks,
+    )
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/sebulba/default_ff_impala_shared_torso.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -279,7 +279,11 @@ def _rollout_body(
             rollout_idx += 1
 
 
-def run_experiment(config: Any, learn_step_builder: Callable = None) -> float:
+def run_experiment(
+    config: Any,
+    learn_step_builder: Callable = None,
+    networks_builder: Callable = None,
+) -> float:
     devices = jax.devices()
     actor_devices = [devices[i] for i in config.arch.actor.device_ids]
     learner_devices = [devices[i] for i in config.arch.learner.device_ids]
@@ -312,7 +316,8 @@ def run_experiment(config: Any, learn_step_builder: Callable = None) -> float:
         else probe_envs.reset(seed=0).observation,
     )
 
-    actor, critic = _build_networks(config, num_actions, dummy_obs)
+    build = networks_builder or _build_networks
+    actor, critic = build(config, num_actions, dummy_obs)
     key = jax.random.PRNGKey(int(config.arch.seed))
     key, a_key, c_key = jax.random.split(key, 3)
     obs0 = jax.tree.map(lambda x: jnp.asarray(x), probe_envs.reset(seed=0).observation)

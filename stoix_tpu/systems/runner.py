@@ -59,6 +59,26 @@ def run_anakin_experiment(
         learner_state = warmup_fn(learner_state)
         jax.block_until_ready(jax.tree.leaves(learner_state)[0])
 
+    # Resume: restore a saved learner state into the freshly built (correctly
+    # sharded) template (reference ff_ppo.py:504-512 via Checkpointer.restore).
+    ckpt_cfg = config.logger.checkpointing
+    start_step = 0
+    if ckpt_cfg.get("load_model", False):
+        from stoix_tpu.utils.checkpointing import Checkpointer
+
+        load_args = ckpt_cfg.get("load_args") or {}
+        loader = Checkpointer(
+            model_name=config.system.system_name,
+            rel_dir=load_args.get("load_path") or "checkpoints",
+            checkpoint_uid=load_args.get("checkpoint_uid"),
+        )
+        loader.check_version()
+        learner_state, start_step = loader.restore(
+            learner_state, load_args.get("timestep")
+        )
+        if is_coordinator():
+            print(f"[checkpoint] restored state from step {start_step}")
+
     make_evaluators = evaluator_setup_fn or evaluator_setup
     evaluator, absolute_evaluator = make_evaluators(eval_env, setup.eval_act_fn, config, mesh)
     logger = StoixLogger(config)
@@ -80,7 +100,7 @@ def run_anakin_experiment(
         jax.block_until_ready(output.learner_state)
         learner_state = output.learner_state
         elapsed = time.time() - start
-        t = (eval_idx + 1) * steps_per_eval
+        t = start_step + (eval_idx + 1) * steps_per_eval
 
         episode_metrics = envs.get_final_step_metrics(dict(output.episode_metrics))
         sps = steps_per_eval / elapsed
@@ -114,7 +134,7 @@ def run_anakin_experiment(
         if is_coordinator():
             logger.log(
                 abs_metrics,
-                int(config.arch.total_timesteps),
+                start_step + int(config.arch.total_timesteps),
                 int(config.arch.num_evaluation),
                 LogEvent.ABSOLUTE,
             )

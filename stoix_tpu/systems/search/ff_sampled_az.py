@@ -1,0 +1,283 @@
+"""Anakin Sampled AlphaZero (reference stoix/systems/search/ff_sampled_az.py,
+866 LoC): continuous actions via a SAMPLED action set (Hubert et al. 2021) —
+K actions drawn from the current policy form the discrete action set the
+search operates over (reference SampledExItTransition.sampled_actions,
+search_types.py:31-39); the policy trains toward the search weights over those
+samples with -sum_i w_i log pi(a_i | s).
+
+Simplification vs the paper (documented): the root-sampled action set is
+reused at deeper tree nodes instead of resampling per node — a standard
+approximation that keeps the tree arrays static.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import (
+    ActorCriticOptStates,
+    ActorCriticParams,
+    ExperimentOutput,
+    OnPolicyLearnerState,
+)
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.search import mcts
+from stoix_tpu.systems import anakin
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.systems.search.ff_az import unwrap_env_state
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.jax_utils import tree_merge_leading_dims
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class SampledExItTransition(NamedTuple):
+    done: jax.Array
+    truncated: jax.Array
+    action: jax.Array  # continuous action executed
+    sampled_actions: jax.Array  # [K, A] the search's action set
+    value: jax.Array
+    reward: jax.Array
+    search_policy: jax.Array  # [K] weights over sampled actions
+    obs: Any
+    next_obs: Any
+    info: Dict[str, Any]
+
+
+def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+    num_simulations = int(config.system.get("num_simulations", 16))
+    num_samples = int(config.system.get("num_sampled_actions", 8))
+
+    def recurrent_fn(params, rng, action_idx, embedding):
+        # embedding per element: {"state": env state, "actions": [K, A]}.
+        state = jax.tree.map(lambda x: x[0], embedding["state"])
+        actions = embedding["actions"][0]  # [K, A]
+        action = actions[action_idx[0]]
+        new_state, ts = sim_env.step(state, action)
+        value = critic_apply(params.critic_params, ts.observation)
+        out = mcts.RecurrentFnOutput(
+            reward=ts.reward[None],
+            discount=gamma * ts.discount[None],
+            prior_logits=jnp.zeros((1, num_samples)),  # uniform over the set
+            value=value[None],
+        )
+        new_embedding = {
+            "state": jax.tree.map(lambda x: x[None], new_state),
+            "actions": actions[None],
+        }
+        return out, new_embedding
+
+    def _env_step(learner_state: OnPolicyLearnerState, _):
+        params, opt_states, key, env_state, last_timestep = learner_state
+        key, sample_key, search_key = jax.random.split(key, 3)
+
+        dist = actor_apply(params.actor_params, last_timestep.observation)
+        sample_keys = jax.random.split(sample_key, num_samples)
+        sampled = jax.vmap(lambda k: dist.sample(seed=k))(sample_keys)  # [K, E, A]
+        sampled = jnp.swapaxes(sampled, 0, 1)  # [E, K, A]
+        value = critic_apply(params.critic_params, last_timestep.observation)
+
+        root = mcts.RootFnOutput(
+            prior_logits=jnp.zeros(value.shape + (num_samples,)),
+            value=value,
+            embedding={"state": unwrap_env_state(env_state), "actions": sampled},
+        )
+        search_out = mcts.muzero_policy(
+            params, search_key, root, recurrent_fn, num_simulations,
+            max_depth=int(config.system.get("max_depth", num_simulations)),
+        )
+        action = jnp.take_along_axis(
+            sampled, search_out.action[:, None, None].repeat(sampled.shape[-1], -1), axis=1
+        )[:, 0]
+        env_state_new, timestep = env.step(env_state, action)
+
+        transition = SampledExItTransition(
+            done=timestep.discount == 0.0,
+            truncated=jnp.logical_and(timestep.last(), timestep.discount != 0.0),
+            action=action,
+            sampled_actions=sampled,
+            value=value,
+            reward=timestep.reward,
+            search_policy=search_out.action_weights,
+            obs=last_timestep.observation,
+            next_obs=timestep.extras["next_obs"],
+            info=timestep.extras["episode_metrics"],
+        )
+        return (
+            OnPolicyLearnerState(params, opt_states, key, env_state_new, timestep),
+            transition,
+        )
+
+    def _actor_loss_fn(actor_params, obs, sampled_actions, search_policy):
+        dist = actor_apply(actor_params, obs)
+        # log pi(a_i | s) for each sampled action: [B, K].
+        log_probs = jax.vmap(dist.log_prob, in_axes=1, out_axes=1)(sampled_actions)
+        loss = -jnp.mean(jnp.sum(search_policy * log_probs, axis=-1))
+        return loss, {"actor_loss": loss}
+
+    def _critic_loss_fn(critic_params, obs, targets):
+        value = critic_apply(critic_params, obs)
+        loss = 0.5 * jnp.mean((value - targets) ** 2)
+        return float(config.system.get("vf_coef", 0.5)) * loss, {"value_loss": loss}
+
+    def _update_step(learner_state: OnPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep = learner_state
+
+        v_t = critic_apply(params.critic_params, traj.next_obs)
+        _, targets = truncated_generalized_advantage_estimation(
+            traj.reward,
+            gamma * (1.0 - traj.done.astype(jnp.float32)),
+            float(config.system.get("gae_lambda", 0.95)),
+            v_tm1=traj.value,
+            v_t=v_t,
+            truncation_t=traj.truncated.astype(jnp.float32),
+        )
+
+        def _epoch(carry, _):
+            params, opt_states, key = carry
+            flat = tree_merge_leading_dims(
+                (traj.obs, traj.sampled_actions, traj.search_policy, targets), 2
+            )
+            obs, sampled, weights, tgt = flat
+            actor_grads, actor_metrics = jax.grad(_actor_loss_fn, has_aux=True)(
+                params.actor_params, obs, sampled, weights
+            )
+            critic_grads, critic_metrics = jax.grad(_critic_loss_fn, has_aux=True)(
+                params.critic_params, obs, tgt
+            )
+            actor_grads, critic_grads = jax.lax.pmean(
+                jax.lax.pmean((actor_grads, critic_grads), axis_name="batch"),
+                axis_name="data",
+            )
+            a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
+            c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
+            params = ActorCriticParams(
+                optax.apply_updates(params.actor_params, a_updates),
+                optax.apply_updates(params.critic_params, c_updates),
+            )
+            return (params, ActorCriticOptStates(a_opt, c_opt), key), {
+                **actor_metrics, **critic_metrics,
+            }
+
+        (params, opt_states, key), loss_info = jax.lax.scan(
+            _epoch, (params, opt_states, key), None, int(config.system.epochs)
+        )
+        learner_state = OnPolicyLearnerState(params, opt_states, key, env_state, last_timestep)
+        return learner_state, (traj.info, loss_info)
+
+    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
+    from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
+
+    config.system.action_dim = env.num_actions
+    net_cfg = config.network
+    actor_network = FeedForwardActor(
+        action_head=config_lib.instantiate(
+            net_cfg.actor_network.action_head,
+            **anakin.head_kwargs_for_env(net_cfg.actor_network.action_head, env),
+        ),
+        torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
+    )
+    critic_network = FeedForwardCritic(
+        critic_head=config_lib.instantiate(net_cfg.critic_network.critic_head),
+        torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
+        input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
+    )
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.actor_lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.critic_lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+
+    key, actor_key, critic_key, env_key = jax.random.split(key, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    actor_params = actor_network.init(actor_key, dummy_obs)
+    critic_params = critic_network.init(critic_key, dummy_obs)
+    params = ActorCriticParams(actor_params, critic_params)
+    opt_states = ActorCriticOptStates(
+        actor_optim.init(actor_params), critic_optim.init(critic_params)
+    )
+
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    state_specs = OnPolicyLearnerState(
+        params=P(), opt_states=P(), key=P("data"),
+        env_state=P(None, "data"), timestep=P(None, "data"),
+    )
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+    learner_state = OnPolicyLearnerState(
+        params=anakin.broadcast_to_update_batch(params, update_batch),
+        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
+        key=anakin.make_step_keys(key, mesh, config),
+        env_state=env_state,
+        timestep=timestep,
+    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+
+    sim_env = envs.make_single(
+        config.env.scenario.name
+        if hasattr(config.env.scenario, "name")
+        else config.env.scenario,
+        **dict(config.env.get("kwargs", {}) or {}),
+    )
+    learn_per_shard = get_learner_fn(
+        env, sim_env, (actor_network.apply, critic_network.apply),
+        (actor_optim.update, critic_optim.update), config,
+    )
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_sampled_az.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

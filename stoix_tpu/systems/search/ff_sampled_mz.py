@@ -1,0 +1,305 @@
+"""Anakin Sampled MuZero (reference stoix/systems/search/ff_sampled_mz.py,
+978 LoC): continuous-action MuZero — K actions sampled from the policy form
+the search's action set (as in ff_sampled_az), but the simulator is the
+LEARNED RewardBasedWorldModel over latents (as in ff_mz). Policy trains on
+search weights over the samples; value on GAE targets; reward head on observed
+rewards via unroll-k.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import ExperimentOutput, OnPolicyLearnerState
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.search import mcts
+from stoix_tpu.systems import anakin
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.systems.search.ff_mz import MZOptStates
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.jax_utils import scale_gradient
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class SampledMZParams(NamedTuple):
+    world_model: Any
+    policy_head: Any
+    value_head: Any
+
+
+class SampledMZTransition(NamedTuple):
+    done: jax.Array
+    truncated: jax.Array
+    action: jax.Array
+    sampled_actions: jax.Array  # [K, A]
+    value: jax.Array
+    reward: jax.Array
+    search_policy: jax.Array  # [K]
+    obs: Any
+    next_obs: Any
+    info: Dict[str, Any]
+
+
+def get_learner_fn(env, networks, optim_update, config):
+    wm, policy_net, value_net = networks
+    gamma = float(config.system.gamma)
+    num_simulations = int(config.system.get("num_simulations", 16))
+    num_samples = int(config.system.get("num_sampled_actions", 8))
+    unroll_k = int(config.system.get("unroll_steps", 4))
+
+    def recurrent_fn(params: SampledMZParams, rng, action_idx, embedding):
+        latent, actions = embedding["latent"], embedding["actions"]
+        action = jnp.take_along_axis(
+            actions, action_idx[:, None, None].repeat(actions.shape[-1], -1), axis=1
+        )[:, 0]
+        new_latent, reward = wm.apply(params.world_model, latent, action, method="step")
+        value = value_net.apply(params.value_head, new_latent)
+        out = mcts.RecurrentFnOutput(
+            reward=reward,
+            discount=jnp.full_like(reward, gamma),
+            prior_logits=jnp.zeros(reward.shape + (num_samples,)),
+            value=value,
+        )
+        return out, {"latent": new_latent, "actions": actions}
+
+    def _env_step(learner_state: OnPolicyLearnerState, _):
+        params, opt_states, key, env_state, last_timestep = learner_state
+        key, sample_key, search_key = jax.random.split(key, 3)
+
+        latent = wm.apply(
+            params.world_model, last_timestep.observation.agent_view, method="initial_state"
+        )
+        dist = policy_net.apply(params.policy_head, latent)
+        sample_keys = jax.random.split(sample_key, num_samples)
+        sampled = jnp.swapaxes(
+            jax.vmap(lambda k: dist.sample(seed=k))(sample_keys), 0, 1
+        )  # [E, K, A]
+        value = value_net.apply(params.value_head, latent)
+
+        root = mcts.RootFnOutput(
+            prior_logits=jnp.zeros(value.shape + (num_samples,)),
+            value=value,
+            embedding={"latent": latent, "actions": sampled},
+        )
+        search_out = mcts.muzero_policy(
+            params, search_key, root, recurrent_fn, num_simulations,
+            max_depth=int(config.system.get("max_depth", num_simulations)),
+        )
+        action = jnp.take_along_axis(
+            sampled, search_out.action[:, None, None].repeat(sampled.shape[-1], -1), axis=1
+        )[:, 0]
+        env_state_new, timestep = env.step(env_state, action)
+
+        transition = SampledMZTransition(
+            done=timestep.discount == 0.0,
+            truncated=jnp.logical_and(timestep.last(), timestep.discount != 0.0),
+            action=action,
+            sampled_actions=sampled,
+            value=value,
+            reward=timestep.reward,
+            search_policy=search_out.action_weights,
+            obs=last_timestep.observation,
+            next_obs=timestep.extras["next_obs"],
+            info=timestep.extras["episode_metrics"],
+        )
+        return (
+            OnPolicyLearnerState(params, opt_states, key, env_state_new, timestep),
+            transition,
+        )
+
+    def _loss_fn(params: SampledMZParams, traj: SampledMZTransition, targets):
+        T = targets.shape[0]
+        T_train = T - unroll_k + 1
+
+        def window(x, i):
+            return jax.lax.dynamic_slice_in_dim(x, i, T_train, axis=0)
+
+        latent = wm.apply(
+            params.world_model,
+            jax.tree.map(lambda x: x[:T_train], traj.obs.agent_view),
+            method="initial_state",
+        )
+
+        def unroll_step(carry, i):
+            latent, total = carry
+            dist = policy_net.apply(params.policy_head, latent)
+            value = value_net.apply(params.value_head, latent)
+            sampled = window(traj.sampled_actions, i)  # [T', E, K, A]
+            weights = window(traj.search_policy, i)  # [T', E, K]
+            log_probs = jax.vmap(dist.log_prob, in_axes=2, out_axes=2)(sampled)
+            policy_loss = -jnp.mean(jnp.sum(weights * log_probs, axis=-1))
+            value_loss = 0.5 * jnp.mean((value - window(targets, i)) ** 2)
+
+            action = window(traj.action, i)
+            new_latent, pred_reward = wm.apply(
+                params.world_model, latent, action, method="step"
+            )
+            reward_loss = 0.5 * jnp.mean((pred_reward - window(traj.reward, i)) ** 2)
+            new_latent = scale_gradient(new_latent, 0.5)
+            return (new_latent, total + policy_loss + value_loss + reward_loss), {
+                "policy_loss": policy_loss,
+                "value_loss": value_loss,
+                "reward_loss": reward_loss,
+            }
+
+        (_, total), metrics = jax.lax.scan(
+            unroll_step, (latent, jnp.zeros(())), jnp.arange(unroll_k)
+        )
+        return total / unroll_k, jax.tree.map(jnp.mean, metrics)
+
+    def _update_step(learner_state: OnPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep = learner_state
+
+        latent_next = wm.apply(
+            params.world_model, traj.next_obs.agent_view, method="initial_state"
+        )
+        v_t = value_net.apply(params.value_head, latent_next)
+        _, targets = truncated_generalized_advantage_estimation(
+            traj.reward,
+            gamma * (1.0 - traj.done.astype(jnp.float32)),
+            float(config.system.get("gae_lambda", 0.95)),
+            v_tm1=jax.lax.stop_gradient(traj.value),
+            v_t=jax.lax.stop_gradient(v_t),
+            truncation_t=traj.truncated.astype(jnp.float32),
+        )
+
+        def _epoch(carry, _):
+            params, opt_states, key = carry
+            grads, metrics = jax.grad(_loss_fn, has_aux=True)(params, traj, targets)
+            grads = jax.lax.pmean(jax.lax.pmean(grads, axis_name="batch"), axis_name="data")
+            updates, opt_state = optim_update(grads, opt_states.opt_state)
+            params = optax.apply_updates(params, updates)
+            return (params, MZOptStates(opt_state), key), metrics
+
+        (params, opt_states, key), loss_info = jax.lax.scan(
+            _epoch, (params, opt_states, key), None, int(config.system.epochs)
+        )
+        learner_state = OnPolicyLearnerState(params, opt_states, key, env_state, last_timestep)
+        return learner_state, (traj.info, loss_info)
+
+    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
+    import flax.linen as nn
+
+    from stoix_tpu.networks import heads as heads_lib, torso as torso_lib
+    from stoix_tpu.networks.model_based import RewardBasedWorldModel
+
+    config.system.action_dim = env.num_actions
+    action_dim = env.num_actions
+    space = env.action_space()
+    lo = float(jnp.min(jnp.asarray(space.low)))
+    hi = float(jnp.max(jnp.asarray(space.high)))
+    hidden = int(config.system.get("wm_hidden_size", 64))
+
+    wm = RewardBasedWorldModel(
+        obs_encoder=torso_lib.MLPTorso((hidden,)),
+        reward_head=heads_lib.LinearHead(output_dim=1),
+        action_embedder=torso_lib.MLPTorso((hidden // 2,)),
+        hidden_size=hidden,
+        num_rnn_layers=int(config.system.get("wm_rnn_layers", 1)),
+        rnn_cell_type=str(config.system.get("wm_cell_type", "lstm")),
+    )
+
+    class LatentPolicy(nn.Module):
+        @nn.compact
+        def __call__(self, latent):
+            x = torso_lib.MLPTorso((hidden,))(latent)
+            return heads_lib.NormalAffineTanhDistributionHead(
+                action_dim=action_dim, minimum=lo, maximum=hi
+            )(x)
+
+    class LatentValue(nn.Module):
+        @nn.compact
+        def __call__(self, latent):
+            x = torso_lib.MLPTorso((hidden,))(latent)
+            return heads_lib.ScalarCriticHead()(x)
+
+    policy_net, value_net = LatentPolicy(), LatentValue()
+
+    key, wm_key, p_key, v_key, env_key = jax.random.split(key, 5)
+    dummy_view = env.observation_value().agent_view[None]
+    dummy_action = jnp.asarray(env.action_value(), jnp.float32)[None]
+    wm_params = wm.init(wm_key, dummy_view, dummy_action)
+    dummy_latent = wm.apply(wm_params, dummy_view, method="initial_state")
+    params = SampledMZParams(
+        world_model=wm_params,
+        policy_head=policy_net.init(p_key, dummy_latent),
+        value_head=value_net.init(v_key, dummy_latent),
+    )
+    optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(make_learning_rate(float(config.system.lr), config,
+                                      int(config.system.epochs)), eps=1e-5),
+    )
+    opt_states = MZOptStates(optim.init(params))
+
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    state_specs = OnPolicyLearnerState(
+        params=P(), opt_states=P(), key=P("data"),
+        env_state=P(None, "data"), timestep=P(None, "data"),
+    )
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+    learner_state = OnPolicyLearnerState(
+        params=anakin.broadcast_to_update_batch(params, update_batch),
+        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
+        key=anakin.make_step_keys(key, mesh, config),
+        env_state=env_state,
+        timestep=timestep,
+    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+
+    learn_per_shard = get_learner_fn(env, (wm, policy_net, value_net), optim.update, config)
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+
+    def eval_apply(params: SampledMZParams, observation):
+        latent = wm.apply(params.world_model, observation.agent_view, method="initial_state")
+        return policy_net.apply(params.policy_head, latent)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, eval_apply),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params),
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_sampled_mz.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Anakin SPO, continuous actions (reference
+stoix/systems/spo/ff_spo_continuous.py, 1958 LoC) — shares the ff_spo SMC
+learner; the continuous head comes from the network config."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from stoix_tpu.systems.runner import run_anakin_experiment
+from stoix_tpu.systems.spo.ff_spo import learner_setup  # noqa: F401
+from stoix_tpu.utils import config as config_lib
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_spo_continuous.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -68,7 +68,11 @@ class Checkpointer:
         return restored, int(step)
 
     def get_metadata(self) -> dict:
-        return dict(self._manager.metadata() or {})
+        meta = self._manager.metadata()
+        # Orbax returns a RootMetadata object; the user-provided dict lives in
+        # `custom_metadata` (older versions returned the dict directly).
+        custom = getattr(meta, "custom_metadata", meta)
+        return dict(custom or {})
 
     def check_version(self) -> None:
         meta = self.get_metadata()

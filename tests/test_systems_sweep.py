@@ -56,6 +56,14 @@ SYSTEMS = [
      ["env=identity_game", "system.num_simulations=8", "system.num_minibatches=2"]),
     ("stoix_tpu.systems.search.ff_mz", "default_ff_mz",
      ["env=identity_game", "system.num_simulations=8", "system.unroll_steps=2"]),
+    ("stoix_tpu.systems.search.ff_sampled_az", "default_ff_sampled_az",
+     ["system.num_simulations=8", "system.num_sampled_actions=4"]),
+    ("stoix_tpu.systems.search.ff_sampled_mz", "default_ff_sampled_mz",
+     ["system.num_simulations=8", "system.num_sampled_actions=4", "system.unroll_steps=2"]),
+    ("stoix_tpu.systems.spo.ff_spo", "default_ff_spo",
+     ["env=identity_game", "system.num_particles=8", "system.search_horizon=3"]),
+    ("stoix_tpu.systems.spo.ff_spo_continuous", "default_ff_spo_continuous",
+     ["system.num_particles=8", "system.search_horizon=3"]),
 ]
 
 
