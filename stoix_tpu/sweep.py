@@ -1,0 +1,122 @@
+"""Hyperparameter search — the reference's Optuna-sweeper equivalent
+(reference configs/default/anakin/hyperparameter_sweep.yaml: Optuna TPE
+multirun over a search space). Optuna is not a dependency here; this module
+provides random + grid search over dotted-override spaces with the same
+maximize-final-eval-return objective.
+
+Usage:
+    python -m stoix_tpu.sweep --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+        --default default/anakin/default_ff_ppo.yaml --trials 8 \
+        --space system.actor_lr=loguniform:1e-5,1e-2 \
+                system.ent_coef=uniform:0.0,0.05 \
+                system.epochs=choice:2,4,8 \
+        --set env=cartpole arch.total_timesteps=1e6
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import itertools
+import json
+import random
+from typing import Any, Dict, List, Tuple
+
+from stoix_tpu.utils import config as config_lib
+
+
+def parse_space(entries: List[str]) -> Dict[str, Tuple[str, list]]:
+    """'key=kind:a,b,...' -> {key: (kind, args)}; kinds: uniform, loguniform,
+    choice, int."""
+    space = {}
+    for entry in entries:
+        key, spec = entry.split("=", 1)
+        kind, _, raw = spec.partition(":")
+        args = raw.split(",") if raw else []
+        space[key] = (kind, args)
+    return space
+
+
+def sample_point(space: Dict[str, Tuple[str, list]], rng: random.Random) -> Dict[str, Any]:
+    point = {}
+    for key, (kind, args) in space.items():
+        if kind == "uniform":
+            lo, hi = float(args[0]), float(args[1])
+            point[key] = rng.uniform(lo, hi)
+        elif kind == "loguniform":
+            import math
+
+            lo, hi = math.log(float(args[0])), math.log(float(args[1]))
+            point[key] = math.exp(rng.uniform(lo, hi))
+        elif kind == "int":
+            point[key] = rng.randint(int(args[0]), int(args[1]))
+        elif kind == "choice":
+            point[key] = rng.choice(args)
+        else:
+            raise ValueError(f"Unknown space kind '{kind}' for {key}")
+    return point
+
+
+def grid_points(space: Dict[str, Tuple[str, list]]) -> List[Dict[str, Any]]:
+    keys = list(space)
+    choices = []
+    for key in keys:
+        kind, args = space[key]
+        if kind != "choice":
+            raise ValueError("grid search requires choice: spaces only")
+        choices.append(args)
+    return [dict(zip(keys, combo)) for combo in itertools.product(*choices)]
+
+
+def run_sweep(
+    module: str,
+    default: str,
+    space: Dict[str, Tuple[str, list]],
+    fixed_overrides: List[str],
+    trials: int = 8,
+    method: str = "random",
+    seed: int = 0,
+) -> Dict[str, Any]:
+    mod = importlib.import_module(module)
+    rng = random.Random(seed)
+    points = (
+        grid_points(space) if method == "grid" else [sample_point(space, rng) for _ in range(trials)]
+    )
+
+    results = []
+    for i, point in enumerate(points):
+        overrides = fixed_overrides + [f"{k}={v}" for k, v in point.items()]
+        cfg = config_lib.compose(config_lib.default_config_dir(), default, overrides)
+        score = mod.run_experiment(cfg)
+        results.append({"trial": i, "params": point, "score": float(score)})
+        print(json.dumps(results[-1]), flush=True)
+
+    best = max(results, key=lambda r: r["score"])
+    print(json.dumps({"best": best}), flush=True)
+    return best
+
+
+def main(argv: List[str] | None = None) -> Dict[str, Any]:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--module", required=True)
+    parser.add_argument("--default", required=True, help="default yaml under configs/")
+    parser.add_argument("--trials", type=int, default=8)
+    parser.add_argument("--method", choices=["random", "grid"], default="random")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--space", nargs="+", required=True)
+    parser.add_argument("--set", nargs="*", default=[], dest="overrides",
+                        help="fixed key=value overrides")
+    args = parser.parse_args(argv)
+    return run_sweep(
+        args.module,
+        args.default,
+        parse_space(args.space),
+        args.overrides,
+        trials=args.trials,
+        method=args.method,
+        seed=args.seed,
+    )
+
+
+if __name__ == "__main__":
+    main()

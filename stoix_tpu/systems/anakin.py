@@ -105,7 +105,11 @@ def shardmap_learner(
             # replicated/varying leaves trip the VMA validator; collectives are
             # correct (see ff_ppo).
             check_vma=False,
-        )
+        ),
+        # NOTE: donate_argnums=(0,) halves HBM traffic here and passes on the
+        # virtual CPU mesh, but deadlocks through remote-platform runtimes
+        # (observed on the tunneled TPU backend) — left off until it can be
+        # validated on a local TPU runtime.
     )
 
 
