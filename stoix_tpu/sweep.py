@@ -25,6 +25,16 @@ from typing import Any, Dict, List, Tuple
 from stoix_tpu.utils import config as config_lib
 
 
+def _coerce(raw: str):
+    """Typed choice values: ints, then floats (incl. '3e-4'), else strings."""
+    for cast in (int, float):
+        try:
+            return cast(raw)
+        except ValueError:
+            continue
+    return raw
+
+
 def parse_space(entries: List[str]) -> Dict[str, Tuple[str, list]]:
     """'key=kind:a,b,...' -> {key: (kind, args)}; kinds: uniform, loguniform,
     choice, int."""
@@ -32,7 +42,7 @@ def parse_space(entries: List[str]) -> Dict[str, Tuple[str, list]]:
     for entry in entries:
         key, spec = entry.split("=", 1)
         kind, _, raw = spec.partition(":")
-        args = raw.split(",") if raw else []
+        args = [_coerce(a) for a in raw.split(",")] if raw else []
         space[key] = (kind, args)
     return space
 
@@ -85,8 +95,11 @@ def run_sweep(
 
     results = []
     for i, point in enumerate(points):
-        overrides = fixed_overrides + [f"{k}={v}" for k, v in point.items()]
-        cfg = config_lib.compose(config_lib.default_config_dir(), default, overrides)
+        cfg = config_lib.compose(config_lib.default_config_dir(), default, fixed_overrides)
+        # Apply sampled values TYPED (stringifying small floats like 1e-05 and
+        # re-parsing via YAML 1.1 would silently turn them into strings).
+        for k, v in point.items():
+            config_lib._set_dotted(cfg, k, v)
         score = mod.run_experiment(cfg)
         results.append({"trial": i, "params": point, "score": float(score)})
         print(json.dumps(results[-1]), flush=True)
