@@ -138,4 +138,8 @@ def make_factory(config: Any) -> EnvFactory:
         from stoix_tpu.envs.cvec import CVecEnvFactory
 
         return CVecEnvFactory(scenario, seed, **kwargs)
+    if backend == "gymnasium":
+        from stoix_tpu.envs.gymnasium_adapter import GymnasiumFactory
+
+        return GymnasiumFactory(scenario, seed, **kwargs)
     return JaxEnvFactory(scenario, seed, **kwargs)

@@ -289,3 +289,12 @@ def apply_core_wrappers(
         env = CachedAutoResetWrapper(env) if use_cached_auto_reset else AutoResetWrapper(env)
         env = VmapWrapper(env)
     return env
+
+
+def chained_wrappers(env: Environment, wrappers: list) -> Environment:
+    """Compose a list of wrapper constructors (reference stoix/wrappers/base.py:
+    6-15): each entry is a callable taking the env (use functools.partial or
+    config _partial_ instantiation for extra kwargs)."""
+    for ctor in wrappers:
+        env = ctor(env)
+    return env

@@ -165,3 +165,8 @@ class RecurrentCritic(nn.Module):
         hstate, x = self.rnn(hstate, (x, done))
         x = self.post_torso(x)
         return hstate, self.critic_head(x)
+
+
+def chained_torsos(torsos: Sequence[nn.Module]) -> CompositeNetwork:
+    """Compose torso modules sequentially (reference base.py:225-252)."""
+    return CompositeNetwork(layers=list(torsos))

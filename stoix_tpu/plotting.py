@@ -1,0 +1,88 @@
+"""Learning-curve plotting from the JSON logger sink.
+
+The reference's plotting pipeline pulls W&B artifacts and feeds marl-eval /
+RLiable notebooks (reference plotting/); here the JsonSink's file(s) are the
+source of truth. Each metrics.json holds
+{env}/{task}/{system}/seed_N/step_K -> {episode_return: [...], ...}; this
+module aggregates seeds (mean +- stddev band) and writes one PNG per task.
+
+Usage: python -m stoix_tpu.plotting results/**/metrics.json -o curves/
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+from collections import defaultdict
+from typing import Dict, List
+
+
+def load_runs(paths: List[str]) -> Dict[str, Dict[str, Dict[int, List[float]]]]:
+    """-> {task: {system: {step: [returns across seeds/episodes]}}}"""
+    curves: Dict[str, Dict[str, Dict[int, List[float]]]] = defaultdict(
+        lambda: defaultdict(lambda: defaultdict(list))
+    )
+    for path in paths:
+        with open(path) as f:
+            data = json.load(f)
+        for env_name, tasks in data.items():
+            for task, systems in tasks.items():
+                for system, seeds in systems.items():
+                    for _seed, steps in seeds.items():
+                        for step_key, entry in steps.items():
+                            if not step_key.startswith("step_"):
+                                continue
+                            t = int(entry.get("step_count", step_key.split("_")[1]))
+                            for key, values in entry.items():
+                                # Exact series only: the sink also stores
+                                # /std|min|max which must not be averaged in.
+                                if key in ("episode_return", "episode_return/mean"):
+                                    curves[task][system][t].extend(values)
+    return curves
+
+
+def plot(curves, out_dir: str) -> List[str]:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for task, systems in curves.items():
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        for system, by_step in sorted(systems.items()):
+            steps = sorted(by_step)
+            means = np.array([np.mean(by_step[t]) for t in steps])
+            stds = np.array([np.std(by_step[t]) for t in steps])
+            ax.plot(steps, means, label=system)
+            ax.fill_between(steps, means - stds, means + stds, alpha=0.2)
+        ax.set_xlabel("environment steps")
+        ax.set_ylabel("episode return")
+        ax.set_title(task)
+        ax.legend()
+        fig.tight_layout()
+        path = os.path.join(out_dir, f"{task}.png")
+        fig.savefig(path, dpi=120)
+        plt.close(fig)
+        written.append(path)
+        print(f"[plotting] wrote {path}")
+    return written
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("paths", nargs="+", help="metrics.json files (globs ok)")
+    parser.add_argument("-o", "--out-dir", default="curves")
+    args = parser.parse_args(argv)
+    files = [f for pattern in args.paths for f in sorted(glob.glob(pattern, recursive=True))]
+    if not files:
+        raise SystemExit("no metrics.json files matched")
+    plot(load_runs(files), args.out_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Cached URL fetch (reference stoix/utils/download.py:8-41) — used by systems
+that ship pretrained artifacts (the reference's disco_rl pulls learned
+update-rule weights). Downloads are cached under ~/.cache/stoix_tpu and
+re-used; environments without egress simply require the file to be placed in
+the cache (or passed via `local_path`) ahead of time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import urllib.request
+from typing import Optional
+
+
+def cache_dir() -> str:
+    root = os.environ.get(
+        "STOIX_TPU_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "stoix_tpu")
+    )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def cached_download(url: str, filename: Optional[str] = None, local_path: Optional[str] = None) -> str:
+    """Returns a local path for `url`, downloading once into the cache.
+
+    `local_path` short-circuits the download (for air-gapped environments).
+    """
+    if local_path is not None:
+        if not os.path.exists(local_path):
+            raise FileNotFoundError(f"local_path {local_path} does not exist")
+        return local_path
+
+    if filename is None:
+        digest = hashlib.sha256(url.encode()).hexdigest()[:16]
+        filename = f"{digest}_{os.path.basename(url) or 'artifact'}"
+    target = os.path.join(cache_dir(), filename)
+    if os.path.exists(target):
+        return target
+
+    import tempfile
+
+    # Per-call unique tmp file so concurrent downloaders never interleave
+    # writes; os.replace keeps publication atomic.
+    fd, tmp = tempfile.mkstemp(dir=cache_dir(), suffix=".part")
+    os.close(fd)
+    try:
+        urllib.request.urlretrieve(url, tmp)
+    except Exception as e:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise RuntimeError(
+            f"Could not download {url} (no egress?). Place the file at {target} "
+            "manually, or pass local_path."
+        ) from e
+    os.replace(tmp, target)
+    return target
