@@ -16,6 +16,7 @@ import time
 
 def main() -> None:
     smoke = "--smoke" in sys.argv
+    large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
 
     import jax
 
@@ -31,6 +32,13 @@ def main() -> None:
         "arch.absolute_metric=False",
         "logger.use_console=False",
     ]
+    if large:
+        overrides += [
+            "network.actor_network.pre_torso.layer_sizes=[1024,1024]",
+            "network.actor_network.pre_torso.compute_dtype=bfloat16",
+            "network.critic_network.pre_torso.layer_sizes=[1024,1024]",
+            "network.critic_network.pre_torso.compute_dtype=bfloat16",
+        ]
     config = config_lib.compose(
         config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml", overrides
     )
@@ -86,7 +94,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "anakin_ppo_env_steps_per_sec",
+                "metric": "anakin_ppo_env_steps_per_sec" + ("_large_bf16" if large else ""),
                 "value": round(steps_per_sec, 1),
                 "unit": f"env_steps/sec ({n_devices} devices, CartPole)",
                 "vs_baseline": round(per_chip / baseline_per_chip, 3),

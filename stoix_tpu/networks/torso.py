@@ -25,6 +25,10 @@ class MLPTorso(nn.Module):
     activate_final: bool = True
     kernel_init: str = "orthogonal"
     kernel_scale: float = 1.4142135  # sqrt(2)
+    # "bfloat16" runs matmuls/activations in bf16 on the MXU while parameters
+    # stay fp32 (flax Dense dtype semantics); outputs are cast back to fp32 so
+    # downstream losses/collectives keep full precision.
+    compute_dtype: str = "float32"
 
     def _kernel_init(self):
         if self.kernel_init == "orthogonal":
@@ -34,13 +38,14 @@ class MLPTorso(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         act = parse_activation_fn(self.activation)
+        dtype = jnp.dtype(self.compute_dtype)
         for i, size in enumerate(self.layer_sizes):
-            x = nn.Dense(size, kernel_init=self._kernel_init())(x)
+            x = nn.Dense(size, kernel_init=self._kernel_init(), dtype=dtype)(x)
             if self.use_layer_norm:
-                x = nn.LayerNorm(use_scale=True)(x)
+                x = nn.LayerNorm(use_scale=True, dtype=dtype)(x)
             if i < len(self.layer_sizes) - 1 or self.activate_final:
                 x = act(x)
-        return x
+        return x.astype(jnp.float32)
 
 
 class NoisyMLPTorso(nn.Module):
@@ -76,21 +81,23 @@ class CNNTorso(nn.Module):
     use_layer_norm: bool = False
     hidden_sizes: Sequence[int] = (256,)
     channel_first: bool = False
+    compute_dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         act = parse_activation_fn(self.activation)
+        dtype = jnp.dtype(self.compute_dtype)
         lead_shape = x.shape[:-3]
         x = x.reshape((-1,) + x.shape[-3:])
         if self.channel_first:  # NCHW input -> NHWC for TPU-friendly convs
             x = jnp.transpose(x, (0, 2, 3, 1))
         for ch, k, s in zip(self.channel_sizes, self.kernel_sizes, self.strides):
-            x = nn.Conv(ch, kernel_size=(k, k), strides=(s, s))(x)
+            x = nn.Conv(ch, kernel_size=(k, k), strides=(s, s), dtype=dtype)(x)
             if self.use_layer_norm:
-                x = nn.LayerNorm(use_scale=True)(x)
+                x = nn.LayerNorm(use_scale=True, dtype=dtype)(x)
             x = act(x)
         x = x.reshape(x.shape[0], -1)
         for size in self.hidden_sizes:
-            x = nn.Dense(size, kernel_init=nn.initializers.orthogonal(jnp.sqrt(2.0)))(x)
+            x = nn.Dense(size, kernel_init=nn.initializers.orthogonal(jnp.sqrt(2.0)), dtype=dtype)(x)
             x = act(x)
-        return x.reshape(lead_shape + x.shape[-1:])
+        return x.reshape(lead_shape + x.shape[-1:]).astype(jnp.float32)
