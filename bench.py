@@ -2,9 +2,13 @@
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 vs_baseline is measured throughput / BASELINE.json's 1M steps/sec v5e-64 target
-scaled to the local chip count (the target implies 15,625 steps/sec/chip).
+scaled to the local chip count (the target implies 15,625 steps/sec/chip); it
+applies to the tracked small-network config only and is reported as null for
+--large, whose workload is incommensurable with that baseline.
 
-Usage: python bench.py [--smoke]  (--smoke: tiny budget for CI wiring checks)
+Usage: python bench.py [--smoke] [--large]
+  --smoke  tiny budget for CI wiring checks
+  --large  MXU-bound variant (1024x1024 bfloat16 torsos)
 """
 
 from __future__ import annotations
@@ -97,7 +101,8 @@ def main() -> None:
                 "metric": "anakin_ppo_env_steps_per_sec" + ("_large_bf16" if large else ""),
                 "value": round(steps_per_sec, 1),
                 "unit": f"env_steps/sec ({n_devices} devices, CartPole)",
-                "vs_baseline": round(per_chip / baseline_per_chip, 3),
+                # The baseline is defined for the small-network config only.
+                "vs_baseline": None if large else round(per_chip / baseline_per_chip, 3),
             }
         )
     )

@@ -1,0 +1,82 @@
+"""Logger tests: sink fan-out, describe semantics, solve-rate metric, JSON
+layout consumed by the plotting module."""
+
+import json
+import os
+
+import numpy as np
+
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.logger import LogEvent, StoixLogger, describe
+
+
+def _logger_config(tmp_path, **logger_overrides):
+    cfg = config_lib.Config.from_dict(
+        {
+            "logger": {
+                "base_exp_path": str(tmp_path / "results"),
+                "use_console": False,
+                "use_json": False,
+                "use_tb": False,
+                "kwargs": {"json_path": None},
+                "system_name": "test_system",
+                "checkpointing": {"save_model": False},
+            },
+            "env": {
+                "env_name": "classic",
+                "scenario": {"name": "CartPole-v1", "task_name": "cartpole"},
+                "solved_return_threshold": 100.0,
+            },
+            "arch": {"seed": 0},
+        }
+    )
+    cfg.logger.update(logger_overrides)
+    return cfg
+
+
+def test_describe_stats():
+    stats = describe(np.array([1.0, 2.0, 3.0, 4.0]))
+    assert stats["mean"] == 2.5
+    assert stats["min"] == 1.0 and stats["max"] == 4.0
+
+
+def test_json_sink_layout_and_solve_rate(tmp_path):
+    json_path = str(tmp_path / "metrics.json")
+    cfg = _logger_config(tmp_path, use_json=True, kwargs={"json_path": json_path})
+    logger = StoixLogger(cfg)
+
+    returns = np.array([50.0, 150.0, 200.0, 90.0])  # 2 of 4 above threshold
+    logger.log({"episode_return": returns}, t=1000, t_eval=0, event=LogEvent.EVAL)
+    logger.log({"episode_return": returns + 100}, t=2000, t_eval=1, event=LogEvent.EVAL)
+    logger.close()
+
+    data = json.load(open(json_path))
+    leaf = data["classic"]["cartpole"]["test_system"]["seed_0"]
+    assert leaf["step_0"]["step_count"] == 1000
+    assert "episode_return/mean" in leaf["step_0"]
+    assert leaf["step_0"]["solve_rate"] == [50.0]
+    assert leaf["step_1"]["solve_rate"] == [100.0]
+
+    # The plotting module consumes this exact layout.
+    from stoix_tpu.plotting import load_runs
+
+    curves = load_runs([json_path])
+    assert set(curves["cartpole"]["test_system"]) == {1000, 2000}
+
+
+def test_train_event_mean_reduction_only(tmp_path, capsys):
+    cfg = _logger_config(tmp_path, use_console=True)
+    logger = StoixLogger(cfg)
+    logger.log({"loss": np.array([1.0, 3.0])}, t=1, t_eval=0, event=LogEvent.TRAIN)
+    out = capsys.readouterr().out
+    assert "Loss: 2.000" in out
+    assert "std" not in out  # TRAIN metrics are mean-reduced, not described
+
+
+def test_tensorboard_sink_writes_events(tmp_path):
+    cfg = _logger_config(tmp_path, use_tb=True)
+    logger = StoixLogger(cfg)
+    logger.log({"episode_return": np.array([5.0])}, t=10, t_eval=0, event=LogEvent.EVAL)
+    logger.close()
+    tb_dir = os.path.join(logger.exp_dir, "tb")
+    assert any(f.startswith("events") for f in os.listdir(tb_dir))
