@@ -50,6 +50,20 @@ def get_distribution_act_fn(
     return act
 
 
+def _make_eval_reset_fn(eval_env: Environment, config: Any):
+    """Episode-reset function for evaluation. By default the env's own reset;
+    an env-specific override (e.g. fixed evaluation levels, the reference's
+    kinetix hook at evaluator.py:365-372) is instantiated from
+    config.env.eval_reset_fn as callable(env, key) -> (state, timestep)."""
+    hook_cfg = config.env.get("eval_reset_fn")
+    if not hook_cfg:
+        return eval_env.reset
+    from stoix_tpu.utils.config import instantiate
+
+    hook = instantiate(hook_cfg)
+    return lambda key: hook(eval_env, key)
+
+
 def get_ff_evaluator_fn(
     eval_env: Environment,
     act_fn: ActFn,
@@ -65,10 +79,11 @@ def get_ff_evaluator_fn(
     if episodes_global % n_shards != 0:
         episodes_global = ((episodes_global // n_shards) + 1) * n_shards
     per_shard = episodes_global // n_shards
+    reset_fn = _make_eval_reset_fn(eval_env, config)
 
     def eval_one_episode(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
         reset_key, act_key = jax.random.split(key)
-        env_state, timestep = eval_env.reset(reset_key)
+        env_state, timestep = reset_fn(reset_key)
 
         def cond(carry: _EvalCarry) -> jax.Array:
             return ~carry.timestep.last()
@@ -123,9 +138,11 @@ def get_rnn_evaluator_fn(
     if episodes_global % n_shards != 0:
         episodes_global = ((episodes_global // n_shards) + 1) * n_shards
 
+    reset_fn = _make_eval_reset_fn(eval_env, config)
+
     def eval_one_episode(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
         reset_key, act_key = jax.random.split(key)
-        env_state, timestep = eval_env.reset(reset_key)
+        env_state, timestep = reset_fn(reset_key)
         hstate = init_hstate_fn()
 
         def cond(carry) -> jax.Array:

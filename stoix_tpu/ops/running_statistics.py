@@ -107,3 +107,13 @@ def denormalize(batch: Any, state: RunningStatisticsState) -> Any:
 
 def clip(batch: Any, max_abs_value: float) -> Any:
     return jax.tree.map(lambda b: jnp.clip(b, -max_abs_value, max_abs_value), batch)
+
+
+def normalize_observation(
+    observation: Any, state: RunningStatisticsState, max_abs_value: float = 10.0
+) -> Any:
+    """Normalize an Observation struct's agent_view in place of per-call-site
+    _replace idioms (one definition so actor/learner/eval paths cannot drift)."""
+    return observation._replace(
+        agent_view=normalize(observation.agent_view, state, max_abs_value=max_abs_value)
+    )

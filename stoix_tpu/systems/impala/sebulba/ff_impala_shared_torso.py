@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
+from stoix_tpu.ops import running_statistics
 from stoix_tpu.ops.multistep import vtrace_td_error_and_advantage
 from stoix_tpu.systems.ppo.sebulba.ff_ppo import CoreLearnerState, run_experiment as _run
 from stoix_tpu.utils import config as config_lib
@@ -57,7 +58,24 @@ def get_shared_impala_learn_step(actor_apply, critic_apply, update_fns, config, 
     actor_update, _ = update_fns
     gamma = float(config.system.gamma)
 
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+
     def per_shard(state: CoreLearnerState, traj: PPOTransition):
+        # Match the actor path: observations the behavior policy consumed were
+        # normalized with these (pre-update) statistics; fold the raw batch in
+        # afterwards so the stats keep advancing.
+        obs_stats = state.obs_stats
+        if normalize_obs:
+            raw_obs = traj.obs
+            traj = traj._replace(
+                obs=running_statistics.normalize_observation(traj.obs, obs_stats),
+                next_obs=running_statistics.normalize_observation(traj.next_obs, obs_stats),
+            )
+            obs_stats = running_statistics.update(
+                obs_stats, raw_obs.agent_view, axis_names=("data",),
+                std_min_value=5e-4, std_max_value=5e4,
+            )
+
         def loss_fn(shared_params):
             dist = actor_apply(shared_params, traj.obs)
             online_log_prob = dist.log_prob(traj.action)
@@ -97,14 +115,14 @@ def get_shared_impala_learn_step(actor_apply, critic_apply, update_fns, config, 
         params = ActorCriticParams(shared, shared)
         metrics = jax.lax.pmean(metrics, axis_name="data")
         new_opts = ActorCriticOptStates(a_opt, state.opt_states.critic_opt_state)
-        return CoreLearnerState(params, new_opts, state.key), metrics
+        return CoreLearnerState(params, new_opts, state.key, obs_stats), metrics
 
     return jax.jit(
         jax.shard_map(
             per_shard,
             mesh=mesh,
-            in_specs=(CoreLearnerState(P(), P(), P()), P(None, "data")),
-            out_specs=(CoreLearnerState(P(), P(), P()), P()),
+            in_specs=(CoreLearnerState(P(), P(), P(), P()), P(None, "data")),
+            out_specs=(CoreLearnerState(P(), P(), P(), P()), P()),
             check_vma=False,
         )
     )

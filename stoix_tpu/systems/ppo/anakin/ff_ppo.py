@@ -85,11 +85,7 @@ def get_learner_fn(
     def _maybe_normalize(observation, obs_stats):
         if not normalize_obs:
             return observation
-        return observation._replace(
-            agent_view=running_statistics.normalize(
-                observation.agent_view, obs_stats, max_abs_value=10.0
-            )
-        )
+        return running_statistics.normalize_observation(observation, obs_stats)
 
     def _env_step(learner_state: PPOLearnerState, _: Any):
         params, opt_states, key, env_state, last_timestep, obs_stats = learner_state
@@ -376,11 +372,7 @@ def learner_setup(
         # Eval params bundle the actor params with the current statistics.
         def eval_apply(bundle, observation):
             params, stats = bundle
-            observation = observation._replace(
-                agent_view=running_statistics.normalize(
-                    observation.agent_view, stats, max_abs_value=10.0
-                )
-            )
+            observation = running_statistics.normalize_observation(observation, stats)
             return actor_network.apply(params, observation)
 
         eval_act_fn = get_distribution_act_fn(config, eval_apply)

@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
 from stoix_tpu.envs.factory import make_factory
 from stoix_tpu.evaluator import get_distribution_act_fn, get_ff_evaluator_fn
-from stoix_tpu.ops import losses
+from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.parallel import assemble_global_array
 from stoix_tpu.sebulba.core import (
@@ -51,6 +51,7 @@ class CoreLearnerState(NamedTuple):
     params: ActorCriticParams
     opt_states: ActorCriticOptStates
     key: jax.Array
+    obs_stats: Any  # observation running statistics (updates gated by config)
 
 
 def _build_networks(config: Any, num_actions: int, obs_value: Any):
@@ -77,8 +78,28 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
     arrays sharded on the env axis."""
     actor_update, critic_update = update_fns
     gamma = float(config.system.gamma)
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+
+    def _maybe_normalize(observation, obs_stats):
+        if not normalize_obs:
+            return observation
+        return running_statistics.normalize_observation(observation, obs_stats)
 
     def per_shard(state: CoreLearnerState, traj: PPOTransition):
+        # Actors already acted on observations normalized with these (pre-
+        # update) statistics; normalize the stored RAW obs identically, then
+        # fold the raw batch into the statistics (psum over the mesh axis).
+        obs_stats = state.obs_stats
+        raw_obs = traj.obs
+        traj = traj._replace(
+            obs=_maybe_normalize(raw_obs, obs_stats),
+            next_obs=_maybe_normalize(traj.next_obs, obs_stats),
+        )
+        if normalize_obs:
+            obs_stats = running_statistics.update(
+                obs_stats, raw_obs.agent_view, axis_names=("data",),
+                std_min_value=5e-4, std_max_value=5e4,
+            )
         v_t = critic_apply(state.params.critic_params, traj.next_obs)
         d_t = gamma * (1.0 - traj.done.astype(jnp.float32))
         advantages, targets = truncated_generalized_advantage_estimation(
@@ -148,14 +169,14 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
             int(config.system.epochs),
         )
         metrics = jax.lax.pmean(metrics, axis_name="data")
-        return CoreLearnerState(params, opt_states, key), metrics
+        return CoreLearnerState(params, opt_states, key, obs_stats), metrics
 
     return jax.jit(
         jax.shard_map(
             per_shard,
             mesh=mesh,
-            in_specs=(CoreLearnerState(P(), P(), P()), P(None, "data")),
-            out_specs=(CoreLearnerState(P(), P(), P()), P()),
+            in_specs=(CoreLearnerState(P(), P(), P(), P()), P(None, "data")),
+            out_specs=(CoreLearnerState(P(), P(), P(), P()), P()),
             check_vma=False,
         )
     )
@@ -202,8 +223,13 @@ def _rollout_body(
     envs = env_factory(envs_per_actor)
     timestep = envs.reset(seed=seed)
 
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+
     @jax.jit
-    def act_fn(params: ActorCriticParams, observation, key):
+    def act_fn(bundle, observation, key):
+        params, obs_stats = bundle
+        if normalize_obs:
+            observation = running_statistics.normalize_observation(observation, obs_stats)
         dist = actor_apply(params.actor_params, observation)
         value = critic_apply(params.critic_params, observation)
         action = dist.sample(seed=key)
@@ -341,8 +367,10 @@ def run_experiment(
         actor_optim.init(actor_params), critic_optim.init(critic_params)
     )
     key, learn_key = jax.random.split(key)
+    obs0_single = jax.tree.map(lambda x: jnp.asarray(x)[0], obs0.agent_view)
+    obs_stats = running_statistics.init_state(obs0_single)
     learner_state = jax.device_put(
-        CoreLearnerState(params, opt_states, learn_key),
+        CoreLearnerState(params, opt_states, learn_key, obs_stats),
         NamedSharding(learner_mesh, P()),
     )
 
@@ -364,8 +392,17 @@ def run_experiment(
             **dict(config.env.get("kwargs", {}) or {}),
         )
     )
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+
+    def eval_apply(payload, observation):
+        if normalize_obs:
+            p, stats = payload
+            observation = running_statistics.normalize_observation(observation, stats)
+            return actor.apply(p, observation)
+        return actor.apply(payload, observation)
+
     eval_fn = get_ff_evaluator_fn(
-        eval_env, get_distribution_act_fn(config, actor.apply), config, eval_mesh
+        eval_env, get_distribution_act_fn(config, eval_apply), config, eval_mesh
     )
 
     logger = StoixLogger(config)
@@ -383,7 +420,7 @@ def run_experiment(
     async_evaluator = AsyncEvaluator(eval_fn, lifetime, on_eval_result)
     async_evaluator.thread.start()
 
-    param_server.distribute_params(params)
+    param_server.distribute_params((params, obs_stats))
 
     actor_threads = []
     for d_idx, device in enumerate(actor_devices):
@@ -433,7 +470,9 @@ def run_experiment(
             with timer.time("learn"):
                 learner_state, train_metrics = learn_step(learner_state, batch)
                 jax.block_until_ready(train_metrics)
-            param_server.distribute_params(learner_state.params)
+            param_server.distribute_params(
+                (learner_state.params, learner_state.obs_stats)
+            )
             t_steps += steps_per_update
 
             if (update_idx + 1) % int(config.arch.num_updates_per_eval) == 0:
@@ -454,9 +493,14 @@ def run_experiment(
                 logger.log({**timings, **timer.all_means(prefix="learner_")},
                            t_steps, update_idx, LogEvent.MISC)
                 key, ek = jax.random.split(key)
+                if normalize_obs:
+                    eval_payload = (
+                        learner_state.params.actor_params, learner_state.obs_stats
+                    )
+                else:
+                    eval_payload = learner_state.params.actor_params
                 eval_params = jax.device_put(
-                    jax.tree.map(np.asarray, learner_state.params.actor_params),
-                    evaluator_device,
+                    jax.tree.map(np.asarray, eval_payload), evaluator_device
                 )
                 async_evaluator.submit(eval_params, ek, t_steps)
     finally:
