@@ -5,6 +5,7 @@ from stoix_tpu.parallel.distributed import (
 )
 from stoix_tpu.parallel.mesh import (
     assemble_global_array,
+    fetch_global,
     axis_size,
     create_mesh,
     data_sharding,
@@ -18,6 +19,7 @@ __all__ = [
     "maybe_initialize_distributed",
     "process_allgather",
     "assemble_global_array",
+    "fetch_global",
     "axis_size",
     "create_mesh",
     "data_sharding",

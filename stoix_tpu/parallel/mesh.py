@@ -94,3 +94,28 @@ def assemble_global_array(
     return jax.make_array_from_single_device_arrays(
         global_shape, NamedSharding(mesh, spec), list(per_device_arrays)
     )
+
+
+_FETCH_GLOBAL_CACHE: Dict[Any, Any] = {}
+
+
+def fetch_global(tree: Any, mesh: Mesh) -> Any:
+    """Bring (possibly sharded) global arrays to the host as numpy.
+
+    Single-process: plain device fetch. Multi-process: replicate via an
+    all-gather-shaped jit first (sharded globals span non-addressable devices
+    and cannot be fetched directly) — every process must call this, it runs a
+    collective. Distinct from distributed.process_allgather, which gathers
+    HOST-LOCAL values. The jitted identity is memoized per tree signature so
+    repeated host-loop calls hit the compile cache.
+    """
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, tree)
+    leaves, treedef = jax.tree.flatten(tree)
+    cache_key = (treedef, tuple((l.shape, str(l.dtype)) for l in leaves), id(mesh))
+    fn = _FETCH_GLOBAL_CACHE.get(cache_key)
+    if fn is None:
+        shardings = jax.tree.map(lambda _: NamedSharding(mesh, P()), tree)
+        fn = jax.jit(lambda t: t, out_shardings=shardings)
+        _FETCH_GLOBAL_CACHE[cache_key] = fn
+    return jax.tree.map(np.asarray, fn(tree))

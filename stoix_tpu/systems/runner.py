@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from stoix_tpu import envs
 from stoix_tpu.evaluator import evaluator_setup, get_rnn_evaluator_fn
-from stoix_tpu.parallel import create_mesh, is_coordinator, maybe_initialize_distributed
+from stoix_tpu.parallel import create_mesh, fetch_global, is_coordinator, maybe_initialize_distributed
 from stoix_tpu.utils.checkpointing import checkpointer_from_config
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
 from stoix_tpu.utils.timestep_checker import check_total_timesteps
@@ -102,35 +102,38 @@ def run_anakin_experiment(
         elapsed = time.time() - start
         t = start_step + (eval_idx + 1) * steps_per_eval
 
-        episode_metrics = envs.get_final_step_metrics(dict(output.episode_metrics))
+        # Collective fetch: sharded global metrics are not host-addressable
+        # under multi-process runs; every process participates.
+        episode_metrics = envs.get_final_step_metrics(
+            fetch_global(dict(output.episode_metrics), mesh)
+        )
+        train_metrics = fetch_global(dict(output.train_metrics), mesh)
         sps = steps_per_eval / elapsed
         if is_coordinator():
             logger.log({**episode_metrics, "steps_per_second": sps}, t, eval_idx, LogEvent.ACT)
             logger.log(
-                jax.tree.map(lambda x: jnp.mean(x), dict(output.train_metrics)),
-                t, eval_idx, LogEvent.TRAIN,
+                jax.tree.map(lambda x: x.mean(), train_metrics), t, eval_idx, LogEvent.TRAIN
             )
 
         trained_params = setup.eval_params_fn(learner_state)
         key, ek = jax.random.split(key)
-        eval_metrics = evaluator(trained_params, ek)
-        jax.block_until_ready(eval_metrics)
+        eval_metrics = fetch_global(evaluator(trained_params, ek), mesh)
         if is_coordinator():
             logger.log(eval_metrics, t, eval_idx, LogEvent.EVAL)
 
-        mean_return = float(jnp.mean(eval_metrics["episode_return"]))
+        mean_return = float(eval_metrics["episode_return"].mean())
         final_return = mean_return
         if mean_return >= float(best_return):
             best_return = mean_return
             best_params = jax.tree.map(jnp.copy, trained_params)
 
-        if checkpointer is not None and is_coordinator():
+        # Orbax saves sharded globals collectively: ALL processes call save.
+        if checkpointer is not None:
             checkpointer.save(t, learner_state, mean_return)
 
     if bool(config.arch.get("absolute_metric", True)):
         key, ek = jax.random.split(key)
-        abs_metrics = absolute_evaluator(best_params, ek)
-        jax.block_until_ready(abs_metrics)
+        abs_metrics = fetch_global(absolute_evaluator(best_params, ek), mesh)
         if is_coordinator():
             logger.log(
                 abs_metrics,
@@ -138,7 +141,7 @@ def run_anakin_experiment(
                 int(config.arch.num_evaluation),
                 LogEvent.ABSOLUTE,
             )
-        final_return = float(jnp.mean(abs_metrics["episode_return"]))
+        final_return = float(abs_metrics["episode_return"].mean())
 
     logger.close()
     return final_return

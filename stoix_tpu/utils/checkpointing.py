@@ -32,7 +32,20 @@ class Checkpointer:
     ):
         import time
 
-        uid = checkpoint_uid or time.strftime("%Y%m%d%H%M%S")
+        uid = checkpoint_uid
+        if uid is None:
+            uid = time.strftime("%Y%m%d%H%M%S")
+            if jax.process_count() > 1:
+                # All processes must agree on the directory (collective save);
+                # startup skew can cross a second boundary, so broadcast the
+                # coordinator's stamp.
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                stamp = multihost_utils.broadcast_one_to_all(
+                    np.asarray([int(uid)], dtype=np.int64)
+                )
+                uid = str(int(stamp[0]))
         self.directory = os.path.abspath(os.path.join(rel_dir, uid, model_name))
         options = ocp.CheckpointManagerOptions(
             save_interval_steps=save_interval_steps,
