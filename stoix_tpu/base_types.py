@@ -59,6 +59,7 @@ class RNNLearnerState(NamedTuple):
     done: jax.Array
     truncated: jax.Array
     hstates: Any
+    obs_stats: Any = None  # observation running statistics (rec_ppo)
 
 
 class RNNOffPolicyLearnerState(NamedTuple):

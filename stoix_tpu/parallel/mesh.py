@@ -115,6 +115,10 @@ def fetch_global(tree: Any, mesh: Mesh) -> Any:
     cache_key = (treedef, tuple((l.shape, str(l.dtype)) for l in leaves), id(mesh))
     fn = _FETCH_GLOBAL_CACHE.get(cache_key)
     if fn is None:
+        if len(_FETCH_GLOBAL_CACHE) >= 64:
+            # Bounded: long-lived processes creating many meshes/signatures
+            # must not pin executables (and their meshes) forever.
+            _FETCH_GLOBAL_CACHE.clear()
         shardings = jax.tree.map(lambda _: NamedSharding(mesh, P()), tree)
         fn = jax.jit(lambda t: t, out_shardings=shardings)
         _FETCH_GLOBAL_CACHE[cache_key] = fn

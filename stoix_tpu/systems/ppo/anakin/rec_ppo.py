@@ -23,7 +23,7 @@ from stoix_tpu.base_types import (
     ExperimentOutput,
     RNNLearnerState,
 )
-from stoix_tpu.ops import losses
+from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.systems import anakin
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
@@ -49,18 +49,24 @@ def get_learner_fn(env, apply_fns, update_fns, config):
     actor_apply, critic_apply = apply_fns
     actor_update, critic_update = update_fns
     gamma = float(config.system.gamma)
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+
+    def _maybe_normalize(observation, obs_stats):
+        if not normalize_obs:
+            return observation
+        return running_statistics.normalize_observation(observation, obs_stats)
 
     def _env_step(learner_state: RNNLearnerState, _):
-        params, opt_states, key, env_state, last_timestep, done, truncated, hstates = (
-            learner_state
-        )
+        (params, opt_states, key, env_state, last_timestep, done, truncated,
+         hstates, obs_stats) = learner_state
         key, policy_key = jax.random.split(key)
         actor_hstate, critic_hstate = hstates
 
         # Single-step time-major unroll: [1, E, ...]. Hidden states reset on
         # done OR truncation (both start a fresh episode).
         reset_flag = jnp.logical_or(done, truncated)
-        obs_t = jax.tree.map(lambda x: x[None], last_timestep.observation)
+        observation = _maybe_normalize(last_timestep.observation, obs_stats)
+        obs_t = jax.tree.map(lambda x: x[None], observation)
         done_t = reset_flag[None]
         new_actor_hstate, dist = actor_apply(params.actor_params, actor_hstate, (obs_t, done_t))
         new_critic_hstate, value = critic_apply(
@@ -75,7 +81,9 @@ def get_learner_fn(env, apply_fns, update_fns, config):
 
         # Bootstrap value of the TRUE next obs using the post-step critic carry
         # (carry itself is not advanced by this evaluation).
-        next_obs_t = jax.tree.map(lambda x: x[None], timestep.extras["next_obs"])
+        next_obs_t = jax.tree.map(
+            lambda x: x[None], _maybe_normalize(timestep.extras["next_obs"], obs_stats)
+        )
         _, bootstrap_value = critic_apply(
             params.critic_params, new_critic_hstate, (next_obs_t, jnp.zeros_like(done_t))
         )
@@ -89,13 +97,13 @@ def get_learner_fn(env, apply_fns, update_fns, config):
             reward=timestep.reward,
             bootstrap_value=bootstrap_value[0],
             log_prob=log_prob[0],
-            obs=last_timestep.observation,
+            obs=last_timestep.observation,  # RAW; normalized at use
             hstates=(actor_hstate, critic_hstate),
             info=timestep.extras["episode_metrics"],
         )
         new_state = RNNLearnerState(
             params, opt_states, key, env_state, timestep, next_done, next_trunc,
-            (new_actor_hstate, new_critic_hstate),
+            (new_actor_hstate, new_critic_hstate), obs_stats,
         )
         return new_state, transition
 
@@ -171,9 +179,19 @@ def get_learner_fn(env, apply_fns, update_fns, config):
         learner_state, traj = jax.lax.scan(
             _env_step, learner_state, None, int(config.system.rollout_length)
         )
-        params, opt_states, key, env_state, last_timestep, done, truncated, hstates = (
-            learner_state
-        )
+        (params, opt_states, key, env_state, last_timestep, done, truncated,
+         hstates, obs_stats) = learner_state
+        # Trajectory obs are stored RAW; normalize with the PRE-update
+        # statistics (identical to what the rollout's log_probs/values used so
+        # the re-unrolls match the behavior policy exactly), then fold the raw
+        # batch into the statistics.
+        raw_obs = traj.obs
+        traj = traj._replace(obs=_maybe_normalize(raw_obs, obs_stats))
+        if normalize_obs:
+            obs_stats = running_statistics.update(
+                obs_stats, raw_obs.agent_view, axis_names=("batch", "data"),
+                std_min_value=5e-4, std_max_value=5e4,
+            )
         advantages, targets = truncated_generalized_advantage_estimation(
             traj.reward,
             gamma * (1.0 - traj.done.astype(jnp.float32)),
@@ -189,7 +207,8 @@ def get_learner_fn(env, apply_fns, update_fns, config):
         )
         params, opt_states, _, _, _, key = update_state
         learner_state = RNNLearnerState(
-            params, opt_states, key, env_state, last_timestep, done, truncated, hstates
+            params, opt_states, key, env_state, last_timestep, done, truncated,
+            hstates, obs_stats,
         )
         return learner_state, (traj.info, loss_info)
 
@@ -269,7 +288,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         params=P(), opt_states=P(), key=P("data"),
         env_state=P(None, "data"), timestep=P(None, "data"),
         done=P(None, "data"), truncated=P(None, "data"),
-        hstates=P(None, "data"),
+        hstates=P(None, "data"), obs_stats=P(),
     )
     env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
     init_h = lambda: ScannedRNN.initialize_carry(cell_type, hidden_size, (update_batch, envs_axis))
@@ -282,6 +301,10 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         done=jnp.zeros((update_batch, envs_axis), bool),
         truncated=jnp.zeros((update_batch, envs_axis), bool),
         hstates=(init_h(), init_h()),
+        obs_stats=anakin.broadcast_to_update_batch(
+            running_statistics.init_state(env.observation_value().agent_view),
+            update_batch,
+        ),
     )
     learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
 
@@ -291,7 +314,14 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     )
     learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
 
-    def rnn_act_fn(params, hstate, observation, done, act_key):
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+
+    def rnn_act_fn(payload, hstate, observation, done, act_key):
+        if normalize_obs:
+            params, stats = payload
+            observation = running_statistics.normalize_observation(observation, stats)
+        else:
+            params = payload
         obs_t = jax.tree.map(lambda x: x[None, None], observation)
         done_t = jnp.asarray(done).reshape(1, 1)
         hstate, dist = actor_network.apply(params, hstate, (obs_t, done_t))
@@ -299,11 +329,19 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         action = dist.mode() if greedy else dist.sample(seed=act_key)
         return hstate, action[0, 0]
 
+    if normalize_obs:
+        eval_params_fn = lambda s: (
+            anakin.unbatch_params(s.params.actor_params),
+            anakin.unbatch_params(s.obs_stats),
+        )
+    else:
+        eval_params_fn = lambda s: anakin.unbatch_params(s.params.actor_params)
+
     setup = AnakinSetup(
         learn=learn,
         learner_state=learner_state,
-        eval_act_fn=rnn_act_fn,  # consumed by the RNN evaluator below
-        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+        eval_act_fn=rnn_act_fn,  # consumed by the RNN evaluator
+        eval_params_fn=eval_params_fn,
     )
     return setup
 

@@ -30,13 +30,19 @@ WORKER = textwrap.dedent(
 
     from stoix_tpu.utils import config as cl
     from stoix_tpu.systems.ppo.anakin import ff_ppo
+    import tempfile
+    ckpt_dir = sys.argv[3]
+    os.chdir(ckpt_dir)  # collective checkpoint saves land in a shared tmp dir
     cfg = cl.compose(cl.default_config_dir(), "default/anakin/default_ff_ppo.yaml",
                      ["env=identity_game", "arch.total_num_envs=16",
                       "arch.total_timesteps=4096", "arch.num_evaluation=1",
                       "arch.num_eval_episodes=8", "arch.absolute_metric=False",
                       "system.rollout_length=8", "system.num_minibatches=2",
-                      "arch.evaluation_greedy=True", "logger.use_console=False"])
+                      "arch.evaluation_greedy=True", "logger.use_console=False",
+                      "logger.checkpointing.save_model=True",
+                      f"logger.base_exp_path={{ckpt_dir}}/results"])
     ret = ff_ppo.run_experiment(cfg)
+    assert os.path.isdir(os.path.join(ckpt_dir, "checkpoints")), "collective save missing"
     print(f"RESULT {{ret}}", flush=True)
     """
 )
@@ -57,9 +63,11 @@ def test_two_process_global_mesh_training(tmp_path):
 
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root  # drop site hooks that pre-initialise jax
+    ckpt_dir = tmp_path / "shared"
+    ckpt_dir.mkdir()
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), str(port)],
+            [sys.executable, str(worker), str(i), str(port), str(ckpt_dir)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             env=env,
@@ -67,7 +75,13 @@ def test_two_process_global_mesh_training(tmp_path):
         )
         for i in range(2)
     ]
-    outputs = [p.communicate(timeout=600)[0] for p in procs]
+    try:
+        outputs = [p.communicate(timeout=600)[0] for p in procs]
+    finally:
+        # A collective deadlock leaves the peer blocked: never leak workers.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert "RESULT" in out
