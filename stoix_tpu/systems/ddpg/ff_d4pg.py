@@ -131,7 +131,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
             **q_metrics, **actor_metrics,
         }
 
-    def act_in_env(params: DDPGParams, observation, key):
+    def act_in_env(params: DDPGParams, observation, key, buffer_state=None):
         action = actor.apply(params.actor_params.online, observation).mode()
         noise = jax.random.normal(key, action.shape) * noise_sigma * (act_hi - act_lo) / 2
         return jnp.clip(action + noise, act_lo, act_hi)

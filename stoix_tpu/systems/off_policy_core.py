@@ -179,13 +179,18 @@ def standard_off_policy_learner(
     """Standard off-policy learner loop.
 
     update_from_batch(params, opt_states, batch, key) -> ((params, opt_states), metrics)
-    act_in_env(params, observation, key) -> action
+    act_in_env(params, observation, key, buffer_state) -> action — buffer_state
+    enables training-progress schedules (e.g. epsilon decay keyed on
+    buffer_state.num_added); implementations that don't need it take it as an
+    unused parameter.
     """
 
     def _env_step(learner_state: OffPolicyLearnerState, _):
         params, opt_states, buffer_state, key, env_state, last_timestep = learner_state
         key, act_key = jax.random.split(key)
-        action = act_in_env(params, last_timestep.observation, act_key)
+        action = act_in_env(
+            params, last_timestep.observation, act_key, buffer_state=buffer_state
+        )
         env_state, timestep = env.step(env_state, action)
         transition = make_transition(last_timestep, action, timestep)
         return (

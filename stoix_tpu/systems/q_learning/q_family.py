@@ -89,6 +89,14 @@ def q_learner_setup(
     config.system.action_dim = num_actions
     tau = float(config.system.tau)
     train_eps = float(config.system.training_epsilon)
+    final_eps = float(config.system.get("final_epsilon", train_eps))
+    decay_steps = float(config.system.get("epsilon_decay_steps", 0) or 0)
+    if decay_steps > 0 and final_eps == train_eps:
+        raise ValueError(
+            "system.epsilon_decay_steps is set but system.final_epsilon equals "
+            "training_epsilon — the requested decay would be a no-op. Set "
+            "system.final_epsilon (e.g. 0.05)."
+        )
 
     q_network = build_q_network(config, num_actions, **(head_kwargs or {}))
     q_optim = optax.chain(
@@ -120,8 +128,17 @@ def q_learner_setup(
         target = optax.incremental_update(online, params.target, tau)
         return (OnlineAndTarget(online, target), opt_states), loss_info
 
-    def act_in_env(params: OnlineAndTarget, observation, key):
-        dist = act_dist(q_network.apply(params.online, observation, train_eps))
+    def act_in_env(params: OnlineAndTarget, observation, key, buffer_state=None):
+        # Linear epsilon decay keyed on per-shard experience count (reference
+        # systems anneal exploration; enabled via system.epsilon_decay_steps).
+        if decay_steps > 0 and buffer_state is not None:
+            frac = jnp.minimum(
+                buffer_state.num_added.astype(jnp.float32) / decay_steps, 1.0
+            )
+            eps = train_eps + frac * (final_eps - train_eps)
+        else:
+            eps = train_eps
+        dist = act_dist(q_network.apply(params.online, observation, eps))
         return dist.sample(seed=key)
 
     learn_per_shard = core.standard_off_policy_learner(

@@ -143,6 +143,10 @@ def run_anakin_experiment(
             )
         final_return = float(abs_metrics["episode_return"].mean())
 
+    if checkpointer is not None:
+        # Wait for in-flight async saves; otherwise interpreter shutdown races
+        # orbax's executor ("cannot schedule new futures after shutdown").
+        checkpointer.close()
     logger.close()
     return final_return
 

@@ -165,7 +165,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         new_opts = SACOptStates(actor_opt_state, q_opt_state, alpha_opt_state)
         return (new_params, new_opts), {**q_metrics, **actor_metrics, **alpha_metrics}
 
-    def act_in_env(params: SACParams, observation, key):
+    def act_in_env(params: SACParams, observation, key, buffer_state=None):
         return actor.apply(params.actor_params, observation).sample(seed=key)
 
     learn_per_shard = core.standard_off_policy_learner(

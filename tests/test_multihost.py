@@ -30,7 +30,6 @@ WORKER = textwrap.dedent(
 
     from stoix_tpu.utils import config as cl
     from stoix_tpu.systems.ppo.anakin import ff_ppo
-    import tempfile
     ckpt_dir = sys.argv[3]
     os.chdir(ckpt_dir)  # collective checkpoint saves land in a shared tmp dir
     cfg = cl.compose(cl.default_config_dir(), "default/anakin/default_ff_ppo.yaml",
@@ -42,7 +41,11 @@ WORKER = textwrap.dedent(
                       "logger.checkpointing.save_model=True",
                       f"logger.base_exp_path={{ckpt_dir}}/results"])
     ret = ff_ppo.run_experiment(cfg)
-    assert os.path.isdir(os.path.join(ckpt_dir, "checkpoints")), "collective save missing"
+    # A real collective save produces a numbered step directory (the manager
+    # mkdirs the root eagerly, so the root alone proves nothing).
+    import glob
+    steps = glob.glob(os.path.join(ckpt_dir, "checkpoints", "*", "ff_ppo", "*"))
+    assert any(os.path.basename(s_).isdigit() for s_ in steps), f"no saved steps: {{steps}}"
     print(f"RESULT {{ret}}", flush=True)
     """
 )
@@ -77,11 +80,22 @@ def test_two_process_global_mesh_training(tmp_path):
     ]
     try:
         outputs = [p.communicate(timeout=600)[0] for p in procs]
-    finally:
-        # A collective deadlock leaves the peer blocked: never leak workers.
+    except subprocess.TimeoutExpired:
+        # A collective deadlock leaves the peer blocked: kill, then harvest the
+        # partial output (the only evidence of where the hang occurred).
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        outputs = [p.communicate()[0] for p in procs]
+        raise AssertionError(
+            "multi-process run deadlocked; partial outputs:\n"
+            + "\n---\n".join(o[-2000:] for o in outputs)
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert "RESULT" in out
