@@ -7,9 +7,9 @@ states (reference make_recurrent_fn:74-102 uses env_state.unwrapped_state),
 (reference :377-379). The actor trains on search visit-weights (CE) and the
 critic on truncation-aware GAE targets.
 
-Round-1 deviation from the reference: training is on-policy over the fresh
-rollout (epochs of shuffled minibatches, PPO-style) instead of a trajectory
-replay buffer; the replay variant lands with the sampled-search systems.
+Training draws from a trajectory REPLAY buffer when
+`system.use_replay_buffer` is set (the reference's scheme, ff_az.py:497);
+otherwise it runs on-policy epochs over the fresh rollout.
 """
 
 from __future__ import annotations
@@ -196,6 +196,139 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         return learner_state, (traj.info, loss_info)
 
     def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        state, (episode_info, loss_info) = jax.lax.scan(
+            jax.vmap(_update_step, axis_name="batch"),
+            state, None, int(config.arch.num_updates_per_eval),
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(state, episode_info, loss_info)
+
+    return learner_fn
+
+
+def get_replay_learner_fn(env, sim_env, apply_fns, update_fns, buffer, config):
+    """Replay variant (reference ff_az.py:497): rollouts feed a trajectory
+    buffer; each epoch samples sequences and recomputes truncation-aware GAE
+    targets with the CURRENT critic before the CE/value update."""
+    from stoix_tpu.base_types import OffPolicyLearnerState
+
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+    num_simulations = int(config.system.get("num_simulations", 16))
+    search_method = str(config.system.get("search_method", "muzero"))
+    policy_fn = (
+        mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
+    )
+    def recurrent_fn(params, rng, action, embedding):
+        state = jax.tree.map(lambda x: x[0], embedding["state"])
+        new_state, ts = sim_env.step(state, action[0])
+        prior = actor_apply(params.actor_params, ts.observation)
+        value = critic_apply(params.critic_params, ts.observation)
+        out = mcts.RecurrentFnOutput(
+            reward=ts.reward[None],
+            discount=gamma * ts.discount[None],
+            prior_logits=prior.logits[None],
+            value=value[None],
+        )
+        return out, {"state": jax.tree.map(lambda x: x[None], new_state)}
+
+    def _env_step(learner_state: OffPolicyLearnerState, _):
+        params, opt_states, buffer_state, key, env_state, last_timestep = learner_state
+        key, search_key = jax.random.split(key)
+        prior = actor_apply(params.actor_params, last_timestep.observation)
+        value = critic_apply(params.critic_params, last_timestep.observation)
+        root = mcts.RootFnOutput(
+            prior_logits=prior.logits, value=value,
+            embedding={"state": unwrap_env_state(env_state)},
+        )
+        search_out = policy_fn(
+            params, search_key, root, recurrent_fn, num_simulations,
+            max_depth=int(config.system.get("max_depth", num_simulations)),
+        )
+        env_state_new, timestep = env.step(env_state, search_out.action)
+        data = {
+            "obs": last_timestep.observation,
+            "search_policy": search_out.action_weights,
+            "reward": timestep.reward,
+            "discount": timestep.discount,
+            "truncated": jnp.logical_and(timestep.last(), timestep.discount != 0.0),
+            "info": timestep.extras["episode_metrics"],
+        }
+        return (
+            OffPolicyLearnerState(params, opt_states, buffer_state, key, env_state_new, timestep),
+            data,
+        )
+
+    def _update_epoch(carry, _):
+        params, opt_states, buffer_state, key = carry
+        key, sample_key = jax.random.split(key)
+        seq = buffer.sample(buffer_state, sample_key).experience  # [B, L, ...]
+
+        # GAE targets with the CURRENT critic over the sampled sequence.
+        values = critic_apply(params.critic_params, seq["obs"])  # [B, L]
+        _, targets = truncated_generalized_advantage_estimation(
+            seq["reward"][:, :-1],
+            gamma * seq["discount"][:, :-1],
+            float(config.system.get("gae_lambda", 0.95)),
+            v_tm1=jax.lax.stop_gradient(values[:, :-1]),
+            v_t=jax.lax.stop_gradient(values[:, 1:]),
+            truncation_t=seq["truncated"][:, :-1].astype(jnp.float32),
+            batch_major=True,
+        )
+        train_obs = jax.tree.map(lambda x: x[:, :-1], seq["obs"])
+
+        def actor_loss_fn(actor_params):
+            dist = actor_apply(actor_params, train_obs)
+            ce = -jnp.sum(
+                seq["search_policy"][:, :-1] * jax.nn.log_softmax(dist.logits, axis=-1),
+                axis=-1,
+            )
+            loss = jnp.mean(ce)
+            return loss, {"actor_loss": loss, "entropy": dist.entropy().mean()}
+
+        def critic_loss_fn(critic_params):
+            v = critic_apply(critic_params, train_obs)
+            loss = 0.5 * jnp.mean((v - jax.lax.stop_gradient(targets)) ** 2)
+            return float(config.system.get("vf_coef", 0.5)) * loss, {"value_loss": loss}
+
+        a_grads, a_metrics = jax.grad(actor_loss_fn, has_aux=True)(params.actor_params)
+        c_grads, c_metrics = jax.grad(critic_loss_fn, has_aux=True)(params.critic_params)
+        a_grads, c_grads = jax.lax.pmean(
+            jax.lax.pmean((a_grads, c_grads), axis_name="batch"), axis_name="data"
+        )
+        a_updates, a_opt = actor_update(a_grads, opt_states.actor_opt_state)
+        c_updates, c_opt = critic_update(c_grads, opt_states.critic_opt_state)
+        params = ActorCriticParams(
+            optax.apply_updates(params.actor_params, a_updates),
+            optax.apply_updates(params.critic_params, c_updates),
+        )
+        return (params, ActorCriticOptStates(a_opt, c_opt), buffer_state, key), {
+            **a_metrics, **c_metrics,
+        }
+
+    def _update_step(learner_state: OffPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, buffer_state, key, env_state, timestep = learner_state
+        store = {k: v for k, v in traj.items() if k != "info"}
+        buffer_state = buffer.add(
+            buffer_state, jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), store)
+        )
+        (params, opt_states, buffer_state, key), loss_info = jax.lax.scan(
+            _update_epoch, (params, opt_states, buffer_state, key), None,
+            int(config.system.epochs),
+        )
+        learner_state = OffPolicyLearnerState(
+            params, opt_states, buffer_state, key, env_state, timestep
+        )
+        return learner_state, (traj["info"], loss_info)
+
+    def learner_fn(learner_state: OffPolicyLearnerState) -> ExperimentOutput:
         key = learner_state.key[0]
         state = learner_state._replace(key=key)
         state, (episode_info, loss_info) = jax.lax.scan(
