@@ -22,6 +22,28 @@ def main() -> None:
     smoke = "--smoke" in sys.argv
     large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
 
+    # Watchdog: remote-platform runtimes can wedge indefinitely (observed with
+    # the tunneled TPU backend); emit a structured failure line instead of
+    # hanging the caller forever.
+    import signal
+
+    def _on_timeout(signum, frame):
+        print(
+            json.dumps(
+                {
+                    "metric": "anakin_ppo_env_steps_per_sec",
+                    "value": 0.0,
+                    "unit": "TIMEOUT: device runtime unresponsive",
+                    "vs_baseline": 0.0,
+                }
+            ),
+            flush=True,
+        )
+        sys.exit(2)
+
+    signal.signal(signal.SIGALRM, _on_timeout)
+    signal.alarm(1800)
+
     import jax
 
     from stoix_tpu.utils import config as config_lib
