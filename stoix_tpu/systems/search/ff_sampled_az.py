@@ -5,9 +5,9 @@ search operates over (reference SampledExItTransition.sampled_actions,
 search_types.py:31-39); the policy trains toward the search weights over those
 samples with -sum_i w_i log pi(a_i | s).
 
-Simplification vs the paper (documented): the root-sampled action set is
-reused at deeper tree nodes instead of resampling per node — a standard
-approximation that keeps the tree arrays static.
+Each expanded node draws a FRESH action set from the policy at its own state
+(per-node resampling, as in the paper); tree arrays stay static because the
+set size K is fixed.
 """
 
 from __future__ import annotations
@@ -64,6 +64,11 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         action = actions[action_idx[0]]
         new_state, ts = sim_env.step(state, action)
         value = critic_apply(params.critic_params, ts.observation)
+        # Per-node RESAMPLING (Sampled MuZero): the expanded node's action set
+        # is drawn fresh from the policy AT THAT STATE.
+        dist = actor_apply(params.actor_params, ts.observation)
+        node_keys = jax.random.split(rng, num_samples)
+        node_actions = jax.vmap(lambda k: dist.sample(seed=k))(node_keys)  # [K, A]
         out = mcts.RecurrentFnOutput(
             reward=ts.reward[None],
             discount=gamma * ts.discount[None],
@@ -72,7 +77,7 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         )
         new_embedding = {
             "state": jax.tree.map(lambda x: x[None], new_state),
-            "actions": actions[None],
+            "actions": node_actions[None],
         }
         return out, new_embedding
 

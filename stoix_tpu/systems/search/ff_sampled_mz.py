@@ -61,13 +61,19 @@ def get_learner_fn(env, networks, optim_update, config):
         )[:, 0]
         new_latent, reward = wm.apply(params.world_model, latent, action, method="step")
         value = value_net.apply(params.value_head, new_latent)
+        # Per-node resampling from the policy at the NEW latent.
+        dist = policy_net.apply(params.policy_head, new_latent)
+        node_keys = jax.random.split(rng, num_samples)
+        node_actions = jnp.swapaxes(
+            jax.vmap(lambda k: dist.sample(seed=k))(node_keys), 0, 1
+        )  # [B, K, A]
         out = mcts.RecurrentFnOutput(
             reward=reward,
             discount=jnp.full_like(reward, gamma),
             prior_logits=jnp.zeros(reward.shape + (num_samples,)),
             value=value,
         )
-        return out, {"latent": new_latent, "actions": actions}
+        return out, {"latent": new_latent, "actions": node_actions}
 
     def _env_step(learner_state: OnPolicyLearnerState, _):
         params, opt_states, key, env_state, last_timestep = learner_state
