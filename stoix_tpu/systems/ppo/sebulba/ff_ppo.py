@@ -54,13 +54,21 @@ class CoreLearnerState(NamedTuple):
     obs_stats: Any  # observation running statistics (updates gated by config)
 
 
-def _build_networks(config: Any, num_actions: int, obs_value: Any):
+def _build_networks(config: Any, num_actions: int, obs_value: Any, env: Any = None):
     from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
 
     net_cfg = config.network
+    if env is not None:
+        # Infer head kwargs from the action space (discrete num_actions or
+        # continuous action_dim/minimum/maximum), like the Anakin systems.
+        from stoix_tpu.systems.anakin import head_kwargs_for_env
+
+        head_kwargs = head_kwargs_for_env(net_cfg.actor_network.action_head, env)
+    else:
+        head_kwargs = {"num_actions": num_actions}
     actor = FeedForwardActor(
         action_head=config_lib.instantiate(
-            net_cfg.actor_network.action_head, num_actions=num_actions
+            net_cfg.actor_network.action_head, **head_kwargs
         ),
         torso=config_lib.instantiate(net_cfg.actor_network.pre_torso),
         input_layer=config_lib.instantiate(net_cfg.actor_network.input_layer),
@@ -342,7 +350,9 @@ def run_experiment(
         else probe_envs.reset(seed=0).observation,
     )
 
-    build = networks_builder or _build_networks
+    build = networks_builder or (
+        lambda cfg, n, obs: _build_networks(cfg, n, obs, env=probe_envs)
+    )
     actor, critic = build(config, num_actions, dummy_obs)
     key = jax.random.PRNGKey(int(config.arch.seed))
     key, a_key, c_key = jax.random.split(key, 3)
