@@ -6,9 +6,11 @@ scaled to the local chip count (the target implies 15,625 steps/sec/chip); it
 applies to the tracked small-network config only and is reported as null for
 --large, whose workload is incommensurable with that baseline.
 
-Usage: python bench.py [--smoke] [--large]
+Usage: python bench.py [--smoke] [--large] [--cpu]
   --smoke  tiny budget for CI wiring checks
   --large  MXU-bound variant (1024x1024 bfloat16 torsos)
+  --cpu    force the CPU backend (a site hook can force a remote platform
+           even over JAX_PLATFORMS=cpu; this flag wins)
 """
 
 from __future__ import annotations
@@ -23,32 +25,67 @@ def main() -> None:
     large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
 
     # Watchdog: remote-platform runtimes can wedge indefinitely (observed with
-    # the tunneled TPU backend); emit a structured failure line instead of
-    # hanging the caller forever.
-    import signal
+    # the tunneled TPU backend). A SIGALRM handler is NOT enough — Python
+    # signal handlers only run between bytecodes, and a wedged backend blocks
+    # the main thread inside a native PJRT RPC, so the alarm never fires
+    # (round 1's watchdog emitted nothing for exactly this reason). A timer
+    # THREAD + os._exit works regardless of what the main thread is stuck in.
+    import os
+    import threading
 
-    def _on_timeout(signum, frame):
+    def _fail(reason: str) -> None:
         print(
             json.dumps(
                 {
                     "metric": "anakin_ppo_env_steps_per_sec",
                     "value": 0.0,
-                    "unit": "TIMEOUT: device runtime unresponsive",
+                    "unit": reason,
                     "vs_baseline": 0.0,
                 }
             ),
             flush=True,
         )
-        sys.exit(2)
+        os._exit(2)
 
-    signal.signal(signal.SIGALRM, _on_timeout)
-    signal.alarm(1800)
+    watchdog = threading.Timer(180.0, _fail, args=("TIMEOUT: backend init/probe unresponsive",))
+    watchdog.daemon = True
+    watchdog.start()
 
     import jax
 
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+
     from stoix_tpu.utils import config as config_lib
 
-    n_devices = len(jax.devices())
+    # Backend init can also fail outright (round 1: the wedged tunnel made
+    # jax.devices() raise). Always emit the structured JSON line, never a
+    # bare traceback.
+    try:
+        n_devices = len(jax.devices())
+    except Exception as exc:  # noqa: BLE001 — any backend-init error is terminal here
+        _fail(f"BACKEND INIT FAILED: {type(exc).__name__}: {exc}")
+
+    # Probe the chip with a matmul (still under the short deadline) before
+    # trusting it with the full run: a wedged runtime can accept the
+    # connection but hang on compute.
+    import numpy as np
+
+    try:
+        probe = jax.numpy.ones((256, 256)) @ jax.numpy.ones((256, 256))
+        # Host materialization is the probe — dispatch alone is async and
+        # proves nothing (and must not live in an assert, which -O strips).
+        value = float(np.asarray(probe[0, 0]))
+        if value != 256.0:
+            raise RuntimeError(f"probe matmul returned {value}, expected 256.0")
+    except Exception as exc:  # noqa: BLE001
+        _fail(f"DEVICE PROBE FAILED: {type(exc).__name__}: {exc}")
+
+    # Healthy chip: swap in the long-deadline watchdog for the timed run.
+    watchdog.cancel()
+    watchdog = threading.Timer(1800.0, _fail, args=("TIMEOUT: device runtime unresponsive",))
+    watchdog.daemon = True
+    watchdog.start()
 
     overrides = [
         "arch.total_num_envs=%d" % (2048 * n_devices if not smoke else 8 * n_devices),
@@ -92,8 +129,6 @@ def main() -> None:
         * int(config.arch.total_num_envs)
         * int(config.arch.num_updates_per_eval)
     )
-
-    import numpy as np
 
     def force(out):
         # Materialize a scalar on the host: block_until_ready alone can be a
