@@ -1,16 +1,21 @@
 """Benchmark harness: Anakin PPO env-steps/sec on the available devices.
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
-vs_baseline is measured throughput / BASELINE.json's 1M steps/sec v5e-64 target
-scaled to the local chip count (the target implies 15,625 steps/sec/chip); it
-applies to the tracked small-network config only and is reported as null for
---large, whose workload is incommensurable with that baseline.
 
-Usage: python bench.py [--smoke] [--large] [--cpu]
-  --smoke  tiny budget for CI wiring checks
-  --large  MXU-bound variant (1024x1024 bfloat16 torsos)
-  --cpu    force the CPU backend (a site hook can force a remote platform
-           even over JAX_PLATFORMS=cpu; this flag wins)
+The tracked workload is PPO on the first-party Ant locomotion env — the
+stand-in for BASELINE.json's north-star config (Anakin PPO on brax ant,
+>= 1M aggregate env-steps/sec on a v5e-64, i.e. 15,625 steps/sec/chip).
+vs_baseline is measured per-chip throughput / that per-chip target; it is
+reported as null for the variant workloads (--cartpole, --large), which are
+incommensurable with the ant baseline.
+
+Usage: python bench.py [--smoke] [--cartpole] [--large] [--cpu]
+  --smoke     tiny budget for CI wiring checks
+  --cartpole  the round-1 metric: tiny-MLP CartPole (VPU-bound; kept for
+              continuity)
+  --large     MXU-bound variant (1024x1024 bfloat16 torsos on Ant)
+  --cpu       force the CPU backend (a site hook can force a remote platform
+              even over JAX_PLATFORMS=cpu; this flag wins)
 """
 
 from __future__ import annotations
@@ -23,6 +28,12 @@ import time
 def main() -> None:
     smoke = "--smoke" in sys.argv
     large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
+    cartpole = "--cartpole" in sys.argv
+    if large and cartpole:
+        sys.exit("--large is the MXU-bound Ant variant; it does not compose with --cartpole")
+
+    env_tag = "cartpole" if cartpole else "ant"
+    metric = f"anakin_ppo_{env_tag}_env_steps_per_sec" + ("_large_bf16" if large else "")
 
     # Watchdog: remote-platform runtimes can wedge indefinitely (observed with
     # the tunneled TPU backend). A SIGALRM handler is NOT enough — Python
@@ -36,12 +47,7 @@ def main() -> None:
     def _fail(reason: str) -> None:
         print(
             json.dumps(
-                {
-                    "metric": "anakin_ppo_env_steps_per_sec",
-                    "value": 0.0,
-                    "unit": reason,
-                    "vs_baseline": 0.0,
-                }
+                {"metric": metric, "value": 0.0, "unit": reason, "vs_baseline": 0.0}
             ),
             flush=True,
         )
@@ -89,12 +95,14 @@ def main() -> None:
 
     overrides = [
         "arch.total_num_envs=%d" % (2048 * n_devices if not smoke else 8 * n_devices),
-        "system.rollout_length=%d" % (64 if not smoke else 8),
+        "system.rollout_length=%d" % ((64 if cartpole else 16) if not smoke else 8),
         "arch.num_evaluation=1",
         "arch.num_eval_episodes=%d" % max(8, n_devices),
         "arch.absolute_metric=False",
         "logger.use_console=False",
     ]
+    if not cartpole:
+        overrides.append("env=ant")
     if large:
         overrides += [
             "network.actor_network.pre_torso.layer_sizes=[1024,1024]",
@@ -102,14 +110,21 @@ def main() -> None:
             "network.critic_network.pre_torso.layer_sizes=[1024,1024]",
             "network.critic_network.pre_torso.compute_dtype=bfloat16",
         ]
-    config = config_lib.compose(
-        config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml", overrides
+    default_yaml = (
+        "default/anakin/default_ff_ppo.yaml"
+        if cartpole
+        else "default/anakin/default_ff_ppo_continuous.yaml"
     )
+    config = config_lib.compose(config_lib.default_config_dir(), default_yaml, overrides)
 
     from stoix_tpu import envs
     from stoix_tpu.parallel import create_mesh
-    from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
     from stoix_tpu.utils.timestep_checker import check_total_timesteps
+
+    if cartpole:
+        from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
+    else:
+        from stoix_tpu.systems.ppo.anakin.ff_ppo_continuous import learner_setup
 
     mesh = create_mesh({"data": -1})
     # Fix the number of updates per timed call.
@@ -155,11 +170,13 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "anakin_ppo_env_steps_per_sec" + ("_large_bf16" if large else ""),
+                "metric": metric,
                 "value": round(steps_per_sec, 1),
-                "unit": f"env_steps/sec ({n_devices} devices, CartPole)",
-                # The baseline is defined for the small-network config only.
-                "vs_baseline": None if large else round(per_chip / baseline_per_chip, 3),
+                "unit": f"env_steps/sec ({n_devices} devices, {env_tag})",
+                # The baseline is defined for the tracked ant config only.
+                "vs_baseline": (
+                    None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
+                ),
             }
         )
     )

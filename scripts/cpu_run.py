@@ -1,0 +1,75 @@
+"""Run a system experiment (or a sweep) on the forced-CPU backend.
+
+Site hooks can pin JAX to a remote accelerator platform even over
+JAX_PLATFORMS=cpu; this launcher wins by updating jax.config after import
+(same pattern as tests/conftest.py and `bench.py --cpu`). Used for
+hyperparameter sweeps and long validation runs on machines whose
+accelerator runtime is absent or unhealthy.
+
+Usage:
+    python scripts/cpu_run.py --module stoix_tpu.systems.q_learning.ff_dqn \
+        --default default/anakin/default_ff_dqn.yaml \
+        [--devices 8] [override ...]
+    python scripts/cpu_run.py --sweep [--devices 8] -- <stoix_tpu.sweep args>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_cpu(devices: int) -> None:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    # Sweep mode: everything except the launcher's own flags belongs to
+    # stoix_tpu.sweep's parser, in the order given (a shared argparse would
+    # reorder interleaved flags and positionals).
+    argv = sys.argv[1:]
+    if "--sweep" in argv:
+        argv.remove("--sweep")
+        devices = 8
+        if "--devices" in argv:
+            i = argv.index("--devices")
+            devices = int(argv[i + 1])
+            del argv[i : i + 2]
+        _force_cpu(devices)
+        from stoix_tpu import sweep
+
+        sweep.main(argv)
+        return
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--module", required=True)
+    parser.add_argument("--default", required=True)
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("rest", nargs="*", help="dotted overrides")
+    args = parser.parse_args()
+
+    _force_cpu(args.devices)
+
+    import importlib
+
+    from stoix_tpu.utils import config as config_lib
+
+    config = config_lib.compose(config_lib.default_config_dir(), args.default, args.rest)
+    mod = importlib.import_module(args.module)
+    score = mod.run_experiment(config)
+    print(json.dumps({"module": args.module, "final_eval_return": float(score)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

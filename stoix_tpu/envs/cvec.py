@@ -3,8 +3,10 @@
 (reference stoix/wrappers/envpool.py adapts EnvPool's API the same way: manual
 auto-reset bookkeeping, numpy episode metrics, stoa-style TimeSteps).
 
-The shared library is compiled on first use with g++ and cached next to the
-source; no Python-level per-env loops exist anywhere on the hot path.
+Games: "CartPole-v1" (4-float obs) and "Breakout-minatar" (10x10x4 pixel obs —
+the Atari-class workload for the Sebulba CNN path). The shared library is
+compiled on first use with g++ and cached next to the source; no Python-level
+per-env loops exist anywhere on the hot path.
 """
 
 from __future__ import annotations
@@ -13,7 +15,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -41,25 +43,41 @@ def _ensure_built() -> str:
 def _load_lib() -> ctypes.CDLL:
     lib = ctypes.CDLL(_ensure_built())
     lib.cvec_create.restype = ctypes.c_void_p
-    lib.cvec_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+    lib.cvec_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
     f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     lib.cvec_reset.argtypes = [ctypes.c_void_p, f32p]
     lib.cvec_step.argtypes = [ctypes.c_void_p, i32p, f32p, f32p, f32p, u8p, u8p, f32p, i32p]
+    lib.cvec_obs_dim.argtypes = [ctypes.c_void_p]
+    lib.cvec_obs_dim.restype = ctypes.c_int
+    lib.cvec_obs_shape.argtypes = [ctypes.c_void_p, i32p]
+    lib.cvec_num_actions.argtypes = [ctypes.c_void_p]
+    lib.cvec_num_actions.restype = ctypes.c_int
     lib.cvec_destroy.argtypes = [ctypes.c_void_p]
     return lib
 
 
-class CVecCartPole:
+class CVecPool:
     """Stateful Sebulba env backed by the native pool: numpy in, TimeStep out."""
 
-    def __init__(self, num_envs: int, seed: int, max_steps: int = 500):
+    def __init__(self, task: str, num_envs: int, seed: int, max_steps: int = 500):
         self._lib = _load_lib()
-        self._handle = self._lib.cvec_create(num_envs, max_steps, seed)
+        self._handle = self._lib.cvec_create(task.encode(), num_envs, max_steps, seed)
+        if not self._handle:
+            raise ValueError(f"Unknown native pool game '{task}'")
+        self._task = task
         self._n = num_envs
-        self._obs = np.zeros((num_envs, 4), np.float32)
-        self._next_obs = np.zeros((num_envs, 4), np.float32)
+        shape3 = np.zeros((3,), np.int32)
+        self._lib.cvec_obs_shape(self._handle, shape3)
+        # (d, 1, 1) encodes a flat d-vector; anything else is an image.
+        self._obs_shape: Tuple[int, ...] = (
+            (int(shape3[0]),) if shape3[1] == 1 and shape3[2] == 1 else tuple(int(s) for s in shape3)
+        )
+        self._num_actions = int(self._lib.cvec_num_actions(self._handle))
+        dim = int(self._lib.cvec_obs_dim(self._handle))
+        self._obs = np.zeros((num_envs, dim), np.float32)
+        self._next_obs = np.zeros((num_envs, dim), np.float32)
         self._reward = np.zeros((num_envs,), np.float32)
         self._done = np.zeros((num_envs,), np.uint8)
         self._trunc = np.zeros((num_envs,), np.uint8)
@@ -72,22 +90,22 @@ class CVecCartPole:
 
     @property
     def num_actions(self) -> int:
-        return 2
+        return self._num_actions
 
     def observation_space(self) -> Observation:
         return Observation(
-            agent_view=spaces.Array((4,), np.float32),
-            action_mask=spaces.Array((2,), np.float32),
+            agent_view=spaces.Array(self._obs_shape, np.float32),
+            action_mask=spaces.Array((self._num_actions,), np.float32),
             step_count=spaces.Array((), np.int32),
         )
 
     def action_space(self) -> spaces.Discrete:
-        return spaces.Discrete(2)
+        return spaces.Discrete(self._num_actions)
 
     def _observation(self, view: np.ndarray, counts: np.ndarray) -> Observation:
         return Observation(
-            agent_view=view.copy(),
-            action_mask=np.ones((self._n, 2), np.float32),
+            agent_view=view.reshape((self._n,) + self._obs_shape).copy(),
+            action_mask=np.ones((self._n, self._num_actions), np.float32),
             step_count=counts.astype(np.int32),
         )
 
@@ -142,8 +160,14 @@ class CVecCartPole:
 
 
 class CVecEnvFactory(EnvFactory):
-    """Factory for the native pool (CartPole-v1 is the only scenario so far)."""
+    """Factory for the native pool; the scenario name selects the game."""
 
-    def __call__(self, num_envs: int) -> CVecCartPole:
+    def __call__(self, num_envs: int) -> CVecPool:
         seed = self._next_seed(num_envs)
-        return CVecCartPole(num_envs, seed, **self._kwargs)
+        return CVecPool(self._task_id, num_envs, seed, **self._kwargs)
+
+
+# Backwards-compatible alias (round-1 name, CartPole-only era).
+class CVecCartPole(CVecPool):
+    def __init__(self, num_envs: int, seed: int, max_steps: int = 500):
+        super().__init__("CartPole-v1", num_envs, seed, max_steps)

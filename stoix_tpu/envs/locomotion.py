@@ -1,0 +1,231 @@
+"""Compute-representative locomotion environments on the first-party
+rigid-body engine (stoix_tpu/envs/rigid_body.py).
+
+The reference's tracked continuous-control baselines run on the external
+`brax` ant (reference stoix/configs/env/brax/ant.yaml: 27-dim observation,
+8-dim torque actions, forward-velocity reward); `Ant` here is the TPU-native
+stand-in with the same interface scale: a 9-body quadruped (torso + 4
+two-link legs), 8 actuated hinge joints, 27-dim observation, healthy-range
+termination and 1000-step truncation.
+
+Unlike the 4-float classic-control suite, stepping this env is real physics
+work (9 bodies x 16 substeps of joint/contact dynamics per control step) and
+its observation/action widths give the policy/value MLPs MXU-relevant shapes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.rigid_body import (
+    RigidBodyState,
+    RigidBodySystem,
+    joint_angles,
+    joint_velocities,
+    rest_state,
+    step,
+)
+from stoix_tpu.envs.types import (
+    Observation,
+    TimeStep,
+    restart,
+    select_step,
+    termination,
+    transition,
+    truncation,
+)
+
+
+def _build_ant() -> Tuple[RigidBodySystem, np.ndarray]:
+    """9-body quadruped: torso sphere + 4 (upper, lower) leg links.
+
+    Body frames coincide with the world frame in the rest pose, so joint
+    anchors/axes in body frames are rest-pose world quantities.
+    """
+    z0 = 0.77  # rest torso height; lower-leg tips then rest at z ~ 0.08
+    torso_r = 0.25
+    upper_len = 0.4
+    lower_len = 0.8
+    leg_angles = [np.pi / 4, 3 * np.pi / 4, 5 * np.pi / 4, 7 * np.pi / 4]
+
+    pos = [np.array([0.0, 0.0, z0])]
+    mass = [3.0]
+    inertia = [np.full(3, 0.075)]  # solid sphere: 2/5 m r^2
+    joint_parent, joint_child = [], []
+    anchor_p, anchor_c, axis_p, limit, gear = [], [], [], [], []
+    sphere_body = [0]
+    sphere_offset = [np.zeros(3)]
+    sphere_radius = [torso_r]
+
+    for i, phi in enumerate(leg_angles):
+        d = np.array([np.cos(phi), np.sin(phi), 0.0])  # outward
+        t = np.array([-np.sin(phi), np.cos(phi), 0.0])  # tangent
+        # Lower legs point outward-down at 60° below horizontal: enough belly
+        # clearance that ankle sag inside the joint limits cannot ground the
+        # torso (zero-action pose stays healthy).
+        e = 0.5 * d - np.array([0.0, 0.0, np.sqrt(3.0) / 2.0])
+
+        hip_world = pos[0] + torso_r * d
+        knee_world = hip_world + upper_len * d
+        tip_world = knee_world + lower_len * e
+
+        upper_idx = len(pos)
+        pos.append(hip_world + 0.5 * upper_len * d)  # upper-leg COM
+        mass.append(0.5)
+        # Rod inertia is ~ m L^2/12 = 0.007, padded for rotational stability
+        # (see the numerical-regime note in rigid_body.py).
+        inertia.append(np.full(3, 0.02))
+        joint_parent.append(0)
+        joint_child.append(upper_idx)
+        anchor_p.append(hip_world - pos[0])
+        anchor_c.append(hip_world - pos[upper_idx])
+        axis_p.append(np.array([0.0, 0.0, 1.0]))  # hip swings horizontally
+        limit.append(np.array([-0.6, 0.6]))
+        gear.append(15.0)
+
+        lower_idx = len(pos)
+        pos.append(knee_world + 0.5 * lower_len * e)  # lower-leg COM
+        mass.append(0.5)
+        inertia.append(np.full(3, 0.04))  # rod ~0.027, padded (see above)
+        joint_parent.append(upper_idx)
+        joint_child.append(lower_idx)
+        anchor_p.append(knee_world - pos[upper_idx])
+        anchor_c.append(knee_world - pos[lower_idx])
+        axis_p.append(t)  # ankle swings vertically
+        limit.append(np.array([-0.35, 0.35]))
+        gear.append(15.0)
+
+        sphere_body += [upper_idx, lower_idx]
+        sphere_offset += [knee_world - pos[upper_idx], tip_world - pos[lower_idx]]
+        sphere_radius += [0.06, 0.08]
+
+    as_f32 = lambda x: jnp.asarray(np.asarray(x), jnp.float32)  # noqa: E731
+    sys = RigidBodySystem(
+        mass=as_f32(mass),
+        inertia=as_f32(inertia),
+        static=jnp.zeros((len(mass),), jnp.float32),
+        joint_parent=jnp.asarray(joint_parent, jnp.int32),
+        joint_child=jnp.asarray(joint_child, jnp.int32),
+        anchor_p=as_f32(anchor_p),
+        anchor_c=as_f32(anchor_c),
+        axis_p=as_f32(axis_p),
+        limit=as_f32(limit),
+        gear=as_f32(gear),
+        sphere_body=jnp.asarray(sphere_body, jnp.int32),
+        sphere_offset=as_f32(sphere_offset),
+        sphere_radius=as_f32(sphere_radius),
+    )
+    return sys, np.asarray(pos, np.float32)
+
+
+class AntState(NamedTuple):
+    key: jax.Array
+    body: RigidBodyState
+    step_count: jax.Array
+
+
+class Ant(Environment):
+    """Quadruped locomotion: run in +x. Reward = forward velocity + healthy
+    bonus - control cost; terminates when the torso leaves its healthy
+    height band (brax/ant semantics at this engine's geometry scale)."""
+
+    _obs_dim = 27
+    _num_joints = 8
+
+    def __init__(
+        self,
+        max_steps: int = 1000,
+        healthy_z: Tuple[float, float] = (0.35, 1.2),
+        ctrl_cost_weight: float = 0.05,
+        healthy_reward: float = 1.0,
+        reset_noise: float = 0.05,
+    ):
+        self._max_steps = int(max_steps)
+        self._healthy_z = (float(healthy_z[0]), float(healthy_z[1]))
+        self._ctrl_cost_weight = float(ctrl_cost_weight)
+        self._healthy_reward = float(healthy_reward)
+        self._reset_noise = float(reset_noise)
+        self._sys, self._rest_pos = _build_ant()
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((self._obs_dim,), jnp.float32),
+            action_mask=spaces.Array((self._num_joints,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Box:
+        return spaces.Box(low=-1.0, high=1.0, shape=(self._num_joints,))
+
+    def _observe(self, state: AntState) -> Observation:
+        body = state.body
+        view = jnp.concatenate(
+            [
+                body.pos[0, 2:3],  # torso height (x/y excluded: translation-invariant)
+                body.quat[0],  # torso orientation
+                body.vel[0],  # torso linear velocity
+                body.ang[0],  # torso angular velocity
+                joint_angles(self._sys, body),  # 8
+                joint_velocities(self._sys, body),  # 8
+            ]
+        )
+        return Observation(
+            agent_view=view,
+            action_mask=jnp.ones((self._num_joints,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[AntState, TimeStep]:
+        key, k_pos, k_vel = jax.random.split(key, 3)
+        body = rest_state(self._sys, self._rest_pos)
+        nb = self._sys.num_bodies
+        body = body._replace(
+            pos=body.pos
+            + self._reset_noise * jax.random.uniform(k_pos, (nb, 3), minval=-1.0, maxval=1.0),
+            vel=self._reset_noise * jax.random.normal(k_vel, (nb, 3)),
+        )
+        state = AntState(key, body, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: AntState, action: jax.Array) -> Tuple[AntState, TimeStep]:
+        action = jnp.clip(jnp.reshape(action, (self._num_joints,)), -1.0, 1.0)
+        body = step(self._sys, state.body, action)
+        next_state = AntState(state.key, body, state.step_count + 1)
+
+        torso_z = body.pos[0, 2]
+        healthy = jnp.logical_and(
+            torso_z > self._healthy_z[0], torso_z < self._healthy_z[1]
+        )
+        finite = jnp.all(
+            jnp.asarray([jnp.all(jnp.isfinite(leaf)) for leaf in body])
+        )
+        terminated = jnp.logical_or(~healthy, ~finite)
+
+        forward_vel = body.vel[0, 0]
+        reward = (
+            forward_vel
+            + self._healthy_reward
+            - self._ctrl_cost_weight * jnp.sum(jnp.square(action))
+        )
+        reward = jnp.where(finite, reward, 0.0).astype(jnp.float32)
+
+        obs = self._observe(next_state)
+        # Non-finite physics must not reach the learner: freeze to the rest
+        # pose observation values via nan_to_num (terminated anyway).
+        obs = obs._replace(agent_view=jnp.nan_to_num(obs.agent_view))
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts

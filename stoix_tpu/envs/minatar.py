@@ -1,0 +1,164 @@
+"""MinAtar-class grid games, implemented natively in JAX.
+
+The reference gets MinAtar-style pixel envs from external suites (gymnax's
+`*-MinAtar` family, reference stoix/utils/make_env.py ENV_MAKERS["gymnax"]);
+this module is the first-party TPU-native equivalent. `Breakout` mirrors the
+native C++ pool's "Breakout-minatar" game (envs/native/cvec.cpp) RULE FOR
+RULE, so Sebulba (C++ pool actors) and Anakin (this env) train on the same
+game and a policy's scores transfer across backends; the equivalence is
+pinned by tests/test_minatar.py which steps both engines in lockstep.
+
+Game: 10x10 grid, 4 binary channels (paddle, ball, trail, brick), 3 actions
+(left/stay/right). Serve is from a top corner below the 3-row brick band,
+moving down-and-inward; bricks reflect the ball vertically and score +1;
+losing the ball past the paddle terminates. All state is fixed-shape int32
+arrays; stepping is pure jnp.where logic — no per-env Python.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.types import (
+    Observation,
+    TimeStep,
+    restart,
+    select_step,
+    termination,
+    transition,
+    truncation,
+)
+
+_GRID = 10
+_BRICK_ROWS = 3
+_PADDLE_ROW = _GRID - 1
+
+
+class BreakoutState(NamedTuple):
+    key: jax.Array
+    ball_r: jax.Array  # [] int32
+    ball_c: jax.Array
+    dr: jax.Array  # {-1, +1}
+    dc: jax.Array
+    last_r: jax.Array
+    last_c: jax.Array
+    paddle: jax.Array
+    bricks: jax.Array  # [3, 10] int32 in {0, 1}
+    step_count: jax.Array
+
+
+class Breakout(Environment):
+    """JAX twin of the native pool's Breakout-minatar (see module docstring)."""
+
+    def __init__(self, max_steps: int = 500):
+        self._max_steps = int(max_steps)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((_GRID, _GRID, 4), jnp.float32),
+            action_mask=spaces.Array((3,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(3)
+
+    def _observe(self, state: BreakoutState) -> Observation:
+        board = jnp.zeros((_GRID, _GRID, 4), jnp.float32)
+        board = board.at[_PADDLE_ROW, state.paddle, 0].set(1.0)
+        board = board.at[state.ball_r, state.ball_c, 1].set(1.0)
+        board = board.at[state.last_r, state.last_c, 2].set(1.0)
+        board = board.at[1 : _BRICK_ROWS + 1, :, 3].set(state.bricks.astype(jnp.float32))
+        return Observation(
+            agent_view=board,
+            action_mask=jnp.ones((3,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def _serve(self, key: jax.Array) -> BreakoutState:
+        key, sub = jax.random.split(key)
+        inward = jax.random.bernoulli(sub)
+        dc = jnp.where(inward, 1, -1).astype(jnp.int32)
+        ball_c = jnp.where(inward, 0, _GRID - 1).astype(jnp.int32)
+        ball_r = jnp.asarray(_BRICK_ROWS + 1, jnp.int32)
+        return BreakoutState(
+            key=key,
+            ball_r=ball_r,
+            ball_c=ball_c,
+            dr=jnp.asarray(1, jnp.int32),
+            dc=dc,
+            last_r=ball_r,
+            last_c=ball_c,
+            paddle=jnp.asarray(_GRID // 2, jnp.int32),
+            bricks=jnp.ones((_BRICK_ROWS, _GRID), jnp.int32),
+            step_count=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[BreakoutState, TimeStep]:
+        state = self._serve(key)
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: BreakoutState, action: jax.Array) -> Tuple[BreakoutState, TimeStep]:
+        # Mirrors cvec.cpp BreakoutVec::step_env exactly.
+        paddle = jnp.clip(state.paddle + (jnp.asarray(action, jnp.int32) - 1), 0, _GRID - 1)
+        last_r, last_c = state.ball_r, state.ball_c
+
+        # Side-wall bounce.
+        nc0 = state.ball_c + state.dc
+        wall = jnp.logical_or(nc0 < 0, nc0 >= _GRID)
+        dc = jnp.where(wall, -state.dc, state.dc)
+        nc = state.ball_c + dc
+        # Ceiling bounce.
+        nr0 = state.ball_r + state.dr
+        ceil = nr0 < 0
+        dr = jnp.where(ceil, 1, state.dr)
+        nr = state.ball_r + dr
+
+        # Brick hit: break it, reflect vertically, score.
+        in_band = jnp.logical_and(nr >= 1, nr <= _BRICK_ROWS)
+        brick_row = jnp.clip(nr - 1, 0, _BRICK_ROWS - 1)
+        hit = jnp.logical_and(in_band, state.bricks[brick_row, nc] == 1)
+        bricks = state.bricks.at[brick_row, nc].set(
+            jnp.where(hit, 0, state.bricks[brick_row, nc])
+        )
+        reward = jnp.where(hit, 1.0, 0.0).astype(jnp.float32)
+        dr = jnp.where(hit, -dr, dr)
+        nr_after_hit = jnp.where(hit, state.ball_r, nr)
+        # All bricks cleared -> fresh wall (play continues).
+        bricks = jnp.where(jnp.any(bricks == 1), bricks, jnp.ones_like(bricks))
+
+        # Paddle row: bounce if caught, terminate if lost.
+        at_paddle = jnp.logical_and(~hit, nr == _PADDLE_ROW)
+        caught = jnp.logical_and(at_paddle, nc == paddle)
+        terminated = jnp.logical_and(at_paddle, nc != paddle)
+        dr = jnp.where(caught, -1, dr)
+        nr_final = jnp.where(caught, state.ball_r, nr_after_hit)
+
+        next_state = BreakoutState(
+            key=state.key,
+            ball_r=nr_final,
+            ball_c=nc,
+            dr=dr,
+            dc=dc,
+            last_r=last_r,
+            last_c=last_c,
+            paddle=paddle,
+            bricks=bricks,
+            step_count=state.step_count + 1,
+        )
+        obs = self._observe(next_state)
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts

@@ -3,20 +3,102 @@
 // The reference delegates C++ vectorized simulation to the external EnvPool
 // package behind its EnvFactory seam (reference stoix/utils/env_factory.py:48-68);
 // this translation unit provides the same capability natively: a batch of
-// CartPole environments stepped in one C call with auto-reset and episode
-// metrics, exposed through a minimal C ABI consumed via ctypes
-// (stoix_tpu/envs/cvec.py). Layout matches the Python classic-control suite so
-// learned policies transfer across backends.
+// environments stepped in one C call with auto-reset and episode metrics,
+// exposed through a minimal C ABI consumed via ctypes (stoix_tpu/envs/cvec.py).
+//
+// Games:
+//   "CartPole-v1"       — 4-float observation, 2 actions (classic control;
+//                         layout matches the Python classic suite so learned
+//                         policies transfer across backends).
+//   "Breakout-minatar"  — 10x10x4 binary-channel pixel observation, 3 actions
+//                         (first-party reimplementation of the published
+//                         MinAtar breakout game description: paddle, ball,
+//                         trail and brick channels, row bounce/break rules).
+//                         This is the Atari-class Sebulba workload: CNN-scale
+//                         observations from a C++ pool.
 //
 // Build: g++ -O3 -march=native -shared -fPIC cvec.cpp -o libcvec.so
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <random>
+#include <string>
 #include <vector>
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// Pool base: shared auto-reset stepping loop + episode metrics.
+// ---------------------------------------------------------------------------
+
+struct VecEnv {
+  int num_envs;
+  int max_steps;
+  std::vector<int32_t> step_count;  // [num_envs]
+  std::vector<float> ep_return;     // [num_envs]
+  std::mt19937 rng;
+
+  VecEnv(int n, int max_steps_, uint64_t seed)
+      : num_envs(n), max_steps(max_steps_), step_count(n), ep_return(n),
+        rng(seed) {}
+  virtual ~VecEnv() = default;
+
+  virtual int obs_dim() const = 0;                 // flattened length
+  virtual void obs_shape(int32_t* out3) const = 0; // (a, b, c); (d, 1, 1) = vector
+  virtual int num_actions() const = 0;
+
+  virtual void reset_env(int i) = 0;
+  virtual void write_obs(int i, float* out) const = 0;
+  // Advances env i; returns reward, sets *terminated.
+  virtual float step_env(int i, int32_t action, bool* terminated) = 0;
+
+  void reset_all(float* obs_out) {
+    for (int i = 0; i < num_envs; ++i) {
+      reset_env(i);
+      step_count[i] = 0;
+      ep_return[i] = 0.0f;
+      write_obs(i, obs_out + static_cast<size_t>(i) * obs_dim());
+    }
+  }
+
+  // One synchronous step for every env with auto-reset. Outputs:
+  //   obs_out:      post-(auto)reset observation    [num_envs, obs_dim]
+  //   next_obs_out: TRUE successor observation      [num_envs, obs_dim]
+  //   reward_out / done_out / trunc_out             [num_envs]
+  //   ep_return_out / ep_length_out: totals at episode end (else running)
+  void step(const int32_t* actions, float* obs_out, float* next_obs_out,
+            float* reward_out, uint8_t* done_out, uint8_t* trunc_out,
+            float* ep_return_out, int32_t* ep_length_out) {
+    const size_t dim = obs_dim();
+    for (int i = 0; i < num_envs; ++i) {
+      bool terminated = false;
+      const float reward = step_env(i, actions[i], &terminated);
+      step_count[i] += 1;
+      ep_return[i] += reward;
+      const bool truncated = !terminated && step_count[i] >= max_steps;
+
+      reward_out[i] = reward;
+      done_out[i] = terminated ? 1 : 0;
+      trunc_out[i] = truncated ? 1 : 0;
+      write_obs(i, next_obs_out + i * dim);
+      ep_return_out[i] = ep_return[i];
+      ep_length_out[i] = step_count[i];
+
+      if (terminated || truncated) {
+        reset_env(i);
+        step_count[i] = 0;
+        ep_return[i] = 0.0f;
+      }
+      write_obs(i, obs_out + i * dim);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// CartPole-v1
+// ---------------------------------------------------------------------------
 
 constexpr float kGravity = 9.8f;
 constexpr float kMassCart = 1.0f;
@@ -29,102 +111,192 @@ constexpr float kTau = 0.02f;
 constexpr float kThetaThreshold = 12.0f * 2.0f * M_PI / 360.0f;
 constexpr float kXThreshold = 2.4f;
 
-struct CartPoleVec {
-  int num_envs;
-  int max_steps;
-  std::vector<float> state;         // [num_envs, 4]
-  std::vector<int32_t> step_count;  // [num_envs]
-  std::vector<float> ep_return;     // [num_envs]
-  std::mt19937 rng;
+struct CartPoleVec : VecEnv {
+  std::vector<float> state;  // [num_envs, 4]
 
   CartPoleVec(int n, int max_steps_, uint64_t seed)
-      : num_envs(n), max_steps(max_steps_), state(n * 4), step_count(n),
-        ep_return(n), rng(seed) {}
+      : VecEnv(n, max_steps_, seed), state(static_cast<size_t>(n) * 4) {}
 
-  void reset_env(int i) {
+  int obs_dim() const override { return 4; }
+  void obs_shape(int32_t* out3) const override { out3[0] = 4; out3[1] = 1; out3[2] = 1; }
+  int num_actions() const override { return 2; }
+
+  void reset_env(int i) override {
     std::uniform_real_distribution<float> dist(-0.05f, 0.05f);
     for (int j = 0; j < 4; ++j) state[i * 4 + j] = dist(rng);
-    step_count[i] = 0;
-    ep_return[i] = 0.0f;
   }
 
-  void reset_all(float* obs_out) {
-    for (int i = 0; i < num_envs; ++i) {
-      reset_env(i);
-      std::memcpy(obs_out + i * 4, &state[i * 4], 4 * sizeof(float));
-    }
+  void write_obs(int i, float* out) const override {
+    std::memcpy(out, &state[i * 4], 4 * sizeof(float));
   }
 
-  // One synchronous step for every env with auto-reset. Outputs:
-  //   obs_out:      post-(auto)reset observation    [num_envs, 4]
-  //   next_obs_out: TRUE successor observation      [num_envs, 4]
-  //   reward_out / done_out / trunc_out             [num_envs]
-  //   ep_return_out / ep_length_out: totals at episode end (else running)
-  void step(const int32_t* actions, float* obs_out, float* next_obs_out,
-            float* reward_out, uint8_t* done_out, uint8_t* trunc_out,
-            float* ep_return_out, int32_t* ep_length_out) {
-    for (int i = 0; i < num_envs; ++i) {
-      float* s = &state[i * 4];
-      float x = s[0], x_dot = s[1], theta = s[2], theta_dot = s[3];
-      const float force = actions[i] == 1 ? kForceMag : -kForceMag;
-      const float costheta = std::cos(theta), sintheta = std::sin(theta);
-      const float temp =
-          (force + kPoleMassLength * theta_dot * theta_dot * sintheta) /
-          kTotalMass;
-      const float thetaacc =
-          (kGravity * sintheta - costheta * temp) /
-          (kLength * (4.0f / 3.0f - kMassPole * costheta * costheta / kTotalMass));
-      const float xacc = temp - kPoleMassLength * thetaacc * costheta / kTotalMass;
-      x += kTau * x_dot;
-      x_dot += kTau * xacc;
-      theta += kTau * theta_dot;
-      theta_dot += kTau * thetaacc;
-      s[0] = x; s[1] = x_dot; s[2] = theta; s[3] = theta_dot;
-
-      step_count[i] += 1;
-      ep_return[i] += 1.0f;
-      const bool terminated =
-          std::fabs(x) > kXThreshold || std::fabs(theta) > kThetaThreshold;
-      const bool truncated = !terminated && step_count[i] >= max_steps;
-
-      reward_out[i] = 1.0f;
-      done_out[i] = terminated ? 1 : 0;
-      trunc_out[i] = truncated ? 1 : 0;
-      std::memcpy(next_obs_out + i * 4, s, 4 * sizeof(float));
-      ep_return_out[i] = ep_return[i];
-      ep_length_out[i] = step_count[i];
-
-      if (terminated || truncated) {
-        reset_env(i);
-      }
-      std::memcpy(obs_out + i * 4, &state[i * 4], 4 * sizeof(float));
-    }
+  float step_env(int i, int32_t action, bool* terminated) override {
+    float* s = &state[i * 4];
+    float x = s[0], x_dot = s[1], theta = s[2], theta_dot = s[3];
+    const float force = action == 1 ? kForceMag : -kForceMag;
+    const float costheta = std::cos(theta), sintheta = std::sin(theta);
+    const float temp =
+        (force + kPoleMassLength * theta_dot * theta_dot * sintheta) /
+        kTotalMass;
+    const float thetaacc =
+        (kGravity * sintheta - costheta * temp) /
+        (kLength * (4.0f / 3.0f - kMassPole * costheta * costheta / kTotalMass));
+    const float xacc = temp - kPoleMassLength * thetaacc * costheta / kTotalMass;
+    x += kTau * x_dot;
+    x_dot += kTau * xacc;
+    theta += kTau * theta_dot;
+    theta_dot += kTau * thetaacc;
+    s[0] = x; s[1] = x_dot; s[2] = theta; s[3] = theta_dot;
+    *terminated =
+        std::fabs(x) > kXThreshold || std::fabs(theta) > kThetaThreshold;
+    return 1.0f;
   }
 };
+
+// ---------------------------------------------------------------------------
+// Breakout (MinAtar-class): 10x10 grid, 4 binary channels, 3 actions.
+// ---------------------------------------------------------------------------
+
+constexpr int kGrid = 10;
+constexpr int kBrickRows = 3;     // rows 1..3 carry bricks
+constexpr int kPaddleRow = kGrid - 1;
+constexpr int kChannels = 4;      // paddle, ball, trail, brick
+
+struct BreakoutVec : VecEnv {
+  struct EnvState {
+    int ball_r, ball_c;
+    int dr, dc;       // ball direction, each in {-1, +1}
+    int last_r, last_c;  // trail
+    int paddle;
+    uint8_t bricks[kBrickRows * kGrid];
+  };
+  std::vector<EnvState> envs;
+
+  BreakoutVec(int n, int max_steps_, uint64_t seed)
+      : VecEnv(n, max_steps_, seed), envs(n) {}
+
+  int obs_dim() const override { return kGrid * kGrid * kChannels; }
+  void obs_shape(int32_t* out3) const override {
+    out3[0] = kGrid; out3[1] = kGrid; out3[2] = kChannels;
+  }
+  int num_actions() const override { return 3; }  // left, stay, right
+
+  void reset_env(int i) override {
+    EnvState& e = envs[i];
+    std::uniform_int_distribution<int> dir(0, 1);
+    // Serve from a top corner BELOW the brick band, moving down and inward
+    // (MinAtar-style): the landing column is always reachable from the
+    // paddle's start, and bricks are only reachable by earning paddle
+    // bounces — the score measures control, not luck.
+    e.ball_r = kBrickRows + 1;
+    e.dr = 1;
+    e.dc = dir(rng) ? 1 : -1;
+    e.ball_c = e.dc == 1 ? 0 : kGrid - 1;
+    e.last_r = e.ball_r;
+    e.last_c = e.ball_c;
+    e.paddle = kGrid / 2;
+    std::fill(e.bricks, e.bricks + kBrickRows * kGrid, uint8_t{1});
+  }
+
+  void write_obs(int i, float* out) const override {
+    const EnvState& e = envs[i];
+    std::memset(out, 0, sizeof(float) * obs_dim());
+    auto at = [&](int r, int c, int ch) -> float& {
+      return out[(r * kGrid + c) * kChannels + ch];
+    };
+    at(kPaddleRow, e.paddle, 0) = 1.0f;
+    at(e.ball_r, e.ball_c, 1) = 1.0f;
+    at(e.last_r, e.last_c, 2) = 1.0f;
+    for (int r = 0; r < kBrickRows; ++r)
+      for (int c = 0; c < kGrid; ++c)
+        if (e.bricks[r * kGrid + c]) at(r + 1, c, 3) = 1.0f;
+  }
+
+  float step_env(int i, int32_t action, bool* terminated) override {
+    EnvState& e = envs[i];
+    // Paddle: 0 = left, 1 = stay, 2 = right.
+    e.paddle = std::clamp(e.paddle + (action - 1), 0, kGrid - 1);
+
+    e.last_r = e.ball_r;
+    e.last_c = e.ball_c;
+    float reward = 0.0f;
+    *terminated = false;
+
+    // Side-wall bounce.
+    int nc = e.ball_c + e.dc;
+    if (nc < 0 || nc >= kGrid) {
+      e.dc = -e.dc;
+      nc = e.ball_c + e.dc;
+    }
+    int nr = e.ball_r + e.dr;
+    // Ceiling bounce.
+    if (nr < 0) {
+      e.dr = 1;
+      nr = e.ball_r + e.dr;
+    }
+    // Brick hit: break it, reflect vertically, score.
+    if (nr >= 1 && nr <= kBrickRows && e.bricks[(nr - 1) * kGrid + nc]) {
+      e.bricks[(nr - 1) * kGrid + nc] = 0;
+      reward = 1.0f;
+      e.dr = -e.dr;
+      nr = e.ball_r;  // bounce back to the incoming row
+      // All bricks cleared -> fresh wall (play continues).
+      bool any = false;
+      for (int b = 0; b < kBrickRows * kGrid; ++b) any |= (envs[i].bricks[b] != 0);
+      if (!any) std::fill(e.bricks, e.bricks + kBrickRows * kGrid, uint8_t{1});
+    } else if (nr == kPaddleRow) {
+      if (nc == e.paddle) {
+        e.dr = -1;
+        nr = e.ball_r;  // paddle bounce
+      } else {
+        *terminated = true;  // ball lost
+      }
+    }
+    e.ball_r = nr;
+    e.ball_c = nc;
+    return reward;
+  }
+};
+
+VecEnv* make_game(const char* task, int num_envs, int max_steps, uint64_t seed) {
+  const std::string name(task ? task : "");
+  if (name == "Breakout-minatar")
+    return new BreakoutVec(num_envs, max_steps, seed);
+  if (name == "CartPole-v1" || name.empty())
+    return new CartPoleVec(num_envs, max_steps, seed);
+  return nullptr;
+}
 
 }  // namespace
 
 extern "C" {
 
-void* cvec_create(int num_envs, int max_steps, uint64_t seed) {
-  return new CartPoleVec(num_envs, max_steps, seed);
+void* cvec_create(const char* task, int num_envs, int max_steps, uint64_t seed) {
+  return make_game(task, num_envs, max_steps, seed);
 }
 
 void cvec_reset(void* handle, float* obs_out) {
-  static_cast<CartPoleVec*>(handle)->reset_all(obs_out);
+  static_cast<VecEnv*>(handle)->reset_all(obs_out);
 }
 
 void cvec_step(void* handle, const int32_t* actions, float* obs_out,
                float* next_obs_out, float* reward_out, uint8_t* done_out,
                uint8_t* trunc_out, float* ep_return_out, int32_t* ep_length_out) {
-  static_cast<CartPoleVec*>(handle)->step(actions, obs_out, next_obs_out,
-                                          reward_out, done_out, trunc_out,
-                                          ep_return_out, ep_length_out);
+  static_cast<VecEnv*>(handle)->step(actions, obs_out, next_obs_out,
+                                     reward_out, done_out, trunc_out,
+                                     ep_return_out, ep_length_out);
 }
 
-int cvec_obs_dim(void*) { return 4; }
-int cvec_num_actions(void*) { return 2; }
+int cvec_obs_dim(void* handle) { return static_cast<VecEnv*>(handle)->obs_dim(); }
 
-void cvec_destroy(void* handle) { delete static_cast<CartPoleVec*>(handle); }
+void cvec_obs_shape(void* handle, int32_t* out3) {
+  static_cast<VecEnv*>(handle)->obs_shape(out3);
+}
+
+int cvec_num_actions(void* handle) {
+  return static_cast<VecEnv*>(handle)->num_actions();
+}
+
+void cvec_destroy(void* handle) { delete static_cast<VecEnv*>(handle); }
 
 }  // extern "C"

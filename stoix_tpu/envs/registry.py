@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from stoix_tpu.envs import classic, debug
+from stoix_tpu.envs import classic, debug, locomotion, minatar
 from stoix_tpu.envs.core import Environment
 from stoix_tpu.envs.wrappers import EpisodeStepLimit, RecordEpisodeMetrics, apply_core_wrappers
 
@@ -22,6 +22,8 @@ ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
     "MountainCar-v0": classic.MountainCar,
     "MountainCarContinuous-v0": classic.MountainCarContinuous,
     "Catch-bsuite": classic.Catch,
+    "Ant": locomotion.Ant,
+    "Breakout-minatar": minatar.Breakout,
     "IdentityGame": debug.IdentityGame,
     "SequenceGame": debug.SequenceGame,
 }

@@ -1,0 +1,295 @@
+"""First-party maximal-coordinate rigid-body physics in JAX.
+
+The reference delegates continuous-control physics to the external `brax`
+suite (reference stoix/utils/make_env.py ENV_MAKERS["brax"], configs
+stoix/configs/env/brax/ant.yaml); this module is the TPU-native stand-in: a
+small force-based rigid-body engine in the style of classical game physics
+(spring joints + penalty contacts + semi-implicit Euler), written so a whole
+batch of worlds advances as fused elementwise/scatter ops inside the rollout
+`lax.scan` — no per-env Python, no dynamic shapes.
+
+Design (TPU-first):
+  - State is struct-of-arrays over bodies: pos [nb,3], quat [nb,4] (wxyz),
+    vel [nb,3], ang [nb,3] (world frame). A vmapped env therefore steps
+    [batch, nb, ...] tensors — large fused VPU work, with the MXU load coming
+    from the policy/value networks that consume the observations.
+  - Joints/contacts are fixed-size index arrays; per-joint forces are
+    scattered onto bodies with `.at[].add` (XLA lowers these to efficient
+    segment sums). Everything is static-shape; `lax.scan` over substeps.
+  - Hinge joints: positional spring on the anchor pair + rotational spring on
+    the off-axis swing (swing-twist decomposition) + angle-limit springs +
+    actuator torque about the hinge axis.
+  - Ground contact: sphere-vs-plane penalty springs with viscous friction.
+
+Numerical regime: spring constants ~1e4 with substep dt ~2e-3 keeps the
+semi-implicit integrator comfortably inside its stability region for
+unit-scale masses (dt < 2/sqrt(k/m)). The binding constraints are ROTATIONAL:
+an anchor spring at lever arm r contributes k*r^2 against the body's inertia
+(need dt*sqrt(k*r^2/I) < ~1), and every explicit damper needs c*dt/I < ~1 —
+light links therefore carry deliberately padded inertia in system builders,
+a standard engine trick that trades a little physical fidelity for a 10x
+larger stable-timestep region.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# --- quaternion helpers (wxyz convention) -----------------------------------
+
+
+def quat_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Hamilton product; a, b [..., 4]."""
+    aw, ax, ay, az = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+    bw, bx, by, bz = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack(
+        [
+            aw * bw - ax * bx - ay * by - az * bz,
+            aw * bx + ax * bw + ay * bz - az * by,
+            aw * by - ax * bz + ay * bw + az * bx,
+            aw * bz + ax * by - ay * bx + az * bw,
+        ],
+        axis=-1,
+    )
+
+
+def quat_conj(q: jax.Array) -> jax.Array:
+    return q * jnp.asarray([1.0, -1.0, -1.0, -1.0])
+
+
+def quat_rotate(q: jax.Array, v: jax.Array) -> jax.Array:
+    """Rotate vectors v [..., 3] by quaternions q [..., 4]."""
+    qv = q[..., 1:]
+    uv = jnp.cross(qv, v)
+    uuv = jnp.cross(qv, uv)
+    return v + 2.0 * (q[..., :1] * uv + uuv)
+
+
+def quat_inv_rotate(q: jax.Array, v: jax.Array) -> jax.Array:
+    return quat_rotate(quat_conj(q), v)
+
+
+def quat_integrate(q: jax.Array, omega: jax.Array, dt: float) -> jax.Array:
+    """q <- normalize(q + dt/2 * [0, omega] ⊗ q); omega in world frame."""
+    zeros = jnp.zeros_like(omega[..., :1])
+    dq = quat_mul(jnp.concatenate([zeros, omega], axis=-1), q)
+    q = q + 0.5 * dt * dq
+    return q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+
+
+def quat_twist_angle(q_rel: jax.Array, axis: jax.Array) -> jax.Array:
+    """Signed rotation of q_rel about `axis` (swing-twist decomposition)."""
+    proj = jnp.sum(q_rel[..., 1:] * axis, axis=-1)
+    return 2.0 * jnp.arctan2(proj, q_rel[..., 0])
+
+
+# --- system description ------------------------------------------------------
+
+
+class RigidBodySystem(NamedTuple):
+    """Static description of an articulated rigid-body system.
+
+    All index/parameter arrays are fixed-size; the system is a pytree of
+    jnp arrays so it can be closed over by jitted step functions.
+    """
+
+    # Bodies.
+    mass: jax.Array  # [nb]
+    inertia: jax.Array  # [nb, 3] diagonal body-frame inertia
+    static: jax.Array  # [nb] 1.0 = immovable (world-pinned base, walls)
+    # Hinge joints (parent -> child).
+    joint_parent: jax.Array  # [nj] int32
+    joint_child: jax.Array  # [nj] int32
+    anchor_p: jax.Array  # [nj, 3] anchor in parent frame
+    anchor_c: jax.Array  # [nj, 3] anchor in child frame
+    axis_p: jax.Array  # [nj, 3] hinge axis in parent frame (unit)
+    limit: jax.Array  # [nj, 2] (lo, hi) joint angle limits, radians
+    gear: jax.Array  # [nj] actuator torque scale
+    # Contact spheres.
+    sphere_body: jax.Array  # [ns] int32
+    sphere_offset: jax.Array  # [ns, 3] centre in body frame
+    sphere_radius: jax.Array  # [ns]
+    # Scalars (python floats — static under jit).
+    gravity: float = -9.81
+    dt: float = 0.002  # substep
+    substeps: int = 16  # substeps per control step
+    joint_kp: float = 10_000.0  # anchor spring
+    joint_kd: float = 50.0  # anchor damper
+    swing_kp: float = 500.0  # off-axis rotational spring
+    swing_kd: float = 2.0  # off-axis rotational damper
+    limit_kp: float = 1_000.0  # angle-limit spring
+    contact_kp: float = 10_000.0  # ground penetration spring
+    contact_kd: float = 50.0  # normal damping
+    friction: float = 1.0  # Coulomb cap on viscous tangential force
+    friction_kv: float = 50.0  # viscous tangential coefficient
+    lin_damping: float = 0.02  # global velocity damping (1/s)
+    ang_damping: float = 0.05
+
+    @property
+    def num_bodies(self) -> int:
+        return self.mass.shape[0]
+
+    @property
+    def num_joints(self) -> int:
+        return self.joint_parent.shape[0]
+
+
+class RigidBodyState(NamedTuple):
+    pos: jax.Array  # [nb, 3]
+    quat: jax.Array  # [nb, 4] wxyz
+    vel: jax.Array  # [nb, 3]
+    ang: jax.Array  # [nb, 3] world-frame angular velocity
+
+
+def rest_state(sys: RigidBodySystem, rest_pos: jax.Array) -> RigidBodyState:
+    nb = sys.num_bodies
+    return RigidBodyState(
+        pos=jnp.asarray(rest_pos, jnp.float32),
+        quat=jnp.tile(jnp.asarray([1.0, 0.0, 0.0, 0.0], jnp.float32), (nb, 1)),
+        vel=jnp.zeros((nb, 3), jnp.float32),
+        ang=jnp.zeros((nb, 3), jnp.float32),
+    )
+
+
+# --- dynamics ----------------------------------------------------------------
+
+
+def joint_angles(sys: RigidBodySystem, state: RigidBodyState) -> jax.Array:
+    """Signed hinge angles [nj] via swing-twist about each joint axis."""
+    qp = state.quat[sys.joint_parent]
+    qc = state.quat[sys.joint_child]
+    q_rel = quat_mul(quat_conj(qp), qc)
+    # Canonicalize sign (q and -q are the same rotation).
+    q_rel = jnp.where(q_rel[..., :1] < 0, -q_rel, q_rel)
+    return quat_twist_angle(q_rel, sys.axis_p)
+
+
+def joint_velocities(sys: RigidBodySystem, state: RigidBodyState) -> jax.Array:
+    """Relative angular velocity about each (world-frame) joint axis [nj]."""
+    axis_w = quat_rotate(state.quat[sys.joint_parent], sys.axis_p)
+    omega_rel = state.ang[sys.joint_child] - state.ang[sys.joint_parent]
+    return jnp.sum(omega_rel * axis_w, axis=-1)
+
+
+def _accumulate_joint_forces(
+    sys: RigidBodySystem, state: RigidBodyState, action: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Forces/torques [nb,3] from joints: anchor springs, swing springs,
+    limits, and actuation. `action` is [nj] in [-1, 1]."""
+    p, c = sys.joint_parent, sys.joint_child
+    qp, qc = state.quat[p], state.quat[c]
+
+    # World-frame anchor points and their velocities.
+    rp = quat_rotate(qp, sys.anchor_p)  # lever arm from parent COM
+    rc = quat_rotate(qc, sys.anchor_c)
+    ap = state.pos[p] + rp
+    ac = state.pos[c] + rc
+    vp = state.vel[p] + jnp.cross(state.ang[p], rp)
+    vc = state.vel[c] + jnp.cross(state.ang[c], rc)
+
+    # Anchor spring: pull the child anchor onto the parent anchor.
+    f_c = sys.joint_kp * (ap - ac) + sys.joint_kd * (vp - vc)  # on child at ac
+
+    # Swing spring: penalize relative rotation off the hinge axis. The
+    # rotation vector of q_rel minus its twist component is the swing error.
+    q_rel = quat_mul(quat_conj(qp), qc)
+    q_rel = jnp.where(q_rel[..., :1] < 0, -q_rel, q_rel)
+    rotvec = 2.0 * q_rel[..., 1:]  # small-angle rotation vector, parent frame
+    twist = jnp.sum(rotvec * sys.axis_p, axis=-1, keepdims=True) * sys.axis_p
+    swing_err_w = quat_rotate(qp, rotvec - twist)
+    axis_w = quat_rotate(qp, sys.axis_p)
+    omega_rel = state.ang[c] - state.ang[p]
+    omega_swing = omega_rel - jnp.sum(omega_rel * axis_w, axis=-1, keepdims=True) * axis_w
+    tau_swing = -sys.swing_kp * swing_err_w - sys.swing_kd * omega_swing  # on child
+
+    # Angle limits + actuation, both about the world hinge axis.
+    angle = quat_twist_angle(q_rel, sys.axis_p)
+    lo, hi = sys.limit[:, 0], sys.limit[:, 1]
+    limit_err = jnp.where(angle < lo, lo - angle, jnp.where(angle > hi, hi - angle, 0.0))
+    tau_axis = (sys.limit_kp * limit_err + sys.gear * action)[:, None] * axis_w
+
+    tau_c = tau_swing + tau_axis
+    force = jnp.zeros((sys.num_bodies, 3), jnp.float32)
+    torque = jnp.zeros((sys.num_bodies, 3), jnp.float32)
+    force = force.at[c].add(f_c).at[p].add(-f_c)
+    torque = (
+        torque.at[c]
+        .add(jnp.cross(rc, f_c) + tau_c)
+        .at[p]
+        .add(jnp.cross(rp, -f_c) - tau_c)
+    )
+    return force, torque
+
+
+def _accumulate_contact_forces(
+    sys: RigidBodySystem, state: RigidBodyState
+) -> Tuple[jax.Array, jax.Array]:
+    """Sphere-vs-ground (z=0 plane) penalty forces/torques [nb,3]."""
+    b = sys.sphere_body
+    r_off = quat_rotate(state.quat[b], sys.sphere_offset)
+    centre = state.pos[b] + r_off
+    depth = sys.sphere_radius - centre[:, 2]  # > 0 when penetrating
+    contact_vel = state.vel[b] + jnp.cross(state.ang[b], r_off)
+
+    active = depth > 0.0
+    normal_mag = jnp.where(
+        active,
+        sys.contact_kp * depth - sys.contact_kd * contact_vel[:, 2],
+        0.0,
+    )
+    normal_mag = jnp.maximum(normal_mag, 0.0)  # ground only pushes
+
+    # Viscous friction, Coulomb-capped by the normal force.
+    tangential = contact_vel.at[:, 2].set(0.0)
+    t_speed = jnp.linalg.norm(tangential, axis=-1, keepdims=True) + 1e-8
+    friction_mag = jnp.minimum(sys.friction_kv * t_speed, sys.friction * normal_mag[:, None])
+    f = jnp.concatenate(
+        [-friction_mag * tangential[:, :2] / t_speed, normal_mag[:, None]], axis=-1
+    )
+    f = jnp.where(active[:, None], f, 0.0)
+
+    force = jnp.zeros((sys.num_bodies, 3), jnp.float32).at[b].add(f)
+    torque = jnp.zeros((sys.num_bodies, 3), jnp.float32).at[b].add(jnp.cross(r_off, f))
+    return force, torque
+
+
+def _substep(
+    sys: RigidBodySystem, state: RigidBodyState, action: jax.Array
+) -> RigidBodyState:
+    fj, tj = _accumulate_joint_forces(sys, state, action)
+    fc, tc = _accumulate_contact_forces(sys, state)
+    force = fj + fc
+    torque = tj + tc
+
+    movable = (1.0 - sys.static)[:, None]
+
+    # Linear: gravity + damping, semi-implicit Euler.
+    accel = force / sys.mass[:, None] + jnp.asarray([0.0, 0.0, sys.gravity])
+    vel = (state.vel + sys.dt * accel * movable) * (1.0 - sys.lin_damping * sys.dt)
+    vel = vel * movable
+    pos = state.pos + sys.dt * vel
+
+    # Angular: Euler's equations in the body frame (diagonal inertia).
+    omega_b = quat_inv_rotate(state.quat, state.ang)
+    torque_b = quat_inv_rotate(state.quat, torque)
+    domega_b = (torque_b - jnp.cross(omega_b, sys.inertia * omega_b)) / sys.inertia
+    ang = (state.ang + sys.dt * quat_rotate(state.quat, domega_b) * movable) * (
+        1.0 - sys.ang_damping * sys.dt
+    )
+    ang = ang * movable
+    quat = quat_integrate(state.quat, ang, sys.dt)
+    return RigidBodyState(pos, quat, vel, ang)
+
+
+def step(sys: RigidBodySystem, state: RigidBodyState, action: jax.Array) -> RigidBodyState:
+    """Advance one control step (`sys.substeps` substeps with held action)."""
+
+    def body(carry, _):
+        return _substep(sys, carry, action), None
+
+    state, _ = jax.lax.scan(body, state, None, sys.substeps)
+    return state

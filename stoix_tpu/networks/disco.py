@@ -1,0 +1,93 @@
+"""Disco agent network: shared torso + action-conditional LSTM transition +
+five prediction heads.
+
+Parity target: reference stoix/networks/specialised/disco103.py (the agent
+model the DiscoRL meta-learned update rule drives — policy logits plus
+categorical value/auxiliary predictions over per-action hidden states).
+
+TPU-native notes: the action-conditional transition runs ONE LSTMCell apply
+over a [batch * num_actions] folded axis (a single fused matmul batch on the
+MXU) rather than looping actions; everything is static-shape.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class DiscoAgentOutput(NamedTuple):
+    """The five prediction heads the disco update rule consumes
+    (reference stoix/systems/disco_rl/disco_rl_types.py AgentOutput)."""
+
+    logits: jax.Array  # [..., A]        policy
+    q: jax.Array  # [..., A, B]          per-action categorical value
+    y: jax.Array  # [..., B]             state categorical prediction
+    z: jax.Array  # [..., A, B]          per-action auxiliary categorical
+    aux_pi: jax.Array  # [..., A, A]     per-action auxiliary policy
+
+
+class ActionConditionedLSTMTorso(nn.Module):
+    """Root embedding -> one LSTM step per action, all actions in parallel
+    (reference disco103.py LSTMActionConditionedTorso:13-110)."""
+
+    num_actions: int
+    lstm_size: int = 256
+    root_mlp_sizes: Sequence[int] = ()
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> jax.Array:
+        from stoix_tpu.networks.utils import parse_activation_fn
+
+        # Rank-agnostic: fold every leading dim (the evaluator applies the
+        # network to single unbatched observations).
+        lead = embedding.shape[:-1]
+        x = embedding.reshape((-1, embedding.shape[-1]))
+        batch = x.shape[0]
+
+        act = parse_activation_fn(self.activation)
+        for size in self.root_mlp_sizes:
+            x = act(nn.Dense(size, kernel_init=nn.initializers.orthogonal(1.0))(x))
+        cell = nn.Dense(
+            self.lstm_size, kernel_init=nn.initializers.orthogonal(1.0), name="root_cell"
+        )(x)
+        carry = (jnp.tanh(cell), cell)
+
+        # Fold actions into the batch: one LSTM apply for every (state, action).
+        one_hot = jnp.eye(self.num_actions, dtype=cell.dtype)  # [A, A]
+        actions = jnp.tile(one_hot, (batch, 1))  # [batch*A, A]
+        carry = jax.tree.map(
+            lambda c: jnp.repeat(c, repeats=self.num_actions, axis=0), carry
+        )
+        _, out = nn.LSTMCell(features=self.lstm_size, name="action_lstm")(
+            carry, actions
+        )
+        return out.reshape(lead + (self.num_actions, self.lstm_size))
+
+
+class DiscoAgentNetwork(nn.Module):
+    """Shared torso + logits/y heads on the state embedding, q/z/aux_pi heads
+    on the action-conditional embeddings (reference disco103.py:113-152)."""
+
+    shared_torso: nn.Module
+    action_conditional_torso: nn.Module
+    logits_head: nn.Module
+    q_head: nn.Module
+    y_head: nn.Module
+    z_head: nn.Module
+    aux_pi_head: nn.Module
+
+    def __call__(self, observation) -> DiscoAgentOutput:
+        embedding = self.shared_torso(observation.agent_view)
+        logits = self.logits_head(embedding)
+        y = self.y_head(embedding)
+
+        per_action = self.action_conditional_torso(embedding)  # [batch, A, H]
+        q = self.q_head(per_action)
+        z = self.z_head(per_action)
+        aux_pi = self.aux_pi_head(per_action)
+        return DiscoAgentOutput(logits=logits, q=q, y=y, z=z, aux_pi=aux_pi)

@@ -1,0 +1,320 @@
+"""Anakin Disco-RL (disco103) — an agent trained by a meta update rule.
+
+Behavioral parity: reference stoix/systems/disco_rl/anakin/ff_disco103.py
+(659 LoC): rollout -> epoch/env-minibatch scans where the per-step loss comes
+from a DiscoUpdateRule (meta-network) instead of a hand-written objective;
+the rule carries an evolving meta-state (EMA target params); meta-params are
+fixed (pretrained) and never trained.
+
+TPU-native redesign: same global-mesh shard_map skeleton as ff_ppo (see
+systems/ppo/anakin/ff_ppo.py header); minibatches are over ENVS, keeping the
+time axis contiguous for the rule's trajectory processing (the reference
+permutes axis=1 identically, ff_disco103.py:215-228). The unavailable
+external disco_rl package is replaced by the first-party rule in
+stoix_tpu/systems/disco/update_rule.py — see its docstring for the
+pretrained-weights gap and the grounded mode that learns without them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoix_tpu import envs
+from stoix_tpu.base_types import ExperimentOutput
+from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.networks.disco import DiscoAgentOutput
+from stoix_tpu.ops import distributions as dists
+from stoix_tpu.parallel import is_coordinator
+from stoix_tpu.systems.disco.update_rule import (
+    DiscoUpdateRule,
+    MetaState,
+    UpdateRuleInputs,
+    load_meta_params,
+)
+from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+from stoix_tpu.utils.jax_utils import count_parameters
+from stoix_tpu.utils.training import make_learning_rate
+
+
+class DiscoTransition(NamedTuple):
+    done: jax.Array
+    truncated: jax.Array
+    action: jax.Array
+    reward: jax.Array
+    obs: Any
+    info: Any
+    agent_out: DiscoAgentOutput
+
+
+class DiscoLearnerState(NamedTuple):
+    params: Any
+    opt_states: Any
+    key: jax.Array
+    env_state: Any
+    timestep: Any
+    meta_state: MetaState
+
+
+def _batched_apply(apply_fn: Callable, params: Any, observations: Any) -> DiscoAgentOutput:
+    """Apply the agent over [T, E, ...] observations in one flattened call
+    (bigger MXU batches than a per-step vmap; identical math)."""
+    shape = jax.tree.leaves(observations)[0].shape[:2]
+    flat = jax.tree.map(lambda x: x.reshape((shape[0] * shape[1],) + x.shape[2:]), observations)
+    out = apply_fn(params, flat)
+    return jax.tree.map(lambda x: x.reshape(shape + x.shape[1:]), out)
+
+
+def get_learner_fn(
+    env: envs.Environment,
+    apply_fn: Callable,
+    update_fn: optax.TransformUpdateFn,
+    rule: DiscoUpdateRule,
+    meta_params: Any,
+    config: Any,
+) -> Callable[[DiscoLearnerState], ExperimentOutput]:
+    """Build the per-shard learner (wrapped in shard_map by setup)."""
+
+    hyperparams = dict(config.system.get("disco_hyperparams", {}) or {})
+    hyperparams.setdefault("gamma", float(config.system.gamma))
+    reward_scale = float(config.system.get("reward_scale", 1.0))
+
+    def agent_unroll_fn(params, unused_state, observations, unused_mask):
+        out = _batched_apply(apply_fn, params, observations)
+        return out._asdict(), unused_state
+
+    def _env_step(learner_state: DiscoLearnerState, _: Any):
+        params, opt_states, key, env_state, last_timestep, meta_state = learner_state
+        key, policy_key = jax.random.split(key)
+
+        agent_out = apply_fn(params, last_timestep.observation)
+        pi = dists.Categorical(logits=agent_out.logits)
+        action = pi.sample(seed=policy_key)
+
+        env_state, timestep = env.step(env_state, action)
+        done = timestep.discount == 0.0
+        truncated = jnp.logical_and(timestep.last(), timestep.discount != 0.0)
+        transition = DiscoTransition(
+            done=done,
+            truncated=truncated,
+            action=action,
+            reward=timestep.reward,
+            obs=last_timestep.observation,
+            info=timestep.extras["episode_metrics"],
+            agent_out=agent_out,
+        )
+        return (
+            DiscoLearnerState(params, opt_states, key, env_state, timestep, meta_state),
+            transition,
+        )
+
+    def _loss_fn(params, minibatch: DiscoTransition, meta_state, key):
+        current_out = _batched_apply(apply_fn, params, minibatch.obs)
+        inputs = UpdateRuleInputs(
+            observations=minibatch.obs,
+            actions=minibatch.action,
+            rewards=minibatch.reward[:-1] * reward_scale,
+            is_terminal=minibatch.done[:-1],
+            agent_out=current_out,
+            behaviour_agent_out=minibatch.agent_out,
+        )
+        loss_per_step, new_meta_state, logs = rule(
+            meta_params, params, None, inputs, hyperparams, meta_state,
+            agent_unroll_fn, key,
+        )
+        return jnp.mean(loss_per_step), (new_meta_state, logs)
+
+    def _update_minibatch(train_state: Tuple, minibatch: DiscoTransition):
+        params, opt_states, meta_state, key = train_state
+        key, loss_key = jax.random.split(key)
+
+        grads, (meta_state, logs) = jax.grad(_loss_fn, has_aux=True)(
+            params, minibatch, meta_state, loss_key
+        )
+        grads = jax.lax.pmean(jax.lax.pmean(grads, "batch"), "data")
+        updates, opt_states = update_fn(grads, opt_states)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_states, meta_state, key), logs
+
+    def _update_epoch(update_state: Tuple, _: Any):
+        params, opt_states, traj_batch, meta_state, key = update_state
+        key, shuffle_key = jax.random.split(key)
+
+        # Minibatch over ENVS (axis=1), keeping the time axis contiguous for
+        # the trajectory-consuming rule (reference ff_disco103.py:215-228).
+        num_envs = traj_batch.action.shape[1]
+        permutation = jax.random.permutation(shuffle_key, num_envs)
+        shuffled = jax.tree.map(lambda x: jnp.take(x, permutation, axis=1), traj_batch)
+        minibatches = jax.tree.map(
+            lambda x: jnp.swapaxes(
+                x.reshape((x.shape[0], int(config.system.num_minibatches), -1) + x.shape[2:]),
+                0,
+                1,
+            ),
+            shuffled,
+        )
+        (params, opt_states, meta_state, key), logs = jax.lax.scan(
+            _update_minibatch, (params, opt_states, meta_state, key), minibatches
+        )
+        return (params, opt_states, traj_batch, meta_state, key), logs
+
+    def _update_step(learner_state: DiscoLearnerState, _: Any):
+        learner_state, traj_batch = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep, meta_state = learner_state
+
+        update_state = (params, opt_states, traj_batch, meta_state, key)
+        update_state, loss_info = jax.lax.scan(
+            _update_epoch, update_state, None, int(config.system.epochs)
+        )
+        params, opt_states, _, meta_state, key = update_state
+        learner_state = DiscoLearnerState(
+            params, opt_states, key, env_state, last_timestep, meta_state
+        )
+        return learner_state, (traj_batch.info, loss_info)
+
+    def learner_fn(learner_state: DiscoLearnerState) -> ExperimentOutput:
+        key = learner_state.key[0]
+        state = learner_state._replace(key=key)
+        batched_update_step = jax.vmap(_update_step, axis_name="batch")
+        state, (episode_info, loss_info) = jax.lax.scan(
+            batched_update_step, state, None, int(config.arch.num_updates_per_eval)
+        )
+        state = state._replace(key=state.key[None])
+        loss_info = jax.lax.pmean(loss_info, axis_name="data")
+        return ExperimentOutput(
+            learner_state=state,
+            episode_metrics=episode_info,
+            train_metrics=loss_info,
+        )
+
+    return learner_fn
+
+
+def learner_setup(
+    env: envs.Environment, config: Any, mesh: Mesh, keys: jax.Array
+) -> AnakinSetup:
+    from stoix_tpu.networks.disco import ActionConditionedLSTMTorso, DiscoAgentNetwork
+    from stoix_tpu.systems import anakin
+
+    num_actions = env.num_actions
+    config.system.action_dim = num_actions
+    num_bins = int(config.system.get("num_bins", 51))
+
+    envs_per_shard = int(config.arch.total_num_envs) // int(mesh.shape["data"])
+    if envs_per_shard % int(config.system.num_minibatches) != 0:
+        raise ValueError(
+            f"disco minibatches are over envs: arch.total_num_envs/shards "
+            f"({envs_per_shard}) must be divisible by system.num_minibatches "
+            f"({config.system.num_minibatches})"
+        )
+
+    rule = DiscoUpdateRule(
+        num_actions=num_actions,
+        num_bins=num_bins,
+        vmax=float(config.system.get("vmax", 500.0)),
+        mode=str(config.system.get("rule_mode", "grounded")),
+        target_ema=float(config.system.get("target_ema", 0.99)),
+        policy_temperature=float(config.system.get("policy_temperature", 0.5)),
+    )
+
+    net_cfg = config.network.agent_network
+    network = DiscoAgentNetwork(
+        shared_torso=config_lib.instantiate(net_cfg.shared_torso),
+        action_conditional_torso=config_lib.instantiate(
+            net_cfg.action_conditional_torso, num_actions=num_actions
+        ),
+        logits_head=config_lib.instantiate(net_cfg.logits_head, output_dim=num_actions),
+        q_head=config_lib.instantiate(net_cfg.q_head, output_dim=num_bins),
+        y_head=config_lib.instantiate(net_cfg.y_head, output_dim=num_bins),
+        z_head=config_lib.instantiate(net_cfg.z_head, output_dim=num_bins),
+        aux_pi_head=config_lib.instantiate(net_cfg.aux_pi_head, output_dim=num_actions),
+    )
+
+    lr = make_learning_rate(
+        float(config.system.lr), config, int(config.system.epochs),
+        int(config.system.num_minibatches),
+    )
+    optim = optax.chain(
+        optax.clip(float(config.system.get("max_abs_update", 1.0))),
+        optax.adam(lr, eps=1e-5),
+    )
+
+    key, net_key, meta_key, env_key = jax.random.split(keys, 4)
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    params = network.init(net_key, dummy_obs)
+    opt_state = optim.init(params)
+
+    # Pretrained meta-parameters (download seam; random fallback documented).
+    meta_params, pretrained = load_meta_params(
+        rule, meta_key, local_path=config.system.get("meta_params_path")
+    )
+    if rule.mode == "meta" and not pretrained and is_coordinator():
+        print("[disco] WARNING: meta mode with random meta-params — machinery "
+              "runs but targets are uninformative")
+
+    learn_per_shard = get_learner_fn(
+        env, network.apply, optim.update, rule, meta_params, config
+    )
+
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    state_specs = DiscoLearnerState(
+        params=P(),
+        opt_states=P(),
+        key=P("data"),
+        env_state=P(None, "data"),
+        timestep=P(None, "data"),
+        meta_state=P(),
+    )
+    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+    learner_state = DiscoLearnerState(
+        params=anakin.broadcast_to_update_batch(params, update_batch),
+        opt_states=anakin.broadcast_to_update_batch(opt_state, update_batch),
+        key=anakin.make_step_keys(key, mesh, config),
+        env_state=env_state,
+        timestep=timestep,
+        meta_state=anakin.broadcast_to_update_batch(
+            rule.init_meta_state(meta_key, params), update_batch
+        ),
+    )
+    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+
+    if is_coordinator():
+        print(f"[setup] {count_parameters(params):,} parameters | mesh "
+              f"{dict(mesh.shape)} | {config.arch.total_num_envs} global envs")
+
+    def eval_apply(params, observation):
+        return dists.Categorical(logits=network.apply(params, observation).logits)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=learner_state,
+        eval_act_fn=get_distribution_act_fn(config, eval_apply),
+        eval_params_fn=lambda s: jax.tree.map(lambda x: x[0], s.params),
+    )
+
+
+def run_experiment(config: Any) -> float:
+    return run_anakin_experiment(config, learner_setup)
+
+
+def main() -> float:
+    import sys
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_disco103.yaml",
+        sys.argv[1:],
+    )
+    return run_experiment(config)
+
+
+if __name__ == "__main__":
+    main()

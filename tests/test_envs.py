@@ -24,6 +24,8 @@ ALL_ENVS = [
     "MountainCar-v0",
     "MountainCarContinuous-v0",
     "Catch-bsuite",
+    "Ant",
+    "Breakout-minatar",
     "IdentityGame",
     "SequenceGame",
 ]

@@ -64,6 +64,8 @@ SYSTEMS = [
      ["env=identity_game", "system.num_particles=8", "system.search_horizon=3"]),
     ("stoix_tpu.systems.spo.ff_spo_continuous", "default_ff_spo_continuous",
      ["system.num_particles=8", "system.search_horizon=3"]),
+    ("stoix_tpu.systems.disco.ff_disco103", "default_ff_disco103",
+     ["env=identity_game", "system.vmax=20.0", "system.num_minibatches=2"]),
 ]
 
 
