@@ -16,7 +16,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -32,6 +31,22 @@ def _force_cpu(devices: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def run_module(module: str, default: str, overrides: list) -> None:
+    """Compose the config, run the system's run_experiment, print a JSON line.
+
+    Shared by this CPU launcher and scripts/run_exp.py (ambient platform).
+    """
+    import importlib
+    import json
+
+    from stoix_tpu.utils import config as config_lib
+
+    config = config_lib.compose(config_lib.default_config_dir(), default, overrides)
+    mod = importlib.import_module(module)
+    score = mod.run_experiment(config)
+    print(json.dumps({"module": module, "final_eval_return": float(score)}), flush=True)
 
 
 def main() -> None:
@@ -60,15 +75,7 @@ def main() -> None:
     args = parser.parse_args()
 
     _force_cpu(args.devices)
-
-    import importlib
-
-    from stoix_tpu.utils import config as config_lib
-
-    config = config_lib.compose(config_lib.default_config_dir(), args.default, args.rest)
-    mod = importlib.import_module(args.module)
-    score = mod.run_experiment(config)
-    print(json.dumps({"module": args.module, "final_eval_return": float(score)}), flush=True)
+    run_module(args.module, args.default, args.rest)
 
 
 if __name__ == "__main__":

@@ -21,6 +21,9 @@ class IdentityState(NamedTuple):
     key: jax.Array
     target: jax.Array
     step_count: jax.Array
+    # Fixed-level episodes (eval-reset hook consumer): >= 0 pins the target to
+    # this value for the whole episode; -1 = normal random targets.
+    level: jax.Array
 
 
 class IdentityGame(Environment):
@@ -28,6 +31,11 @@ class IdentityGame(Environment):
 
     Optimal return over an episode of length `episode_length` is exactly
     `episode_length` — a learner failing to reach it has a plumbing bug.
+
+    Also the first-party consumer of the evaluator's eval_reset_fn hook
+    (reference kinetix levels, wrappers/kinetix.py:15-51): `reset_to_level(k)`
+    pins the target to k for the whole episode, so a fixed level list can be
+    tiled across eval episodes via make_tiled_eval_reset_fn.
     """
 
     def __init__(self, num_actions: int = 4, episode_length: int = 10):
@@ -54,14 +62,20 @@ class IdentityGame(Environment):
     def reset(self, key: jax.Array) -> Tuple[IdentityState, TimeStep]:
         key, sub = jax.random.split(key)
         target = jax.random.randint(sub, (), 0, self._num_actions)
-        state = IdentityState(key, target, jnp.zeros((), jnp.int32))
+        state = IdentityState(key, target, jnp.zeros((), jnp.int32), jnp.full((), -1, jnp.int32))
+        return state, restart(self._obs(state))
+
+    def reset_to_level(self, level: jax.Array, key: jax.Array) -> Tuple[IdentityState, TimeStep]:
+        level = jnp.asarray(level, jnp.int32)
+        state = IdentityState(key, level, jnp.zeros((), jnp.int32), level)
         return state, restart(self._obs(state))
 
     def step(self, state: IdentityState, action: jax.Array) -> Tuple[IdentityState, TimeStep]:
         reward = jnp.asarray(action == state.target, jnp.float32)
         key, sub = jax.random.split(state.key)
-        target = jax.random.randint(sub, (), 0, self._num_actions)
-        next_state = IdentityState(key, target, state.step_count + 1)
+        random_target = jax.random.randint(sub, (), 0, self._num_actions)
+        target = jnp.where(state.level >= 0, state.level, random_target)
+        next_state = IdentityState(key, target, state.step_count + 1, state.level)
         obs = self._obs(next_state)
         done = next_state.step_count >= self._episode_length
         return next_state, select_step(done, termination(reward, obs), transition(reward, obs))

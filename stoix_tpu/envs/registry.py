@@ -33,11 +33,27 @@ def register(name: str, ctor: Callable[..., Environment]) -> None:
     ENV_REGISTRY[name] = ctor
 
 
-def make_single(scenario: str, **env_kwargs: Any) -> Environment:
-    """Construct a raw (unwrapped, unbatched) environment."""
-    if scenario not in ENV_REGISTRY:
-        raise ValueError(f"Unknown environment '{scenario}'. Known: {sorted(ENV_REGISTRY)}")
-    return ENV_REGISTRY[scenario](**env_kwargs)
+def make_single(scenario: str, suite: Optional[str] = None, **env_kwargs: Any) -> Environment:
+    """Construct a raw (unwrapped, unbatched) environment.
+
+    `suite` selects an external-suite adapter (gymnax/brax/jumanji, lazy
+    imports — see stoix_tpu/envs/suites.py); first-party scenarios resolve
+    through ENV_REGISTRY regardless of the suite tag so configs can spell
+    `env_name: classic` etc. explicitly.
+    """
+    from stoix_tpu.envs import suites
+
+    # An explicit external-suite tag wins over the first-party registry —
+    # e.g. env_name: gymnax + CartPole-v1 must build the gymnax adapter, not
+    # the first-party CartPole that happens to share the scenario name.
+    if suite in suites.SUITE_MAKERS:
+        return suites.SUITE_MAKERS[suite](scenario, **env_kwargs)
+    if scenario in ENV_REGISTRY:
+        return ENV_REGISTRY[scenario](**env_kwargs)
+    raise ValueError(
+        f"Unknown environment '{scenario}' (suite={suite!r}). First-party: "
+        f"{sorted(ENV_REGISTRY)}; external suites: {sorted(suites.SUITE_MAKERS)}"
+    )
 
 
 def make(config: Any) -> Tuple[Environment, Environment]:
@@ -53,10 +69,11 @@ def make(config: Any) -> Tuple[Environment, Environment]:
     env_cfg = config.env
     kwargs = dict(getattr(env_cfg, "kwargs", {}) or {})
     scenario = env_cfg.scenario.name if hasattr(env_cfg.scenario, "name") else env_cfg.scenario
+    suite = getattr(env_cfg, "env_name", None)
     wrapper_cfg = dict(getattr(env_cfg, "wrapper", {}) or {})
 
-    train_env = make_single(scenario, **kwargs)
-    eval_env = make_single(scenario, **kwargs)
+    train_env = make_single(scenario, suite=suite, **kwargs)
+    eval_env = make_single(scenario, suite=suite, **kwargs)
 
     num_envs = int(config.arch.total_num_envs)
     train_env = apply_core_wrappers(

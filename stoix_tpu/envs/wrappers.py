@@ -45,7 +45,12 @@ class EpisodeStepLimit(Wrapper):
         self._max_steps = int(max_steps)
 
     def reset(self, key: jax.Array) -> Tuple[State, TimeStep]:
-        state, ts = self._env.reset(key)
+        return self._wrap_reset(*self._env.reset(key))
+
+    def reset_to_level(self, level: Any, key: jax.Array) -> Tuple[State, TimeStep]:
+        return self._wrap_reset(*self._env.reset_to_level(level, key))
+
+    def _wrap_reset(self, state: State, ts: TimeStep) -> Tuple[State, TimeStep]:
         _ensure_truncation(ts)
         return StepLimitState(state, jnp.zeros((), jnp.int32)), ts
 
@@ -73,7 +78,12 @@ class RecordEpisodeMetrics(Wrapper):
     """Accumulates per-episode return/length into extras["episode_metrics"]."""
 
     def reset(self, key: jax.Array) -> Tuple[State, TimeStep]:
-        state, ts = self._env.reset(key)
+        return self._wrap_reset(*self._env.reset(key))
+
+    def reset_to_level(self, level: Any, key: jax.Array) -> Tuple[State, TimeStep]:
+        return self._wrap_reset(*self._env.reset_to_level(level, key))
+
+    def _wrap_reset(self, state: State, ts: TimeStep) -> Tuple[State, TimeStep]:
         zero = jnp.zeros((), jnp.float32)
         ts.extras["episode_metrics"] = {
             "episode_return": zero,

@@ -51,17 +51,58 @@ def get_distribution_act_fn(
 
 
 def _make_eval_reset_fn(eval_env: Environment, config: Any):
-    """Episode-reset function for evaluation. By default the env's own reset;
-    an env-specific override (e.g. fixed evaluation levels, the reference's
-    kinetix hook at evaluator.py:365-372) is instantiated from
-    config.env.eval_reset_fn as callable(env, key) -> (state, timestep)."""
+    """Episode-reset function for evaluation: (key, episode_index) -> (state, ts).
+
+    By default the env's own reset. An env-specific override (e.g. fixed
+    evaluation levels, the reference's kinetix hook at evaluator.py:365-372)
+    is instantiated from config.env.eval_reset_fn as either
+      callable(env, key) -> (state, timestep), or
+      callable(env, key, episode_index) -> (state, timestep)
+    — the 3-arg form receives the global episode index so hooks can tile a
+    fixed level list deterministically across episodes (see
+    make_tiled_eval_reset_fn; reference wrappers/kinetix.py:15-51)."""
     hook_cfg = config.env.get("eval_reset_fn")
     if not hook_cfg:
-        return eval_env.reset
+        return lambda key, idx: eval_env.reset(key)
+    import inspect
+
     from stoix_tpu.utils.config import instantiate
 
     hook = instantiate(hook_cfg)
-    return lambda key: hook(eval_env, key)
+    try:
+        n_params = len(inspect.signature(hook).parameters)
+    except (TypeError, ValueError):
+        n_params = 2
+    if n_params >= 3:
+        return lambda key, idx: hook(eval_env, key, idx)
+    return lambda key, idx: hook(eval_env, key)
+
+
+def make_tiled_eval_reset_fn(levels: Any):
+    """Eval-reset hook that cycles a fixed list of levels across episodes
+    (the reference's kinetix list-mode eval reset, wrappers/kinetix.py:15-51,
+    generalized to any env exposing reset_to_level(level, key)).
+
+    `levels` is a sequence of per-level values — scalars, arrays, or pytrees
+    (kinetix-style level states) — or an already-stacked pytree whose leaves
+    have a leading level axis. Episode i resets to level i % n_levels, so with
+    num_eval_episodes a multiple of n_levels every level is evaluated equally
+    often.
+    """
+    import numpy as np
+
+    if isinstance(levels, (list, tuple)):
+        stacked = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *levels)
+        n_levels = len(levels)
+    else:
+        stacked = levels
+        n_levels = int(np.asarray(jax.tree.leaves(levels)[0]).shape[0])
+
+    def hook(env: Environment, key: jax.Array, episode_index: jax.Array):
+        level = jax.tree.map(lambda x: x[episode_index % n_levels], stacked)
+        return env.reset_to_level(level, key)
+
+    return hook
 
 
 def get_ff_evaluator_fn(
@@ -81,9 +122,9 @@ def get_ff_evaluator_fn(
     per_shard = episodes_global // n_shards
     reset_fn = _make_eval_reset_fn(eval_env, config)
 
-    def eval_one_episode(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
+    def eval_one_episode(params: Any, key: jax.Array, idx: jax.Array) -> Dict[str, jax.Array]:
         reset_key, act_key = jax.random.split(key)
-        env_state, timestep = reset_fn(reset_key)
+        env_state, timestep = reset_fn(reset_key, idx)
 
         def cond(carry: _EvalCarry) -> jax.Array:
             return ~carry.timestep.last()
@@ -101,14 +142,14 @@ def get_ff_evaluator_fn(
             "episode_length": metrics["episode_length"],
         }
 
-    def _shard_eval(params: Any, keys: jax.Array) -> Dict[str, jax.Array]:
-        return jax.vmap(eval_one_episode, in_axes=(None, 0))(params, keys)
+    def _shard_eval(params: Any, keys: jax.Array, idxs: jax.Array) -> Dict[str, jax.Array]:
+        return jax.vmap(eval_one_episode, in_axes=(None, 0, 0))(params, keys, idxs)
 
     sharded = jax.jit(
         jax.shard_map(
             _shard_eval,
             mesh=mesh,
-            in_specs=(P(), P("data")),
+            in_specs=(P(), P("data"), P("data")),
             out_specs=P("data"),
             check_vma=False,  # while_loop carries mix replicated and varying leaves
         )
@@ -116,7 +157,7 @@ def get_ff_evaluator_fn(
 
     def evaluator(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
         keys = jax.random.split(key, episodes_global)
-        return sharded(params, keys)
+        return sharded(params, keys, jnp.arange(episodes_global))
 
     return evaluator
 
@@ -140,9 +181,9 @@ def get_rnn_evaluator_fn(
 
     reset_fn = _make_eval_reset_fn(eval_env, config)
 
-    def eval_one_episode(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
+    def eval_one_episode(params: Any, key: jax.Array, idx: jax.Array) -> Dict[str, jax.Array]:
         reset_key, act_key = jax.random.split(key)
-        env_state, timestep = reset_fn(reset_key)
+        env_state, timestep = reset_fn(reset_key, idx)
         hstate = init_hstate_fn()
 
         def cond(carry) -> jax.Array:
@@ -164,19 +205,19 @@ def get_rnn_evaluator_fn(
             "episode_length": metrics["episode_length"],
         }
 
-    def _shard_eval(params: Any, keys: jax.Array) -> Dict[str, jax.Array]:
-        return jax.vmap(eval_one_episode, in_axes=(None, 0))(params, keys)
+    def _shard_eval(params: Any, keys: jax.Array, idxs: jax.Array) -> Dict[str, jax.Array]:
+        return jax.vmap(eval_one_episode, in_axes=(None, 0, 0))(params, keys, idxs)
 
     sharded = jax.jit(
         jax.shard_map(
-            _shard_eval, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
+            _shard_eval, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P("data"),
             check_vma=False,
         )
     )
 
     def evaluator(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
         keys = jax.random.split(key, episodes_global)
-        return sharded(params, keys)
+        return sharded(params, keys, jnp.arange(episodes_global))
 
     return evaluator
 
