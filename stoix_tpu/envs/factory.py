@@ -15,7 +15,6 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from stoix_tpu.envs.core import Environment
 from stoix_tpu.envs.types import TimeStep

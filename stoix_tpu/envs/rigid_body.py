@@ -37,7 +37,6 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 # --- quaternion helpers (wxyz convention) -----------------------------------
 

@@ -10,7 +10,7 @@ stoix/utils/make_env.py and stoix/base_types.py) without depending on it.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Sequence, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -121,13 +121,15 @@ def get_ff_evaluator_fn(
         episodes_global = ((episodes_global // n_shards) + 1) * n_shards
     per_shard = episodes_global // n_shards
     reset_fn = _make_eval_reset_fn(eval_env, config)
+    # Fixed-trip-count episode loop (SURVEY §7.3.6): under vmap, a while_loop
+    # runs every episode until the LONGEST one ends (divergence cost); with a
+    # known step limit a lax.scan with result masking is fully static and
+    # TPU-friendly. Enabled via arch.eval_max_steps.
+    eval_max_steps = config.arch.get("eval_max_steps")
 
     def eval_one_episode(params: Any, key: jax.Array, idx: jax.Array) -> Dict[str, jax.Array]:
         reset_key, act_key = jax.random.split(key)
         env_state, timestep = reset_fn(reset_key, idx)
-
-        def cond(carry: _EvalCarry) -> jax.Array:
-            return ~carry.timestep.last()
 
         def body(carry: _EvalCarry) -> _EvalCarry:
             key, act_key = jax.random.split(carry.key)
@@ -135,11 +137,39 @@ def get_ff_evaluator_fn(
             env_state, timestep = eval_env.step(carry.env_state, action)
             return _EvalCarry(env_state, timestep, key)
 
-        final = jax.lax.while_loop(cond, body, _EvalCarry(env_state, timestep, act_key))
+        if eval_max_steps:
+
+            def scan_body(carry: _EvalCarry, _):
+                stepped = body(carry)
+                # Freeze the carry once the episode has ended; the env is
+                # still stepped but its results are discarded, keeping the
+                # trip count static for XLA.
+                done = carry.timestep.last()  # scalar — broadcasts over leaves
+                frozen = jax.tree.map(lambda a, b: jnp.where(done, a, b), carry, stepped)
+                return frozen, None
+
+            final, _ = jax.lax.scan(
+                scan_body, _EvalCarry(env_state, timestep, act_key), None,
+                int(eval_max_steps),
+            )
+            # Episodes still running at the step cap are truncated AT the cap:
+            # their running return/length are reported as-is, and the
+            # episode_finished metric surfaces how many were cut short (a
+            # mean < 1.0 in the logs means eval_max_steps is too small for
+            # this env — not a silent condition).
+            finished = final.timestep.last()
+        else:
+
+            def cond(carry: _EvalCarry) -> jax.Array:
+                return ~carry.timestep.last()
+
+            final = jax.lax.while_loop(cond, body, _EvalCarry(env_state, timestep, act_key))
+            finished = jnp.ones((), bool)
         metrics = final.timestep.extras["episode_metrics"]
         return {
             "episode_return": metrics["episode_return"],
             "episode_length": metrics["episode_length"],
+            "episode_finished": finished.astype(jnp.float32),
         }
 
     def _shard_eval(params: Any, keys: jax.Array, idxs: jax.Array) -> Dict[str, jax.Array]:

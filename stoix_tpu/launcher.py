@@ -38,7 +38,7 @@ SBATCH_TEMPLATE = """#!/bin/bash
 export JAX_COORDINATOR_ADDRESS="$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1):12345"
 export JAX_NUM_PROCESSES="$SLURM_NNODES"
 
-srun bash -c 'JAX_PROCESS_ID="$SLURM_PROCID" python -m {module} {overrides}' 
+srun bash -c 'JAX_PROCESS_ID="$SLURM_PROCID" python -m {module} {overrides}'
 """
 
 

@@ -9,7 +9,7 @@ vector between search steps so the MCTS tree stores one array per node.
 
 from __future__ import annotations
 
-from typing import Any, Sequence, Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax

@@ -22,7 +22,7 @@ Used as a `pre_torso` via config `_target_`, same as any torso module.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence
+from typing import Dict
 
 import flax.linen as nn
 import jax

@@ -13,7 +13,6 @@ surrounding update step.
 
 from __future__ import annotations
 
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

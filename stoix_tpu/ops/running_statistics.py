@@ -15,7 +15,7 @@ and fully typed.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Sequence, Tuple
+from typing import Any, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,8 @@ saves globals directly and there is no `unreplicate_*` dance.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np
@@ -96,7 +97,11 @@ def assemble_global_array(
     )
 
 
-_FETCH_GLOBAL_CACHE: Dict[Any, Any] = {}
+# LRU of jitted replicate-identities: move-to-end on hit, evict ONE oldest
+# entry at capacity (never clear wholesale — dropping the entire cache on the
+# 65th signature would silently recompile every signature thereafter).
+_FETCH_GLOBAL_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
+_FETCH_GLOBAL_CACHE_SIZE = 64
 
 
 def fetch_global(tree: Any, mesh: Mesh) -> Any:
@@ -115,11 +120,11 @@ def fetch_global(tree: Any, mesh: Mesh) -> Any:
     cache_key = (treedef, tuple((l.shape, str(l.dtype)) for l in leaves), id(mesh))
     fn = _FETCH_GLOBAL_CACHE.get(cache_key)
     if fn is None:
-        if len(_FETCH_GLOBAL_CACHE) >= 64:
-            # Bounded: long-lived processes creating many meshes/signatures
-            # must not pin executables (and their meshes) forever.
-            _FETCH_GLOBAL_CACHE.clear()
+        while len(_FETCH_GLOBAL_CACHE) >= _FETCH_GLOBAL_CACHE_SIZE:
+            _FETCH_GLOBAL_CACHE.popitem(last=False)
         shardings = jax.tree.map(lambda _: NamedSharding(mesh, P()), tree)
         fn = jax.jit(lambda t: t, out_shardings=shardings)
         _FETCH_GLOBAL_CACHE[cache_key] = fn
+    else:
+        _FETCH_GLOBAL_CACHE.move_to_end(cache_key)
     return jax.tree.map(np.asarray, fn(tree))

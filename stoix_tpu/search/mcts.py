@@ -17,7 +17,6 @@ No dynamic allocation, no host round-trips.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax

@@ -1,12 +1,19 @@
 """Hyperparameter search — the reference's Optuna-sweeper equivalent
 (reference configs/default/anakin/hyperparameter_sweep.yaml: Optuna TPE
 multirun over a search space). Optuna is not a dependency here; this module
-provides random + grid search over dotted-override spaces with the same
-maximize-final-eval-return objective.
+provides random, grid, and first-party TPE search over dotted-override spaces
+with the same maximize-final-eval-return objective.
+
+TPE (Bergstra et al. 2011, the sampler the reference's Optuna config selects):
+after `n_startup` random trials, observed points split into good (top gamma
+quantile by score) and bad; numeric params get Parzen (Gaussian-kernel)
+densities l(x) over good and g(x) over bad, candidates are drawn from l and
+ranked by l/g; choice params use smoothed count ratios.
 
 Usage:
     python -m stoix_tpu.sweep --module stoix_tpu.systems.ppo.anakin.ff_ppo \
         --default default/anakin/default_ff_ppo.yaml --trials 8 \
+        --method tpe \
         --space system.actor_lr=loguniform:1e-5,1e-2 \
                 system.ent_coef=uniform:0.0,0.05 \
                 system.epochs=choice:2,4,8 \
@@ -67,6 +74,64 @@ def sample_point(space: Dict[str, Tuple[str, list]], rng: random.Random) -> Dict
     return point
 
 
+def _parzen_logpdf(x: float, centers: List[float], sigma: float) -> float:
+    import math
+
+    if sigma <= 0:
+        sigma = 1e-12
+    acc = 0.0
+    for c in centers:
+        acc += math.exp(-0.5 * ((x - c) / sigma) ** 2)
+    return math.log(max(acc / (len(centers) * sigma), 1e-300))
+
+
+def tpe_next_point(
+    space: Dict[str, Tuple[str, list]],
+    history: List[Dict[str, Any]],
+    rng: random.Random,
+    n_startup: int = 5,
+    gamma: float = 0.25,
+    n_candidates: int = 24,
+) -> Dict[str, Any]:
+    """Propose the next trial point by the TPE l(x)/g(x) criterion."""
+    import math
+
+    if len(history) < n_startup:
+        return sample_point(space, rng)
+    ranked = sorted(history, key=lambda r: -r["score"])
+    n_good = max(1, int(len(ranked) * gamma))
+    good, bad = ranked[:n_good], ranked[n_good:] or ranked[:n_good]
+
+    point: Dict[str, Any] = {}
+    for key, (kind, args) in space.items():
+        gvals = [r["params"][key] for r in good]
+        bvals = [r["params"][key] for r in bad]
+        if kind == "choice":
+            weights = []
+            for a in args:
+                lg = (gvals.count(a) + 1.0) / (len(gvals) + len(args))
+                lb = (bvals.count(a) + 1.0) / (len(bvals) + len(args))
+                weights.append(lg / lb)
+            point[key] = rng.choices(args, weights=weights)[0]
+            continue
+        log_scale = kind == "loguniform"
+        conv = math.log if log_scale else float
+        lo, hi = conv(float(args[0])), conv(float(args[1]))
+        g_centers = [conv(float(v)) for v in gvals]
+        b_centers = [conv(float(v)) for v in bvals]
+        # Scott-style bandwidth on the search width, shrinking with samples.
+        sigma = (hi - lo) * max(0.08, 1.0 / math.sqrt(len(g_centers) + 1))
+        best_x, best_ratio = None, -math.inf
+        for _ in range(n_candidates):
+            x = min(max(rng.gauss(rng.choice(g_centers), sigma), lo), hi)
+            ratio = _parzen_logpdf(x, g_centers, sigma) - _parzen_logpdf(x, b_centers, sigma)
+            if ratio > best_ratio:
+                best_x, best_ratio = x, ratio
+        value = math.exp(best_x) if log_scale else best_x
+        point[key] = int(round(value)) if kind == "int" else value
+    return point
+
+
 def grid_points(space: Dict[str, Tuple[str, list]]) -> List[Dict[str, Any]]:
     keys = list(space)
     choices = []
@@ -89,12 +154,17 @@ def run_sweep(
 ) -> Dict[str, Any]:
     mod = importlib.import_module(module)
     rng = random.Random(seed)
-    points = (
-        grid_points(space) if method == "grid" else [sample_point(space, rng) for _ in range(trials)]
-    )
+    if method == "grid":
+        points: List[Any] = grid_points(space)
+    elif method == "tpe":
+        points = [None] * trials  # proposed adaptively from the history below
+    else:
+        points = [sample_point(space, rng) for _ in range(trials)]
 
     results = []
     for i, point in enumerate(points):
+        if point is None:
+            point = tpe_next_point(space, results, rng)
         cfg = config_lib.compose(config_lib.default_config_dir(), default, fixed_overrides)
         # Apply sampled values TYPED (stringifying small floats like 1e-05 and
         # re-parsing via YAML 1.1 would silently turn them into strings).
@@ -114,7 +184,7 @@ def main(argv: List[str] | None = None) -> Dict[str, Any]:
     parser.add_argument("--module", required=True)
     parser.add_argument("--default", required=True, help="default yaml under configs/")
     parser.add_argument("--trials", type=int, default=8)
-    parser.add_argument("--method", choices=["random", "grid"], default="random")
+    parser.add_argument("--method", choices=["random", "grid", "tpe"], default="random")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--space", nargs="+", required=True)
     parser.add_argument("--set", nargs="*", default=[], dest="overrides",

@@ -9,12 +9,12 @@ exp(advantage / beta) (clipped). Serves discrete and continuous heads
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from stoix_tpu import envs
 from stoix_tpu.base_types import (

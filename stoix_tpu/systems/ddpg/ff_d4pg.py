@@ -8,7 +8,7 @@ actor ascending the expected-Q.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp

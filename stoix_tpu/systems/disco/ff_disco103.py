@@ -200,7 +200,7 @@ def get_learner_fn(
 def learner_setup(
     env: envs.Environment, config: Any, mesh: Mesh, keys: jax.Array
 ) -> AnakinSetup:
-    from stoix_tpu.networks.disco import ActionConditionedLSTMTorso, DiscoAgentNetwork
+    from stoix_tpu.networks.disco import DiscoAgentNetwork
     from stoix_tpu.systems import anakin
 
     num_actions = env.num_actions

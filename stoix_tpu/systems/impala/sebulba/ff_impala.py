@@ -10,7 +10,7 @@ scaffolding (threads/pipeline/param-server/async-eval) with sebulba ff_ppo.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,7 @@ once through the actor optimizer (the critic optimizer sees an empty tree).
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any
 
 import flax.linen as nn
 import jax

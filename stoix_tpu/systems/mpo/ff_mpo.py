@@ -14,12 +14,12 @@ Maximum a Posteriori Policy Optimization (Abdolmaleki et al. 2018):
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput, OffPolicyLearnerState, OnlineAndTarget
@@ -28,6 +28,13 @@ from stoix_tpu.evaluator import get_distribution_act_fn
 from stoix_tpu.ops import distributions as dists
 from stoix_tpu.ops.multistep import retrace_continuous
 from stoix_tpu.systems import anakin, off_policy_core as core
+from stoix_tpu.systems.mpo.ff_vmpo import (
+    decoupled_alpha_losses,
+    gaussian_kls_per_dim,
+    gaussian_params,
+    init_log_duals,
+    project_duals,
+)
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.jax_utils import tree_merge_leading_dims
@@ -142,22 +149,15 @@ def get_learner_fn(env, networks, update_fns, buffer, config, continuous: bool):
             log_probs = jax.vmap(online_dist.log_prob)(actions)  # [N,B]
             policy_loss = -jnp.mean(jnp.sum(jax.lax.stop_gradient(weights) * log_probs, axis=0))
 
-            b_loc, b_scale = target_dist.loc, target_dist.scale_diag
-            behavior = dists.MultivariateNormalDiag(b_loc, b_scale)
-            fixed_scale = dists.MultivariateNormalDiag(online_dist.loc, b_scale)
-            fixed_mean = dists.MultivariateNormalDiag(b_loc, online_dist.scale_diag)
-            kl_mean = jnp.mean(behavior.kl_divergence(fixed_scale))
-            kl_std = jnp.mean(behavior.kl_divergence(fixed_mean))
-            alpha_mean = _softplus(log_alpha[0])
-            alpha_std = _softplus(log_alpha[1])
-            alpha_loss = alpha_mean * (eps_alpha_mean - jax.lax.stop_gradient(kl_mean)) + (
-                alpha_std * (eps_alpha_stddev - jax.lax.stop_gradient(kl_std))
+            b_loc, b_scale = gaussian_params(target_dist)
+            o_loc, o_scale = gaussian_params(online_dist)
+            # Decoupled per-dimension mean/stddev KLs with per-dimension
+            # alpha duals [2, A] (reference continuous_loss.py,
+            # per_dim_constraining=True).
+            kl_mean, kl_std = gaussian_kls_per_dim(b_loc, b_scale, o_loc, o_scale)
+            alpha_loss, kl_loss, kl_metric = decoupled_alpha_losses(
+                log_alpha, kl_mean, kl_std, eps_alpha_mean, eps_alpha_stddev
             )
-            kl_loss = (
-                jax.lax.stop_gradient(alpha_mean) * kl_mean
-                + jax.lax.stop_gradient(alpha_std) * kl_std
-            )
-            kl_metric = kl_mean + kl_std
         else:
             q_all = q_network.apply(params.q_params.target, obs, 0.0).preferences  # [B, A]
             prior_logits = dists.Categorical(target_dist.logits).logits
@@ -215,6 +215,7 @@ def get_learner_fn(env, networks, update_fns, buffer, config, continuous: bool):
         log_temperature, log_alpha = optax.apply_updates(
             (params.log_temperature, params.log_alpha), d_updates
         )
+        log_temperature, log_alpha = project_duals(log_temperature, log_alpha)
 
         params = MPOParams(
             OnlineAndTarget(actor_online, actor_target),
@@ -310,12 +311,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         q_p = q_network.init(q_key, dummy_obs, dummy_act)
     else:
         q_p = q_network.init(q_key, dummy_obs)
-    log_temperature = jnp.asarray(float(config.system.get("init_log_temperature", 3.0)))
-    log_alpha = (
-        jnp.full((2,), float(config.system.get("init_log_alpha", 3.0)))
-        if continuous
-        else jnp.asarray(float(config.system.get("init_log_alpha", 3.0)))
-    )
+    log_temperature, log_alpha = init_log_duals(config, continuous, int(env.num_actions))
     params = MPOParams(
         OnlineAndTarget(actor_p, actor_p), OnlineAndTarget(q_p, q_p),
         log_temperature, log_alpha,

@@ -7,7 +7,7 @@ mean/stddev alphas for Gaussian policies, reference mpo_types.py:23-31).
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -43,12 +43,91 @@ def _softplus(x):
     return jax.nn.softplus(x) + 1e-8
 
 
+# Dual variables live in softplus space; keep them from drifting so far
+# negative that softplus underflows and the dual can never recover
+# (the reference projects duals the same way, continuous_loss.py).
+_MIN_LOG_DUAL = -18.0
+
+
+def project_duals(log_temperature, log_alpha):
+    return (
+        jnp.maximum(log_temperature, _MIN_LOG_DUAL),
+        jnp.maximum(log_alpha, _MIN_LOG_DUAL),
+    )
+
+
+def gaussian_params(dist):
+    """(loc, scale) of the underlying diagonal Gaussian.
+
+    Supports both the raw MultivariateNormalDiag policy and the squashed
+    Independent(TanhNormal) policy (the reference's continuous-MPO head,
+    NormalAffineTanhDistributionHead — continuous_loss.py reads the pre-tanh
+    Normal's mean/stddev for the decoupled KLs exactly like this)."""
+    if hasattr(dist, "scale_diag"):
+        return dist.loc, dist.scale_diag
+    inner = getattr(dist, "distribution", dist)  # unwrap Independent
+    if hasattr(inner, "base"):  # TanhNormal wraps a Normal
+        return inner.base.loc, inner.base.scale
+    return inner.loc, inner.scale
+
+
+def gaussian_kls_per_dim(b_loc, b_scale, o_loc, o_scale):
+    """Decoupled per-dimension KL(behavior || online) for diag Gaussians
+    (reference continuous_loss.py per_dim_constraining): mean-KL holds the
+    stddev fixed at the behavior's, stddev-KL holds the mean fixed. Returns
+    (kl_mean, kl_stddev), each shaped [action_dim] (batch-averaged)."""
+    kl_mean = 0.5 * jnp.square((o_loc - b_loc) / b_scale)
+    kl_std = (
+        jnp.log(o_scale / b_scale)
+        + 0.5 * jnp.square(b_scale / o_scale)
+        - 0.5
+    )
+    reduce_dims = tuple(range(kl_mean.ndim - 1))
+    return jnp.mean(kl_mean, axis=reduce_dims), jnp.mean(kl_std, axis=reduce_dims)
+
+
+def init_log_duals(config, continuous: bool, act_dim: int):
+    """(log_temperature, log_alpha) initial values shared by MPO and V-MPO.
+
+    Continuous policies get per-dimension alpha duals [2, A]: row 0 = mean KL,
+    row 1 = stddev KL (reference init_log_alpha_mean=10,
+    init_log_alpha_stddev=500)."""
+    default_temp = 10.0 if continuous else 3.0
+    log_temperature = jnp.asarray(
+        float(config.system.get("init_log_temperature", default_temp))
+    )
+    if continuous:
+        init_mean = float(config.system.get("init_log_alpha_mean",
+                                            config.system.get("init_log_alpha", 10.0)))
+        init_std = float(config.system.get("init_log_alpha_stddev", 500.0))
+        log_alpha = jnp.stack(
+            [jnp.full((act_dim,), init_mean), jnp.full((act_dim,), init_std)]
+        )
+    else:
+        log_alpha = jnp.asarray(float(config.system.get("init_log_alpha", 3.0)))
+    return log_temperature, log_alpha
+
+
+def decoupled_alpha_losses(log_alpha, kl_mean, kl_std, eps_mean, eps_std):
+    """Per-dimension alpha dual losses + KL penalty for continuous policies.
+    Returns (alpha_loss, kl_loss, kl_metric) — shared by MPO and V-MPO."""
+    alpha_mean = _softplus(log_alpha[0])
+    alpha_std = _softplus(log_alpha[1])
+    alpha_loss = jnp.sum(
+        alpha_mean * (eps_mean - jax.lax.stop_gradient(kl_mean))
+    ) + jnp.sum(alpha_std * (eps_std - jax.lax.stop_gradient(kl_std)))
+    kl_loss = jnp.sum(jax.lax.stop_gradient(alpha_mean) * kl_mean) + jnp.sum(
+        jax.lax.stop_gradient(alpha_std) * kl_std
+    )
+    return alpha_loss, kl_loss, jnp.sum(kl_mean) + jnp.sum(kl_std)
+
+
 def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
     actor_apply, critic_apply = apply_fns
     actor_update, critic_update, dual_update = update_fns
     gamma = float(config.system.gamma)
-    eps_eta = float(config.system.get("epsilon_eta", 0.01))
-    eps_alpha = float(config.system.get("epsilon_alpha", 0.005))
+    eps_eta = float(config.system.get("epsilon_eta", 0.5))
+    eps_alpha = float(config.system.get("epsilon_alpha", 0.001))
     eps_alpha_mean = float(config.system.get("epsilon_alpha_mean", 0.0075))
     eps_alpha_stddev = float(config.system.get("epsilon_alpha_stddev", 1e-5))
 
@@ -60,7 +139,8 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
         env_state, timestep = env.step(env_state, action)
         # Behavior-policy stats for the KL trust region.
         if continuous:
-            behavior = {"loc": dist.loc, "scale": dist.scale_diag}
+            b_loc, b_scale = gaussian_params(dist)
+            behavior = {"loc": b_loc, "scale": b_scale}
         else:
             behavior = {"logits": dist.logits}
         data = {
@@ -104,24 +184,15 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
 
         # KL trust region to the behavior policy.
         if continuous:
-            online = dist
+            o_loc, o_scale = gaussian_params(dist)
             b_loc, b_scale = traj_f["behavior"]["loc"], traj_f["behavior"]["scale"]
-            behavior = dists.MultivariateNormalDiag(b_loc, b_scale)
-            # Decoupled mean/stddev KL (reference continuous_loss.py).
-            fixed_scale = dists.MultivariateNormalDiag(online.loc, b_scale)
-            fixed_mean = dists.MultivariateNormalDiag(b_loc, online.scale_diag)
-            kl_mean = jnp.mean(behavior.kl_divergence(fixed_scale))
-            kl_std = jnp.mean(behavior.kl_divergence(fixed_mean))
-            alpha_mean = _softplus(log_alpha[0])
-            alpha_std = _softplus(log_alpha[1])
-            alpha_loss = alpha_mean * (eps_alpha_mean - jax.lax.stop_gradient(kl_mean)) + (
-                alpha_std * (eps_alpha_stddev - jax.lax.stop_gradient(kl_std))
+            # Decoupled per-dimension mean/stddev KLs with per-dimension
+            # alpha duals [2, A] (reference continuous_loss.py,
+            # per_dim_constraining=True).
+            kl_mean, kl_std = gaussian_kls_per_dim(b_loc, b_scale, o_loc, o_scale)
+            alpha_loss, kl_loss, kl_metric = decoupled_alpha_losses(
+                log_alpha, kl_mean, kl_std, eps_alpha_mean, eps_alpha_stddev
             )
-            kl_loss = (
-                jax.lax.stop_gradient(alpha_mean) * kl_mean
-                + jax.lax.stop_gradient(alpha_std) * kl_std
-            )
-            kl_metric = kl_mean + kl_std
         else:
             behavior = dists.Categorical(traj_f["behavior"]["logits"])
             kl = jnp.mean(behavior.kl_divergence(dist))
@@ -138,11 +209,12 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
         }
         return total, metrics
 
-    def _update_step(learner_state: OnPolicyLearnerState, _):
-        learner_state, traj = jax.lax.scan(
-            _env_step, learner_state, None, int(config.system.rollout_length)
-        )
-        params, opt_states, key, env_state, last_timestep = learner_state
+    def _update_epoch(carry, _):
+        # One full-batch pass over the rollout. Multiple epochs re-use the
+        # trajectory (reference ff_vmpo epochs=16); the recorded behavior
+        # stats keep the KL trust region anchored at the rollout policy, and
+        # advantages are recomputed as the critic improves.
+        params, opt_states, traj = carry
 
         v_tm1 = critic_apply(params.critic_params, traj["obs"])
         v_t = critic_apply(params.critic_params, traj["next_obs"])
@@ -181,13 +253,28 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
         log_temperature, log_alpha = optax.apply_updates(
             (params.log_temperature, params.log_alpha), d_updates
         )
+        log_temperature, log_alpha = project_duals(log_temperature, log_alpha)
+
+        params = VMPOParams(actor_params, critic_params, log_temperature, log_alpha)
+        opt_states = VMPOOptStates(a_opt, c_opt, d_opt)
+        return (params, opt_states, traj), {**metrics, **critic_metrics}
+
+    def _update_step(learner_state: OnPolicyLearnerState, _):
+        learner_state, traj = jax.lax.scan(
+            _env_step, learner_state, None, int(config.system.rollout_length)
+        )
+        params, opt_states, key, env_state, last_timestep = learner_state
+
+        (params, opt_states, _), loss_info = jax.lax.scan(
+            _update_epoch, (params, opt_states, traj), None,
+            int(config.system.get("epochs", 1)),
+        )
+        loss_info = jax.tree.map(lambda x: x[-1], loss_info)
 
         learner_state = OnPolicyLearnerState(
-            VMPOParams(actor_params, critic_params, log_temperature, log_alpha),
-            VMPOOptStates(a_opt, c_opt, d_opt),
-            key, env_state, last_timestep,
+            params, opt_states, key, env_state, last_timestep,
         )
-        return learner_state, (traj["info"], {**metrics, **critic_metrics})
+        return learner_state, (traj["info"], loss_info)
 
     def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
         key = learner_state.key[0]
@@ -237,12 +324,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
     actor_params = actor_network.init(actor_key, dummy_obs)
     critic_params = critic_network.init(critic_key, dummy_obs)
-    log_temperature = jnp.asarray(float(config.system.get("init_log_temperature", 1.0)))
-    log_alpha = (
-        jnp.full((2,), float(config.system.get("init_log_alpha", 1.0)))
-        if continuous
-        else jnp.asarray(float(config.system.get("init_log_alpha", 1.0)))
-    )
+    log_temperature, log_alpha = init_log_duals(config, continuous, int(env.num_actions))
     params = VMPOParams(actor_params, critic_params, log_temperature, log_alpha)
     opt_states = VMPOOptStates(
         actor_optim.init(actor_params),

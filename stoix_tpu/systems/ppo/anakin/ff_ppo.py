@@ -33,9 +33,9 @@ from typing import Any, Callable, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from stoix_tpu import base_types, envs
+from stoix_tpu import envs
 from stoix_tpu.base_types import (
     ActorCriticOptStates,
     ActorCriticParams,

@@ -9,7 +9,7 @@ rec_ppo minibatch scheme), truncation-aware GAE from per-step bootstrap values.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +26,7 @@ from stoix_tpu.base_types import (
 from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.systems import anakin
-from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.systems.runner import AnakinSetup
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.training import make_learning_rate
 

@@ -20,8 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
-from typing import Any, Callable, List, NamedTuple, Tuple
+from typing import Any, Callable, List, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -6,7 +6,7 @@ like PPO. The reference pairs it with a LayerNorm MLP torso.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp

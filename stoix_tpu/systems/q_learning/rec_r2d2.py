@@ -11,7 +11,7 @@ eta (:364-374, buffer_set_priorities :413-416).
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +25,7 @@ from stoix_tpu.ops.value_transforms import SIGNED_HYPERBOLIC_PAIR
 from stoix_tpu.ops.multistep import n_step_bootstrapped_returns
 from stoix_tpu.systems import anakin
 from stoix_tpu.systems.off_policy_core import pmean_grads
-from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
+from stoix_tpu.systems.runner import AnakinSetup
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.training import make_learning_rate
 

@@ -11,7 +11,7 @@ evaluate / checkpoint / absolute metric.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -130,6 +130,9 @@ def run_anakin_experiment(
         # Orbax saves sharded globals collectively: ALL processes call save.
         if checkpointer is not None:
             checkpointer.save(t, learner_state, mean_return)
+            # The state is donated to the next learn() call — an async save
+            # still serializing those buffers would read deleted memory.
+            checkpointer.wait()
 
     if bool(config.arch.get("absolute_metric", True)):
         key, ek = jax.random.split(key)

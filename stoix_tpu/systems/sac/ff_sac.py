@@ -7,7 +7,7 @@ actor, polyak critic targets. Anakin scaffolding shared via off_policy_core.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -14,8 +14,7 @@ otherwise it runs on-policy epochs over the fresh rollout.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -9,12 +9,12 @@ One policy-gradient update per rollout: n-step discounted return targets
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from stoix_tpu import envs
 from stoix_tpu.base_types import (

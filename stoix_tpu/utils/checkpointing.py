@@ -95,6 +95,13 @@ class Checkpointer:
                 f"Checkpoint major version {saved} incompatible with {CHECKPOINTER_VERSION}"
             )
 
+    def wait(self) -> None:
+        """Block until in-flight (async) saves complete. The Anakin host loop
+        calls this after each save: the learner state is DONATED to the next
+        `learn` call, which would invalidate buffers an async save is still
+        serializing (systems/anakin.py shardmap_learner)."""
+        self._manager.wait_until_finished()
+
     def close(self) -> None:
         self._manager.wait_until_finished()
         self._manager.close()

@@ -122,6 +122,91 @@ class TensorboardSink(BaseSink):
         self._writer.close()
 
 
+class WandbSink(BaseSink):
+    """Weights & Biases sink (reference logger.py:188-258).
+
+    With the `wandb` package installed, logs through a real `wandb.init`
+    run — `mode="offline"` by default so egress-blocked machines record runs
+    syncable later with `wandb sync`. Without the package (this sandbox),
+    writes a wandb-style offline run directory instead:
+
+        <dir>/offline-run-<stamp>/files/wandb-metadata.json   (run metadata)
+        <dir>/offline-run-<stamp>/files/config.yaml           (run config)
+        <dir>/offline-run-<stamp>/files/wandb-summary.json    (latest values)
+        <dir>/offline-run-<stamp>/wandb-history.jsonl         (per-step rows,
+                                                               _step/_runtime/
+                                                               _timestamp keys)
+
+    The fallback keeps the metric layout identical (event-prefixed keys,
+    history rows keyed by `_step`), so dashboards or scripts written against
+    the W&B export format read either source.
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        project: str = "stoix_tpu",
+        mode: str = "offline",
+        config_dict: Optional[Dict[str, Any]] = None,
+        **init_kwargs: Any,
+    ):
+        self._start = time.time()
+        self._run = None
+        self._history = None
+        self._summary: Dict[str, Any] = {}
+        try:
+            import wandb
+
+            self._run = wandb.init(
+                project=project, dir=run_dir, mode=mode, config=config_dict, **init_kwargs
+            )
+        except ImportError:
+            stamp = time.strftime("%Y%m%d_%H%M%S")
+            base = os.path.join(run_dir, f"offline-run-{stamp}")
+            files = os.path.join(base, "files")
+            os.makedirs(files, exist_ok=True)
+            with open(os.path.join(files, "wandb-metadata.json"), "w") as f:
+                json.dump(
+                    {
+                        "project": project,
+                        "mode": mode,
+                        "startedAt": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                        "writer": "stoix_tpu.WandbSink (wandb package not installed)",
+                    },
+                    f,
+                    indent=2,
+                )
+            if config_dict is not None:
+                try:
+                    import yaml
+
+                    with open(os.path.join(files, "config.yaml"), "w") as f:
+                        yaml.safe_dump(config_dict, f)
+                except Exception:  # noqa: BLE001 — config snapshot is best-effort
+                    pass
+            self._files_dir = files
+            self._history = open(os.path.join(base, "wandb-history.jsonl"), "a")
+
+    def write(self, metrics: Dict[str, float], t: int, t_eval: int, event: LogEvent) -> None:
+        row = {f"{event.value}/{k}": v for k, v in metrics.items()}
+        if self._run is not None:
+            self._run.log(row, step=t)
+            return
+        now = time.time()
+        row.update({"_step": t, "_runtime": now - self._start, "_timestamp": now})
+        self._history.write(json.dumps(row) + "\n")
+        self._history.flush()
+        self._summary.update(row)
+        with open(os.path.join(self._files_dir, "wandb-summary.json"), "w") as f:
+            json.dump(self._summary, f)
+
+    def close(self) -> None:
+        if self._run is not None:
+            self._run.finish()
+        elif self._history is not None:
+            self._history.close()
+
+
 class StoixLogger:
     """Thread-safe fan-out logger. `log` accepts raw (possibly array-valued)
     metrics; non-TRAIN events are described (mean/std/min/max)."""
@@ -150,6 +235,13 @@ class StoixLogger:
             self._sinks.append(JsonSink(json_path, env_name, task_name, system_name, seed))
         if logger_cfg.get("use_tb", False):
             self._sinks.append(TensorboardSink(os.path.join(exp_dir, "tb")))
+        if logger_cfg.get("use_wandb", False):
+            kwargs = dict(logger_cfg.get("wandb_kwargs") or {})
+            kwargs.setdefault("project", "stoix_tpu")
+            cfg_snapshot = config.to_dict() if hasattr(config, "to_dict") else None
+            self._sinks.append(
+                WandbSink(os.path.join(exp_dir, "wandb"), config_dict=cfg_snapshot, **kwargs)
+            )
 
         self._solve_threshold = config.env.get("solved_return_threshold")
 

@@ -15,7 +15,6 @@ from stoix_tpu.envs import (
     VmapWrapper,
     make_single,
 )
-from stoix_tpu.envs.types import StepType
 
 ALL_ENVS = [
     "CartPole-v1",

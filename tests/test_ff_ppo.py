@@ -6,7 +6,6 @@ shard_map learner, GAE bootstrapping, gradient pmean, evaluator — is wired
 correctly. (A plumbing bug anywhere shows up as no learning.)
 """
 
-import jax
 import pytest
 
 from stoix_tpu.systems.ppo.anakin.ff_ppo import run_experiment
@@ -94,10 +93,11 @@ def test_rec_ppo_and_dqn_decay_paths(devices):
     ret = ff_dqn.run_experiment(cfg)
     assert ret == ret
 
-    # Misconfigured decay (no final_epsilon) must fail loudly.
+    # Misconfigured decay (final_epsilon == training_epsilon) must fail loudly.
     cfg = config_lib.compose(
         config_lib.default_config_dir(), "default/anakin/default_ff_dqn.yaml",
         ["env=identity_game", "system.epsilon_decay_steps=1000",
+         "system.training_epsilon=0.1", "system.final_epsilon=0.1",
          "arch.total_num_envs=16", "logger.use_console=False"],
     )
     with pytest.raises(ValueError, match="final_epsilon"):

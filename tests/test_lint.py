@@ -1,0 +1,19 @@
+"""Lint gate as a test (the reference gates lint in CI,
+.github/workflows/test_linters.yaml); scripts/lint.py runs the native checks
+plus ruff/mypy when installed."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_lint_gate_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"lint gate failed:\n{proc.stdout}\n{proc.stderr}"

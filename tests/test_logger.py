@@ -80,3 +80,30 @@ def test_tensorboard_sink_writes_events(tmp_path):
     logger.close()
     tb_dir = os.path.join(logger.exp_dir, "tb")
     assert any(f.startswith("events") for f in os.listdir(tb_dir))
+
+
+def test_wandb_offline_fallback_sink(tmp_path):
+    # wandb is not installed in this sandbox, so the sink must write the
+    # wandb-format offline directory (history jsonl + summary + metadata).
+    cfg = _logger_config(tmp_path, use_wandb=True, wandb_kwargs={"project": "proj_x"})
+    logger = StoixLogger(cfg)
+    logger.log({"episode_return": np.array([120.0, 80.0])}, t=500, t_eval=0, event=LogEvent.EVAL)
+    logger.log({"loss": np.array([0.5])}, t=600, t_eval=0, event=LogEvent.TRAIN)
+    logger.close()
+
+    wandb_dir = os.path.join(logger.exp_dir, "wandb")
+    runs = [d for d in os.listdir(wandb_dir) if d.startswith("offline-run-")]
+    assert len(runs) == 1
+    base = os.path.join(wandb_dir, runs[0])
+    meta = json.load(open(os.path.join(base, "files", "wandb-metadata.json")))
+    assert meta["project"] == "proj_x"
+    rows = [json.loads(l) for l in open(os.path.join(base, "wandb-history.jsonl"))]
+    assert len(rows) == 2
+    assert rows[0]["_step"] == 500
+    assert rows[0]["evaluator/episode_return/mean"] == 100.0
+    assert rows[0]["evaluator/solve_rate"] == 50.0
+    assert rows[1]["trainer/loss"] == 0.5
+    summary = json.load(open(os.path.join(base, "files", "wandb-summary.json")))
+    assert summary["_step"] == 600
+    # Config snapshot written as yaml.
+    assert os.path.exists(os.path.join(base, "files", "config.yaml"))

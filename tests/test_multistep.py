@@ -5,7 +5,6 @@ vectors (reference stoix/tests/multistep_test.py); the other estimators are
 checked against independent numpy brute-force implementations.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

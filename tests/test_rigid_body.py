@@ -4,7 +4,6 @@ Ant locomotion env built on it (stand-ins for the reference's brax suite)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from stoix_tpu.envs import rigid_body as rb
 from stoix_tpu.envs.locomotion import Ant

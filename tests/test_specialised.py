@@ -109,3 +109,30 @@ class TestTiledEvalReset:
         metrics = evaluator({}, jax.random.PRNGKey(0))
         # Oracle policy solves every episode.
         np.testing.assert_array_equal(np.asarray(metrics["episode_return"]), 4.0)
+
+
+class TestScanEvaluator:
+    def test_scan_mode_matches_while_mode(self):
+        # arch.eval_max_steps switches the episode loop to a fixed-trip scan
+        # with masking; same act_fn + seed must give identical metrics.
+        env = RecordEpisodeMetrics(IdentityGame(num_actions=4, episode_length=5))
+        mesh = create_mesh({"data": -1})
+
+        def act_fn(params, observation, key):
+            return jnp.argmax(observation.agent_view).astype(jnp.int32)
+
+        def run(arch_extra):
+            config = Config.from_dict(
+                {"arch": {"num_eval_episodes": 8, **arch_extra}, "env": {}}
+            )
+            evaluator = get_ff_evaluator_fn(env, act_fn, config, mesh)
+            return evaluator({}, jax.random.PRNGKey(7))
+
+        m_while = run({})
+        m_scan = run({"eval_max_steps": 16})
+        np.testing.assert_array_equal(
+            np.asarray(m_while["episode_return"]), np.asarray(m_scan["episode_return"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(m_while["episode_length"]), np.asarray(m_scan["episode_length"])
+        )
