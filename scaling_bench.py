@@ -69,9 +69,19 @@ def main() -> None:
     parser.add_argument("--sizes", nargs="+", type=int, default=None)
     parser.add_argument("--envs-per-device", type=int, default=512)
     parser.add_argument("--rollout-length", type=int, default=32)
+    parser.add_argument(
+        "--cpu",
+        action="store_true",
+        help="force the virtual-CPU platform (a site hook can pin a remote "
+        "accelerator platform even over JAX_PLATFORMS=cpu; this flag wins, "
+        "same as bench.py --cpu)",
+    )
     args = parser.parse_args()
 
     import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     n_avail = len(jax.devices())
     sizes = args.sizes or [s for s in (1, 2, 4, 8, 16, 32, 64) if s <= n_avail]

@@ -8,9 +8,8 @@ pyproject.toml, so installing them upgrades the gate with zero changes here):
 
   1. syntax: every file must compile (py_compile);
   2. unused imports (AST-based, flake8 F401 equivalent; `# noqa` respected);
-  3. hygiene: no tabs in indentation, no trailing whitespace, no
-     `print(` in library code (stoix_tpu/ outside systems/utils CLI paths is
-     exempt-listed explicitly), max line length 100 (warnings only).
+  3. hygiene: no tabs in indentation, no trailing whitespace, max line
+     length 100 (warnings only).
 
 Exit code 0 = clean, 1 = findings. Run: python scripts/lint.py [paths...]
 """

@@ -3,8 +3,8 @@
 (reference stoix/wrappers/envpool.py adapts EnvPool's API the same way: manual
 auto-reset bookkeeping, numpy episode metrics, stoa-style TimeSteps).
 
-Games: "CartPole-v1" (4-float obs) and "Breakout-minatar" (10x10x4 pixel obs —
-the Atari-class workload for the Sebulba CNN path). The shared library is
+Games: "CartPole-v1" (4-float obs), "Breakout-minatar" and "Asterix-minatar"
+(10x10x4 pixel obs — the Atari-class workloads for the Sebulba CNN path). The shared library is
 compiled on first use with g++ and cached next to the source; no Python-level
 per-env loops exist anywhere on the hot path.
 """

@@ -2,17 +2,23 @@
 
 The reference gets MinAtar-style pixel envs from external suites (gymnax's
 `*-MinAtar` family, reference stoix/utils/make_env.py ENV_MAKERS["gymnax"]);
-this module is the first-party TPU-native equivalent. `Breakout` mirrors the
-native C++ pool's "Breakout-minatar" game (envs/native/cvec.cpp) RULE FOR
-RULE, so Sebulba (C++ pool actors) and Anakin (this env) train on the same
-game and a policy's scores transfer across backends; the equivalence is
-pinned by tests/test_minatar.py which steps both engines in lockstep.
+this module is the first-party TPU-native equivalent. Each game mirrors the
+native C++ pool's version (envs/native/cvec.cpp) RULE FOR RULE, so Sebulba
+(C++ pool actors) and Anakin (this env) train on the same game and a policy's
+scores transfer across backends; the equivalence is pinned by
+tests/test_minatar.py which steps both engines in lockstep.
 
-Game: 10x10 grid, 4 binary channels (paddle, ball, trail, brick), 3 actions
-(left/stay/right). Serve is from a top corner below the 3-row brick band,
-moving down-and-inward; bricks reflect the ball vertically and score +1;
-losing the ball past the paddle terminates. All state is fixed-shape int32
-arrays; stepping is pure jnp.where logic — no per-env Python.
+Breakout: 10x10 grid, 4 binary channels (paddle, ball, trail, brick),
+3 actions (left/stay/right). Serve is from a top corner below the 3-row brick
+band, moving down-and-inward; bricks reflect the ball vertically and score +1;
+losing the ball past the paddle terminates.
+
+Asterix: 10x10 grid, 4 channels (player, enemy, gold, moving-right), 5 actions
+(stay/left/up/right/down). Entities stream across rows 1..8 on a deterministic
+spawn schedule; touching gold scores +1, touching an enemy terminates.
+
+All state is fixed-shape int32 arrays; stepping is pure jnp.where logic — no
+per-env Python.
 """
 
 from __future__ import annotations
@@ -37,6 +43,9 @@ from stoix_tpu.envs.types import (
 _GRID = 10
 _BRICK_ROWS = 3
 _PADDLE_ROW = _GRID - 1
+_ASTERIX_SLOTS = 8
+_SPAWN_PERIOD = 5
+_MOVE_PERIOD = 2
 
 
 class BreakoutState(NamedTuple):
@@ -151,6 +160,159 @@ class Breakout(Environment):
             last_c=last_c,
             paddle=paddle,
             bricks=bricks,
+            step_count=state.step_count + 1,
+        )
+        obs = self._observe(next_state)
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts
+
+
+class AsterixState(NamedTuple):
+    key: jax.Array
+    player_r: jax.Array  # [] int32
+    player_c: jax.Array
+    active: jax.Array  # [8] int32 in {0, 1}
+    col: jax.Array  # [8] int32
+    dirn: jax.Array  # [8] int32 in {-1, +1}
+    gold: jax.Array  # [8] int32 in {0, 1}
+    spawn_count: jax.Array  # [] int32
+    t: jax.Array  # [] int32  (in-episode step index, drives the schedules)
+    step_count: jax.Array
+
+
+class Asterix(Environment):
+    """JAX twin of the native pool's Asterix-minatar (see module docstring).
+
+    Mirrors cvec.cpp AsterixVec rule for rule: the spawn schedule is
+    deterministic in (spawn_count, slot), so the two engines stay
+    bit-identical under lockstep with no shared RNG.
+    """
+
+    def __init__(self, max_steps: int = 500):
+        self._max_steps = int(max_steps)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((_GRID, _GRID, 4), jnp.float32),
+            action_mask=spaces.Array((5,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(5)
+
+    def _observe(self, state: AsterixState) -> Observation:
+        board = jnp.zeros((_GRID, _GRID, 4), jnp.float32)
+        board = board.at[state.player_r, state.player_c, 0].set(1.0)
+        rows = jnp.arange(_ASTERIX_SLOTS) + 1
+        live = state.active.astype(jnp.float32)
+        is_gold = state.gold.astype(jnp.float32)
+        board = board.at[rows, state.col, 1].max(live * (1.0 - is_gold))
+        board = board.at[rows, state.col, 2].max(live * is_gold)
+        board = board.at[rows, state.col, 3].max(live * (state.dirn > 0))
+        return Observation(
+            agent_view=board,
+            action_mask=jnp.ones((5,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[AsterixState, TimeStep]:
+        state = AsterixState(
+            key=key,
+            player_r=jnp.asarray(_GRID // 2, jnp.int32),
+            player_c=jnp.asarray(_GRID // 2, jnp.int32),
+            active=jnp.zeros((_ASTERIX_SLOTS,), jnp.int32),
+            col=jnp.zeros((_ASTERIX_SLOTS,), jnp.int32),
+            dirn=jnp.ones((_ASTERIX_SLOTS,), jnp.int32),
+            gold=jnp.zeros((_ASTERIX_SLOTS,), jnp.int32),
+            spawn_count=jnp.zeros((), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+            step_count=jnp.zeros((), jnp.int32),
+        )
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: AsterixState, action: jax.Array) -> Tuple[AsterixState, TimeStep]:
+        # Mirrors cvec.cpp AsterixVec::step_env exactly.
+        action = jnp.asarray(action, jnp.int32)
+        drs = jnp.array([0, 0, -1, 0, 1], jnp.int32)
+        dcs = jnp.array([0, -1, 0, 1, 0], jnp.int32)
+        player_r = jnp.clip(state.player_r + drs[action], 0, _GRID - 1)
+        player_c = jnp.clip(state.player_c + dcs[action], 0, _GRID - 1)
+
+        rows = jnp.arange(_ASTERIX_SLOTS) + 1
+
+        def collide(active, gold, reward, terminated):
+            on_player = jnp.logical_and(
+                active == 1,
+                jnp.logical_and(player_r == rows, player_c == state_col[0]),
+            )
+            got_gold = jnp.logical_and(on_player, gold == 1)
+            hit_enemy = jnp.any(jnp.logical_and(on_player, gold == 0))
+            reward = reward + jnp.sum(got_gold.astype(jnp.float32))
+            active = jnp.where(got_gold, 0, active)
+            terminated = jnp.logical_or(terminated, hit_enemy)
+            return active, reward, terminated
+
+        # collide() reads the CURRENT columns; use a one-element list so the
+        # closure sees updates as entities move.
+        state_col = [state.col]
+        active, gold, dirn = state.active, state.gold, state.dirn
+        reward = jnp.zeros((), jnp.float32)
+        terminated = jnp.zeros((), bool)
+
+        active, reward, terminated = collide(active, gold, reward, terminated)
+
+        # Entity movement every _MOVE_PERIOD steps.
+        move_now = state.t % _MOVE_PERIOD == 0
+        new_col = state_col[0] + dirn
+        off = jnp.logical_or(new_col < 0, new_col >= _GRID)
+        moved_col = jnp.where(move_now, new_col, state_col[0])
+        active = jnp.where(jnp.logical_and(move_now, off), 0, active)
+        state_col[0] = jnp.clip(moved_col, 0, _GRID - 1)
+        a2, r2, t2 = collide(active, gold, reward, terminated)
+        active = jnp.where(move_now, a2, active)
+        reward = jnp.where(move_now, r2, reward)
+        terminated = jnp.where(move_now, t2, terminated)
+
+        # Deterministic spawn schedule every _SPAWN_PERIOD steps.
+        spawn_now = state.t % _SPAWN_PERIOD == 0
+        slot = state.spawn_count % _ASTERIX_SLOTS
+        slot_free = active[slot] == 0
+        do_spawn = jnp.logical_and(spawn_now, slot_free)
+        new_dir = jnp.where((state.spawn_count // _ASTERIX_SLOTS + slot) % 2 == 0, 1, -1)
+        spawn_col = jnp.where(new_dir > 0, 0, _GRID - 1)
+        new_gold = jnp.where(state.spawn_count % 3 == 0, 1, 0)
+        active = active.at[slot].set(jnp.where(do_spawn, 1, active[slot]))
+        dirn = dirn.at[slot].set(jnp.where(do_spawn, new_dir, dirn[slot]))
+        state_col[0] = state_col[0].at[slot].set(
+            jnp.where(do_spawn, spawn_col, state_col[0][slot])
+        )
+        gold = gold.at[slot].set(jnp.where(do_spawn, new_gold, gold[slot]))
+        a3, r3, t3 = collide(active, gold, reward, terminated)
+        active = jnp.where(do_spawn, a3, active)
+        reward = jnp.where(do_spawn, r3, reward)
+        terminated = jnp.where(do_spawn, t3, terminated)
+
+        spawn_count = state.spawn_count + spawn_now.astype(jnp.int32)
+
+        next_state = AsterixState(
+            key=state.key,
+            player_r=player_r,
+            player_c=player_c,
+            active=active,
+            col=state_col[0],
+            dirn=dirn,
+            gold=gold,
+            spawn_count=spawn_count,
+            t=state.t + 1,
             step_count=state.step_count + 1,
         )
         obs = self._observe(next_state)

@@ -16,6 +16,10 @@
 //                         trail and brick channels, row bounce/break rules).
 //                         This is the Atari-class Sebulba workload: CNN-scale
 //                         observations from a C++ pool.
+//   "Asterix-minatar"   — 10x10x4 pixel observation, 5 actions: entities
+//                         stream across rows, gold +1 / enemies kill, on a
+//                         deterministic spawn schedule (lockstep-equal with
+//                         the JAX twin).
 //
 // Build: g++ -O3 -march=native -shared -fPIC cvec.cpp -o libcvec.so
 
@@ -258,10 +262,129 @@ struct BreakoutVec : VecEnv {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Asterix (MinAtar-class): 10x10 grid, 4 channels, 5 actions.
+//
+// Entities stream across rows 1..8 (one slot per row); gold scores +1 on
+// contact, enemies kill. The spawn schedule is DETERMINISTIC (slot/direction/
+// kind derived from a running counter) so the pure-JAX twin in
+// stoix_tpu/envs/minatar.py stays bit-identical under lockstep — game variety
+// comes from the entity pattern interacting with the agent's movement, not
+// from per-step RNG.
+// ---------------------------------------------------------------------------
+
+constexpr int kAsterixSlots = 8;      // rows 1..8
+constexpr int kSpawnPeriod = 5;       // spawn attempt every 5 steps
+constexpr int kMovePeriod = 2;        // entities advance every 2 steps
+
+struct AsterixVec : VecEnv {
+  struct EnvState {
+    int player_r, player_c;
+    uint8_t active[kAsterixSlots];
+    int col[kAsterixSlots];
+    int dir[kAsterixSlots];       // -1 or +1
+    uint8_t gold[kAsterixSlots];
+    int spawn_count;
+    int t;
+  };
+  std::vector<EnvState> envs;
+
+  AsterixVec(int n, int max_steps_, uint64_t seed)
+      : VecEnv(n, max_steps_, seed), envs(n) {}
+
+  int obs_dim() const override { return kGrid * kGrid * 4; }
+  void obs_shape(int32_t* out3) const override {
+    out3[0] = kGrid; out3[1] = kGrid; out3[2] = 4;
+  }
+  int num_actions() const override { return 5; }  // stay, left, up, right, down
+
+  void reset_env(int i) override {
+    EnvState& e = envs[i];
+    e.player_r = kGrid / 2;
+    e.player_c = kGrid / 2;
+    std::fill(e.active, e.active + kAsterixSlots, uint8_t{0});
+    std::fill(e.col, e.col + kAsterixSlots, 0);
+    std::fill(e.dir, e.dir + kAsterixSlots, 1);
+    std::fill(e.gold, e.gold + kAsterixSlots, uint8_t{0});
+    e.spawn_count = 0;
+    e.t = 0;
+  }
+
+  void write_obs(int i, float* out) const override {
+    const EnvState& e = envs[i];
+    std::memset(out, 0, sizeof(float) * obs_dim());
+    auto at = [&](int r, int c, int ch) -> float& {
+      return out[(r * kGrid + c) * 4 + ch];
+    };
+    at(e.player_r, e.player_c, 0) = 1.0f;
+    for (int s = 0; s < kAsterixSlots; ++s) {
+      if (!e.active[s]) continue;
+      const int r = s + 1;
+      at(r, e.col[s], e.gold[s] ? 2 : 1) = 1.0f;
+      if (e.dir[s] > 0) at(r, e.col[s], 3) = 1.0f;
+    }
+  }
+
+  float step_env(int i, int32_t action, bool* terminated) override {
+    EnvState& e = envs[i];
+    float reward = 0.0f;
+    *terminated = false;
+
+    // Player move: 0 stay, 1 left, 2 up, 3 right, 4 down (stays on rows 1..8
+    // only by bounds, walls clamp).
+    const int drs[5] = {0, 0, -1, 0, 1};
+    const int dcs[5] = {0, -1, 0, 1, 0};
+    e.player_r = std::clamp(e.player_r + drs[action], 0, kGrid - 1);
+    e.player_c = std::clamp(e.player_c + dcs[action], 0, kGrid - 1);
+
+    auto collide = [&]() {
+      for (int s = 0; s < kAsterixSlots; ++s) {
+        if (!e.active[s]) continue;
+        if (e.player_r == s + 1 && e.player_c == e.col[s]) {
+          if (e.gold[s]) {
+            reward += 1.0f;
+            e.active[s] = 0;
+          } else {
+            *terminated = true;
+          }
+        }
+      }
+    };
+    collide();  // player stepped onto an entity
+
+    // Entity movement every kMovePeriod steps.
+    if (e.t % kMovePeriod == 0) {
+      for (int s = 0; s < kAsterixSlots; ++s) {
+        if (!e.active[s]) continue;
+        e.col[s] += e.dir[s];
+        if (e.col[s] < 0 || e.col[s] >= kGrid) e.active[s] = 0;
+      }
+      collide();  // entity moved onto the player
+    }
+
+    // Deterministic spawn schedule.
+    if (e.t % kSpawnPeriod == 0) {
+      const int s = e.spawn_count % kAsterixSlots;
+      if (!e.active[s]) {
+        e.active[s] = 1;
+        e.dir[s] = ((e.spawn_count / kAsterixSlots + s) % 2 == 0) ? 1 : -1;
+        e.col[s] = e.dir[s] > 0 ? 0 : kGrid - 1;
+        e.gold[s] = (e.spawn_count % 3 == 0) ? 1 : 0;
+        collide();  // spawned under the player
+      }
+      e.spawn_count += 1;
+    }
+    e.t += 1;
+    return reward;
+  }
+};
+
 VecEnv* make_game(const char* task, int num_envs, int max_steps, uint64_t seed) {
   const std::string name(task ? task : "");
   if (name == "Breakout-minatar")
     return new BreakoutVec(num_envs, max_steps, seed);
+  if (name == "Asterix-minatar")
+    return new AsterixVec(num_envs, max_steps, seed);
   if (name == "CartPole-v1" || name.empty())
     return new CartPoleVec(num_envs, max_steps, seed);
   return nullptr;

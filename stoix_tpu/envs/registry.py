@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from stoix_tpu.envs import classic, debug, locomotion, minatar
+from stoix_tpu.envs import classic, debug, locomotion, minatar, snake
 from stoix_tpu.envs.core import Environment
 from stoix_tpu.envs.wrappers import EpisodeStepLimit, RecordEpisodeMetrics, apply_core_wrappers
 
@@ -24,6 +24,8 @@ ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
     "Catch-bsuite": classic.Catch,
     "Ant": locomotion.Ant,
     "Breakout-minatar": minatar.Breakout,
+    "Asterix-minatar": minatar.Asterix,
+    "Snake-v1": snake.Snake,
     "IdentityGame": debug.IdentityGame,
     "SequenceGame": debug.SequenceGame,
 }

@@ -1,0 +1,186 @@
+"""Snake — first-party pure-JAX grid game (Jumanji Snake-v1 class).
+
+The driver's BASELINE tracks ff_dqn + ff_c51 on Jumanji Snake
+(reference configs/env/jumanji/snake.yaml, observation_attribute "grid");
+this module is the no-dependency equivalent so the tracked config runs
+first-party. Semantics follow the Jumanji game: a snake moves on a
+num_rows x num_cols grid, eating fruit grows it by one and scores +1;
+hitting a wall or its own body ends the episode.
+
+TPU-first design: the body is a fixed-size position buffer [max_len, 2]
+ordered head-first with an explicit length counter — every step is a static
+shift/scatter over that buffer (144 cells; pure VPU work that fuses into the
+rollout scan). Fruit respawn samples a categorical over the flattened grid
+with occupied cells masked to -inf, so respawn never lands on the snake and
+needs no rejection loop.
+
+Observation (jumanji-like "grid" rendering): [rows, cols, 5] float32 channels
+    0: body (excluding head)   1: head   2: tail   3: fruit
+    4: whole-snake occupancy scaled by body order (head=1 -> tail->0)
+Action space: Discrete(4) = up/right/down/left; the action mask excludes the
+direct reverse of the current heading (stepping into the neck).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.types import Observation, TimeStep, restart, select_step, termination, transition, truncation
+
+# Row/col deltas for up, right, down, left.
+_DELTAS = jnp.array([[-1, 0], [0, 1], [1, 0], [0, -1]], jnp.int32)
+
+
+class SnakeState(NamedTuple):
+    key: jax.Array
+    body: jax.Array  # [max_len, 2] positions, head first; rows beyond length unused
+    length: jax.Array  # [] int32
+    heading: jax.Array  # [] int32, last action direction
+    fruit: jax.Array  # [2] int32
+    step_count: jax.Array  # [] int32
+
+
+class Snake(Environment):
+    def __init__(self, num_rows: int = 12, num_cols: int = 12, max_steps: int = 500):
+        self._rows = int(num_rows)
+        self._cols = int(num_cols)
+        self._max_len = self._rows * self._cols
+        self._max_steps = int(max_steps)
+
+    # ------------------------------------------------------------------ spaces
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((self._rows, self._cols, 5), jnp.float32),
+            action_mask=spaces.Array((4,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(4)
+
+    # ------------------------------------------------------------------ helpers
+    def _occupancy_mask(self, state: SnakeState) -> jax.Array:
+        """[max_len] bool: which body rows hold live segments."""
+        return jnp.arange(self._max_len) < state.length
+
+    def _grid_obs(self, state: SnakeState) -> Observation:
+        live = self._occupancy_mask(state)
+        flat_idx = state.body[:, 0] * self._cols + state.body[:, 1]  # [max_len]
+        n_cells = self._rows * self._cols
+
+        def paint(values: jax.Array) -> jax.Array:
+            cells = jnp.zeros((n_cells,), jnp.float32).at[flat_idx].max(values)
+            return cells.reshape(self._rows, self._cols)
+
+        live_f = live.astype(jnp.float32)
+        head_onehot = jnp.zeros((self._max_len,), jnp.float32).at[0].set(1.0)
+        tail_idx = jnp.maximum(state.length - 1, 0)
+        tail_onehot = jnp.zeros((self._max_len,), jnp.float32).at[tail_idx].set(1.0) * live_f
+        # Body order channel: head 1.0 decaying linearly toward the tail.
+        order = (1.0 - jnp.arange(self._max_len) / self._max_len) * live_f
+
+        body_wo_head = paint(live_f * (1.0 - head_onehot))
+        head = paint(head_onehot)
+        tail = paint(tail_onehot)
+        fruit = jnp.zeros((n_cells,), jnp.float32).at[
+            state.fruit[0] * self._cols + state.fruit[1]
+        ].set(1.0).reshape(self._rows, self._cols)
+        order_grid = paint(order)
+
+        view = jnp.stack([body_wo_head, head, tail, fruit, order_grid], axis=-1)
+        # Mask out the reverse of the current heading (stepping into the neck).
+        reverse = (state.heading + 2) % 4
+        mask = jnp.ones((4,), jnp.float32).at[reverse].set(
+            jnp.where(state.length > 1, 0.0, 1.0)
+        )
+        return Observation(agent_view=view, action_mask=mask, step_count=state.step_count)
+
+    def _spawn_fruit(self, key: jax.Array, body: jax.Array, length: jax.Array) -> jax.Array:
+        n_cells = self._rows * self._cols
+        flat_idx = body[:, 0] * self._cols + body[:, 1]
+        live = jnp.arange(self._max_len) < length
+        occupied = jnp.zeros((n_cells,), bool).at[flat_idx].max(live)
+        logits = jnp.where(occupied, -jnp.inf, 0.0)
+        cell = jax.random.categorical(key, logits)
+        return jnp.stack([cell // self._cols, cell % self._cols]).astype(jnp.int32)
+
+    # ------------------------------------------------------------------ api
+    def reset(self, key: jax.Array) -> Tuple[SnakeState, TimeStep]:
+        key, pos_key, fruit_key = jax.random.split(key, 3)
+        # Random head cell; snake starts at length 1 heading right (jumanji
+        # starts from a random position).
+        cell = jax.random.randint(pos_key, (), 0, self._rows * self._cols)
+        head = jnp.stack([cell // self._cols, cell % self._cols]).astype(jnp.int32)
+        body = jnp.zeros((self._max_len, 2), jnp.int32).at[0].set(head)
+        length = jnp.ones((), jnp.int32)
+        fruit = self._spawn_fruit(fruit_key, body, length)
+        state = SnakeState(
+            key=key,
+            body=body,
+            length=length,
+            heading=jnp.ones((), jnp.int32),  # right
+            fruit=fruit,
+            step_count=jnp.zeros((), jnp.int32),
+        )
+        ts = restart(self._grid_obs(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: SnakeState, action: jax.Array) -> Tuple[SnakeState, TimeStep]:
+        action = jnp.asarray(action, jnp.int32)
+        # Reversing with a body is stepping into the neck -> handled by the
+        # self-collision test naturally (new head == body[1]).
+        new_head = state.body[0] + _DELTAS[action]
+
+        out_of_bounds = jnp.logical_or(
+            jnp.logical_or(new_head[0] < 0, new_head[0] >= self._rows),
+            jnp.logical_or(new_head[1] < 0, new_head[1] >= self._cols),
+        )
+        ate = jnp.all(new_head == state.fruit)
+        new_length = state.length + ate.astype(jnp.int32)
+
+        # The tail cell vacates unless we grew this step, so moving onto the
+        # current tail is legal when not eating (jumanji semantics).
+        live = self._occupancy_mask(state)
+        is_tail = jnp.arange(self._max_len) == (state.length - 1)
+        blocking = jnp.logical_and(live, jnp.logical_or(~is_tail, ate))
+        hits_body = jnp.any(
+            jnp.logical_and(blocking, jnp.all(state.body == new_head, axis=-1))
+        )
+        died = jnp.logical_or(out_of_bounds, hits_body)
+
+        # Shift the body: new head at row 0, previous segments slide down.
+        shifted = jnp.roll(state.body, 1, axis=0).at[0].set(new_head)
+
+        key, fruit_key = jax.random.split(state.key)
+        new_fruit = jnp.where(
+            ate, self._spawn_fruit(fruit_key, shifted, new_length), state.fruit
+        )
+
+        next_state = SnakeState(
+            key=key,
+            body=shifted,
+            length=new_length,
+            heading=action,
+            fruit=new_fruit,
+            step_count=state.step_count + 1,
+        )
+        reward = ate.astype(jnp.float32)
+        obs = self._grid_obs(next_state)
+        full = next_state.length >= self._max_len
+        terminated = jnp.logical_or(died, full)
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        # ate and died are mutually exclusive (fruit never spawns on the body),
+        # so the terminal reward is correct in both cases.
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts

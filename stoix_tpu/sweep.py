@@ -74,6 +74,16 @@ def sample_point(space: Dict[str, Tuple[str, list]], rng: random.Random) -> Dict
     return point
 
 
+def _finite_score(r: Dict[str, Any]) -> float:
+    """NaN scores (diverged trials) rank BELOW every finite score — a NaN sort
+    key would otherwise scramble the good/bad split and could even surface the
+    diverged trial as 'best'."""
+    import math
+
+    s = float(r["score"])
+    return s if math.isfinite(s) else -math.inf
+
+
 def _parzen_logpdf(x: float, centers: List[float], sigma: float) -> float:
     import math
 
@@ -98,7 +108,7 @@ def tpe_next_point(
 
     if len(history) < n_startup:
         return sample_point(space, rng)
-    ranked = sorted(history, key=lambda r: -r["score"])
+    ranked = sorted(history, key=lambda r: -_finite_score(r))
     n_good = max(1, int(len(ranked) * gamma))
     good, bad = ranked[:n_good], ranked[n_good:] or ranked[:n_good]
 
@@ -174,7 +184,7 @@ def run_sweep(
         results.append({"trial": i, "params": point, "score": float(score)})
         print(json.dumps(results[-1]), flush=True)
 
-    best = max(results, key=lambda r: r["score"])
+    best = max(results, key=_finite_score)
     print(json.dumps({"best": best}), flush=True)
     return best
 

@@ -16,7 +16,10 @@ from typing import Any, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
-CHECKPOINTER_VERSION = 1.0
+# 2.0: continuous MPO/V-MPO dual variables changed shape from (2,) to
+# [2, action_dim] (per-dimension KL constraints) — old checkpoints cannot
+# restore into the new template.
+CHECKPOINTER_VERSION = 2.0
 
 
 class Checkpointer:

@@ -56,3 +56,29 @@ def test_tpe_concentrates_on_optimum():
 
     best = max(history, key=lambda r: r["score"])
     assert abs(math.log10(best["params"]["system.lr"]) + 3.0) < 0.5
+
+
+def test_tpe_nan_scores_rank_last():
+    from stoix_tpu.sweep import _finite_score
+
+    space = parse_space(["system.lr=loguniform:1e-5,1e-1"])
+    rng = random.Random(2)
+    history = [
+        {"trial": 0, "params": {"system.lr": 1e-2}, "score": float("nan")},
+        {"trial": 1, "params": {"system.lr": 1e-3}, "score": 1.0},
+        {"trial": 2, "params": {"system.lr": 1e-4}, "score": 0.5},
+        {"trial": 3, "params": {"system.lr": 3e-3}, "score": 0.8},
+        {"trial": 4, "params": {"system.lr": 3e-4}, "score": 0.2},
+        {"trial": 5, "params": {"system.lr": 1e-5}, "score": 0.1},
+    ]
+    assert _finite_score(history[0]) == float("-inf")
+    # The NaN trial must rank LAST (never entering the top-gamma "good" set)
+    # and must never be selected as best.
+    ranked = sorted(history, key=lambda r: -_finite_score(r))
+    assert ranked[0]["trial"] == 1 and ranked[-1]["trial"] == 0
+    assert max(history, key=_finite_score)["trial"] == 1
+    # Proposals still work with a NaN in the history (no exception, in-range
+    # up to exp/log round-trip error at the bounds).
+    for _ in range(5):
+        p = tpe_next_point(space, history, rng, n_startup=3)
+        assert 1e-5 * (1 - 1e-9) <= p["system.lr"] <= 1e-1 * (1 + 1e-9)
