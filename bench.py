@@ -45,13 +45,16 @@ def main() -> None:
     import threading
 
     def _fail(reason: str) -> None:
+        # Structured failure, rc 0: the contract is ONE JSON line, never a
+        # traceback — the zero value + reason string in `unit` mark the
+        # failure; a nonzero rc would read as "no result at all".
         print(
             json.dumps(
                 {"metric": metric, "value": 0.0, "unit": reason, "vs_baseline": 0.0}
             ),
             flush=True,
         )
-        os._exit(2)
+        os._exit(0)
 
     watchdog = threading.Timer(180.0, _fail, args=("TIMEOUT: backend init/probe unresponsive",))
     watchdog.daemon = True

@@ -81,8 +81,11 @@ class Snake(Environment):
         head_onehot = jnp.zeros((self._max_len,), jnp.float32).at[0].set(1.0)
         tail_idx = jnp.maximum(state.length - 1, 0)
         tail_onehot = jnp.zeros((self._max_len,), jnp.float32).at[tail_idx].set(1.0) * live_f
-        # Body order channel: head 1.0 decaying linearly toward the tail.
-        order = (1.0 - jnp.arange(self._max_len) / self._max_len) * live_f
+        # Body order channel: head 1.0 decaying linearly along the CURRENT
+        # body (tail -> 1/length), so the ordering gradient spans the full
+        # channel range regardless of snake size.
+        length_f = jnp.maximum(state.length, 1).astype(jnp.float32)
+        order = (1.0 - jnp.arange(self._max_len) / length_f) * live_f
 
         body_wo_head = paint(live_f * (1.0 - head_onehot))
         head = paint(head_onehot)
@@ -133,8 +136,11 @@ class Snake(Environment):
 
     def step(self, state: SnakeState, action: jax.Array) -> Tuple[SnakeState, TimeStep]:
         action = jnp.asarray(action, jnp.int32)
-        # Reversing with a body is stepping into the neck -> handled by the
-        # self-collision test naturally (new head == body[1]).
+        # Reversing: at length >= 3 the neck (body[1]) blocks and the snake
+        # dies via the self-collision test below. At length 2 the "neck" IS
+        # the vacating tail, so a reversal is a legal head/tail swap — the
+        # action mask (reverse excluded when length > 1) is what discourages
+        # it for mask-respecting policies.
         new_head = state.body[0] + _DELTAS[action]
 
         out_of_bounds = jnp.logical_or(
