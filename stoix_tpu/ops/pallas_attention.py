@@ -1,0 +1,172 @@
+"""Pallas TPU flash attention — the fused hot-op behind the transformer torso.
+
+The pure-JAX `full_attention` (ops/ring_attention.py) materializes the full
+[S, S] score matrix in HBM; XLA fuses some of it but the memory traffic still
+scales O(S^2). This kernel runs the online-softmax recurrence entirely in
+VMEM: each grid step holds one query block plus one (batch*head)'s K/V in
+VMEM, streams K/V blocks through the MXU, and never writes scores to HBM —
+attention becomes compute-bound on the MXU instead of HBM-bandwidth-bound.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md):
+  - grid = (B*H, ceil(S / block_q)); one kernel instance owns one query block;
+  - K/V for the (b, h) slice live in VMEM whole (S×D ≤ ~2 MB at S=8192, D=64,
+    bf16) and are walked with `pl.ds` dynamic slices, block_k at a time;
+  - accumulators (m, l, acc) are fp32 regardless of input dtype; all matmuls
+    request `preferred_element_type=float32` so bf16 inputs still accumulate
+    in fp32 on the MXU;
+  - sequence padding to the block size is masked with statically-known
+    lengths; causal masking uses 2-D `broadcasted_iota` (TPU needs ≥2-D iota).
+
+`flash_attention` is a drop-in for `full_attention` ([B, S, H, D] in/out) and
+is the default `attention_fn` for the transformer torso on TPU; on non-TPU
+backends it falls back to the pure-JAX path (the Pallas interpreter is
+orders of magnitude slower than XLA's fused attention on CPU, so the
+fallback — not interpret mode — is the portable path; tests force interpret
+mode explicitly to validate the kernel itself).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from stoix_tpu.ops.ring_attention import full_attention
+
+_NEG_INF = float("-inf")
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int, causal: bool, kv_len: int
+):
+    block_q, head_dim = q_ref.shape
+    s_pad = k_ref.shape[0]
+    num_kv_blocks = s_pad // block_k
+
+    q = q_ref[:].astype(jnp.float32) * scale  # [Bq, D]
+    q_block_idx = pl.program_id(1)
+    q_pos = q_block_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    def body(j, carry):
+        m_acc, l_acc, acc = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bq, Bk]
+
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < kv_len  # strip the padded tail
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        m_blk = jnp.max(scores, axis=-1, keepdims=True)  # [Bq, 1]
+        m_new = jnp.maximum(m_acc, m_blk)
+        # Rows with nothing unmasked yet keep -inf; exp(-inf - -inf) is NaN,
+        # so shift by a finite proxy and zero the weights via the mask.
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - m_safe)  # [Bq, Bk]
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_safe), 0.0)
+        l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bq, D]
+        acc_new = acc * alpha + pv
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Blocks fully in the future contribute nothing; bound the walk at the
+        # last block that can contain key ≤ the block's max query position.
+        last = jnp.minimum(
+            (q_block_idx * block_q + block_q + block_k - 1) // block_k,
+            num_kv_blocks,
+        )
+    else:
+        last = num_kv_blocks
+    m_acc, l_acc, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def _pad_axis(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused online-softmax attention. [B, S, H, D] -> [B, S, H, D].
+
+    Self-attention shapes only (q and k share a sequence length). `interpret`
+    runs the Pallas interpreter (slow; for tests/debugging off-TPU).
+    """
+    b, s, h, d = q.shape
+    scale = d**-0.5
+
+    # [B, S, H, D] -> [B*H, S, D]
+    def fold(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf = _pad_axis(qf, 1, block_q)
+    kf = _pad_axis(kf, 1, block_k)
+    vf = _pad_axis(vf, 1, block_k)
+    s_q_pad, s_kv_pad = qf.shape[1], kf.shape[1]
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_k=block_k, causal=causal, kv_len=s
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s_kv_pad, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s_kv_pad, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q_pad, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :s]  # strip query padding
+    return jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
+def best_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False):
+    """Backend dispatch: the Pallas kernel on TPU, pure-JAX elsewhere."""
+    if jax.default_backend() == "tpu":
+        return flash_attention(q, k, v, causal=causal)
+    return full_attention(q, k, v, causal=causal)

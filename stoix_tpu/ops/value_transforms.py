@@ -67,3 +67,53 @@ def transformed_n_step_q_learning_td(
     targets = tx_pair.apply(targets)
     qa_tm1 = jnp.take_along_axis(q_tm1, a_tm1[:, None], axis=-1)[:, 0]
     return jax.lax.stop_gradient(targets) - qa_tm1[:-1]
+
+
+class CategoricalTxPair(NamedTuple):
+    """Scalar <-> categorical transform pair for distributional MuZero heads.
+
+    `apply` maps raw scalars to two-hot probability vectors over a fixed atom
+    support laid out in TRANSFORMED space; `apply_inv` maps logits back to raw
+    scalars via the support expectation. Native replacement for
+    rlax.muzero_pair as used at reference stoix/systems/search/ff_mz.py:537.
+    """
+
+    apply: Callable[[Array], Array]
+    apply_inv: Callable[[Array], Array]
+    num_atoms: int
+
+
+def twohot(x: Array, atoms: Array) -> Array:
+    """Project scalars [...] onto probs [..., N] over a uniform atom support:
+    each scalar becomes weight split between its two neighbouring atoms."""
+    vmin, vmax = atoms[0], atoms[-1]
+    step = (vmax - vmin) / (atoms.shape[0] - 1)
+    x = jnp.clip(x, vmin, vmax)
+    pos = (x - vmin) / step
+    low = jnp.clip(jnp.floor(pos), 0, atoms.shape[0] - 1)
+    up_w = pos - low
+    low = low.astype(jnp.int32)
+    high = jnp.clip(low + 1, 0, atoms.shape[0] - 1)
+    one_hot_low = jax.nn.one_hot(low, atoms.shape[0])
+    one_hot_high = jax.nn.one_hot(high, atoms.shape[0])
+    return one_hot_low * (1.0 - up_w[..., None]) + one_hot_high * up_w[..., None]
+
+
+def muzero_pair(
+    num_atoms: int = 601,
+    vmin: float = -300.0,
+    vmax: float = 300.0,
+    tx_pair: TxPair = SIGNED_HYPERBOLIC_PAIR,
+) -> CategoricalTxPair:
+    """Categorical value/reward codec: scalar -> tx -> two-hot over the support
+    (training target); logits -> softmax expectation -> tx^-1 (scalar read)."""
+    atoms = jnp.linspace(vmin, vmax, num_atoms)
+
+    def apply(scalar: Array) -> Array:
+        return twohot(tx_pair.apply(scalar), atoms)
+
+    def apply_inv(logits: Array) -> Array:
+        probs = jax.nn.softmax(logits, axis=-1)
+        return tx_pair.apply_inv(jnp.sum(probs * atoms, axis=-1))
+
+    return CategoricalTxPair(apply=apply, apply_inv=apply_inv, num_atoms=num_atoms)

@@ -172,19 +172,16 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         actor_optim.init(actor_params), critic_optim.init(critic_params)
     )
 
-    n_shards = int(mesh.shape["data"])
-    update_batch = int(config.arch.get("update_batch_size", 1))
-    local_envs = int(config.arch.total_num_envs) // (n_shards * update_batch)
     discrete = not hasattr(env.action_space(), "low")
+    local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+        config, mesh, 2 * int(config.system.rollout_length)
+    )
     buffer = make_trajectory_buffer(
         add_batch_size=local_envs,
-        sample_batch_size=max(1, int(config.system.total_batch_size) // (n_shards * update_batch)),
+        sample_batch_size=sample_batch,
         sample_sequence_length=int(config.system.get("sample_sequence_length", 8)),
         period=int(config.system.get("sample_period", 1)),
-        max_length_time_axis=max(
-            int(config.system.total_buffer_size) // (n_shards * update_batch * local_envs),
-            2 * int(config.system.rollout_length),
-        ),
+        max_length_time_axis=max_length,
     )
     dummy_item = {
         "obs": env.observation_value(),
@@ -202,17 +199,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         config, mesh, env, params, opt_states, buffer_state, key, env_key
     )
 
-    def per_shard_learn(state):
-        squeezed = state._replace(
-            buffer_state=jax.tree.map(lambda x: x[0], state.buffer_state)
-        )
-        out = learn_per_shard(squeezed)
-        new_state = out.learner_state._replace(
-            buffer_state=jax.tree.map(lambda x: x[None], out.learner_state.buffer_state)
-        )
-        return out._replace(learner_state=new_state)
-
-    learn = anakin.shardmap_learner(per_shard_learn, mesh, state_specs)
+    learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
 
     return AnakinSetup(
         learn=learn,

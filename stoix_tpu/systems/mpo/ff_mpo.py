@@ -29,6 +29,7 @@ from stoix_tpu.ops import distributions as dists
 from stoix_tpu.ops.multistep import retrace_continuous
 from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.mpo.ff_vmpo import (
+    decomposed_dists,
     decoupled_alpha_losses,
     gaussian_kls_per_dim,
     gaussian_params,
@@ -146,8 +147,17 @@ def get_learner_fn(env, networks, update_fns, buffer, config, continuous: bool):
             temperature_loss = eta * eps_eta + eta * jnp.mean(
                 jax.nn.logsumexp(q_vals / eta, axis=0) - jnp.log(float(num_samples))
             )
-            log_probs = jax.vmap(online_dist.log_prob)(actions)  # [N,B]
-            policy_loss = -jnp.mean(jnp.sum(jax.lax.stop_gradient(weights) * log_probs, axis=0))
+            # Decomposed M-step (reference continuous_loss.py:232-256): the
+            # mean learns through a distribution borrowing the TARGET's
+            # stddev, the stddev through one borrowing the TARGET's mean —
+            # two cross-entropy losses instead of one.
+            fixed_std, fixed_mean = decomposed_dists(target_dist, online_dist)
+            lp_mean = jax.vmap(fixed_std.log_prob)(actions)  # [N,B]
+            lp_std = jax.vmap(fixed_mean.log_prob)(actions)  # [N,B]
+            w = jax.lax.stop_gradient(weights)
+            policy_loss = -jnp.mean(jnp.sum(w * lp_mean, axis=0)) - jnp.mean(
+                jnp.sum(w * lp_std, axis=0)
+            )
 
             b_loc, b_scale = gaussian_params(target_dist)
             o_loc, o_scale = gaussian_params(online_dist)
@@ -321,18 +331,15 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         dual_optim.init((log_temperature, log_alpha)),
     )
 
-    n_shards = int(mesh.shape["data"])
-    update_batch = int(config.arch.get("update_batch_size", 1))
-    local_envs = int(config.arch.total_num_envs) // (n_shards * update_batch)
+    local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+        config, mesh, 2 * int(config.system.rollout_length)
+    )
     buffer = make_trajectory_buffer(
         add_batch_size=local_envs,
-        sample_batch_size=max(1, int(config.system.total_batch_size) // (n_shards * update_batch)),
+        sample_batch_size=sample_batch,
         sample_sequence_length=int(config.system.get("sample_sequence_length", 8)),
         period=int(config.system.get("sample_period", 1)),
-        max_length_time_axis=max(
-            int(config.system.total_buffer_size) // (n_shards * update_batch * local_envs),
-            2 * int(config.system.rollout_length),
-        ),
+        max_length_time_axis=max_length,
     )
     dummy_item = {
         "obs": env.observation_value(),
@@ -354,17 +361,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         config, mesh, env, params, opt_states, buffer_state, key, env_key
     )
 
-    def per_shard_learn(state):
-        squeezed = state._replace(
-            buffer_state=jax.tree.map(lambda x: x[0], state.buffer_state)
-        )
-        out = learn_per_shard(squeezed)
-        new_state = out.learner_state._replace(
-            buffer_state=jax.tree.map(lambda x: x[None], out.learner_state.buffer_state)
-        )
-        return out._replace(learner_state=new_state)
-
-    learn = anakin.shardmap_learner(per_shard_learn, mesh, state_specs)
+    learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
 
     return AnakinSetup(
         learn=learn,

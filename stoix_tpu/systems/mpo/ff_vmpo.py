@@ -3,6 +3,13 @@
 learnable temperature (eta) dual, M-step maximizes weighted log-likelihood
 under a KL trust region enforced by a learnable alpha dual (decoupled
 mean/stddev alphas for Gaussian policies, reference mpo_types.py:23-31).
+
+The policy that ACTS is a slow-moving TARGET actor, refreshed from the online
+actor every `actor_target_period` SGD steps (reference ff_vmpo.py:77 "We act
+with target params in VMPO", :270-276 periodic_update). The KL trust region
+is KL(target || online), so the online policy can take many small steps away
+from a fixed anchor before the anchor jumps — this is what makes V-MPO's
+16-epoch reuse of each rollout stable.
 """
 
 from __future__ import annotations
@@ -15,7 +22,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from stoix_tpu import envs
-from stoix_tpu.base_types import ExperimentOutput, OnPolicyLearnerState
+from stoix_tpu.base_types import ExperimentOutput, OnlineAndTarget, OnPolicyLearnerState
 from stoix_tpu.evaluator import get_distribution_act_fn
 from stoix_tpu.ops import distributions as dists
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
@@ -27,10 +34,11 @@ from stoix_tpu.utils.training import make_learning_rate
 
 
 class VMPOParams(NamedTuple):
-    actor_params: Any
+    actor_params: Any  # OnlineAndTarget — acting + the KL anchor use .target
     critic_params: Any
     log_temperature: jax.Array  # eta dual
     log_alpha: jax.Array  # KL dual (scalar for categorical; [2] mean/std for Gaussian)
+    step_count: jax.Array  # SGD steps taken, drives the periodic target refresh
 
 
 class VMPOOptStates(NamedTuple):
@@ -86,6 +94,29 @@ def gaussian_kls_per_dim(b_loc, b_scale, o_loc, o_scale):
     return jnp.mean(kl_mean, axis=reduce_dims), jnp.mean(kl_std, axis=reduce_dims)
 
 
+def decomposed_dists(target_dist, online_dist):
+    """Fixed-stddev / fixed-mean decompositions of the online Gaussian policy.
+
+    The reference's continuous M-step (continuous_loss.py:232-252) updates the
+    mean through a distribution that borrows the TARGET's stddev, and the
+    stddev through one that borrows the TARGET's mean — decoupling the two
+    gradient paths (Abdolmaleki et al.). Returns (fixed_stddev, fixed_mean)
+    distributions matching the policy family (squashed TanhNormal or raw
+    diagonal Gaussian)."""
+    b_loc, b_scale = gaussian_params(target_dist)
+    o_loc, o_scale = gaussian_params(online_dist)
+    inner = getattr(target_dist, "distribution", target_dist)
+    if hasattr(inner, "base"):  # TanhNormal: rebuild with the same affine range
+        minimum = inner._shift - inner._scale
+        maximum = inner._shift + inner._scale
+        fixed_std = dists.Independent(dists.TanhNormal(o_loc, b_scale, minimum, maximum), 1)
+        fixed_mean = dists.Independent(dists.TanhNormal(b_loc, o_scale, minimum, maximum), 1)
+    else:
+        fixed_std = dists.MultivariateNormalDiag(o_loc, b_scale)
+        fixed_mean = dists.MultivariateNormalDiag(b_loc, o_scale)
+    return fixed_std, fixed_mean
+
+
 def init_log_duals(config, continuous: bool, act_dim: int):
     """(log_temperature, log_alpha) initial values shared by MPO and V-MPO.
 
@@ -134,15 +165,10 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
     def _env_step(learner_state: OnPolicyLearnerState, _):
         params, opt_states, key, env_state, last_timestep = learner_state
         key, act_key = jax.random.split(key)
-        dist = actor_apply(params.actor_params, last_timestep.observation)
+        # Act with the TARGET actor (reference ff_vmpo.py:77).
+        dist = actor_apply(params.actor_params.target, last_timestep.observation)
         action = dist.sample(seed=act_key)
         env_state, timestep = env.step(env_state, action)
-        # Behavior-policy stats for the KL trust region.
-        if continuous:
-            b_loc, b_scale = gaussian_params(dist)
-            behavior = {"loc": b_loc, "scale": b_scale}
-        else:
-            behavior = {"logits": dist.logits}
         data = {
             "obs": last_timestep.observation,
             "action": action,
@@ -151,17 +177,17 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
             "truncated": jnp.logical_and(timestep.last(), timestep.discount != 0.0),
             "next_obs": timestep.extras["next_obs"],
             "info": timestep.extras["episode_metrics"],
-            "behavior": behavior,
         }
         return OnPolicyLearnerState(params, opt_states, key, env_state, timestep), data
 
-    def _loss_fn(learnable, traj, advantages):
+    def _loss_fn(learnable, target_actor_params, traj, advantages):
         actor_params, log_temperature, log_alpha = learnable
         eta = _softplus(log_temperature)
 
         flat = tree_merge_leading_dims((traj, advantages), 2)
         traj_f, adv = flat
         dist = actor_apply(actor_params, traj_f["obs"])
+        target_dist = actor_apply(target_actor_params, traj_f["obs"])
         log_prob = dist.log_prob(traj_f["action"])
 
         # E-step: top-half advantages only (the V-MPO distinctive).
@@ -182,10 +208,11 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
         # gradients into the temperature dual (reference continuous_loss.py:54).
         policy_loss = -jnp.sum(jax.lax.stop_gradient(weights) * log_prob[top_idx])
 
-        # KL trust region to the behavior policy.
+        # KL trust region to the slow-moving TARGET policy (reference
+        # ff_vmpo.py:136-141 — kl = target.kl_divergence(online)).
         if continuous:
             o_loc, o_scale = gaussian_params(dist)
-            b_loc, b_scale = traj_f["behavior"]["loc"], traj_f["behavior"]["scale"]
+            b_loc, b_scale = gaussian_params(target_dist)
             # Decoupled per-dimension mean/stddev KLs with per-dimension
             # alpha duals [2, A] (reference continuous_loss.py,
             # per_dim_constraining=True).
@@ -194,7 +221,7 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
                 log_alpha, kl_mean, kl_std, eps_alpha_mean, eps_alpha_stddev
             )
         else:
-            behavior = dists.Categorical(traj_f["behavior"]["logits"])
+            behavior = dists.Categorical(jax.lax.stop_gradient(target_dist.logits))
             kl = jnp.mean(behavior.kl_divergence(dist))
             alpha = _softplus(log_alpha)
             alpha_loss = jnp.sum(alpha * (eps_alpha - jax.lax.stop_gradient(kl)))
@@ -211,9 +238,10 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
 
     def _update_epoch(carry, _):
         # One full-batch pass over the rollout. Multiple epochs re-use the
-        # trajectory (reference ff_vmpo epochs=16); the recorded behavior
-        # stats keep the KL trust region anchored at the rollout policy, and
-        # advantages are recomputed as the critic improves.
+        # trajectory (reference ff_vmpo epochs=16); the KL trust region is
+        # anchored at the slow-moving target actor (refreshed every
+        # actor_target_period SGD steps below), and advantages are recomputed
+        # as the critic improves.
         params, opt_states, traj = carry
 
         v_tm1 = critic_apply(params.critic_params, traj["obs"])
@@ -227,8 +255,10 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
             truncation_t=traj["truncated"].astype(jnp.float32),
         )
 
-        learnable = (params.actor_params, params.log_temperature, params.log_alpha)
-        grads, metrics = jax.grad(_loss_fn, has_aux=True)(learnable, traj, advantages)
+        learnable = (params.actor_params.online, params.log_temperature, params.log_alpha)
+        grads, metrics = jax.grad(_loss_fn, has_aux=True)(
+            learnable, params.actor_params.target, traj, advantages
+        )
 
         def critic_loss_fn(critic_params):
             v = critic_apply(critic_params, traj["obs"])
@@ -244,7 +274,7 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
         actor_grads, temp_grads, alpha_grads = grads
 
         a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
-        actor_params = optax.apply_updates(params.actor_params, a_updates)
+        actor_online = optax.apply_updates(params.actor_params.online, a_updates)
         c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
         critic_params = optax.apply_updates(params.critic_params, c_updates)
         d_updates, d_opt = dual_update(
@@ -255,7 +285,18 @@ def get_learner_fn(env, apply_fns, update_fns, config, continuous: bool):
         )
         log_temperature, log_alpha = project_duals(log_temperature, log_alpha)
 
-        params = VMPOParams(actor_params, critic_params, log_temperature, log_alpha)
+        # Refresh the acting/KL-anchor target every actor_target_period SGD
+        # steps (reference ff_vmpo.py:270-276 optax.periodic_update).
+        step_count = params.step_count + 1
+        actor_target = optax.periodic_update(
+            actor_online, params.actor_params.target, step_count,
+            int(config.system.get("actor_target_period", 50)),
+        )
+
+        params = VMPOParams(
+            OnlineAndTarget(actor_online, actor_target), critic_params,
+            log_temperature, log_alpha, step_count,
+        )
         opt_states = VMPOOptStates(a_opt, c_opt, d_opt)
         return (params, opt_states, traj), {**metrics, **critic_metrics}
 
@@ -325,7 +366,10 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     actor_params = actor_network.init(actor_key, dummy_obs)
     critic_params = critic_network.init(critic_key, dummy_obs)
     log_temperature, log_alpha = init_log_duals(config, continuous, int(env.num_actions))
-    params = VMPOParams(actor_params, critic_params, log_temperature, log_alpha)
+    params = VMPOParams(
+        OnlineAndTarget(actor_params, actor_params), critic_params,
+        log_temperature, log_alpha, jnp.zeros((), jnp.int32),
+    )
     opt_states = VMPOOptStates(
         actor_optim.init(actor_params),
         critic_optim.init(critic_params),
@@ -357,7 +401,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         learn=learn,
         learner_state=learner_state,
         eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
-        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params.online),
     )
 
 

@@ -129,13 +129,40 @@ def assemble_off_policy_state(
     return anakin.place_learner_state(learner_state, mesh, state_specs), state_specs
 
 
-def wrap_learn_and_warmup(
+def trajectory_buffer_sizing(
+    config: Any, mesh: Mesh, min_length_time_axis: int
+) -> Tuple[int, int, int]:
+    """Per-shard trajectory-buffer sizes from the GLOBAL config totals.
+
+    Returns (local_envs, sample_batch_size, max_length_time_axis): the
+    global env/batch/buffer totals divided over data shards × update batch
+    (reference ff_dqn.py:325-338 divides per device the same way). Shared by
+    every sequence-replay system (AWR/MPO/Rainbow/R2D2/MuZero).
+    """
+    n_shards = int(mesh.shape["data"])
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    denom = n_shards * update_batch
+    local_envs = int(config.arch.total_num_envs) // denom
+    if local_envs == 0:
+        raise ValueError(
+            f"arch.total_num_envs ({config.arch.total_num_envs}) must be >= "
+            f"num_data_shards * update_batch_size ({denom})"
+        )
+    sample_batch = max(1, int(config.system.total_batch_size) // denom)
+    max_length = max(
+        int(config.system.total_buffer_size) // (denom * local_envs),
+        int(min_length_time_axis),
+    )
+    return local_envs, sample_batch, max_length
+
+
+def wrap_learn(
     learn_per_shard: Callable,
-    warmup_core: Callable,
     mesh: Mesh,
     state_specs: Any,
-) -> Tuple[Callable, Callable]:
-    """shard_map both fns, squeezing the buffer's [S] shard axis per shard."""
+) -> Callable:
+    """shard_map a learner fn, squeezing the buffer's [S] shard axis per
+    shard (every buffer-holding system shares this wrapper)."""
 
     def per_shard_learn(state):
         squeezed = state._replace(
@@ -147,7 +174,17 @@ def wrap_learn_and_warmup(
         )
         return out._replace(learner_state=new_state)
 
-    learn = anakin.shardmap_learner(per_shard_learn, mesh, state_specs)
+    return anakin.shardmap_learner(per_shard_learn, mesh, state_specs)
+
+
+def wrap_learn_and_warmup(
+    learn_per_shard: Callable,
+    warmup_core: Callable,
+    mesh: Mesh,
+    state_specs: Any,
+) -> Tuple[Callable, Callable]:
+    """shard_map both fns, squeezing the buffer's [S] shard axis per shard."""
+    learn = wrap_learn(learn_per_shard, mesh, state_specs)
 
     def per_shard_warmup(state):
         squeezed = state._replace(

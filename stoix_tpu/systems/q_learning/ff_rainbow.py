@@ -169,19 +169,16 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     params = OnlineAndTarget(online, online)
     opt_state = q_optim.init(online)
 
-    n_shards = int(mesh.shape["data"])
-    update_batch = int(config.arch.get("update_batch_size", 1))
-    local_envs = int(config.arch.total_num_envs) // (n_shards * update_batch)
     n_step = int(config.system.get("n_step", 3))
+    local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+        config, mesh, 2 * int(config.system.rollout_length)
+    )
     buffer = make_prioritised_trajectory_buffer(
         add_batch_size=local_envs,
-        sample_batch_size=max(1, int(config.system.total_batch_size) // (n_shards * update_batch)),
+        sample_batch_size=sample_batch,
         sample_sequence_length=n_step + 1,
         period=1,
-        max_length_time_axis=max(
-            int(config.system.total_buffer_size) // (n_shards * update_batch * local_envs),
-            2 * int(config.system.rollout_length),
-        ),
+        max_length_time_axis=max_length,
         priority_exponent=float(config.system.get("priority_exponent", 0.6)),
     )
     dummy_item = {
@@ -198,17 +195,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         config, mesh, env, params, opt_state, buffer_state, key, env_key
     )
 
-    def per_shard_learn(state):
-        squeezed = state._replace(
-            buffer_state=jax.tree.map(lambda x: x[0], state.buffer_state)
-        )
-        out = learn_per_shard(squeezed)
-        new_state = out.learner_state._replace(
-            buffer_state=jax.tree.map(lambda x: x[None], out.learner_state.buffer_state)
-        )
-        return out._replace(learner_state=new_state)
-
-    learn = anakin.shardmap_learner(per_shard_learn, mesh, state_specs)
+    learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
 
     # Rainbow's warmup writes trajectory-layout sequences (not flat items).
     def traj_warmup(state):

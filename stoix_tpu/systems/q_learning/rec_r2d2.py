@@ -23,7 +23,7 @@ from stoix_tpu.base_types import ExperimentOutput, OnlineAndTarget, RNNOffPolicy
 from stoix_tpu.buffers import make_prioritised_trajectory_buffer
 from stoix_tpu.ops.value_transforms import SIGNED_HYPERBOLIC_PAIR
 from stoix_tpu.ops.multistep import n_step_bootstrapped_returns
-from stoix_tpu.systems import anakin
+from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.off_policy_core import pmean_grads
 from stoix_tpu.systems.runner import AnakinSetup
 from stoix_tpu.utils import config as config_lib
@@ -228,19 +228,18 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     n_shards = int(mesh.shape["data"])
     update_batch = int(config.arch.get("update_batch_size", 1))
     envs_axis = int(config.arch.total_num_envs) // update_batch
-    local_envs = envs_axis // n_shards
     seq_len = int(config.system.get("burn_in_length", 8)) + int(
         config.system.get("train_length", 8)
     )
+    local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+        config, mesh, 2 * seq_len
+    )
     buffer = make_prioritised_trajectory_buffer(
         add_batch_size=local_envs,
-        sample_batch_size=max(1, int(config.system.total_batch_size) // (n_shards * update_batch)),
+        sample_batch_size=sample_batch,
         sample_sequence_length=seq_len,
         period=int(config.system.get("period", 4)),
-        max_length_time_axis=max(
-            int(config.system.total_buffer_size) // (n_shards * update_batch * local_envs),
-            2 * seq_len,
-        ),
+        max_length_time_axis=max_length,
         priority_exponent=float(config.system.get("priority_exponent", 0.6)),
     )
     dummy_item = {
@@ -280,17 +279,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         env, q_network, q_optim.update, buffer, config, cell_type, hidden_size
     )
 
-    def per_shard_learn(state):
-        squeezed = state._replace(
-            buffer_state=jax.tree.map(lambda x: x[0], state.buffer_state)
-        )
-        out = learn_per_shard(squeezed)
-        new_state = out.learner_state._replace(
-            buffer_state=jax.tree.map(lambda x: x[None], out.learner_state.buffer_state)
-        )
-        return out._replace(learner_state=new_state)
-
-    learn = anakin.shardmap_learner(per_shard_learn, mesh, state_specs)
+    learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
 
     def rnn_act_fn(params, hstate, observation, done, act_key):
         obs_t = jax.tree.map(lambda x: x[None, None], observation)

@@ -3,27 +3,36 @@
 Search in a LEARNED model: the RewardBasedWorldModel encodes observations to a
 flat latent, the dynamics RNN rolls latents forward under embedded actions
 (reference networks/model_based.py), and prediction heads give priors/values on
-latents. Training is unroll-k (reference scale_gradient usage): from each
-window, the policy head matches search visit-weights, the value head matches
-GAE targets, the reward head matches observed rewards, with latent gradients
-scaled 0.5 between steps.
+latents.
+
+Training follows the reference's replay design (ff_mz.py:220-427):
+  - rollouts (acting by MCTS in the learned model) feed a trajectory buffer;
+  - each epoch samples [B, L] sequences, computes value targets as n-step
+    bootstrapped returns FROM THE STORED SEARCH VALUES (reference :276-284),
+    then unrolls the dynamics L-1 steps from the first observation's latent:
+    policy CE against search visit-weights, categorical (two-hot,
+    signed-hyperbolic) cross-entropy for value and reward (reference :537
+    rlax.muzero_pair), losses masked past episode end, latent gradients
+    scaled 0.5 between steps (reference scale_gradient usage).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from stoix_tpu import envs
-from stoix_tpu.base_types import ExperimentOutput, OnPolicyLearnerState
+from stoix_tpu.base_types import ExperimentOutput, OffPolicyLearnerState
+from stoix_tpu.buffers import make_trajectory_buffer
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops.multistep import n_step_bootstrapped_returns
+from stoix_tpu.ops.value_transforms import muzero_pair
 from stoix_tpu.search import mcts
-from stoix_tpu.systems import anakin
+from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.jax_utils import scale_gradient
@@ -40,31 +49,33 @@ class MZOptStates(NamedTuple):
     opt_state: Any
 
 
-class MZTransition(NamedTuple):
-    done: jax.Array
-    truncated: jax.Array
-    action: jax.Array
-    value: jax.Array
-    reward: jax.Array
-    search_policy: jax.Array
-    obs: Any
-    next_obs: Any
-    info: Dict[str, Any]
-
-
-def get_learner_fn(env, networks, optim_update, config):
+def get_learner_fn(env, networks, optim_update, buffer, config):
     wm, policy_net, value_net = networks
     gamma = float(config.system.gamma)
-    num_simulations = int(config.system.get("num_simulations", 16))
-    unroll_k = int(config.system.get("unroll_steps", 4))
+    num_simulations = int(config.system.get("num_simulations", 25))
+    n_steps = int(config.system.get("n_steps", 5))
+    ent_coef = float(config.system.get("ent_coef", 0.0))
+    vf_coef = float(config.system.get("vf_coef", 0.25))
+    num_atoms = int(config.system.get("num_atoms", 601))
+    vmin = float(config.system.get("vmin", -300.0))
+    vmax = float(config.system.get("vmax", 300.0))
+    critic_pair = muzero_pair(num_atoms, vmin, vmax)
+    reward_pair = muzero_pair(num_atoms, vmin, vmax)
+    search_method = str(config.system.get("search_method", "muzero"))
+    policy_fn = (
+        mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
+    )
 
     def _predict(params: MZParams, latent):
         prior = policy_net.apply(params.policy_head, latent)
-        value = value_net.apply(params.value_head, latent)
+        value = critic_pair.apply_inv(value_net.apply(params.value_head, latent))
         return prior, value
 
     def recurrent_fn(params: MZParams, rng, action, latent):
-        new_latent, reward = wm.apply(params.world_model, latent, action, method="step")
+        new_latent, reward_logits = wm.apply(
+            params.world_model, latent, action, method="step"
+        )
+        reward = reward_pair.apply_inv(reward_logits)
         prior, value = _predict(params, new_latent)
         out = mcts.RecurrentFnOutput(
             reward=reward,
@@ -74,8 +85,8 @@ def get_learner_fn(env, networks, optim_update, config):
         )
         return out, new_latent
 
-    def _env_step(learner_state: OnPolicyLearnerState, _):
-        params, opt_states, key, env_state, last_timestep = learner_state
+    def _env_step(learner_state: OffPolicyLearnerState, _):
+        params, opt_states, buffer_state, key, env_state, last_timestep = learner_state
         key, search_key = jax.random.split(key)
 
         latent = wm.apply(
@@ -85,114 +96,145 @@ def get_learner_fn(env, networks, optim_update, config):
         root = mcts.RootFnOutput(
             prior_logits=prior.logits, value=value, embedding=latent
         )
-        search_out = mcts.muzero_policy(
+        search_out = policy_fn(
             params, search_key, root, recurrent_fn, num_simulations,
-            max_depth=int(config.system.get("max_depth", num_simulations)),
+            max_depth=int(config.system.get("max_depth") or num_simulations),
         )
         action = search_out.action
         env_state_new, timestep = env.step(env_state, action)
 
-        transition = MZTransition(
-            done=timestep.discount == 0.0,
-            truncated=jnp.logical_and(timestep.last(), timestep.discount != 0.0),
-            action=action,
-            value=value,
-            reward=timestep.reward,
-            search_policy=search_out.action_weights,
-            obs=last_timestep.observation,
-            next_obs=timestep.extras["next_obs"],
-            info=timestep.extras["episode_metrics"],
-        )
+        data = {
+            "obs": last_timestep.observation.agent_view,
+            "action": action,
+            "reward": timestep.reward,
+            "done": (timestep.discount == 0.0).astype(jnp.float32),
+            "truncated": jnp.logical_and(
+                timestep.last(), timestep.discount != 0.0
+            ).astype(jnp.float32),
+            "search_policy": search_out.action_weights,
+            "search_value": search_out.search_value,
+            "info": timestep.extras["episode_metrics"],
+        }
         return (
-            OnPolicyLearnerState(params, opt_states, key, env_state_new, timestep),
-            transition,
+            OffPolicyLearnerState(
+                params, opt_states, buffer_state, key, env_state_new, timestep
+            ),
+            data,
         )
 
-    def _loss_fn(params: MZParams, traj: MZTransition, targets):
-        T = targets.shape[0]
-        T_train = T - unroll_k + 1
-
-        # Windows: index i covers steps [i, i + T_train).
-        def window(x, i):
-            return jax.lax.dynamic_slice_in_dim(x, i, T_train, axis=0)
+    def _loss_fn(params: MZParams, seq):
+        # seq: [B, L, ...]; train on the first L-1 steps.
+        r_t = seq["reward"][:, :-1]
+        done = seq["done"].astype(jnp.float32)[:, :-1]
+        truncated = seq["truncated"].astype(jnp.float32)[:, :-1]
+        # Truncation (time limit, discount still 1) must not let returns or
+        # the dynamics unroll leak across the auto-reset boundary. The
+        # stored search_value after a truncation is the POST-reset state's,
+        # so: cut the n-step return there (conservative: no bootstrap) and
+        # mask the corrupted boundary step out of the value loss below.
+        d_t = gamma * (1.0 - done) * (1.0 - truncated)
+        value_targets = n_step_bootstrapped_returns(
+            r_t, d_t, seq["search_value"][:, 1:], n_steps
+        )  # [B, L-1]
 
         latent = wm.apply(
-            params.world_model,
-            jax.tree.map(lambda x: x[:T_train], traj.obs.agent_view),
-            method="initial_state",
-        )  # [T_train, E, D]
+            params.world_model, seq["obs"][:, 0], method="initial_state"
+        )  # [B, D]
 
-        def unroll_step(carry, i):
-            latent, total_loss = carry
+        def unroll_step(carry, targets_t):
+            latent, mask = carry
+            action, rew_target, pol_target, val_target, done, truncated = targets_t
             prior = policy_net.apply(params.policy_head, latent)
-            value = value_net.apply(params.value_head, latent)
-            pol_target = window(traj.search_policy, i)
-            val_target = window(targets, i)
-            rew_target = window(traj.reward, i)
+            value_logits = value_net.apply(params.value_head, latent)
 
-            policy_loss = -jnp.mean(
-                jnp.sum(pol_target * jax.nn.log_softmax(prior.logits, axis=-1), axis=-1)
+            # Policy: CE against search visit-weights, masked past episode end.
+            ce = -jnp.sum(
+                pol_target * jax.nn.log_softmax(prior.logits, axis=-1), axis=-1
             )
-            value_loss = 0.5 * jnp.mean((value - val_target) ** 2)
+            policy_loss = jnp.mean(ce * mask)
+            entropy = jnp.mean(prior.entropy() * mask)
 
-            action = window(traj.action, i)
-            new_latent, pred_reward = wm.apply(
-                params.world_model, latent, action, method="step"
+            # Value/reward: categorical CE on two-hot transformed targets.
+            # Targets are masked (absorbing state => 0) rather than the loss
+            # (reference ff_mz.py:322-339), so past-done steps still train
+            # toward the absorbing value. Only the in-episode truncation
+            # boundary step is excluded from the value loss: its n-step
+            # target has no bootstrap (see _loss_fn).
+            val_probs = critic_pair.apply(val_target * mask)
+            value_loss = vf_coef * jnp.mean(
+                optax.softmax_cross_entropy(value_logits, val_probs)
+                * (1.0 - truncated * mask)
             )
-            reward_loss = 0.5 * jnp.mean((pred_reward - rew_target) ** 2)
-            # Scale latent gradients between unroll steps (MuZero trick).
-            new_latent = scale_gradient(new_latent, 0.5)
-            step_loss = policy_loss + value_loss + reward_loss
-            return (new_latent, total_loss + step_loss), {
+
+            latent_scaled = scale_gradient(latent, 0.5)
+            new_latent, reward_logits = wm.apply(
+                params.world_model, latent_scaled, action, method="step"
+            )
+            rew_probs = reward_pair.apply(rew_target * mask)
+            reward_loss = jnp.mean(
+                optax.softmax_cross_entropy(reward_logits, rew_probs)
+            )
+
+            # Sequence break on termination OR truncation — the unroll must
+            # not straddle an auto-reset.
+            new_mask = mask * (1.0 - done) * (1.0 - truncated)
+            metrics = {
                 "policy_loss": policy_loss,
                 "value_loss": value_loss,
                 "reward_loss": reward_loss,
+                "entropy": entropy,
             }
+            return (new_latent, new_mask), metrics
 
-        (final_latent, total_loss), metrics = jax.lax.scan(
-            unroll_step, (latent, jnp.zeros(())), jnp.arange(unroll_k)
+        targets = (
+            seq["action"][:, :-1],
+            r_t,
+            seq["search_policy"][:, :-1],
+            value_targets,
+            done,
+            truncated,
         )
+        targets = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), targets)  # [L-1, B, ...]
+        init_mask = jnp.ones_like(r_t[:, 0])
+        (_, _), metrics = jax.lax.scan(unroll_step, (latent, init_mask), targets)
         metrics = jax.tree.map(jnp.mean, metrics)
-        return total_loss / unroll_k, metrics
+        total = (
+            metrics["policy_loss"]
+            + metrics["value_loss"]
+            + metrics["reward_loss"]
+            - ent_coef * metrics["entropy"]
+        )
+        return total, metrics
 
-    def _update_step(learner_state: OnPolicyLearnerState, _):
+    def _update_epoch(carry, _):
+        params, opt_states, buffer_state, key = carry
+        key, sample_key = jax.random.split(key)
+        seq = buffer.sample(buffer_state, sample_key).experience  # [B, L, ...]
+        grads, metrics = jax.grad(_loss_fn, has_aux=True)(params, seq)
+        grads = jax.lax.pmean(jax.lax.pmean(grads, axis_name="batch"), axis_name="data")
+        updates, opt_state = optim_update(grads, opt_states.opt_state)
+        params = optax.apply_updates(params, updates)
+        return (params, MZOptStates(opt_state), buffer_state, key), metrics
+
+    def _update_step(learner_state: OffPolicyLearnerState, _):
         learner_state, traj = jax.lax.scan(
             _env_step, learner_state, None, int(config.system.rollout_length)
         )
-        params, opt_states, key, env_state, last_timestep = learner_state
-
-        latent_next = wm.apply(
-            params.world_model, traj.next_obs.agent_view, method="initial_state"
+        params, opt_states, buffer_state, key, env_state, timestep = learner_state
+        store = {k: v for k, v in traj.items() if k != "info"}
+        buffer_state = buffer.add(
+            buffer_state, jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), store)
         )
-        v_t = value_net.apply(params.value_head, latent_next)
-        latent_cur = wm.apply(
-            params.world_model, traj.obs.agent_view, method="initial_state"
+        (params, opt_states, buffer_state, key), loss_info = jax.lax.scan(
+            _update_epoch, (params, opt_states, buffer_state, key), None,
+            int(config.system.epochs),
         )
-        v_tm1 = value_net.apply(params.value_head, latent_cur)
-        _, targets = truncated_generalized_advantage_estimation(
-            traj.reward,
-            gamma * (1.0 - traj.done.astype(jnp.float32)),
-            float(config.system.get("gae_lambda", 0.95)),
-            v_tm1=jax.lax.stop_gradient(v_tm1),
-            v_t=jax.lax.stop_gradient(v_t),
-            truncation_t=traj.truncated.astype(jnp.float32),
+        learner_state = OffPolicyLearnerState(
+            params, opt_states, buffer_state, key, env_state, timestep
         )
+        return learner_state, (traj["info"], loss_info)
 
-        def _epoch(carry, _):
-            params, opt_states, key = carry
-            grads, metrics = jax.grad(_loss_fn, has_aux=True)(params, traj, targets)
-            grads = jax.lax.pmean(jax.lax.pmean(grads, axis_name="batch"), axis_name="data")
-            updates, opt_state = optim_update(grads, opt_states.opt_state)
-            params = optax.apply_updates(params, updates)
-            return (params, MZOptStates(opt_state), key), metrics
-
-        (params, opt_states, key), loss_info = jax.lax.scan(
-            _epoch, (params, opt_states, key), None, int(config.system.epochs)
-        )
-        learner_state = OnPolicyLearnerState(params, opt_states, key, env_state, last_timestep)
-        return learner_state, (traj.info, loss_info)
-
-    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+    def learner_fn(learner_state: OffPolicyLearnerState) -> ExperimentOutput:
         key = learner_state.key[0]
         state = learner_state._replace(key=key)
         state, (episode_info, loss_info) = jax.lax.scan(
@@ -209,12 +251,13 @@ def get_learner_fn(env, networks, optim_update, config):
 def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
     import flax.linen as nn
 
-    from stoix_tpu.networks import heads as heads_lib, torso as torso_lib
+    from stoix_tpu.networks import torso as torso_lib
     from stoix_tpu.networks.model_based import RewardBasedWorldModel
 
     config.system.action_dim = env.num_actions
     num_actions = env.num_actions
     hidden = int(config.system.get("wm_hidden_size", 64))
+    num_atoms = int(config.system.get("num_atoms", 601))
 
     class ActionOneHot(nn.Module):
         num_actions: int
@@ -223,9 +266,17 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         def __call__(self, action):
             return jax.nn.one_hot(action, self.num_actions)
 
+    class LogitsHead(nn.Module):
+        num_outputs: int
+
+        @nn.compact
+        def __call__(self, x):
+            x = torso_lib.MLPTorso((hidden,))(x)
+            return nn.Dense(self.num_outputs)(x)
+
     wm = RewardBasedWorldModel(
         obs_encoder=torso_lib.MLPTorso((hidden,)),
-        reward_head=heads_lib.LinearHead(output_dim=1),
+        reward_head=LogitsHead(num_outputs=num_atoms),
         action_embedder=ActionOneHot(num_actions=num_actions),
         hidden_size=hidden,
         num_rnn_layers=int(config.system.get("wm_rnn_layers", 1)),
@@ -235,16 +286,13 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     class LatentPolicy(nn.Module):
         @nn.compact
         def __call__(self, latent):
+            from stoix_tpu.networks import heads as heads_lib
+
             x = torso_lib.MLPTorso((hidden,))(latent)
             return heads_lib.CategoricalHead(num_actions=num_actions)(x)
 
-    class LatentValue(nn.Module):
-        @nn.compact
-        def __call__(self, latent):
-            x = torso_lib.MLPTorso((hidden,))(latent)
-            return heads_lib.ScalarCriticHead()(x)
-
-    policy_net, value_net = LatentPolicy(), LatentValue()
+    policy_net = LatentPolicy()
+    value_net = LogitsHead(num_outputs=num_atoms)
 
     key, wm_key, p_key, v_key, env_key = jax.random.split(key, 5)
     dummy_view = env.observation_value().agent_view[None]
@@ -263,23 +311,35 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     )
     opt_states = MZOptStates(optim.init(params))
 
-    update_batch = int(config.arch.get("update_batch_size", 1))
-    state_specs = OnPolicyLearnerState(
-        params=P(), opt_states=P(), key=P("data"),
-        env_state=P(None, "data"), timestep=P(None, "data"),
+    local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+        config, mesh, 2 * int(config.system.rollout_length)
     )
-    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
-    learner_state = OnPolicyLearnerState(
-        params=anakin.broadcast_to_update_batch(params, update_batch),
-        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
-        key=anakin.make_step_keys(key, mesh, config),
-        env_state=env_state,
-        timestep=timestep,
+    buffer = make_trajectory_buffer(
+        add_batch_size=local_envs,
+        sample_batch_size=sample_batch,
+        sample_sequence_length=int(config.system.get("sample_sequence_length", 6)),
+        period=int(config.system.get("sample_period", 1)),
+        max_length_time_axis=max_length,
     )
-    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+    dummy_item = {
+        "obs": env.observation_value().agent_view,
+        "action": jnp.zeros((), jnp.int32),
+        "reward": jnp.zeros((), jnp.float32),
+        "done": jnp.zeros((), jnp.float32),
+        "truncated": jnp.zeros((), jnp.float32),
+        "search_policy": jnp.zeros((num_actions,), jnp.float32),
+        "search_value": jnp.zeros((), jnp.float32),
+    }
+    buffer_state = buffer.init(dummy_item)
 
-    learn_per_shard = get_learner_fn(env, (wm, policy_net, value_net), optim.update, config)
-    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+    learn_per_shard = get_learner_fn(
+        env, (wm, policy_net, value_net), optim.update, buffer, config
+    )
+    learner_state, state_specs = core.assemble_off_policy_state(
+        config, mesh, env, params, opt_states, buffer_state, key, env_key
+    )
+
+    learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
 
     def eval_apply(params: MZParams, observation):
         latent = wm.apply(params.world_model, observation.agent_view, method="initial_state")

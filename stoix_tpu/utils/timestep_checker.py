@@ -44,9 +44,19 @@ def check_total_timesteps(config: Any, num_data_shards: int) -> Any:
         )
 
     num_evaluation = max(1, int(arch.get("num_evaluation", 1)))
-    if int(arch.num_updates) % num_evaluation != 0:
-        num_evaluation = 1
-        print("[timestep-check] num_updates not divisible by num_evaluation; using 1 eval")
+    num_updates = int(arch.num_updates)
+    if num_updates % num_evaluation != 0:
+        # Round DOWN to the nearest divisor of num_updates rather than falling
+        # back to a single eval: one eval fuses every update into one compiled
+        # program, which for long runs is both unobservable and big enough to
+        # hit device-runtime execution limits.
+        requested_evals = num_evaluation
+        while num_updates % num_evaluation != 0:
+            num_evaluation -= 1
+        print(
+            f"[timestep-check] num_evaluation adjusted {requested_evals} -> "
+            f"{num_evaluation} (nearest divisor of num_updates={num_updates})"
+        )
     arch.num_evaluation = num_evaluation
     arch.num_updates_per_eval = int(arch.num_updates) // num_evaluation
     return config
