@@ -223,6 +223,22 @@ class LinearHead(nn.Module):
         return out[..., 0] if self.output_dim == 1 else out
 
 
+class MLPLogitsHead(nn.Module):
+    """MLP torso + raw logits projection — MuZero's 601-atom value/reward
+    heads over a transformed support (decoded via ops.value_transforms.
+    muzero_pair, never softmaxed here)."""
+
+    num_outputs: int
+    hidden_sizes: tuple = (64,)
+
+    @nn.compact
+    def __call__(self, embedding: jax.Array) -> jax.Array:
+        from stoix_tpu.networks.torso import MLPTorso
+
+        x = MLPTorso(tuple(self.hidden_sizes))(embedding)
+        return nn.Dense(self.num_outputs)(x)
+
+
 class MultiDiscreteHead(nn.Module):
     """Factorized categorical policy over multiple discrete dims."""
 

@@ -59,8 +59,8 @@ def get_learner_fn(env, networks, optim_update, buffer, config):
     num_atoms = int(config.system.get("num_atoms", 601))
     vmin = float(config.system.get("vmin", -300.0))
     vmax = float(config.system.get("vmax", 300.0))
-    critic_pair = muzero_pair(num_atoms, vmin, vmax)
-    reward_pair = muzero_pair(num_atoms, vmin, vmax)
+    # One codec serves both value and reward heads (same support).
+    critic_pair = reward_pair = muzero_pair(num_atoms, vmin, vmax)
     search_method = str(config.system.get("search_method", "muzero"))
     policy_fn = (
         mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
@@ -259,6 +259,8 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     hidden = int(config.system.get("wm_hidden_size", 64))
     num_atoms = int(config.system.get("num_atoms", 601))
 
+    from stoix_tpu.networks.heads import MLPLogitsHead
+
     class ActionOneHot(nn.Module):
         num_actions: int
 
@@ -266,17 +268,9 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         def __call__(self, action):
             return jax.nn.one_hot(action, self.num_actions)
 
-    class LogitsHead(nn.Module):
-        num_outputs: int
-
-        @nn.compact
-        def __call__(self, x):
-            x = torso_lib.MLPTorso((hidden,))(x)
-            return nn.Dense(self.num_outputs)(x)
-
     wm = RewardBasedWorldModel(
         obs_encoder=torso_lib.MLPTorso((hidden,)),
-        reward_head=LogitsHead(num_outputs=num_atoms),
+        reward_head=MLPLogitsHead(num_outputs=num_atoms, hidden_sizes=(hidden,)),
         action_embedder=ActionOneHot(num_actions=num_actions),
         hidden_size=hidden,
         num_rnn_layers=int(config.system.get("wm_rnn_layers", 1)),
@@ -292,7 +286,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
             return heads_lib.CategoricalHead(num_actions=num_actions)(x)
 
     policy_net = LatentPolicy()
-    value_net = LogitsHead(num_outputs=num_atoms)
+    value_net = MLPLogitsHead(num_outputs=num_atoms, hidden_sizes=(hidden,))
 
     key, wm_key, p_key, v_key, env_key = jax.random.split(key, 5)
     dummy_view = env.observation_value().agent_view[None]

@@ -56,6 +56,12 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
     gamma = float(config.system.gamma)
     num_simulations = int(config.system.get("num_simulations", 16))
     num_samples = int(config.system.get("num_sampled_actions", 8))
+    ent_coef = float(config.system.get("ent_coef", 0.005))
+    root_noise = float(config.system.get("root_exploration_fraction", 0.1))
+    search_method = str(config.system.get("search_method", "muzero"))
+    policy_fn = (
+        mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
+    )
 
     def recurrent_fn(params, rng, action_idx, embedding):
         # embedding per element: {"state": env state, "actions": [K, A]}.
@@ -89,6 +95,14 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         sample_keys = jax.random.split(sample_key, num_samples)
         sampled = jax.vmap(lambda k: dist.sample(seed=k))(sample_keys)  # [K, E, A]
         sampled = jnp.swapaxes(sampled, 0, 1)  # [E, K, A]
+        if root_noise > 0.0:
+            # Root exploration (reference root_exploration_fraction): perturb
+            # the root's sampled action set so the search sees actions a
+            # collapsing policy would never draw.
+            key, noise_key = jax.random.split(key)
+            sampled = sampled + root_noise * jax.random.normal(
+                noise_key, sampled.shape, sampled.dtype
+            )
         value = critic_apply(params.critic_params, last_timestep.observation)
 
         root = mcts.RootFnOutput(
@@ -96,9 +110,9 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
             value=value,
             embedding={"state": unwrap_env_state(env_state), "actions": sampled},
         )
-        search_out = mcts.muzero_policy(
+        search_out = policy_fn(
             params, search_key, root, recurrent_fn, num_simulations,
-            max_depth=int(config.system.get("max_depth", num_simulations)),
+            max_depth=int(config.system.get("max_depth") or num_simulations),
         )
         action = jnp.take_along_axis(
             sampled, search_out.action[:, None, None].repeat(sampled.shape[-1], -1), axis=1
@@ -126,8 +140,12 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         dist = actor_apply(actor_params, obs)
         # log pi(a_i | s) for each sampled action: [B, K].
         log_probs = jax.vmap(dist.log_prob, in_axes=1, out_axes=1)(sampled_actions)
-        loss = -jnp.mean(jnp.sum(search_policy * log_probs, axis=-1))
-        return loss, {"actor_loss": loss}
+        ce = -jnp.mean(jnp.sum(search_policy * log_probs, axis=-1))
+        # Entropy bonus (reference ent_coef 0.005) keeps the Gaussian from
+        # collapsing before the search has found better actions to weight.
+        entropy = dist.entropy().mean()
+        loss = ce - ent_coef * entropy
+        return loss, {"actor_loss": ce, "entropy": entropy}
 
     def _critic_loss_fn(critic_params, obs, targets):
         value = critic_apply(critic_params, obs)

@@ -1,28 +1,35 @@
 """Anakin Sampled MuZero (reference stoix/systems/search/ff_sampled_mz.py,
 978 LoC): continuous-action MuZero — K actions sampled from the policy form
 the search's action set (as in ff_sampled_az), but the simulator is the
-LEARNED RewardBasedWorldModel over latents (as in ff_mz). Policy trains on
-search weights over the samples; value on GAE targets; reward head on observed
-rewards via unroll-k.
+LEARNED RewardBasedWorldModel over latents (as in ff_mz), with per-node
+action resampling at every expanded latent.
+
+Training mirrors ff_mz's replay design (reference ff_sampled_mz.py follows
+the same buffer/unroll scheme as ff_mz): trajectory buffer; n-step value
+targets bootstrapped from stored SEARCH values; unroll-(L-1) training from
+the first observation's latent with categorical (two-hot, signed-hyperbolic)
+value/reward heads; policy matches search weights over the STORED sampled
+action set; sequence breaks on termination/truncation.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from stoix_tpu import envs
-from stoix_tpu.base_types import ExperimentOutput, OnPolicyLearnerState
+from stoix_tpu.base_types import ExperimentOutput, OffPolicyLearnerState
+from stoix_tpu.buffers import make_trajectory_buffer
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops.multistep import n_step_bootstrapped_returns
+from stoix_tpu.ops.value_transforms import muzero_pair
 from stoix_tpu.search import mcts
-from stoix_tpu.systems import anakin
+from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
-from stoix_tpu.systems.search.ff_mz import MZOptStates
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.jax_utils import scale_gradient
 from stoix_tpu.utils.training import make_learning_rate
@@ -34,33 +41,39 @@ class SampledMZParams(NamedTuple):
     value_head: Any
 
 
-class SampledMZTransition(NamedTuple):
-    done: jax.Array
-    truncated: jax.Array
-    action: jax.Array
-    sampled_actions: jax.Array  # [K, A]
-    value: jax.Array
-    reward: jax.Array
-    search_policy: jax.Array  # [K]
-    obs: Any
-    next_obs: Any
-    info: Dict[str, Any]
+class SampledMZOptStates(NamedTuple):
+    opt_state: Any
 
 
-def get_learner_fn(env, networks, optim_update, config):
+def get_learner_fn(env, networks, optim_update, buffer, config):
     wm, policy_net, value_net = networks
     gamma = float(config.system.gamma)
-    num_simulations = int(config.system.get("num_simulations", 16))
+    num_simulations = int(config.system.get("num_simulations", 25))
     num_samples = int(config.system.get("num_sampled_actions", 8))
-    unroll_k = int(config.system.get("unroll_steps", 4))
+    n_steps = int(config.system.get("n_steps", 5))
+    ent_coef = float(config.system.get("ent_coef", 0.005))
+    vf_coef = float(config.system.get("vf_coef", 0.25))
+    root_noise = float(config.system.get("root_exploration_fraction", 0.1))
+    num_atoms = int(config.system.get("num_atoms", 601))
+    vmin = float(config.system.get("vmin", -300.0))
+    vmax = float(config.system.get("vmax", 300.0))
+    # One codec serves both value and reward heads (same support).
+    critic_pair = reward_pair = muzero_pair(num_atoms, vmin, vmax)
+    search_method = str(config.system.get("search_method", "muzero"))
+    policy_fn = (
+        mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
+    )
 
     def recurrent_fn(params: SampledMZParams, rng, action_idx, embedding):
         latent, actions = embedding["latent"], embedding["actions"]
         action = jnp.take_along_axis(
             actions, action_idx[:, None, None].repeat(actions.shape[-1], -1), axis=1
         )[:, 0]
-        new_latent, reward = wm.apply(params.world_model, latent, action, method="step")
-        value = value_net.apply(params.value_head, new_latent)
+        new_latent, reward_logits = wm.apply(
+            params.world_model, latent, action, method="step"
+        )
+        reward = reward_pair.apply_inv(reward_logits)
+        value = critic_pair.apply_inv(value_net.apply(params.value_head, new_latent))
         # Per-node resampling from the policy at the NEW latent.
         dist = policy_net.apply(params.policy_head, new_latent)
         node_keys = jax.random.split(rng, num_samples)
@@ -75,8 +88,8 @@ def get_learner_fn(env, networks, optim_update, config):
         )
         return out, {"latent": new_latent, "actions": node_actions}
 
-    def _env_step(learner_state: OnPolicyLearnerState, _):
-        params, opt_states, key, env_state, last_timestep = learner_state
+    def _env_step(learner_state: OffPolicyLearnerState, _):
+        params, opt_states, buffer_state, key, env_state, last_timestep = learner_state
         key, sample_key, search_key = jax.random.split(key, 3)
 
         latent = wm.apply(
@@ -87,113 +100,147 @@ def get_learner_fn(env, networks, optim_update, config):
         sampled = jnp.swapaxes(
             jax.vmap(lambda k: dist.sample(seed=k))(sample_keys), 0, 1
         )  # [E, K, A]
-        value = value_net.apply(params.value_head, latent)
+        if root_noise > 0.0:
+            key, noise_key = jax.random.split(key)
+            sampled = sampled + root_noise * jax.random.normal(
+                noise_key, sampled.shape, sampled.dtype
+            )
+        value = critic_pair.apply_inv(value_net.apply(params.value_head, latent))
 
         root = mcts.RootFnOutput(
             prior_logits=jnp.zeros(value.shape + (num_samples,)),
             value=value,
             embedding={"latent": latent, "actions": sampled},
         )
-        search_out = mcts.muzero_policy(
+        search_out = policy_fn(
             params, search_key, root, recurrent_fn, num_simulations,
-            max_depth=int(config.system.get("max_depth", num_simulations)),
+            max_depth=int(config.system.get("max_depth") or num_simulations),
         )
         action = jnp.take_along_axis(
             sampled, search_out.action[:, None, None].repeat(sampled.shape[-1], -1), axis=1
         )[:, 0]
         env_state_new, timestep = env.step(env_state, action)
 
-        transition = SampledMZTransition(
-            done=timestep.discount == 0.0,
-            truncated=jnp.logical_and(timestep.last(), timestep.discount != 0.0),
-            action=action,
-            sampled_actions=sampled,
-            value=value,
-            reward=timestep.reward,
-            search_policy=search_out.action_weights,
-            obs=last_timestep.observation,
-            next_obs=timestep.extras["next_obs"],
-            info=timestep.extras["episode_metrics"],
-        )
+        data = {
+            "obs": last_timestep.observation.agent_view,
+            "action": action,
+            "sampled_actions": sampled,
+            "search_policy": search_out.action_weights,
+            "search_value": search_out.search_value,
+            "reward": timestep.reward,
+            "done": (timestep.discount == 0.0).astype(jnp.float32),
+            "truncated": jnp.logical_and(
+                timestep.last(), timestep.discount != 0.0
+            ).astype(jnp.float32),
+            "info": timestep.extras["episode_metrics"],
+        }
         return (
-            OnPolicyLearnerState(params, opt_states, key, env_state_new, timestep),
-            transition,
+            OffPolicyLearnerState(
+                params, opt_states, buffer_state, key, env_state_new, timestep
+            ),
+            data,
         )
 
-    def _loss_fn(params: SampledMZParams, traj: SampledMZTransition, targets):
-        T = targets.shape[0]
-        T_train = T - unroll_k + 1
+    def _loss_fn(params: SampledMZParams, seq):
+        # seq: [B, L, ...]; train on the first L-1 steps.
+        r_t = seq["reward"][:, :-1]
+        done = seq["done"].astype(jnp.float32)[:, :-1]
+        truncated = seq["truncated"].astype(jnp.float32)[:, :-1]
+        # No bootstrap across the auto-reset boundary (see ff_mz._loss_fn).
+        d_t = gamma * (1.0 - done) * (1.0 - truncated)
+        value_targets = n_step_bootstrapped_returns(
+            r_t, d_t, seq["search_value"][:, 1:], n_steps
+        )  # [B, L-1]
 
-        def window(x, i):
-            return jax.lax.dynamic_slice_in_dim(x, i, T_train, axis=0)
+        latent = wm.apply(params.world_model, seq["obs"][:, 0], method="initial_state")
 
-        latent = wm.apply(
-            params.world_model,
-            jax.tree.map(lambda x: x[:T_train], traj.obs.agent_view),
-            method="initial_state",
-        )
-
-        def unroll_step(carry, i):
-            latent, total = carry
+        def unroll_step(carry, targets_t):
+            latent, mask = carry
+            (action, sampled, weights, rew_target, val_target, done_t,
+             truncated_t) = targets_t
             dist = policy_net.apply(params.policy_head, latent)
-            value = value_net.apply(params.value_head, latent)
-            sampled = window(traj.sampled_actions, i)  # [T', E, K, A]
-            weights = window(traj.search_policy, i)  # [T', E, K]
-            log_probs = jax.vmap(dist.log_prob, in_axes=2, out_axes=2)(sampled)
-            policy_loss = -jnp.mean(jnp.sum(weights * log_probs, axis=-1))
-            value_loss = 0.5 * jnp.mean((value - window(targets, i)) ** 2)
+            value_logits = value_net.apply(params.value_head, latent)
 
-            action = window(traj.action, i)
-            new_latent, pred_reward = wm.apply(
-                params.world_model, latent, action, method="step"
+            # Policy: weighted max-likelihood over the STORED sampled action
+            # set, masked past episode end; entropy bonus keeps the Gaussian
+            # from collapsing early (reference ent_coef).
+            log_probs = jax.vmap(dist.log_prob, in_axes=1, out_axes=1)(sampled)  # [B, K]
+            ce = -jnp.sum(weights * log_probs, axis=-1)
+            policy_loss = jnp.mean(ce * mask)
+            entropy = jnp.mean(dist.entropy() * mask)
+
+            val_probs = critic_pair.apply(val_target * mask)
+            value_loss = vf_coef * jnp.mean(
+                optax.softmax_cross_entropy(value_logits, val_probs)
+                * (1.0 - truncated_t * mask)
             )
-            reward_loss = 0.5 * jnp.mean((pred_reward - window(traj.reward, i)) ** 2)
-            new_latent = scale_gradient(new_latent, 0.5)
-            return (new_latent, total + policy_loss + value_loss + reward_loss), {
+
+            latent_scaled = scale_gradient(latent, 0.5)
+            new_latent, reward_logits = wm.apply(
+                params.world_model, latent_scaled, action, method="step"
+            )
+            rew_probs = reward_pair.apply(rew_target * mask)
+            reward_loss = jnp.mean(optax.softmax_cross_entropy(reward_logits, rew_probs))
+
+            new_mask = mask * (1.0 - done_t) * (1.0 - truncated_t)
+            metrics = {
                 "policy_loss": policy_loss,
                 "value_loss": value_loss,
                 "reward_loss": reward_loss,
+                "entropy": entropy,
             }
+            return (new_latent, new_mask), metrics
 
-        (_, total), metrics = jax.lax.scan(
-            unroll_step, (latent, jnp.zeros(())), jnp.arange(unroll_k)
+        targets = (
+            seq["action"][:, :-1],
+            seq["sampled_actions"][:, :-1],
+            seq["search_policy"][:, :-1],
+            r_t,
+            value_targets,
+            done,
+            truncated,
         )
-        return total / unroll_k, jax.tree.map(jnp.mean, metrics)
+        targets = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), targets)
+        init_mask = jnp.ones_like(r_t[:, 0])
+        (_, _), metrics = jax.lax.scan(unroll_step, (latent, init_mask), targets)
+        metrics = jax.tree.map(jnp.mean, metrics)
+        total = (
+            metrics["policy_loss"]
+            + metrics["value_loss"]
+            + metrics["reward_loss"]
+            - ent_coef * metrics["entropy"]
+        )
+        return total, metrics
 
-    def _update_step(learner_state: OnPolicyLearnerState, _):
+    def _update_epoch(carry, _):
+        params, opt_states, buffer_state, key = carry
+        key, sample_key = jax.random.split(key)
+        seq = buffer.sample(buffer_state, sample_key).experience
+        grads, metrics = jax.grad(_loss_fn, has_aux=True)(params, seq)
+        grads = jax.lax.pmean(jax.lax.pmean(grads, axis_name="batch"), axis_name="data")
+        updates, opt_state = optim_update(grads, opt_states.opt_state)
+        params = optax.apply_updates(params, updates)
+        return (params, SampledMZOptStates(opt_state), buffer_state, key), metrics
+
+    def _update_step(learner_state: OffPolicyLearnerState, _):
         learner_state, traj = jax.lax.scan(
             _env_step, learner_state, None, int(config.system.rollout_length)
         )
-        params, opt_states, key, env_state, last_timestep = learner_state
-
-        latent_next = wm.apply(
-            params.world_model, traj.next_obs.agent_view, method="initial_state"
+        params, opt_states, buffer_state, key, env_state, timestep = learner_state
+        store = {k: v for k, v in traj.items() if k != "info"}
+        buffer_state = buffer.add(
+            buffer_state, jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), store)
         )
-        v_t = value_net.apply(params.value_head, latent_next)
-        _, targets = truncated_generalized_advantage_estimation(
-            traj.reward,
-            gamma * (1.0 - traj.done.astype(jnp.float32)),
-            float(config.system.get("gae_lambda", 0.95)),
-            v_tm1=jax.lax.stop_gradient(traj.value),
-            v_t=jax.lax.stop_gradient(v_t),
-            truncation_t=traj.truncated.astype(jnp.float32),
+        (params, opt_states, buffer_state, key), loss_info = jax.lax.scan(
+            _update_epoch, (params, opt_states, buffer_state, key), None,
+            int(config.system.epochs),
         )
-
-        def _epoch(carry, _):
-            params, opt_states, key = carry
-            grads, metrics = jax.grad(_loss_fn, has_aux=True)(params, traj, targets)
-            grads = jax.lax.pmean(jax.lax.pmean(grads, axis_name="batch"), axis_name="data")
-            updates, opt_state = optim_update(grads, opt_states.opt_state)
-            params = optax.apply_updates(params, updates)
-            return (params, MZOptStates(opt_state), key), metrics
-
-        (params, opt_states, key), loss_info = jax.lax.scan(
-            _epoch, (params, opt_states, key), None, int(config.system.epochs)
+        learner_state = OffPolicyLearnerState(
+            params, opt_states, buffer_state, key, env_state, timestep
         )
-        learner_state = OnPolicyLearnerState(params, opt_states, key, env_state, last_timestep)
-        return learner_state, (traj.info, loss_info)
+        return learner_state, (traj["info"], loss_info)
 
-    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+    def learner_fn(learner_state: OffPolicyLearnerState) -> ExperimentOutput:
         key = learner_state.key[0]
         state = learner_state._replace(key=key)
         state, (episode_info, loss_info) = jax.lax.scan(
@@ -219,10 +266,14 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     lo = float(jnp.min(jnp.asarray(space.low)))
     hi = float(jnp.max(jnp.asarray(space.high)))
     hidden = int(config.system.get("wm_hidden_size", 64))
+    num_atoms = int(config.system.get("num_atoms", 601))
+    num_samples = int(config.system.get("num_sampled_actions", 8))
+
+    from stoix_tpu.networks.heads import MLPLogitsHead
 
     wm = RewardBasedWorldModel(
         obs_encoder=torso_lib.MLPTorso((hidden,)),
-        reward_head=heads_lib.LinearHead(output_dim=1),
+        reward_head=MLPLogitsHead(num_outputs=num_atoms, hidden_sizes=(hidden,)),
         action_embedder=torso_lib.MLPTorso((hidden // 2,)),
         hidden_size=hidden,
         num_rnn_layers=int(config.system.get("wm_rnn_layers", 1)),
@@ -237,13 +288,8 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
                 action_dim=action_dim, minimum=lo, maximum=hi
             )(x)
 
-    class LatentValue(nn.Module):
-        @nn.compact
-        def __call__(self, latent):
-            x = torso_lib.MLPTorso((hidden,))(latent)
-            return heads_lib.ScalarCriticHead()(x)
-
-    policy_net, value_net = LatentPolicy(), LatentValue()
+    policy_net = LatentPolicy()
+    value_net = MLPLogitsHead(num_outputs=num_atoms, hidden_sizes=(hidden,))
 
     key, wm_key, p_key, v_key, env_key = jax.random.split(key, 5)
     dummy_view = env.observation_value().agent_view[None]
@@ -260,25 +306,37 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         optax.adam(make_learning_rate(float(config.system.lr), config,
                                       int(config.system.epochs)), eps=1e-5),
     )
-    opt_states = MZOptStates(optim.init(params))
+    opt_states = SampledMZOptStates(optim.init(params))
 
-    update_batch = int(config.arch.get("update_batch_size", 1))
-    state_specs = OnPolicyLearnerState(
-        params=P(), opt_states=P(), key=P("data"),
-        env_state=P(None, "data"), timestep=P(None, "data"),
+    local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+        config, mesh, 2 * int(config.system.rollout_length)
     )
-    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
-    learner_state = OnPolicyLearnerState(
-        params=anakin.broadcast_to_update_batch(params, update_batch),
-        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
-        key=anakin.make_step_keys(key, mesh, config),
-        env_state=env_state,
-        timestep=timestep,
+    buffer = make_trajectory_buffer(
+        add_batch_size=local_envs,
+        sample_batch_size=sample_batch,
+        sample_sequence_length=int(config.system.get("sample_sequence_length", 6)),
+        period=int(config.system.get("sample_period", 1)),
+        max_length_time_axis=max_length,
     )
-    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+    dummy_item = {
+        "obs": env.observation_value().agent_view,
+        "action": jnp.zeros((action_dim,), jnp.float32),
+        "sampled_actions": jnp.zeros((num_samples, action_dim), jnp.float32),
+        "search_policy": jnp.zeros((num_samples,), jnp.float32),
+        "search_value": jnp.zeros((), jnp.float32),
+        "reward": jnp.zeros((), jnp.float32),
+        "done": jnp.zeros((), jnp.float32),
+        "truncated": jnp.zeros((), jnp.float32),
+    }
+    buffer_state = buffer.init(dummy_item)
 
-    learn_per_shard = get_learner_fn(env, (wm, policy_net, value_net), optim.update, config)
-    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+    learn_per_shard = get_learner_fn(
+        env, (wm, policy_net, value_net), optim.update, buffer, config
+    )
+    learner_state, state_specs = core.assemble_off_policy_state(
+        config, mesh, env, params, opt_states, buffer_state, key, env_key
+    )
+    learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
 
     def eval_apply(params: SampledMZParams, observation):
         latent = wm.apply(params.world_model, observation.agent_view, method="initial_state")
