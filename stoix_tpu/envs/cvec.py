@@ -3,10 +3,12 @@
 (reference stoix/wrappers/envpool.py adapts EnvPool's API the same way: manual
 auto-reset bookkeeping, numpy episode metrics, stoa-style TimeSteps).
 
-Games: "CartPole-v1" (4-float obs), "Breakout-minatar" and "Asterix-minatar"
-(10x10x4 pixel obs — the Atari-class workloads for the Sebulba CNN path). The shared library is
-compiled on first use with g++ and cached next to the source; no Python-level
-per-env loops exist anywhere on the hot path.
+Games: "CartPole-v1" (4-float obs), and the 10x10x4-pixel MinAtar-class set
+"Breakout-minatar", "Asterix-minatar", "Freeway-minatar",
+"SpaceInvaders-minatar" — the Atari-class workloads for the Sebulba CNN path,
+each with a bit-identical pure-JAX twin in envs/minatar.py. The shared
+library is compiled on first use with g++ and cached next to the source; no
+Python-level per-env loops exist anywhere on the hot path.
 """
 
 from __future__ import annotations

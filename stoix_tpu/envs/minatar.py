@@ -324,3 +324,324 @@ class Asterix(Environment):
         )
         ts.extras["truncation"] = truncated
         return next_state, ts
+
+
+_FREEWAY_START_R = _GRID - 1
+_FREEWAY_START_C = _GRID // 2
+_SI_ROWS = 4
+_SI_COLS = 6
+_SI_ALIEN_PERIOD = 4
+_SI_SHOOT_PERIOD = 6
+
+
+class FreewayState(NamedTuple):
+    key: jax.Array
+    player_r: jax.Array  # [] int32
+    player_c: jax.Array
+    car_col: jax.Array  # [8] int32
+    t: jax.Array  # [] int32 (drives per-row movement periods)
+    step_count: jax.Array
+
+
+class Freeway(Environment):
+    """Freeway (MinAtar-class): cross 8 lanes of traffic, +1 per crossing.
+
+    JAX twin of the native pool's Freeway-minatar (envs/native/cvec.cpp),
+    rule for rule. Fully deterministic: lane s has fixed direction
+    (+1 if s even) and fixed period 1 + (s % 3); a collision sends the
+    chicken back to the start (no termination — the episode is purely
+    time-limited, as in the published MinAtar freeway).
+
+    Channels: 0 player, 1 car, 2 car-moving-right, 3 fast-car (period 1).
+    Actions: 0 stay, 1 up, 2 down.
+    """
+
+    def __init__(self, max_steps: int = 500):
+        self._max_steps = int(max_steps)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((_GRID, _GRID, 4), jnp.float32),
+            action_mask=spaces.Array((3,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(3)
+
+    @staticmethod
+    def _dirs() -> jax.Array:
+        s = jnp.arange(8)
+        return jnp.where(s % 2 == 0, 1, -1).astype(jnp.int32)
+
+    @staticmethod
+    def _periods() -> jax.Array:
+        return (1 + jnp.arange(8) % 3).astype(jnp.int32)
+
+    def _observe(self, state: FreewayState) -> Observation:
+        board = jnp.zeros((_GRID, _GRID, 4), jnp.float32)
+        board = board.at[state.player_r, state.player_c, 0].set(1.0)
+        rows = jnp.arange(8) + 1
+        board = board.at[rows, state.car_col, 1].set(1.0)
+        board = board.at[rows, state.car_col, 2].max(
+            (self._dirs() > 0).astype(jnp.float32)
+        )
+        board = board.at[rows, state.car_col, 3].max(
+            (self._periods() == 1).astype(jnp.float32)
+        )
+        return Observation(
+            agent_view=board,
+            action_mask=jnp.ones((3,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[FreewayState, TimeStep]:
+        state = FreewayState(
+            key=key,
+            player_r=jnp.asarray(_FREEWAY_START_R, jnp.int32),
+            player_c=jnp.asarray(_FREEWAY_START_C, jnp.int32),
+            car_col=((3 * jnp.arange(8) + 1) % _GRID).astype(jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+            step_count=jnp.zeros((), jnp.int32),
+        )
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: FreewayState, action: jax.Array) -> Tuple[FreewayState, TimeStep]:
+        # Mirrors cvec.cpp FreewayVec::step_env exactly: move player, move
+        # cars, collide, then score/reset at the top row.
+        action = jnp.asarray(action, jnp.int32)
+        dr = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        player_r = jnp.clip(state.player_r + dr, 0, _GRID - 1)
+        player_c = state.player_c
+
+        move_now = state.t % self._periods() == 0
+        car_col = jnp.where(
+            move_now, (state.car_col + self._dirs()) % _GRID, state.car_col
+        )
+
+        rows = jnp.arange(8) + 1
+        hit = jnp.any(
+            jnp.logical_and(player_r == rows, player_c == car_col)
+        )
+        player_r = jnp.where(hit, _FREEWAY_START_R, player_r)
+        player_c = jnp.where(hit, _FREEWAY_START_C, player_c)
+
+        crossed = player_r == 0
+        reward = jnp.where(crossed, 1.0, 0.0).astype(jnp.float32)
+        player_r = jnp.where(crossed, _FREEWAY_START_R, player_r)
+        player_c = jnp.where(crossed, _FREEWAY_START_C, player_c)
+
+        next_state = FreewayState(
+            key=state.key,
+            player_r=player_r,
+            player_c=player_c,
+            car_col=car_col,
+            t=state.t + 1,
+            step_count=state.step_count + 1,
+        )
+        obs = self._observe(next_state)
+        truncated = next_state.step_count >= self._max_steps
+        ts = select_step(truncated, truncation(reward, obs), transition(reward, obs))
+        ts.extras["truncation"] = truncated
+        return next_state, ts
+
+
+class SpaceInvadersState(NamedTuple):
+    key: jax.Array
+    player_c: jax.Array  # [] int32 (row fixed at bottom)
+    alive: jax.Array  # [4, 6] int32
+    alien_r0: jax.Array  # [] int32 block top-left
+    alien_c0: jax.Array
+    adir: jax.Array  # [] int32 in {-1, +1}
+    fb_r: jax.Array  # friendly bullet
+    fb_c: jax.Array
+    fb_live: jax.Array  # [] int32
+    eb_r: jax.Array  # enemy bullet
+    eb_c: jax.Array
+    eb_live: jax.Array
+    shot_count: jax.Array
+    t: jax.Array
+    step_count: jax.Array
+
+
+class SpaceInvaders(Environment):
+    """Space Invaders (MinAtar-class): shoot the marching alien block.
+
+    JAX twin of the native pool's SpaceInvaders-minatar (cvec.cpp), rule for
+    rule, fully deterministic: the 4x6 block marches every 4 steps (drop and
+    reverse at the walls); every 6 steps the lowest alien in a cycling column
+    fires; one friendly and one enemy bullet may be in flight. +1 per alien;
+    being shot or invaded terminates.
+
+    Channels: 0 player, 1 alien, 2 friendly bullet, 3 enemy bullet.
+    Actions: 0 stay, 1 left, 2 right, 3 fire.
+    """
+
+    def __init__(self, max_steps: int = 500):
+        self._max_steps = int(max_steps)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((_GRID, _GRID, 4), jnp.float32),
+            action_mask=spaces.Array((4,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(4)
+
+    def _observe(self, state: SpaceInvadersState) -> Observation:
+        board = jnp.zeros((_GRID, _GRID, 4), jnp.float32)
+        board = board.at[_GRID - 1, state.player_c, 0].set(1.0)
+        rr = state.alien_r0 + jnp.arange(_SI_ROWS)[:, None]
+        cc = state.alien_c0 + jnp.arange(_SI_COLS)[None, :]
+        rr_c = jnp.clip(rr, 0, _GRID - 1)
+        cc_c = jnp.clip(cc, 0, _GRID - 1)
+        board = board.at[rr_c, cc_c, 1].max(state.alive.astype(jnp.float32))
+        board = board.at[
+            jnp.clip(state.fb_r, 0, _GRID - 1), jnp.clip(state.fb_c, 0, _GRID - 1), 2
+        ].max(state.fb_live.astype(jnp.float32))
+        board = board.at[
+            jnp.clip(state.eb_r, 0, _GRID - 1), jnp.clip(state.eb_c, 0, _GRID - 1), 3
+        ].max(state.eb_live.astype(jnp.float32))
+        return Observation(
+            agent_view=board,
+            action_mask=jnp.ones((4,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def _fresh_wave(self):
+        return (
+            jnp.ones((_SI_ROWS, _SI_COLS), jnp.int32),
+            jnp.asarray(1, jnp.int32),
+            jnp.asarray(2, jnp.int32),
+            jnp.asarray(1, jnp.int32),
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[SpaceInvadersState, TimeStep]:
+        alive, r0, c0, adir = self._fresh_wave()
+        zero = jnp.zeros((), jnp.int32)
+        state = SpaceInvadersState(
+            key=key,
+            player_c=jnp.asarray(_GRID // 2, jnp.int32),
+            alive=alive, alien_r0=r0, alien_c0=c0, adir=adir,
+            fb_r=zero, fb_c=zero, fb_live=zero,
+            eb_r=zero, eb_c=zero, eb_live=zero,
+            shot_count=zero, t=zero, step_count=zero,
+        )
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(
+        self, state: SpaceInvadersState, action: jax.Array
+    ) -> Tuple[SpaceInvadersState, TimeStep]:
+        # Mirrors cvec.cpp SpaceInvadersVec::step_env exactly; phase order:
+        # player/fire -> friendly bullet -> enemy bullet -> march -> shoot ->
+        # wave refresh.
+        action = jnp.asarray(action, jnp.int32)
+        player_c = jnp.clip(
+            state.player_c
+            + jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0)),
+            0, _GRID - 1,
+        )
+        fire = jnp.logical_and(action == 3, state.fb_live == 0)
+        fb_live = jnp.where(fire, 1, state.fb_live)
+        fb_r = jnp.where(fire, _GRID - 2, state.fb_r)
+        fb_c = jnp.where(fire, player_c, state.fb_c)
+
+        # Friendly bullet: up one, die off-top, then alien hit check.
+        fb_r = jnp.where(fb_live == 1, fb_r - 1, fb_r)
+        fb_live = jnp.where(fb_r < 0, 0, fb_live)
+        rel_r = fb_r - state.alien_r0
+        rel_c = fb_c - state.alien_c0
+        in_block = jnp.logical_and(
+            jnp.logical_and(rel_r >= 0, rel_r < _SI_ROWS),
+            jnp.logical_and(rel_c >= 0, rel_c < _SI_COLS),
+        )
+        rel_r_c = jnp.clip(rel_r, 0, _SI_ROWS - 1)
+        rel_c_c = jnp.clip(rel_c, 0, _SI_COLS - 1)
+        hit = jnp.logical_and(
+            jnp.logical_and(fb_live == 1, in_block),
+            state.alive[rel_r_c, rel_c_c] == 1,
+        )
+        alive = state.alive.at[rel_r_c, rel_c_c].set(
+            jnp.where(hit, 0, state.alive[rel_r_c, rel_c_c])
+        )
+        reward = jnp.where(hit, 1.0, 0.0).astype(jnp.float32)
+        fb_live = jnp.where(hit, 0, fb_live)
+
+        # Enemy bullet: down one, die off-bottom, player hit terminates.
+        eb_r = jnp.where(state.eb_live == 1, state.eb_r + 1, state.eb_r)
+        eb_live = jnp.where(eb_r >= _GRID, 0, state.eb_live)
+        shot_down = jnp.logical_and(
+            jnp.logical_and(eb_live == 1, eb_r == _GRID - 1),
+            state.eb_c == player_c,
+        )
+
+        # Alien march every _SI_ALIEN_PERIOD steps: sideways, or drop+reverse.
+        march_now = state.t % _SI_ALIEN_PERIOD == 0
+        nc0 = state.alien_c0 + state.adir
+        blocked = jnp.logical_or(nc0 < 0, nc0 + _SI_COLS > _GRID)
+        alien_c0 = jnp.where(
+            march_now, jnp.where(blocked, state.alien_c0, nc0), state.alien_c0
+        )
+        alien_r0 = jnp.where(
+            jnp.logical_and(march_now, blocked), state.alien_r0 + 1, state.alien_r0
+        )
+        adir = jnp.where(
+            jnp.logical_and(march_now, blocked), -state.adir, state.adir
+        )
+        # Invasion: the lowest LIVING alien row reaching the player row.
+        row_alive = jnp.any(alive == 1, axis=1)  # [4]
+        lowest = jnp.max(
+            jnp.where(row_alive, jnp.arange(_SI_ROWS), -1)
+        )
+        invaded = jnp.logical_and(
+            lowest >= 0, alien_r0 + lowest >= _GRID - 1
+        )
+
+        # Enemy shot every _SI_SHOOT_PERIOD steps from the lowest living
+        # alien in a cycling column.
+        shoot_now = jnp.logical_and(state.t % _SI_SHOOT_PERIOD == 0, eb_live == 0)
+        sc = state.shot_count % _SI_COLS
+        col_alive = alive[:, sc] == 1  # [4]
+        low_in_col = jnp.max(jnp.where(col_alive, jnp.arange(_SI_ROWS), -1))
+        can_shoot = jnp.logical_and(shoot_now, low_in_col >= 0)
+        eb_live = jnp.where(can_shoot, 1, eb_live)
+        eb_r = jnp.where(can_shoot, alien_r0 + low_in_col + 1, eb_r)
+        eb_c = jnp.where(can_shoot, alien_c0 + sc, state.eb_c)
+        shot_count = state.shot_count + jnp.where(
+            state.t % _SI_SHOOT_PERIOD == 0, 1, 0
+        )
+
+        # Wave cleared -> fresh block (score keeps accumulating).
+        cleared = jnp.all(alive == 0)
+        fresh_alive, fresh_r0, fresh_c0, fresh_adir = self._fresh_wave()
+        alive = jnp.where(cleared, fresh_alive, alive)
+        alien_r0 = jnp.where(cleared, fresh_r0, alien_r0)
+        alien_c0 = jnp.where(cleared, fresh_c0, alien_c0)
+        adir = jnp.where(cleared, fresh_adir, adir)
+
+        terminated = jnp.logical_or(shot_down, invaded)
+        next_state = SpaceInvadersState(
+            key=state.key,
+            player_c=player_c,
+            alive=alive, alien_r0=alien_r0, alien_c0=alien_c0, adir=adir,
+            fb_r=fb_r, fb_c=fb_c, fb_live=fb_live,
+            eb_r=eb_r, eb_c=eb_c, eb_live=eb_live,
+            shot_count=shot_count,
+            t=state.t + 1,
+            step_count=state.step_count + 1,
+        )
+        obs = self._observe(next_state)
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts

@@ -379,12 +379,251 @@ struct AsterixVec : VecEnv {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Freeway (MinAtar-class): cross 8 lanes of traffic, +1 per crossing.
+//
+// Fully deterministic (lockstep-equal with the JAX twin): lane s has fixed
+// direction (+1 if s even) and fixed period 1 + (s % 3); collisions send the
+// chicken back to the start; no termination — episodes are time-limited.
+// Channels: 0 player, 1 car, 2 car-moving-right, 3 fast-car. Actions:
+// 0 stay, 1 up, 2 down.
+// ---------------------------------------------------------------------------
+
+struct FreewayVec : VecEnv {
+  struct EnvState {
+    int player_r, player_c;
+    int car_col[8];
+    int t;
+  };
+  std::vector<EnvState> envs;
+
+  FreewayVec(int n, int max_steps_, uint64_t seed)
+      : VecEnv(n, max_steps_, seed), envs(n) {}
+
+  int obs_dim() const override { return kGrid * kGrid * kChannels; }
+  void obs_shape(int32_t* out3) const override {
+    out3[0] = kGrid; out3[1] = kGrid; out3[2] = kChannels;
+  }
+  int num_actions() const override { return 3; }
+
+  static int lane_dir(int s) { return (s % 2 == 0) ? 1 : -1; }
+  static int lane_period(int s) { return 1 + (s % 3); }
+
+  void reset_env(int i) override {
+    EnvState& e = envs[i];
+    e.player_r = kGrid - 1;
+    e.player_c = kGrid / 2;
+    for (int s = 0; s < 8; ++s) e.car_col[s] = (3 * s + 1) % kGrid;
+    e.t = 0;
+  }
+
+  void write_obs(int i, float* out) const override {
+    const EnvState& e = envs[i];
+    std::memset(out, 0, sizeof(float) * obs_dim());
+    auto at = [&](int r, int c, int ch) -> float& {
+      return out[(r * kGrid + c) * kChannels + ch];
+    };
+    at(e.player_r, e.player_c, 0) = 1.0f;
+    for (int s = 0; s < 8; ++s) {
+      at(s + 1, e.car_col[s], 1) = 1.0f;
+      if (lane_dir(s) > 0) at(s + 1, e.car_col[s], 2) = 1.0f;
+      if (lane_period(s) == 1) at(s + 1, e.car_col[s], 3) = 1.0f;
+    }
+  }
+
+  float step_env(int i, int32_t action, bool* terminated) override {
+    EnvState& e = envs[i];
+    *terminated = false;
+    const int dr = action == 1 ? -1 : (action == 2 ? 1 : 0);
+    e.player_r = std::clamp(e.player_r + dr, 0, kGrid - 1);
+
+    for (int s = 0; s < 8; ++s)
+      if (e.t % lane_period(s) == 0)
+        e.car_col[s] = (e.car_col[s] + lane_dir(s) + kGrid) % kGrid;
+
+    bool hit = false;
+    for (int s = 0; s < 8; ++s)
+      hit |= (e.player_r == s + 1 && e.player_c == e.car_col[s]);
+    if (hit) {
+      e.player_r = kGrid - 1;
+      e.player_c = kGrid / 2;
+    }
+
+    float reward = 0.0f;
+    if (e.player_r == 0) {
+      reward = 1.0f;
+      e.player_r = kGrid - 1;
+      e.player_c = kGrid / 2;
+    }
+    e.t += 1;
+    return reward;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Space Invaders (MinAtar-class): shoot the marching 4x6 alien block.
+//
+// Fully deterministic (lockstep-equal with the JAX twin): the block marches
+// every 4 steps (drop + reverse at the walls); every 6 steps the lowest
+// alien in a cycling column fires; one friendly and one enemy bullet in
+// flight. +1 per alien; being shot or invaded terminates. Channels:
+// 0 player, 1 alien, 2 friendly bullet, 3 enemy bullet. Actions: 0 stay,
+// 1 left, 2 right, 3 fire.
+// ---------------------------------------------------------------------------
+
+constexpr int kSiRows = 4;
+constexpr int kSiCols = 6;
+constexpr int kSiAlienPeriod = 4;
+constexpr int kSiShootPeriod = 6;
+
+struct SpaceInvadersVec : VecEnv {
+  struct EnvState {
+    int player_c;
+    uint8_t alive[kSiRows * kSiCols];
+    int alien_r0, alien_c0, adir;
+    int fb_r, fb_c, fb_live;
+    int eb_r, eb_c, eb_live;
+    int shot_count;
+    int t;
+  };
+  std::vector<EnvState> envs;
+
+  SpaceInvadersVec(int n, int max_steps_, uint64_t seed)
+      : VecEnv(n, max_steps_, seed), envs(n) {}
+
+  int obs_dim() const override { return kGrid * kGrid * kChannels; }
+  void obs_shape(int32_t* out3) const override {
+    out3[0] = kGrid; out3[1] = kGrid; out3[2] = kChannels;
+  }
+  int num_actions() const override { return 4; }
+
+  static void fresh_wave(EnvState& e) {
+    std::fill(e.alive, e.alive + kSiRows * kSiCols, uint8_t{1});
+    e.alien_r0 = 1;
+    e.alien_c0 = 2;
+    e.adir = 1;
+  }
+
+  void reset_env(int i) override {
+    EnvState& e = envs[i];
+    e.player_c = kGrid / 2;
+    fresh_wave(e);
+    e.fb_r = e.fb_c = e.fb_live = 0;
+    e.eb_r = e.eb_c = e.eb_live = 0;
+    e.shot_count = 0;
+    e.t = 0;
+  }
+
+  void write_obs(int i, float* out) const override {
+    const EnvState& e = envs[i];
+    std::memset(out, 0, sizeof(float) * obs_dim());
+    auto at = [&](int r, int c, int ch) -> float& {
+      return out[(r * kGrid + c) * kChannels + ch];
+    };
+    at(kGrid - 1, e.player_c, 0) = 1.0f;
+    for (int r = 0; r < kSiRows; ++r)
+      for (int c = 0; c < kSiCols; ++c)
+        if (e.alive[r * kSiCols + c]) {
+          const int rr = std::clamp(e.alien_r0 + r, 0, kGrid - 1);
+          const int cc = std::clamp(e.alien_c0 + c, 0, kGrid - 1);
+          at(rr, cc, 1) = 1.0f;
+        }
+    if (e.fb_live)
+      at(std::clamp(e.fb_r, 0, kGrid - 1), std::clamp(e.fb_c, 0, kGrid - 1), 2) = 1.0f;
+    if (e.eb_live)
+      at(std::clamp(e.eb_r, 0, kGrid - 1), std::clamp(e.eb_c, 0, kGrid - 1), 3) = 1.0f;
+  }
+
+  float step_env(int i, int32_t action, bool* terminated) override {
+    EnvState& e = envs[i];
+    *terminated = false;
+    float reward = 0.0f;
+
+    // Player move / fire.
+    e.player_c = std::clamp(
+        e.player_c + (action == 1 ? -1 : (action == 2 ? 1 : 0)), 0, kGrid - 1);
+    if (action == 3 && !e.fb_live) {
+      e.fb_live = 1;
+      e.fb_r = kGrid - 2;
+      e.fb_c = e.player_c;
+    }
+
+    // Friendly bullet: up one, die off-top, alien hit check.
+    if (e.fb_live) {
+      e.fb_r -= 1;
+      if (e.fb_r < 0) e.fb_live = 0;
+    }
+    if (e.fb_live) {
+      const int rel_r = e.fb_r - e.alien_r0;
+      const int rel_c = e.fb_c - e.alien_c0;
+      if (rel_r >= 0 && rel_r < kSiRows && rel_c >= 0 && rel_c < kSiCols &&
+          e.alive[rel_r * kSiCols + rel_c]) {
+        e.alive[rel_r * kSiCols + rel_c] = 0;
+        reward += 1.0f;
+        e.fb_live = 0;
+      }
+    }
+
+    // Enemy bullet: down one, die off-bottom, player hit terminates.
+    if (e.eb_live) {
+      e.eb_r += 1;
+      if (e.eb_r >= kGrid) e.eb_live = 0;
+    }
+    if (e.eb_live && e.eb_r == kGrid - 1 && e.eb_c == e.player_c)
+      *terminated = true;
+
+    // Alien march: sideways, or drop + reverse at the walls.
+    if (e.t % kSiAlienPeriod == 0) {
+      const int nc0 = e.alien_c0 + e.adir;
+      if (nc0 < 0 || nc0 + kSiCols > kGrid) {
+        e.alien_r0 += 1;
+        e.adir = -e.adir;
+      } else {
+        e.alien_c0 = nc0;
+      }
+    }
+    int lowest = -1;
+    for (int r = 0; r < kSiRows; ++r)
+      for (int c = 0; c < kSiCols; ++c)
+        if (e.alive[r * kSiCols + c]) lowest = std::max(lowest, r);
+    if (lowest >= 0 && e.alien_r0 + lowest >= kGrid - 1) *terminated = true;
+
+    // Enemy shot from the lowest living alien in a cycling column.
+    if (e.t % kSiShootPeriod == 0) {
+      if (!e.eb_live) {
+        const int sc = e.shot_count % kSiCols;
+        int low_in_col = -1;
+        for (int r = 0; r < kSiRows; ++r)
+          if (e.alive[r * kSiCols + sc]) low_in_col = std::max(low_in_col, r);
+        if (low_in_col >= 0) {
+          e.eb_live = 1;
+          e.eb_r = e.alien_r0 + low_in_col + 1;
+          e.eb_c = e.alien_c0 + sc;
+        }
+      }
+      e.shot_count += 1;
+    }
+
+    // Wave cleared -> fresh block.
+    bool any = false;
+    for (int b = 0; b < kSiRows * kSiCols; ++b) any |= (e.alive[b] != 0);
+    if (!any) fresh_wave(e);
+
+    e.t += 1;
+    return reward;
+  }
+};
+
 VecEnv* make_game(const char* task, int num_envs, int max_steps, uint64_t seed) {
   const std::string name(task ? task : "");
   if (name == "Breakout-minatar")
     return new BreakoutVec(num_envs, max_steps, seed);
   if (name == "Asterix-minatar")
     return new AsterixVec(num_envs, max_steps, seed);
+  if (name == "Freeway-minatar")
+    return new FreewayVec(num_envs, max_steps, seed);
+  if (name == "SpaceInvaders-minatar")
+    return new SpaceInvadersVec(num_envs, max_steps, seed);
   if (name == "CartPole-v1" || name.empty())
     return new CartPoleVec(num_envs, max_steps, seed);
   return nullptr;

@@ -25,6 +25,8 @@ ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
     "Ant": locomotion.Ant,
     "Breakout-minatar": minatar.Breakout,
     "Asterix-minatar": minatar.Asterix,
+    "Freeway-minatar": minatar.Freeway,
+    "SpaceInvaders-minatar": minatar.SpaceInvaders,
     "Snake-v1": snake.Snake,
     "IdentityGame": debug.IdentityGame,
     "SequenceGame": debug.SequenceGame,

@@ -11,6 +11,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from stoix_tpu.networks import torso as torso_lib
 from stoix_tpu.ops import distributions as dists
 
 _ORTHO_SMALL = nn.initializers.orthogonal(0.01)
@@ -233,9 +234,7 @@ class MLPLogitsHead(nn.Module):
 
     @nn.compact
     def __call__(self, embedding: jax.Array) -> jax.Array:
-        from stoix_tpu.networks.torso import MLPTorso
-
-        x = MLPTorso(tuple(self.hidden_sizes))(embedding)
+        x = torso_lib.MLPTorso(tuple(self.hidden_sizes))(embedding)
         return nn.Dense(self.num_outputs)(x)
 
 
