@@ -48,6 +48,9 @@ SYSTEMS = [
     ("stoix_tpu.systems.mpo.ff_mpo_continuous", "default_ff_mpo_continuous", BUFFER),
     ("stoix_tpu.systems.ppo.anakin.rec_ppo", "default_rec_ppo",
      ["env=identity_game", "system.num_minibatches=2"]),
+    ("stoix_tpu.systems.ppo.anakin.ff_trans_ppo", "default_ff_trans_ppo",
+     ["env=identity_game", "system.window_length=4", "system.num_layers=1",
+      "system.num_minibatches=2"]),
     ("stoix_tpu.systems.q_learning.rec_r2d2", "default_rec_r2d2",
      ["env=identity_game", "system.total_buffer_size=4096", "system.total_batch_size=16"]),
     ("stoix_tpu.systems.q_learning.ff_rainbow", "default_ff_rainbow",
