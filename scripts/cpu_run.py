@@ -33,6 +33,35 @@ def _force_cpu(devices: int) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def arm_watchdog_from_env() -> None:
+    """Opt-in hard exit if the run outlives RUN_WATCHDOG_MINUTES (<= 0 or
+    unset = disabled). A wedged device runtime can hang an RPC forever
+    (observed twice on the tunneled-TPU platform); a stuck process also
+    blocks any serial experiment queue behind it, so a structured timeout
+    line + exit beats waiting. Covers both the single-run and --sweep paths
+    (armed from main())."""
+    import json
+    import threading
+
+    try:
+        minutes = float(os.environ.get("RUN_WATCHDOG_MINUTES", "0") or "0")
+    except ValueError:
+        minutes = 0.0
+    if minutes <= 0.0:
+        return
+
+    def _fire() -> None:
+        print(
+            json.dumps({"error": "watchdog_timeout", "minutes": minutes}),
+            flush=True,
+        )
+        os._exit(124)
+
+    timer = threading.Timer(minutes * 60.0, _fire)
+    timer.daemon = True
+    timer.start()
+
+
 def run_module(module: str, default: str, overrides: list) -> None:
     """Compose the config, run the system's run_experiment, print a JSON line.
 
@@ -43,6 +72,7 @@ def run_module(module: str, default: str, overrides: list) -> None:
 
     from stoix_tpu.utils import config as config_lib
 
+    arm_watchdog_from_env()
     config = config_lib.compose(config_lib.default_config_dir(), default, overrides)
     mod = importlib.import_module(module)
     score = mod.run_experiment(config)
@@ -62,6 +92,7 @@ def main() -> None:
             devices = int(argv[i + 1])
             del argv[i : i + 2]
         _force_cpu(devices)
+        arm_watchdog_from_env()
         from stoix_tpu import sweep
 
         sweep.main(argv)
