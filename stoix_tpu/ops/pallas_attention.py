@@ -38,6 +38,46 @@ from stoix_tpu.ops.ring_attention import full_attention
 _NEG_INF = float("-inf")
 
 
+def _fold_block(q, k_blk, v_blk, mask, carry):
+    """One K/V block folded into the online-softmax accumulator (m, l, acc).
+
+    The single shared body for every kernel in this module — the -inf /
+    finite-proxy guards live only here. `mask` may be None (no masking).
+    q [Bq, D] is pre-scaled fp32; k_blk/v_blk [Bk, D] fp32."""
+    m_acc, l_acc, acc = carry
+    scores = jax.lax.dot_general(
+        q, k_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [Bq, Bk]
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    m_blk = jnp.max(scores, axis=-1, keepdims=True)  # [Bq, 1]
+    m_new = jnp.maximum(m_acc, m_blk)
+    # Rows with nothing unmasked yet keep -inf; exp(-inf - -inf) is NaN,
+    # so shift by a finite proxy and zero the weights via the mask.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe)  # [Bq, Bk]
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_safe), 0.0)
+    l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p, v_blk,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [Bq, D]
+    return m_new, l_new, acc * alpha + pv
+
+
+def _init_carry(block_q: int, head_dim: int):
+    return (
+        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+        jnp.zeros((block_q, head_dim), jnp.float32),
+    )
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int, causal: bool, kv_len: int
 ):
@@ -51,44 +91,16 @@ def _flash_kernel(
         jnp.int32, (block_q, block_k), 0
     )
 
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
-
     def body(j, carry):
-        m_acc, l_acc, acc = carry
         k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q, k_blk,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bq, Bk]
-
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
         mask = k_pos < kv_len  # strip the padded tail
         if causal:
             mask = jnp.logical_and(mask, q_pos >= k_pos)
-        scores = jnp.where(mask, scores, _NEG_INF)
-
-        m_blk = jnp.max(scores, axis=-1, keepdims=True)  # [Bq, 1]
-        m_new = jnp.maximum(m_acc, m_blk)
-        # Rows with nothing unmasked yet keep -inf; exp(-inf - -inf) is NaN,
-        # so shift by a finite proxy and zero the weights via the mask.
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(scores - m_safe)  # [Bq, Bk]
-        p = jnp.where(mask, p, 0.0)
-        alpha = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_safe), 0.0)
-        l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p, v_blk,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bq, D]
-        acc_new = acc * alpha + pv
-        return m_new, l_new, acc_new
+        return _fold_block(q, k_blk, v_blk, mask, carry)
 
     if causal:
         # Blocks fully in the future contribute nothing; bound the walk at the
@@ -99,10 +111,17 @@ def _flash_kernel(
         )
     else:
         last = num_kv_blocks
-    m_acc, l_acc, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
+    m_acc, l_acc, acc = jax.lax.fori_loop(
+        0, last, body, _init_carry(block_q, head_dim)
+    )
 
     l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
     o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def _fold_heads(x: jax.Array, b: int, h: int, d: int) -> jax.Array:
+    """[B, S, H, D] -> [B*H, S, D]."""
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
 
 
 def _pad_axis(x: jax.Array, axis: int, multiple: int) -> jax.Array:
@@ -134,11 +153,7 @@ def flash_attention(
     """
     b, s, h, d = q.shape
     scale = d**-0.5
-
-    # [B, S, H, D] -> [B*H, S, D]
-    def fold(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
-
+    fold = functools.partial(_fold_heads, b=b, h=h, d=d)
     qf, kf, vf = fold(q), fold(k), fold(v)
     qf = _pad_axis(qf, 1, block_q)
     kf = _pad_axis(kf, 1, block_k)
@@ -170,3 +185,119 @@ def best_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = Fals
     if jax.default_backend() == "tpu":
         return flash_attention(q, k, v, causal=causal)
     return full_attention(q, k, v, causal=causal)
+
+
+def _flash_chunk_kernel(
+    q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, m_ref, l_ref,
+    *, scale: float, block_k: int, causal: bool
+):
+    """One K/V chunk's UNNORMALIZED contribution + online-softmax stats.
+
+    Like `_flash_kernel` but (a) query/key positions come from refs (the
+    caller supplies GLOBAL positions, so a ring-attention shard can attend a
+    rotated K/V block correctly) and (b) the outputs are the raw streaming
+    accumulator (acc, m, l) so the caller can fold several chunks — this is
+    exactly ring attention's per-block contract."""
+    block_q, head_dim = q_ref.shape
+    s_kv = k_ref.shape[0]
+    num_kv_blocks = s_kv // block_k
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    q_pos = qpos_ref[:].reshape(block_q, 1)  # [Bq, 1] int32 global positions
+
+    def body(j, carry):
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        if causal:
+            k_pos = kpos_ref[pl.ds(j * block_k, block_k), :].reshape(1, block_k)
+            mask = q_pos >= k_pos
+        else:
+            mask = None
+        return _fold_block(q, k_blk, v_blk, mask, carry)
+
+    if causal:
+        # Positions are contiguous ascending within a ring chunk; key blocks
+        # entirely in this query block's future contribute nothing — bound
+        # the walk (blocks whose first key position <= the max query pos).
+        max_q = qpos_ref[block_q - 1, 0]
+        k0 = kpos_ref[0, 0]
+        last = jnp.clip((max_q - k0) // block_k + 1, 0, num_kv_blocks)
+    else:
+        last = num_kv_blocks
+    m_acc, l_acc, acc = jax.lax.fori_loop(
+        0, last, body, _init_carry(block_q, head_dim)
+    )
+    o_ref[:] = acc
+    # Fully-masked rows keep m = -inf internally; emit a finite proxy (their
+    # l and acc are 0, so the caller's accumulator fold stays NaN-free) —
+    # same guard as the pure-JAX _block_attend.
+    m_ref[:] = jnp.where(jnp.isfinite(m_acc), m_acc, 0.0)
+    l_ref[:] = l_acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention_chunk(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_positions: jax.Array,
+    k_positions: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Per-chunk streaming attention for ring composition.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; q_positions [Sq] / k_positions [Sk]
+    are GLOBAL sequence positions (int32) for causal masking across rotated
+    blocks. Requires Sq % block_q == 0 and Sk % block_k == 0 (ring shards
+    are uniformly sized). Returns (pv [B, Sq, H, D] unnormalized fp32,
+    m [B, H, Sq] fp32 running max, l [B, H, Sq] fp32 normalizer) — the exact
+    contract of ring attention's per-block accumulator fold.
+    """
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    if s_q % block_q or s_kv % block_k:
+        raise ValueError(
+            f"block sizes must divide the chunk lengths: got Sq={s_q} vs "
+            f"block_q={block_q}, Sk={s_kv} vs block_k={block_k}"
+        )
+    scale = d**-0.5
+    fold = functools.partial(_fold_heads, b=b, h=h, d=d)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    qpos = q_positions.astype(jnp.int32).reshape(s_q, 1)
+    kpos = k_positions.astype(jnp.int32).reshape(s_kv, 1)
+
+    kernel = functools.partial(
+        _flash_chunk_kernel, scale=scale, block_k=block_k, causal=causal
+    )
+    pv, m, l = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s_kv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s_kv, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((block_q, 1), lambda i, j: (j, 0)),
+            pl.BlockSpec((s_kv, 1), lambda i, j: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, qpos, kpos)
+
+    pv = jnp.transpose(pv.reshape(b, h, s_q, d), (0, 2, 1, 3))  # [B, Sq, H, D]
+    m = m.reshape(b, h, s_q)
+    l = l.reshape(b, h, s_q)
+    return pv, m, l

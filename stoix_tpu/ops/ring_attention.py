@@ -74,6 +74,7 @@ def ring_attention(
     v: jax.Array,
     axis_name: str,
     causal: bool = False,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention with sequence sharded over `axis_name` (call inside
     shard_map). Per-device shapes [B, S_local, H, D].
@@ -81,19 +82,31 @@ def ring_attention(
     The K/V block starts as the local shard and rotates one neighbor per step;
     after R steps every device has attended to every block. For causal masks
     the block's global offset is derived from the rotating source index.
+
+    `use_flash` routes each block's contribution through the Pallas
+    flash-attention chunk kernel (ops/pallas_attention.flash_attention_chunk)
+    — same (m, pv, l) accumulator contract, fused in VMEM. Defaults to on
+    when the backend is TPU and the kernel block size (128) divides the
+    shard length; forcing it on elsewhere runs the Pallas interpreter
+    (slow — for tests).
     """
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
     s_local = q.shape[1]
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu" and s_local % 128 == 0
+    use_flash = use_flash and s_local % min(128, s_local) == 0
 
-    # Online-softmax accumulators. They are constant-initialized but become
-    # device-varying through the scan — mark them varying over the ring axis
-    # up front so the scan carry types line up under shard_map.
+    # Online-softmax accumulators — always fp32 (both the pure-JAX and the
+    # Pallas chunk paths fold fp32 block stats; bf16 inputs still accumulate
+    # exactly). They are constant-initialized but become device-varying
+    # through the scan — mark them varying over the ring axis up front so
+    # the scan carry types line up under shard_map.
     b, s, h, d = q.shape
-    m_acc = jnp.full((b, h, s), -jnp.inf, q.dtype)  # running max
-    l_acc = jnp.zeros((b, h, s), q.dtype)  # running normalizer
-    o_acc = jnp.zeros((b, s, h, d), q.dtype)  # unnormalized output
+    m_acc = jnp.full((b, h, s), -jnp.inf, jnp.float32)  # running max
+    l_acc = jnp.zeros((b, h, s), jnp.float32)  # running normalizer
+    o_acc = jnp.zeros((b, s, h, d), jnp.float32)  # unnormalized output
     m_acc, l_acc, o_acc = jax.lax.pcast(
         (m_acc, l_acc, o_acc), (axis_name,), to="varying"
     )
@@ -104,13 +117,23 @@ def ring_attention(
         m_acc, l_acc, o_acc, k_blk, v_blk = carry
         # The block currently held arrived from device (my_idx + r) % R.
         src = (my_idx + r) % axis_size
-        if causal:
-            k_pos = src * s_local + jnp.arange(s_local)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
-            mask = mask[None, None]  # broadcast over [B, H]
+        k_pos = src * s_local + jnp.arange(s_local)
+        if use_flash:
+            from stoix_tpu.ops.pallas_attention import flash_attention_chunk
+
+            interpret = jax.default_backend() != "tpu"
+            block = min(128, s_local)
+            pv_blk, m_blk, l_blk = flash_attention_chunk(
+                q, k_blk, v_blk, q_pos, k_pos, causal=causal,
+                block_q=block, block_k=block, interpret=interpret,
+            )
         else:
-            mask = None
-        m_blk, pv_blk, l_blk = _block_attend(q, k_blk, v_blk, scale, mask)
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+                mask = mask[None, None]  # broadcast over [B, H]
+            else:
+                mask = None
+            m_blk, pv_blk, l_blk = _block_attend(q, k_blk, v_blk, scale, mask)
 
         m_new = jnp.maximum(m_acc, m_blk)
         # Rescale both accumulators onto the new max.
@@ -136,7 +159,7 @@ def ring_attention(
     )
     # Normalize; fully-masked rows (l == 0) return zeros.
     l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
-    return o_acc / _bhs_to_bshd(l_safe)
+    return (o_acc / _bhs_to_bshd(l_safe)).astype(q.dtype)
 
 
 def _bhs_to_bshd(x: jax.Array) -> jax.Array:

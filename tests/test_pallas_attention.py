@@ -61,3 +61,67 @@ def test_flash_multiple_q_blocks_causal():
     )
     want = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_chunk_kernel_folds_to_full_attention(causal):
+    # Fold three K/V chunks through the streaming accumulator exactly as
+    # ring attention does; the result must equal full attention.
+    b, s, h, d = 2, 192, 2, 32
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), b, s, h, d)
+    from stoix_tpu.ops.pallas_attention import flash_attention_chunk
+
+    chunk = s // 3
+    m_acc = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    l_acc = jnp.zeros((b, h, s), jnp.float32)
+    o_acc = jnp.zeros((b, s, h, d), jnp.float32)
+    q_pos = jnp.arange(s)
+    for c in range(3):
+        k_blk = k[:, c * chunk:(c + 1) * chunk]
+        v_blk = v[:, c * chunk:(c + 1) * chunk]
+        k_pos = jnp.arange(c * chunk, (c + 1) * chunk)
+        pv, m, l = flash_attention_chunk(
+            q, k_blk, v_blk, q_pos, k_pos, causal=causal,
+            block_q=64, block_k=64, interpret=True,
+        )
+        m_new = jnp.maximum(m_acc, m)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m - m_new)
+        l_acc = l_acc * alpha + l * beta
+        o_acc = o_acc * jnp.transpose(alpha, (0, 2, 1))[..., None] + pv * jnp.transpose(
+            beta, (0, 2, 1)
+        )[..., None]
+        m_acc = m_new
+    l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
+    got = o_acc / jnp.transpose(l_safe, (0, 2, 1))[..., None]
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ring_attention_matches_full_attention(use_flash):
+    # Both ring block paths — pure-JAX _block_attend and the Pallas chunk
+    # kernel (interpreter off-TPU) — must reproduce single-device full
+    # attention when sharded over all 8 virtual CPU devices.
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from stoix_tpu.ops.ring_attention import ring_attention
+    from stoix_tpu.parallel import create_mesh
+
+    mesh = create_mesh({"data": -1})  # all 8 virtual CPU devices
+    b, s, h, d = 1, 64, 2, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), b, s, h, d)
+    spec = P(None, "data")
+    ring = jax.jit(
+        jax.shard_map(
+            partial(
+                ring_attention, axis_name="data", causal=True, use_flash=use_flash
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )
+    )
+    got = ring(q, k, v)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
