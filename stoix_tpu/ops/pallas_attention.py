@@ -124,6 +124,16 @@ def _fold_heads(x: jax.Array, b: int, h: int, d: int) -> jax.Array:
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
 
 
+def _out_vma(*arrays: jax.Array) -> frozenset:
+    """Union of the inputs' varying-mesh-axes: under shard_map (where vma
+    checking applies) a pallas_call's out_shape must state how the output
+    varies; it varies wherever any input does. Empty outside shard_map."""
+    vma: frozenset = frozenset()
+    for a in arrays:
+        vma = vma | getattr(jax.typeof(a), "vma", frozenset())
+    return vma
+
+
 def _pad_axis(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     size = x.shape[axis]
     pad = (-size) % multiple
@@ -172,7 +182,9 @@ def flash_attention(
             pl.BlockSpec((None, s_kv_pad, d), lambda i, j: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q_pad, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (b * h, s_q_pad, d), q.dtype, vma=_out_vma(qf, kf, vf)
+        ),
         interpret=interpret,
     )(qf, kf, vf)
 
@@ -274,6 +286,7 @@ def flash_attention_chunk(
     kernel = functools.partial(
         _flash_chunk_kernel, scale=scale, block_k=block_k, causal=causal
     )
+    vma = _out_vma(qf, kf, vf, qpos, kpos)
     pv, m, l = pl.pallas_call(
         kernel,
         grid=(b * h, s_q // block_q),
@@ -290,9 +303,9 @@ def flash_attention_chunk(
             pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_q, d), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32, vma=vma),
         ],
         interpret=interpret,
     )(qf, kf, vf, qpos, kpos)

@@ -101,14 +101,17 @@ def ring_attention(
     # Online-softmax accumulators — always fp32 (both the pure-JAX and the
     # Pallas chunk paths fold fp32 block stats; bf16 inputs still accumulate
     # exactly). They are constant-initialized but become device-varying
-    # through the scan — mark them varying over the ring axis up front so
-    # the scan carry types line up under shard_map.
+    # through the scan — mark them varying over every mesh axis q varies
+    # over (not just the ring axis: on a 2D data x seq mesh the batch is
+    # sharded over 'data' too) so the scan carry types line up under
+    # shard_map.
     b, s, h, d = q.shape
     m_acc = jnp.full((b, h, s), -jnp.inf, jnp.float32)  # running max
     l_acc = jnp.zeros((b, h, s), jnp.float32)  # running normalizer
     o_acc = jnp.zeros((b, s, h, d), jnp.float32)  # unnormalized output
+    vma = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
     m_acc, l_acc, o_acc = jax.lax.pcast(
-        (m_acc, l_acc, o_acc), (axis_name,), to="varying"
+        (m_acc, l_acc, o_acc), vma, to="varying"
     )
 
     q_pos = my_idx * s_local + jnp.arange(s_local)  # global query positions

@@ -114,12 +114,18 @@ def test_ring_attention_matches_full_attention(use_flash):
     b, s, h, d = 1, 64, 2, 16
     q, k, v = _rand_qkv(jax.random.PRNGKey(5), b, s, h, d)
     spec = P(None, "data")
+    # check_vma=False for the flash variant only: the Pallas HLO *interpreter*
+    # re-traces kernel-internal constants under shard_map, which trips the
+    # varying-axes checker (JAX's error text prescribes exactly this
+    # workaround). The compiled Mosaic path on real TPU never interprets the
+    # kernel body, so the check stays on everywhere else.
     ring = jax.jit(
         jax.shard_map(
             partial(
                 ring_attention, axis_name="data", causal=True, use_flash=use_flash
             ),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=not use_flash,
         )
     )
     got = ring(q, k, v)
