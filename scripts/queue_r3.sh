@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-3 serial CPU validation queue (TPU tunnel wedged; MLP workloads only).
+# Each run: forced-CPU backend, 8 virtual devices, hard watchdog, one JSON
+# result line appended to docs/runs_r3.jsonl.
+cd /root/repo
+OUT=docs/runs_r3.jsonl
+run() {
+  local tag="$1"; shift
+  local minutes="$1"; shift
+  echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+  RUN_WATCHDOG_MINUTES=$minutes python scripts/cpu_run.py "$@" \
+    logger.use_console=False > /tmp/q_last.out 2>&1
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' /tmp/q_last.out | tail -1)
+  echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": ${line:-null}, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+}
+
+# Fast closures first (Pendulum off-policy + CartPole Q-variants).
+run ddpg_pendulum 40 --module stoix_tpu.systems.ddpg.ff_ddpg \
+  --default default/anakin/default_ff_ddpg.yaml env=pendulum arch.total_timesteps=300000
+run d4pg_pendulum 40 --module stoix_tpu.systems.ddpg.ff_d4pg \
+  --default default/anakin/default_ff_d4pg.yaml env=pendulum arch.total_timesteps=300000
+run pqn_cartpole 40 --module stoix_tpu.systems.q_learning.ff_pqn \
+  --default default/anakin/default_ff_pqn.yaml arch.total_timesteps=500000
+run rainbow_cartpole 60 --module stoix_tpu.systems.q_learning.ff_rainbow \
+  --default default/anakin/default_ff_rainbow.yaml arch.total_timesteps=1000000
+
+# Tracked config: Snake (6x6, flattened, MLP — the reference's own recipe).
+run dqn_snake 90 --module stoix_tpu.systems.q_learning.ff_dqn \
+  --default default/anakin/default_ff_dqn.yaml env=snake arch.total_timesteps=1000000
+run c51_snake 90 --module stoix_tpu.systems.q_learning.ff_c51 \
+  --default default/anakin/default_ff_c51.yaml env=snake arch.total_timesteps=1000000
+
+# Tracked config: SAC on Ant + PPO-continuous on the physics suite.
+run sac_ant 90 --module stoix_tpu.systems.sac.ff_sac \
+  --default default/anakin/default_ff_sac.yaml env=ant arch.total_timesteps=500000
+run ppo_ant 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=ant arch.total_timesteps=1000000
+run ppo_hopper 60 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=hopper arch.total_timesteps=1000000
+run ppo_walker2d 60 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=walker2d arch.total_timesteps=1000000
+run ppo_halfcheetah 60 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=halfcheetah arch.total_timesteps=1000000
+
+# Search track (MCTS is slow on CPU; keep budgets modest).
+run sampled_az_pendulum 120 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum arch.total_timesteps=300000
+run sampled_mz_pendulum 120 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum arch.total_timesteps=300000
+run spo_cont_pendulum 120 --module stoix_tpu.systems.spo.ff_spo_continuous \
+  --default default/anakin/default_ff_spo_continuous.yaml env=pendulum arch.total_timesteps=300000
+
+echo '{"queue": "done"}' >> "$OUT"

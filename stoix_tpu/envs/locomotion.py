@@ -2,15 +2,21 @@
 rigid-body engine (stoix_tpu/envs/rigid_body.py).
 
 The reference's tracked continuous-control baselines run on the external
-`brax` ant (reference stoix/configs/env/brax/ant.yaml: 27-dim observation,
-8-dim torque actions, forward-velocity reward); `Ant` here is the TPU-native
-stand-in with the same interface scale: a 9-body quadruped (torso + 4
-two-link legs), 8 actuated hinge joints, 27-dim observation, healthy-range
-termination and 1000-step truncation.
+`brax` suite (reference stoix/utils/make_env.py ENV_MAKERS["brax"], configs
+stoix/configs/env/brax/ant.yaml: 27-dim obs, 8-dim torque actions,
+forward-velocity reward); this module is the TPU-native stand-in suite:
 
-Unlike the 4-float classic-control suite, stepping this env is real physics
-work (9 bodies x 16 substeps of joint/contact dynamics per control step) and
-its observation/action widths give the policy/value MLPs MXU-relevant shapes.
+  - `Ant` — 9-body quadruped (torso + 4 two-link legs), 8 actuated hinges,
+    27-dim observation, healthy-band termination, 1000-step truncation.
+  - `Hopper` / `Walker2d` / `HalfCheetah` — the classic planar morphologies
+    (brax/MuJoCo conventions: motion in the x-z plane, hinges about +y,
+    observation widths 11 / 17 / 17), built on the engine's hard planar
+    constraint (rigid_body.RigidBodySystem.planar).
+
+Unlike the 4-float classic-control suite, stepping these envs is real physics
+work (up to 9 bodies x 16 substeps of joint/contact dynamics per control
+step) and the observation/action widths give the policy/value MLPs
+MXU-relevant shapes.
 """
 
 from __future__ import annotations
@@ -124,19 +130,114 @@ def _build_ant() -> Tuple[RigidBodySystem, np.ndarray]:
     return sys, np.asarray(pos, np.float32)
 
 
-class AntState(NamedTuple):
+class LocoState(NamedTuple):
     key: jax.Array
     body: RigidBodyState
     step_count: jax.Array
 
 
-class Ant(Environment):
+# Backwards-compatible aliases (Ant predates the shared base).
+AntState = LocoState
+
+
+class _Locomotion(Environment):
+    """Shared run-in-+x locomotion scaffolding.
+
+    Subclasses set `self._sys` / `self._rest_pos` / `self._obs_dim` in
+    __init__ and supply `_observe` plus a `_healthy(body)` predicate
+    (return None to disable healthy-band termination). Reward =
+    forward velocity + healthy bonus - ctrl_cost_weight * |a|^2;
+    episodes truncate at `max_steps`.
+    """
+
+    _healthy_reward: float = 1.0
+    _ctrl_cost_weight: float = 0.1
+
+    def _noise_mask(self) -> jax.Array:
+        """Per-axis reset-noise mask (planar robots zero the y column)."""
+        if self._sys.planar:
+            return jnp.asarray([1.0, 0.0, 1.0])
+        return jnp.ones((3,))
+
+    def _healthy(self, body: RigidBodyState):
+        """Healthy predicate (scalar bool array), or None for no termination."""
+        raise NotImplementedError
+
+    def _observe(self, state: LocoState) -> Observation:
+        raise NotImplementedError
+
+    @property
+    def _nj(self) -> int:
+        return int(self._sys.num_joints)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((self._obs_dim,), jnp.float32),
+            action_mask=spaces.Array((self._nj,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Box:
+        return spaces.Box(low=-1.0, high=1.0, shape=(self._nj,))
+
+    def reset(self, key: jax.Array) -> Tuple[LocoState, TimeStep]:
+        key, k_pos, k_vel = jax.random.split(key, 3)
+        body = rest_state(self._sys, self._rest_pos)
+        nb = self._sys.num_bodies
+        mask = self._noise_mask()
+        body = body._replace(
+            pos=body.pos
+            + self._reset_noise
+            * mask
+            * jax.random.uniform(k_pos, (nb, 3), minval=-1.0, maxval=1.0),
+            vel=self._reset_noise * mask * jax.random.normal(k_vel, (nb, 3)),
+        )
+        state = LocoState(key, body, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: LocoState, action: jax.Array) -> Tuple[LocoState, TimeStep]:
+        action = jnp.clip(jnp.reshape(action, (self._nj,)), -1.0, 1.0)
+        body = step(self._sys, state.body, action)
+        next_state = LocoState(state.key, body, state.step_count + 1)
+
+        finite = jnp.all(
+            jnp.asarray([jnp.all(jnp.isfinite(leaf)) for leaf in body])
+        )
+        healthy = self._healthy(body)
+        if healthy is None:
+            terminated = ~finite
+        else:
+            terminated = jnp.logical_or(~healthy, ~finite)
+
+        reward = (
+            body.vel[0, 0]  # forward velocity
+            + self._healthy_reward
+            - self._ctrl_cost_weight * jnp.sum(jnp.square(action))
+        )
+        reward = jnp.where(finite, reward, 0.0).astype(jnp.float32)
+
+        obs = self._observe(next_state)
+        # Non-finite physics must not reach the learner: freeze the
+        # observation values via nan_to_num (terminated anyway).
+        obs = obs._replace(agent_view=jnp.nan_to_num(obs.agent_view))
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts
+
+
+class Ant(_Locomotion):
     """Quadruped locomotion: run in +x. Reward = forward velocity + healthy
     bonus - control cost; terminates when the torso leaves its healthy
     height band (brax/ant semantics at this engine's geometry scale)."""
 
     _obs_dim = 27
-    _num_joints = 8
 
     def __init__(
         self,
@@ -153,17 +254,11 @@ class Ant(Environment):
         self._reset_noise = float(reset_noise)
         self._sys, self._rest_pos = _build_ant()
 
-    def observation_space(self) -> Observation:
-        return Observation(
-            agent_view=spaces.Array((self._obs_dim,), jnp.float32),
-            action_mask=spaces.Array((self._num_joints,), jnp.float32),
-            step_count=spaces.Array((), jnp.int32),
-        )
+    def _healthy(self, body: RigidBodyState):
+        torso_z = body.pos[0, 2]
+        return jnp.logical_and(torso_z > self._healthy_z[0], torso_z < self._healthy_z[1])
 
-    def action_space(self) -> spaces.Box:
-        return spaces.Box(low=-1.0, high=1.0, shape=(self._num_joints,))
-
-    def _observe(self, state: AntState) -> Observation:
+    def _observe(self, state: LocoState) -> Observation:
         body = state.body
         view = jnp.concatenate(
             [
@@ -177,55 +272,225 @@ class Ant(Environment):
         )
         return Observation(
             agent_view=view,
-            action_mask=jnp.ones((self._num_joints,), jnp.float32),
+            action_mask=jnp.ones((self._nj,), jnp.float32),
             step_count=state.step_count,
         )
 
-    def reset(self, key: jax.Array) -> Tuple[AntState, TimeStep]:
-        key, k_pos, k_vel = jax.random.split(key, 3)
-        body = rest_state(self._sys, self._rest_pos)
-        nb = self._sys.num_bodies
-        body = body._replace(
-            pos=body.pos
-            + self._reset_noise * jax.random.uniform(k_pos, (nb, 3), minval=-1.0, maxval=1.0),
-            vel=self._reset_noise * jax.random.normal(k_vel, (nb, 3)),
+
+# --- planar morphologies (hopper / walker2d / halfcheetah) -------------------
+
+
+class _PlanarBuilder:
+    """Accumulates bodies/joints/spheres for a planar chain robot.
+
+    All geometry lives in the x-z plane; every hinge axis is +y. Body frames
+    coincide with the world frame in the rest pose (same convention as
+    `_build_ant`), so anchors in body frames are rest-pose world offsets.
+    """
+
+    def __init__(self) -> None:
+        self.pos: list = []
+        self.mass: list = []
+        self.inertia: list = []
+        self.joint_parent: list = []
+        self.joint_child: list = []
+        self.anchor_p: list = []
+        self.anchor_c: list = []
+        self.limit: list = []
+        self.gear: list = []
+        self.sphere_body: list = []
+        self.sphere_offset: list = []
+        self.sphere_radius: list = []
+
+    def body(self, com, mass: float, inertia: float) -> int:
+        idx = len(self.pos)
+        self.pos.append(np.asarray(com, np.float64))
+        self.mass.append(mass)
+        # Rod inertias (~m L^2/12) are padded for rotational stability — see
+        # the numerical-regime note in rigid_body.py.
+        self.inertia.append(np.full(3, inertia))
+        return idx
+
+    def hinge(self, parent: int, child: int, anchor_world, limit, gear: float) -> None:
+        anchor_world = np.asarray(anchor_world, np.float64)
+        self.joint_parent.append(parent)
+        self.joint_child.append(child)
+        self.anchor_p.append(anchor_world - self.pos[parent])
+        self.anchor_c.append(anchor_world - self.pos[child])
+        self.limit.append(np.asarray(limit, np.float64))
+        self.gear.append(gear)
+
+    def sphere(self, body: int, centre_world, radius: float) -> None:
+        self.sphere_body.append(body)
+        self.sphere_offset.append(np.asarray(centre_world, np.float64) - self.pos[body])
+        self.sphere_radius.append(radius)
+
+    def build(self) -> Tuple[RigidBodySystem, np.ndarray]:
+        as_f32 = lambda x: jnp.asarray(np.asarray(x), jnp.float32)  # noqa: E731
+        nj = len(self.joint_parent)
+        sys = RigidBodySystem(
+            mass=as_f32(self.mass),
+            inertia=as_f32(self.inertia),
+            static=jnp.zeros((len(self.mass),), jnp.float32),
+            joint_parent=jnp.asarray(self.joint_parent, jnp.int32),
+            joint_child=jnp.asarray(self.joint_child, jnp.int32),
+            anchor_p=as_f32(self.anchor_p),
+            anchor_c=as_f32(self.anchor_c),
+            axis_p=as_f32(np.tile(np.asarray([0.0, 1.0, 0.0]), (nj, 1))),
+            limit=as_f32(self.limit),
+            gear=as_f32(self.gear),
+            sphere_body=jnp.asarray(self.sphere_body, jnp.int32),
+            sphere_offset=as_f32(self.sphere_offset),
+            sphere_radius=as_f32(self.sphere_radius),
+            planar=True,
         )
-        state = AntState(key, body, jnp.zeros((), jnp.int32))
-        ts = restart(self._observe(state))
-        ts.extras["truncation"] = jnp.zeros((), bool)
-        return state, ts
+        return sys, np.asarray(self.pos, np.float32)
 
-    def step(self, state: AntState, action: jax.Array) -> Tuple[AntState, TimeStep]:
-        action = jnp.clip(jnp.reshape(action, (self._num_joints,)), -1.0, 1.0)
-        body = step(self._sys, state.body, action)
-        next_state = AntState(state.key, body, state.step_count + 1)
 
+def _leg(b: _PlanarBuilder, torso: int, hip_world, gear: float = 30.0) -> None:
+    """One (thigh, leg, foot) planar leg hanging from `hip_world`; shared by
+    hopper and walker2d (MuJoCo hopper leg proportions)."""
+    hip = np.asarray(hip_world, np.float64)
+    knee = hip - np.asarray([0.0, 0.0, 0.45])
+    ankle = knee - np.asarray([0.0, 0.0, 0.5])
+    heel = ankle + np.asarray([-0.13, 0.0, 0.0])
+    toe = ankle + np.asarray([0.26, 0.0, 0.0])
+
+    thigh = b.body(com=(hip + knee) / 2.0, mass=0.8, inertia=0.03)
+    b.hinge(torso, thigh, hip, limit=(-0.9, 0.9), gear=gear)
+    leg = b.body(com=(knee + ankle) / 2.0, mass=0.6, inertia=0.03)
+    b.hinge(thigh, leg, knee, limit=(-1.2, 1.2), gear=gear)
+    foot = b.body(com=(heel + toe) / 2.0, mass=0.4, inertia=0.02)
+    b.hinge(leg, foot, ankle, limit=(-0.6, 0.6), gear=gear / 2.0)
+    b.sphere(foot, heel, 0.08)
+    b.sphere(foot, toe, 0.08)
+
+
+def _build_hopper() -> Tuple[RigidBodySystem, np.ndarray]:
+    """4-body monoped: torso rod (z 1.05-1.45) on one (thigh, leg, foot)."""
+    b = _PlanarBuilder()
+    torso = b.body(com=(0.0, 0.0, 1.25), mass=3.0, inertia=0.08)
+    b.sphere(torso, (0.0, 0.0, 1.45), 0.08)  # crown contact for falls
+    _leg(b, torso, hip_world=(0.0, 0.0, 1.05))
+    return b.build()
+
+
+def _build_walker2d() -> Tuple[RigidBodySystem, np.ndarray]:
+    """7-body biped: the hopper torso with two legs on the same hip point."""
+    b = _PlanarBuilder()
+    torso = b.body(com=(0.0, 0.0, 1.25), mass=3.0, inertia=0.08)
+    b.sphere(torso, (0.0, 0.0, 1.45), 0.08)
+    _leg(b, torso, hip_world=(0.0, 0.0, 1.05))
+    _leg(b, torso, hip_world=(0.0, 0.0, 1.05))
+    return b.build()
+
+
+def _build_halfcheetah() -> Tuple[RigidBodySystem, np.ndarray]:
+    """7-body planar quadruped-gait runner: horizontal torso rod with a
+    (thigh, shin, foot) leg at each end. No healthy band — it may roll."""
+    b = _PlanarBuilder()
+    z0 = 0.6
+    torso = b.body(com=(0.0, 0.0, z0), mass=3.0, inertia=0.3)
+    b.sphere(torso, (-0.5, 0.0, z0), 0.1)
+    b.sphere(torso, (0.5, 0.0, z0), 0.1)
+
+    for hip_x, direction in ((-0.5, -1.0), (0.5, 1.0)):
+        hip = np.asarray([hip_x, 0.0, z0])
+        knee = hip + np.asarray([0.08 * direction, 0.0, -0.27])
+        ankle = knee + np.asarray([-0.06 * direction, 0.0, -0.25])
+        toe = ankle + np.asarray([0.16 * direction, 0.0, 0.0])
+
+        thigh = b.body(com=(hip + knee) / 2.0, mass=0.8, inertia=0.03)
+        b.hinge(torso, thigh, hip, limit=(-1.0, 1.0), gear=30.0)
+        shin = b.body(com=(knee + ankle) / 2.0, mass=0.6, inertia=0.03)
+        b.hinge(thigh, shin, knee, limit=(-1.2, 1.2), gear=30.0)
+        foot = b.body(com=(ankle + toe) / 2.0, mass=0.3, inertia=0.02)
+        b.hinge(shin, foot, ankle, limit=(-0.7, 0.7), gear=15.0)
+        b.sphere(foot, ankle, 0.07)
+        b.sphere(foot, toe, 0.07)
+    return b.build()
+
+
+PlanarState = LocoState
+
+
+class _PlanarLocomotion(_Locomotion):
+    """Planar chain robot running in +x (hopper / walker2d / halfcheetah).
+
+    Observation (MuJoCo planar convention, x excluded as translation
+    invariant): [torso_z, torso_pitch, joint_angles (nj), torso vx, vz,
+    pitch velocity, joint velocities (nj)] — width 5 + 2 * nj.
+    `_terminates = False` disables the healthy band (halfcheetah).
+    """
+
+    _builder = None  # subclass hook
+    _healthy_z: Tuple[float, float] = (0.7, 2.0)
+    _healthy_pitch: float = 1.0
+    _terminates: bool = True
+
+    def __init__(self, max_steps: int = 1000, reset_noise: float = 0.005):
+        self._max_steps = int(max_steps)
+        self._reset_noise = float(reset_noise)
+        self._sys, self._rest_pos = type(self)._builder()
+        self._obs_dim = 5 + 2 * self._nj
+
+    def _pitch(self, body: RigidBodyState) -> jax.Array:
+        # Planar quats stay in the (w, y) subspace: signed rotation about +y.
+        return 2.0 * jnp.arctan2(body.quat[0, 2], body.quat[0, 0])
+
+    def _healthy(self, body: RigidBodyState):
+        if not self._terminates:
+            return None
         torso_z = body.pos[0, 2]
-        healthy = jnp.logical_and(
-            torso_z > self._healthy_z[0], torso_z < self._healthy_z[1]
+        return (
+            (torso_z > self._healthy_z[0])
+            & (torso_z < self._healthy_z[1])
+            & (jnp.abs(self._pitch(body)) < self._healthy_pitch)
         )
-        finite = jnp.all(
-            jnp.asarray([jnp.all(jnp.isfinite(leaf)) for leaf in body])
-        )
-        terminated = jnp.logical_or(~healthy, ~finite)
 
-        forward_vel = body.vel[0, 0]
-        reward = (
-            forward_vel
-            + self._healthy_reward
-            - self._ctrl_cost_weight * jnp.sum(jnp.square(action))
+    def _observe(self, state: LocoState) -> Observation:
+        body = state.body
+        view = jnp.concatenate(
+            [
+                body.pos[0, 2:3],
+                self._pitch(body)[None],
+                joint_angles(self._sys, body),
+                body.vel[0, 0:1],
+                body.vel[0, 2:3],
+                body.ang[0, 1:2],
+                joint_velocities(self._sys, body),
+            ]
         )
-        reward = jnp.where(finite, reward, 0.0).astype(jnp.float32)
+        return Observation(
+            agent_view=view,
+            action_mask=jnp.ones((self._nj,), jnp.float32),
+            step_count=state.step_count,
+        )
 
-        obs = self._observe(next_state)
-        # Non-finite physics must not reach the learner: freeze to the rest
-        # pose observation values via nan_to_num (terminated anyway).
-        obs = obs._replace(agent_view=jnp.nan_to_num(obs.agent_view))
-        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
-        ts = select_step(
-            terminated,
-            termination(reward, obs),
-            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
-        )
-        ts.extras["truncation"] = truncated
-        return next_state, ts
+
+class Hopper(_PlanarLocomotion):
+    """Planar monoped (obs 11, actions 3) — brax/MuJoCo Hopper-class."""
+
+    _builder = staticmethod(_build_hopper)
+    _healthy_z = (0.8, 2.0)
+    _healthy_pitch = 0.4
+    _ctrl_cost_weight = 0.001
+
+
+class Walker2d(_PlanarLocomotion):
+    """Planar biped (obs 17, actions 6) — brax/MuJoCo Walker2d-class."""
+
+    _builder = staticmethod(_build_walker2d)
+    _healthy_z = (0.8, 2.0)
+    _healthy_pitch = 1.0
+    _ctrl_cost_weight = 0.001
+
+
+class HalfCheetah(_PlanarLocomotion):
+    """Planar runner (obs 17, actions 6), no healthy-band termination —
+    brax/MuJoCo HalfCheetah-class."""
+
+    _builder = staticmethod(_build_halfcheetah)
+    _healthy_reward = 0.0
+    _ctrl_cost_weight = 0.1
+    _terminates = False

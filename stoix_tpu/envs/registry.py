@@ -12,7 +12,12 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from stoix_tpu.envs import classic, debug, locomotion, minatar, snake
 from stoix_tpu.envs.core import Environment
-from stoix_tpu.envs.wrappers import EpisodeStepLimit, RecordEpisodeMetrics, apply_core_wrappers
+from stoix_tpu.envs.wrappers import (
+    EpisodeStepLimit,
+    FlattenObservationWrapper,
+    RecordEpisodeMetrics,
+    apply_core_wrappers,
+)
 
 # scenario name -> constructor(**env_kwargs)
 ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
@@ -23,6 +28,9 @@ ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
     "MountainCarContinuous-v0": classic.MountainCarContinuous,
     "Catch-bsuite": classic.Catch,
     "Ant": locomotion.Ant,
+    "Hopper": locomotion.Hopper,
+    "Walker2d": locomotion.Walker2d,
+    "HalfCheetah": locomotion.HalfCheetah,
     "Breakout-minatar": minatar.Breakout,
     "Asterix-minatar": minatar.Asterix,
     "Freeway-minatar": minatar.Freeway,
@@ -67,7 +75,8 @@ def make(config: Any) -> Tuple[Environment, Environment]:
         env.scenario.name        — registry key
         env.kwargs               — ctor kwargs (optional)
         env.wrapper              — dict(max_episode_steps, use_optimistic_reset,
-                                   reset_ratio, use_cached_auto_reset) (optional)
+                                   reset_ratio, use_cached_auto_reset,
+                                   flatten_observation) (optional)
         arch.total_num_envs      — global env count (split across data shards upstream)
     """
     env_cfg = config.env
@@ -78,6 +87,10 @@ def make(config: Any) -> Tuple[Environment, Environment]:
 
     train_env = make_single(scenario, suite=suite, **kwargs)
     eval_env = make_single(scenario, suite=suite, **kwargs)
+
+    if wrapper_cfg.get("flatten_observation", False):
+        train_env = FlattenObservationWrapper(train_env)
+        eval_env = FlattenObservationWrapper(eval_env)
 
     num_envs = int(config.arch.total_num_envs)
     train_env = apply_core_wrappers(

@@ -127,6 +127,13 @@ class RigidBodySystem(NamedTuple):
     friction_kv: float = 50.0  # viscous tangential coefficient
     lin_damping: float = 0.02  # global velocity damping (1/s)
     ang_damping: float = 0.05
+    # Planar mode: constrain all motion to the x-z plane (hinges about +y).
+    # The MuJoCo/brax hopper / walker2d / halfcheetah morphologies are planar
+    # robots; a 3D engine integrating them unconstrained lets them fall
+    # sideways, so planar systems project velocities onto the plane each
+    # substep (y translation and x/z rotation zeroed — a hard constraint,
+    # not a spring). Static python bool: jit specializes per system.
+    planar: bool = False
 
     @property
     def num_bodies(self) -> int:
@@ -280,6 +287,11 @@ def _substep(
         1.0 - sys.ang_damping * sys.dt
     )
     ang = ang * movable
+    if sys.planar:
+        # Hard x-z plane constraint: no y translation, rotation about +y only.
+        vel = vel * jnp.asarray([1.0, 0.0, 1.0])
+        pos = pos * jnp.asarray([1.0, 0.0, 1.0])
+        ang = ang * jnp.asarray([0.0, 1.0, 0.0])
     quat = quat_integrate(state.quat, ang, sys.dt)
     return RigidBodyState(pos, quat, vel, ang)
 

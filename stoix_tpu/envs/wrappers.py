@@ -216,6 +216,45 @@ class CachedAutoResetWrapper(Wrapper):
         return CachedAutoResetState(next_inner, state.cached_state, state.cached_obs, key), ts
 
 
+class FlattenObservationWrapper(Wrapper):
+    """Flatten a structured (grid/pixel) agent_view to 1-D so MLP torsos can
+    consume it — the reference pairs its MLP networks with grid envs via
+    `stoa.FlattenObservationWrapper` (reference configs/env/jumanji/snake.yaml
+    `wrapper: _target_: stoa.FlattenObservationWrapper`). Applied to the raw
+    env, below the core stack, so `extras["next_obs"]` is flattened too."""
+
+    def __init__(self, env: Environment):
+        super().__init__(env)
+        spec = env.observation_space().agent_view
+        self._feature_rank = len(spec.shape)
+        self._flat_dim = 1
+        for d in spec.shape:
+            self._flat_dim *= int(d)
+
+    def _flatten(self, ts: TimeStep) -> TimeStep:
+        view = ts.observation.agent_view
+        shape = view.shape[: view.ndim - self._feature_rank] + (self._flat_dim,)
+        return ts._replace(
+            observation=ts.observation._replace(agent_view=view.reshape(shape))
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[State, TimeStep]:
+        state, ts = self._env.reset(key)
+        return state, self._flatten(ts)
+
+    def step(self, state: State, action: Action) -> Tuple[State, TimeStep]:
+        state, ts = self._env.step(state, action)
+        return state, self._flatten(ts)
+
+    def observation_space(self) -> Any:
+        import dataclasses
+
+        obs = self._env.observation_space()
+        return obs._replace(
+            agent_view=dataclasses.replace(obs.agent_view, shape=(self._flat_dim,))
+        )
+
+
 class VmapWrapper(Wrapper):
     """Vectorizes reset/step over a leading batch of keys/states/actions."""
 

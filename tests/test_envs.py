@@ -222,3 +222,29 @@ def test_step_limit_wrapper():
     assert bool(ts.last())
     assert float(ts.discount) == 1.0
     assert bool(ts.extras["truncation"])
+
+
+def test_flatten_observation_wrapper():
+    """Grid agent_view flattens to 1-D everywhere: spec, reset, step, and
+    under the full core stack (so extras["next_obs"] is flat too)."""
+    from stoix_tpu.envs.snake import Snake
+    from stoix_tpu.envs.wrappers import FlattenObservationWrapper, apply_core_wrappers
+
+    env = FlattenObservationWrapper(Snake(num_rows=6, num_cols=6))
+    spec = env.observation_space().agent_view
+    grid_shape = Snake(num_rows=6, num_cols=6).observation_space().agent_view.shape
+    flat = int(np.prod(grid_shape))
+    assert spec.shape == (flat,)
+
+    state, ts = env.reset(jax.random.PRNGKey(0))
+    assert ts.observation.agent_view.shape == (flat,)
+    state, ts = env.step(state, jnp.asarray(0))
+    assert ts.observation.agent_view.shape == (flat,)
+
+    wrapped = apply_core_wrappers(
+        FlattenObservationWrapper(Snake(num_rows=6, num_cols=6)), num_envs=4
+    )
+    state, ts = wrapped.reset(jax.random.split(jax.random.PRNGKey(0), 4))
+    state, ts = wrapped.step(state, jnp.zeros((4,), jnp.int32))
+    assert ts.observation.agent_view.shape == (4, flat)
+    assert ts.extras["next_obs"].agent_view.shape == (4, flat)

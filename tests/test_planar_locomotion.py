@@ -1,0 +1,98 @@
+"""Planar locomotion morphologies (Hopper / Walker2d / HalfCheetah) — the
+first-party stand-ins for the reference's brax planar configs
+(reference stoix/configs/env/brax/{hopper,walker2d,halfcheetah}.yaml)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.envs.locomotion import HalfCheetah, Hopper, Walker2d
+
+ALL = [Hopper, Walker2d, HalfCheetah]
+
+
+@pytest.mark.parametrize("cls", ALL)
+def test_observation_widths_match_mujoco_convention(cls):
+    env = cls()
+    _, ts = env.reset(jax.random.PRNGKey(0))
+    nj = env.action_space().shape[0]
+    assert ts.observation.agent_view.shape == (5 + 2 * nj,)
+    assert (cls, nj) in {(Hopper, 3), (Walker2d, 6), (HalfCheetah, 6)}
+
+
+@pytest.mark.parametrize("cls", ALL)
+def test_planar_constraint_is_exact(cls):
+    """y translation and out-of-plane rotation must stay identically zero."""
+    env = cls()
+    state, _ = env.reset(jax.random.PRNGKey(0))
+    step = jax.jit(env.step)
+    for i in range(40):
+        a = jax.random.uniform(
+            jax.random.PRNGKey(i), env.action_space().shape, minval=-1.0, maxval=1.0
+        )
+        state, _ = step(state, a)
+    assert float(jnp.max(jnp.abs(state.body.pos[:, 1]))) == 0.0
+    # Planar quats live in the (w, y) subspace.
+    assert float(jnp.max(jnp.abs(state.body.quat[:, 1]))) < 1e-6
+    assert float(jnp.max(jnp.abs(state.body.quat[:, 3]))) < 1e-6
+
+
+def test_walker_zero_action_stands():
+    env = Walker2d()
+    state, _ = env.reset(jax.random.PRNGKey(0))
+    step = jax.jit(env.step)
+    for _ in range(80):
+        state, ts = step(state, jnp.zeros(env.action_space().shape))
+        assert not bool(ts.last())
+    assert float(state.body.pos[0, 2]) > 0.9
+
+
+def test_hopper_zero_action_eventually_falls():
+    """A monoped with no control collapses — termination fires, like MuJoCo."""
+    env = Hopper()
+    state, _ = env.reset(jax.random.PRNGKey(0))
+    step = jax.jit(env.step)
+    for i in range(200):
+        state, ts = step(state, jnp.zeros(env.action_space().shape))
+        if bool(ts.last()):
+            return
+    raise AssertionError("hopper never terminated under zero action")
+
+
+def test_halfcheetah_never_terminates_only_truncates():
+    env = HalfCheetah(max_steps=50)
+    state, _ = env.reset(jax.random.PRNGKey(0))
+    step = jax.jit(env.step)
+    for i in range(50):
+        a = jax.random.uniform(
+            jax.random.PRNGKey(i), env.action_space().shape, minval=-1.0, maxval=1.0
+        )
+        state, ts = step(state, a)
+        if i < 49:
+            assert not bool(ts.last())
+    assert bool(ts.last()) and bool(ts.extras["truncation"])
+    assert float(ts.discount) == 1.0  # truncation bootstraps
+
+
+@pytest.mark.parametrize("cls", ALL)
+def test_random_rollout_finite(cls):
+    env = cls()
+    state, _ = env.reset(jax.random.PRNGKey(3))
+    step = jax.jit(env.step)
+    for i in range(60):
+        a = jax.random.uniform(
+            jax.random.PRNGKey(100 + i), env.action_space().shape, minval=-1.0, maxval=1.0
+        )
+        state, ts = step(state, a)
+        assert bool(jnp.all(jnp.isfinite(ts.observation.agent_view)))
+        assert np.isfinite(float(ts.reward))
+
+
+def test_vmap_batches():
+    env = Hopper()
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    states, ts = jax.vmap(env.reset)(keys)
+    actions = jnp.zeros((4,) + env.action_space().shape)
+    states, ts = jax.jit(jax.vmap(env.step))(states, actions)
+    assert ts.observation.agent_view.shape == (4, 11)
