@@ -9,11 +9,14 @@ vs_baseline is measured per-chip throughput / that per-chip target; it is
 reported as null for the variant workloads (--cartpole, --large), which are
 incommensurable with the ant baseline.
 
-Usage: python bench.py [--smoke] [--cartpole] [--large] [--cpu]
+Usage: python bench.py [--smoke] [--cartpole] [--large] [--sebulba] [--cpu]
   --smoke     tiny budget for CI wiring checks
   --cartpole  the round-1 metric: tiny-MLP CartPole (VPU-bound; kept for
               continuity)
   --large     MXU-bound variant (1024x1024 bfloat16 torsos on Ant)
+  --sebulba   actor/learner-disaggregated PPO on the native C++ env pool
+              (CartPole); reports steady-state env-steps/sec (post-compile
+              window measured inside the host loop)
   --cpu       force the CPU backend (a site hook can force a remote platform
               even over JAX_PLATFORMS=cpu; this flag wins)
 """
@@ -29,11 +32,17 @@ def main() -> None:
     smoke = "--smoke" in sys.argv
     large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
     cartpole = "--cartpole" in sys.argv
+    sebulba = "--sebulba" in sys.argv
     if large and cartpole:
         sys.exit("--large is the MXU-bound Ant variant; it does not compose with --cartpole")
+    if sebulba and (large or cartpole):
+        sys.exit("--sebulba is its own workload; it does not compose with other variants")
 
     env_tag = "cartpole" if cartpole else "ant"
-    metric = f"anakin_ppo_{env_tag}_env_steps_per_sec" + ("_large_bf16" if large else "")
+    if sebulba:
+        metric = "sebulba_ppo_cartpole_env_steps_per_sec"
+    else:
+        metric = f"anakin_ppo_{env_tag}_env_steps_per_sec" + ("_large_bf16" if large else "")
 
     # Watchdog: remote-platform runtimes can wedge indefinitely (observed with
     # the tunneled TPU backend). A SIGALRM handler is NOT enough — Python
@@ -95,6 +104,10 @@ def main() -> None:
     watchdog = threading.Timer(1800.0, _fail, args=("TIMEOUT: device runtime unresponsive",))
     watchdog.daemon = True
     watchdog.start()
+
+    if sebulba:
+        _run_sebulba(metric, smoke, n_devices)
+        return
 
     overrides = [
         "arch.total_num_envs=%d" % (2048 * n_devices if not smoke else 8 * n_devices),
@@ -182,6 +195,54 @@ def main() -> None:
                 ),
             }
         )
+    )
+
+
+def _run_sebulba(metric: str, smoke: bool, n_devices: int) -> None:
+    """Sebulba PPO on the native C++ CartPole pool; steady-state SPS.
+
+    Device split: with 1 device everything shares it; with 2+ devices actors
+    get device 0, the learner the rest (mirrors the validated CI split).
+    """
+    import json as _json
+
+    from stoix_tpu.systems.ppo.sebulba import ff_ppo as sebulba_ppo
+    from stoix_tpu.utils import config as config_lib
+
+    learner_ids = [0] if n_devices == 1 else list(range(1, n_devices))
+    overrides = [
+        "env=cartpole",
+        "env.backend=cvec",
+        "arch.total_num_envs=%d" % (16 if smoke else 512),
+        "arch.actor.device_ids=[0]",
+        "arch.actor.actor_per_device=%d" % (1 if smoke else 2),
+        "arch.learner.device_ids=%s" % str(learner_ids).replace(" ", ""),
+        "arch.evaluator_device_id=0",
+        "arch.num_updates=%d" % (4 if smoke else 64),
+        "arch.total_timesteps=~",
+        "arch.num_evaluation=%d" % (2 if smoke else 8),
+        "arch.num_eval_episodes=8",
+        "arch.absolute_metric=False",
+        "system.rollout_length=%d" % (8 if smoke else 64),
+        "logger.use_console=False",
+    ]
+    config = config_lib.compose(
+        config_lib.default_config_dir(), "default/sebulba/default_ff_ppo.yaml", overrides
+    )
+    sebulba_ppo.run_experiment(config)
+    steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
+    print(
+        _json.dumps(
+            {
+                "metric": metric,
+                "value": round(float(steady), 1) if steady else 0.0,
+                "unit": "env_steps/sec (steady-state, %d devices, C++ pool)" % n_devices,
+                # Sebulba has no tracked numeric baseline (reference publishes
+                # none for its sebulba arch); report the raw number.
+                "vs_baseline": None,
+            }
+        ),
+        flush=True,
     )
 
 

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-3 wave 2: re-runs after run-shape defaults + PQN decay + C51 vmax fix.
+cd /root/repo
+# Serialize behind wave 1.
+while pgrep -f "queue_r3.sh" > /dev/null && [ "$(pgrep -f queue_r3.sh | head -1)" != "$$" ]; do
+  sleep 60
+done
+OUT=docs/runs_r3.jsonl
+run() {
+  local tag="$1"; shift
+  local minutes="$1"; shift
+  echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+  RUN_WATCHDOG_MINUTES=$minutes python scripts/cpu_run.py "$@" \
+    logger.use_console=False > /tmp/q_last.out 2>&1
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' /tmp/q_last.out | tail -1)
+  echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": ${line:-null}, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+}
+
+run ddpg_pendulum_v2 60 --module stoix_tpu.systems.ddpg.ff_ddpg \
+  --default default/anakin/default_ff_ddpg.yaml env=pendulum arch.total_timesteps=300000
+run d4pg_pendulum_v2 60 --module stoix_tpu.systems.ddpg.ff_d4pg \
+  --default default/anakin/default_ff_d4pg.yaml env=pendulum arch.total_timesteps=300000 \
+  system.vmin=-1700 system.vmax=0
+run td3_pendulum_v2 60 --module stoix_tpu.systems.ddpg.ff_td3 \
+  --default default/anakin/default_ff_td3.yaml env=pendulum arch.total_timesteps=300000
+run pqn_cartpole_v2 60 --module stoix_tpu.systems.q_learning.ff_pqn \
+  --default default/anakin/default_ff_pqn.yaml arch.total_timesteps=1000000
+run rainbow_cartpole_v2 90 --module stoix_tpu.systems.q_learning.ff_rainbow \
+  --default default/anakin/default_ff_rainbow.yaml arch.total_timesteps=1000000
+run c51_snake_v2 90 --module stoix_tpu.systems.q_learning.ff_c51 \
+  --default default/anakin/default_ff_c51.yaml env=snake arch.total_timesteps=1000000 \
+  system.vmin=0 system.vmax=40
+run sampled_az_pendulum_v2 150 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum arch.total_timesteps=300000
+run sampled_mz_pendulum_v2 150 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum arch.total_timesteps=300000
+
+echo '{"queue": "wave2 done"}' >> "$OUT"

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-3 wave 3: physics envs with observation normalization + bigger
+# budgets; SPO-continuous re-run on the 64-env replay shape.
+cd /root/repo
+while pgrep -f "queue_r3b.sh" > /dev/null; do sleep 60; done
+OUT=docs/runs_r3.jsonl
+run() {
+  local tag="$1"; shift
+  local minutes="$1"; shift
+  echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+  RUN_WATCHDOG_MINUTES=$minutes python scripts/cpu_run.py "$@" \
+    logger.use_console=False > /tmp/q_last.out 2>&1
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' /tmp/q_last.out | tail -1)
+  echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": ${line:-null}, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+}
+
+run spo_cont_pendulum_v2 120 --module stoix_tpu.systems.spo.ff_spo_continuous \
+  --default default/anakin/default_ff_spo_continuous.yaml env=pendulum arch.total_timesteps=300000
+run sac_ant_v2 120 --module stoix_tpu.systems.sac.ff_sac \
+  --default default/anakin/default_ff_sac.yaml env=ant arch.total_timesteps=1000000
+run ppo_ant_norm 120 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=ant \
+  arch.total_timesteps=3000000 system.normalize_observations=true
+run ppo_hopper_norm 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=hopper \
+  arch.total_timesteps=2000000 system.normalize_observations=true
+run ppo_halfcheetah_norm 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=halfcheetah \
+  arch.total_timesteps=2000000 system.normalize_observations=true
+
+echo '{"queue": "wave3 done"}' >> "$OUT"

@@ -411,9 +411,6 @@ def _build_halfcheetah() -> Tuple[RigidBodySystem, np.ndarray]:
     return b.build()
 
 
-PlanarState = LocoState
-
-
 class _PlanarLocomotion(_Locomotion):
     """Planar chain robot running in +x (hopper / walker2d / halfcheetah).
 

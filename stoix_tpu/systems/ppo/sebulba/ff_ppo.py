@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, List, NamedTuple
 
 import jax
@@ -44,6 +45,11 @@ from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
 from stoix_tpu.utils.timing import TimingTracker
 from stoix_tpu.utils.training import make_learning_rate
+
+# Throughput stats of the most recent run_experiment call in this process
+# (steady-state window: after the first eval block, i.e. post-compile).
+# Read by bench.py --sebulba; a dict so callers can ignore it entirely.
+LAST_RUN_STATS: dict = {}
 
 
 class CoreLearnerState(NamedTuple):
@@ -317,6 +323,7 @@ def run_experiment(
     learn_step_builder: Callable = None,
     networks_builder: Callable = None,
 ) -> float:
+    LAST_RUN_STATS.clear()
     devices = jax.devices()
     actor_devices = [devices[i] for i in config.arch.actor.device_ids]
     learner_devices = [devices[i] for i in config.arch.learner.device_ids]
@@ -450,6 +457,8 @@ def run_experiment(
 
     timer = TimingTracker()
     t_steps = 0
+    steady_start_time = None  # set after the first eval block (post-compile)
+    steady_start_steps = 0
     try:
         for update_idx in range(int(config.arch.num_updates)):
             with timer.time("rollout_get"):
@@ -512,6 +521,15 @@ def run_experiment(
                     jax.tree.map(np.asarray, eval_payload), evaluator_device
                 )
                 async_evaluator.submit(eval_params, ek, t_steps)
+                if steady_start_time is None:
+                    # Steady-state SPS window opens once compile/warmup has
+                    # been paid (end of the first eval block).
+                    steady_start_time = time.perf_counter()
+                    steady_start_steps = t_steps
+        # Close the window BEFORE shutdown: thread joins / evaluator drain in
+        # the finally block below can take tens of seconds and must not
+        # deflate the steady-state number.
+        steady_end_time = time.perf_counter()
     finally:
         lifetime.stop()
         param_server.shutdown()
@@ -524,6 +542,13 @@ def run_experiment(
         for t in actor_threads:
             t.join(timeout=10.0)
         async_evaluator.wait_until_idle(timeout=120.0)
+
+    if steady_start_time is not None and t_steps > steady_start_steps:
+        steady = (t_steps - steady_start_steps) / (
+            steady_end_time - steady_start_time
+        )
+        LAST_RUN_STATS["steps_per_sec_steady"] = steady
+        LAST_RUN_STATS["steady_window_steps"] = t_steps - steady_start_steps
 
     logger.close()
     return eval_results[-1] if eval_results else 0.0

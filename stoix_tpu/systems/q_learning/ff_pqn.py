@@ -29,11 +29,37 @@ def get_learner_fn(env, q_apply, q_update, config):
     gamma = float(config.system.gamma)
     lam = float(config.system.get("q_lambda", 0.65))
     train_eps = float(config.system.training_epsilon)
+    # Reference PQN anneals epsilon 1.0 -> training_epsilon over
+    # exploration_fraction of training (reference
+    # configs/system/q_learning/ff_pqn.yaml decay_epsilon/exploration_fraction).
+    # PQN is buffer-free, so progress is read off the optimizer step count.
+    decay = bool(config.system.get("decay_epsilon", False))
+    explore_frac = float(config.system.get("exploration_fraction", 0.5))
+    grad_steps_per_update = int(config.system.epochs) * int(config.system.num_minibatches)
+    decay_updates = max(1.0, explore_frac * int(config.arch.num_updates))
+
+    def _epsilon(opt_states):
+        if not decay:
+            return train_eps
+        # First 'count' leaf by tree path: with decay_learning_rates the
+        # chain holds TWO count leaves (radam's and the LR schedule's), so
+        # optax.tree_utils.tree_get would raise on ambiguity; every count in
+        # the chain increments once per gradient step, any one will do.
+        count = None
+        for path, leaf in jax.tree_util.tree_leaves_with_path(opt_states):
+            if any(getattr(k, "name", None) == "count" for k in path):
+                count = leaf
+                break
+        assert count is not None, "optimizer state has no step count leaf"
+        frac = jnp.minimum(
+            count.astype(jnp.float32) / grad_steps_per_update / decay_updates, 1.0
+        )
+        return 1.0 + frac * (train_eps - 1.0)
 
     def _env_step(learner_state: OnPolicyLearnerState, _):
         params, opt_states, key, env_state, last_timestep = learner_state
         key, act_key = jax.random.split(key)
-        dist = q_apply(params, last_timestep.observation, train_eps)
+        dist = q_apply(params, last_timestep.observation, _epsilon(opt_states))
         action = dist.sample(seed=act_key)
         env_state, timestep = env.step(env_state, action)
         data = {
