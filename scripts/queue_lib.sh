@@ -1,0 +1,30 @@
+#!/bin/bash
+# Shared helpers for the serial validation queues (source from a wave script).
+#
+# Serialization is a global flock (one experiment process at a time — the
+# safe-run rule for the shared CPU core / TPU tunnel), not pgrep chaining:
+# waves started in any order queue behind the lock instead of racing. Each
+# run captures to its own file so concurrent-wave captures can't cross.
+#
+# Usage:
+#   source "$(dirname "$0")/queue_lib.sh"
+#   run <tag> <watchdog-minutes> <cpu_run.py args...>
+
+QUEUE_OUT=${QUEUE_OUT:-docs/runs_r3.jsonl}
+QUEUE_LOCK=${QUEUE_LOCK:-/tmp/stoix_queue.lock}
+
+run() {
+  local tag="$1"; shift
+  local minutes="$1"; shift
+  local capture="/tmp/q_${tag}.out"
+  (
+    flock 9
+    echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
+    RUN_WATCHDOG_MINUTES=$minutes python scripts/cpu_run.py "$@" \
+      logger.use_console=False > "$capture" 2>&1
+    local rc=$?
+    local line
+    line=$(grep -E '^\{' "$capture" | tail -1)
+    echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": ${line:-null}, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
+  ) 9>"$QUEUE_LOCK"
+}

@@ -1,0 +1,49 @@
+#!/bin/bash
+# Round-3 wave 4: C51-on-Snake recipe variants, TD3 shape check, extended
+# DDPG/D4PG/Rainbow budgets.
+cd /root/repo
+while pgrep -f "queue_r3c.sh" > /dev/null; do sleep 60; done
+OUT=docs/runs_r3.jsonl
+run() {
+  local tag="$1"; shift
+  local minutes="$1"; shift
+  echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+  RUN_WATCHDOG_MINUTES=$minutes python scripts/cpu_run.py "$@" \
+    logger.use_console=False > /tmp/q_last.out 2>&1
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' /tmp/q_last.out | tail -1)
+  echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": ${line:-null}, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+}
+
+# C51 on Snake: (a) add the epsilon decay the round-1 CartPole solve used;
+# (b) additionally adopt the DQN reuse recipe (epochs 8, lr, tau).
+run c51_snake_v3a 90 --module stoix_tpu.systems.q_learning.ff_c51 \
+  --default default/anakin/default_ff_c51.yaml env=snake arch.total_timesteps=1000000 \
+  system.vmin=0 system.vmax=40 system.final_epsilon=0.02 system.epsilon_decay_steps=25000
+run c51_snake_v3b 90 --module stoix_tpu.systems.q_learning.ff_c51 \
+  --default default/anakin/default_ff_c51.yaml env=snake arch.total_timesteps=1000000 \
+  system.vmin=0 system.vmax=40 system.final_epsilon=0.02 system.epsilon_decay_steps=25000 \
+  system.q_lr=5.0e-4 system.tau=0.05 system.epochs=8
+
+# TD3 regression check: 64-env default vs the wave-1 1024-env shape.
+run td3_pendulum_seed1 60 --module stoix_tpu.systems.ddpg.ff_td3 \
+  --default default/anakin/default_ff_td3.yaml env=pendulum arch.total_timesteps=300000 arch.seed=1
+run td3_pendulum_256 60 --module stoix_tpu.systems.ddpg.ff_td3 \
+  --default default/anakin/default_ff_td3.yaml env=pendulum arch.total_timesteps=300000 \
+  arch.total_num_envs=256
+
+# DDPG / D4PG: longer budget + reference exploration sigma 0.15.
+run ddpg_pendulum_v3 90 --module stoix_tpu.systems.ddpg.ff_ddpg \
+  --default default/anakin/default_ff_ddpg.yaml env=pendulum arch.total_timesteps=600000 \
+  system.exploration_sigma=0.15
+run d4pg_pendulum_v3 90 --module stoix_tpu.systems.ddpg.ff_d4pg \
+  --default default/anakin/default_ff_d4pg.yaml env=pendulum arch.total_timesteps=600000 \
+  system.exploration_sigma=0.15 system.vmin=-1700 system.vmax=0
+
+# Rainbow: higher lr + longer budget.
+run rainbow_cartpole_v3 120 --module stoix_tpu.systems.q_learning.ff_rainbow \
+  --default default/anakin/default_ff_rainbow.yaml arch.total_timesteps=2000000 \
+  system.q_lr=2.5e-4 system.tau=0.05
+
+echo '{"queue": "wave4 done"}' >> "$OUT"

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-3 wave 5: Sebulba continuous-control long run on the native C++ pool.
+cd /root/repo
+while pgrep -f "queue_r3d.sh" > /dev/null; do sleep 60; done
+OUT=docs/runs_r3.jsonl
+run() {
+  local tag="$1"; shift
+  local minutes="$1"; shift
+  echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+  RUN_WATCHDOG_MINUTES=$minutes python scripts/cpu_run.py "$@" \
+    logger.use_console=False > /tmp/q_last.out 2>&1
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' /tmp/q_last.out | tail -1)
+  echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": ${line:-null}, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$OUT"
+}
+
+run sebulba_ppo_cont_pendulum 90 --module stoix_tpu.systems.ppo.sebulba.ff_ppo \
+  --default default/sebulba/default_ff_ppo.yaml env=pendulum env.backend=cvec \
+  env.kwargs.max_steps=200 network=mlp_continuous arch.total_num_envs=64 \
+  arch.total_timesteps=500000 system.rollout_length=32 \
+  arch.actor.device_ids='[0]' arch.actor.actor_per_device=2 \
+  arch.learner.device_ids='[1]' arch.evaluator_device_id=2
+
+echo '{"queue": "wave5 done"}' >> "$OUT"

@@ -3,11 +3,13 @@
 (reference stoix/wrappers/envpool.py adapts EnvPool's API the same way: manual
 auto-reset bookkeeping, numpy episode metrics, stoa-style TimeSteps).
 
-Games: "CartPole-v1" (4-float obs), and the 10x10x4-pixel MinAtar-class set
-"Breakout-minatar", "Asterix-minatar", "Freeway-minatar",
-"SpaceInvaders-minatar" — the Atari-class workloads for the Sebulba CNN path,
-each with a bit-identical pure-JAX twin in envs/minatar.py. The shared
-library is compiled on first use with g++ and cached next to the source; no
+Games: "CartPole-v1" (4-float obs), "Pendulum-v1" (continuous torque — the
+Sebulba continuous-control workload, float actions through cvec_step_cont),
+and the 10x10x4-pixel MinAtar-class set "Breakout-minatar",
+"Asterix-minatar", "Freeway-minatar", "SpaceInvaders-minatar" — the
+Atari-class workloads for the Sebulba CNN path, each with a (bit-)identical
+pure-JAX twin in envs/minatar.py / envs/classic.py. The shared library is
+compiled on first use with g++ and cached next to the source; no
 Python-level per-env loops exist anywhere on the hot path.
 """
 
@@ -56,6 +58,14 @@ def _load_lib() -> ctypes.CDLL:
     lib.cvec_obs_shape.argtypes = [ctypes.c_void_p, i32p]
     lib.cvec_num_actions.argtypes = [ctypes.c_void_p]
     lib.cvec_num_actions.restype = ctypes.c_int
+    lib.cvec_action_dim.argtypes = [ctypes.c_void_p]
+    lib.cvec_action_dim.restype = ctypes.c_int
+    lib.cvec_action_bounds.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.cvec_step_cont.argtypes = [ctypes.c_void_p, f32p, f32p, f32p, f32p, u8p, u8p, f32p, i32p]
     lib.cvec_destroy.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -77,6 +87,13 @@ class CVecPool:
             (int(shape3[0]),) if shape3[1] == 1 and shape3[2] == 1 else tuple(int(s) for s in shape3)
         )
         self._num_actions = int(self._lib.cvec_num_actions(self._handle))
+        # action_dim > 0 marks a continuous game (float [n, action_dim]
+        # actions through cvec_step_cont; Box action space with the game's
+        # native bounds).
+        self._action_dim = int(self._lib.cvec_action_dim(self._handle))
+        lo, hi = ctypes.c_float(), ctypes.c_float()
+        self._lib.cvec_action_bounds(self._handle, ctypes.byref(lo), ctypes.byref(hi))
+        self._action_bounds = (float(lo.value), float(hi.value))
         dim = int(self._lib.cvec_obs_dim(self._handle))
         self._obs = np.zeros((num_envs, dim), np.float32)
         self._next_obs = np.zeros((num_envs, dim), np.float32)
@@ -101,7 +118,10 @@ class CVecPool:
             step_count=spaces.Array((), np.int32),
         )
 
-    def action_space(self) -> spaces.Discrete:
+    def action_space(self):
+        if self._action_dim > 0:
+            lo, hi = self._action_bounds
+            return spaces.Box(low=lo, high=hi, shape=(self._action_dim,))
         return spaces.Discrete(self._num_actions)
 
     def _observation(self, view: np.ndarray, counts: np.ndarray) -> Observation:
@@ -148,11 +168,20 @@ class CVecPool:
         return self._timestep(first=True)
 
     def step(self, action: Any) -> TimeStep:
-        actions = np.ascontiguousarray(np.asarray(action, np.int32))
-        self._lib.cvec_step(
-            self._handle, actions, self._obs, self._next_obs, self._reward,
-            self._done, self._trunc, self._ep_return, self._ep_length,
-        )
+        if self._action_dim > 0:
+            actions = np.ascontiguousarray(
+                np.asarray(action, np.float32).reshape(self._n, self._action_dim)
+            )
+            self._lib.cvec_step_cont(
+                self._handle, actions, self._obs, self._next_obs, self._reward,
+                self._done, self._trunc, self._ep_return, self._ep_length,
+            )
+        else:
+            actions = np.ascontiguousarray(np.asarray(action, np.int32))
+            self._lib.cvec_step(
+                self._handle, actions, self._obs, self._next_obs, self._reward,
+                self._done, self._trunc, self._ep_return, self._ep_length,
+            )
         return self._timestep(first=False)
 
     def __del__(self) -> None:

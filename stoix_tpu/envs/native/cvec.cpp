@@ -52,11 +52,20 @@ struct VecEnv {
   virtual int obs_dim() const = 0;                 // flattened length
   virtual void obs_shape(int32_t* out3) const = 0; // (a, b, c); (d, 1, 1) = vector
   virtual int num_actions() const = 0;
+  // Continuous-control surface: action_dim 0 marks a discrete game; a
+  // continuous game overrides action_dim/action_bounds/step_env_cont and the
+  // pool is stepped through cvec_step_cont with float actions instead.
+  virtual int action_dim() const { return 0; }
+  virtual void action_bounds(float* lo, float* hi) const { *lo = -1.0f; *hi = 1.0f; }
 
   virtual void reset_env(int i) = 0;
   virtual void write_obs(int i, float* out) const = 0;
   // Advances env i; returns reward, sets *terminated.
   virtual float step_env(int i, int32_t action, bool* terminated) = 0;
+  virtual float step_env_cont(int i, const float* action, bool* terminated) {
+    (void)i; (void)action; (void)terminated;
+    return 0.0f;  // discrete games never reach this
+  }
 
   void reset_all(float* obs_out) {
     for (int i = 0; i < num_envs; ++i) {
@@ -67,6 +76,33 @@ struct VecEnv {
     }
   }
 
+  // Shared post-step bookkeeping for env i (auto-reset + episode metrics);
+  // the discrete and continuous stepping loops differ only in how the
+  // per-env reward is produced.
+  void finish_env(int i, float reward, bool terminated, float* obs_out,
+                  float* next_obs_out, float* reward_out, uint8_t* done_out,
+                  uint8_t* trunc_out, float* ep_return_out,
+                  int32_t* ep_length_out) {
+    const size_t dim = obs_dim();
+    step_count[i] += 1;
+    ep_return[i] += reward;
+    const bool truncated = !terminated && step_count[i] >= max_steps;
+
+    reward_out[i] = reward;
+    done_out[i] = terminated ? 1 : 0;
+    trunc_out[i] = truncated ? 1 : 0;
+    write_obs(i, next_obs_out + i * dim);
+    ep_return_out[i] = ep_return[i];
+    ep_length_out[i] = step_count[i];
+
+    if (terminated || truncated) {
+      reset_env(i);
+      step_count[i] = 0;
+      ep_return[i] = 0.0f;
+    }
+    write_obs(i, obs_out + i * dim);
+  }
+
   // One synchronous step for every env with auto-reset. Outputs:
   //   obs_out:      post-(auto)reset observation    [num_envs, obs_dim]
   //   next_obs_out: TRUE successor observation      [num_envs, obs_dim]
@@ -75,27 +111,25 @@ struct VecEnv {
   void step(const int32_t* actions, float* obs_out, float* next_obs_out,
             float* reward_out, uint8_t* done_out, uint8_t* trunc_out,
             float* ep_return_out, int32_t* ep_length_out) {
-    const size_t dim = obs_dim();
     for (int i = 0; i < num_envs; ++i) {
       bool terminated = false;
       const float reward = step_env(i, actions[i], &terminated);
-      step_count[i] += 1;
-      ep_return[i] += reward;
-      const bool truncated = !terminated && step_count[i] >= max_steps;
+      finish_env(i, reward, terminated, obs_out, next_obs_out, reward_out,
+                 done_out, trunc_out, ep_return_out, ep_length_out);
+    }
+  }
 
-      reward_out[i] = reward;
-      done_out[i] = terminated ? 1 : 0;
-      trunc_out[i] = truncated ? 1 : 0;
-      write_obs(i, next_obs_out + i * dim);
-      ep_return_out[i] = ep_return[i];
-      ep_length_out[i] = step_count[i];
-
-      if (terminated || truncated) {
-        reset_env(i);
-        step_count[i] = 0;
-        ep_return[i] = 0.0f;
-      }
-      write_obs(i, obs_out + i * dim);
+  // Continuous twin of step(): actions are [num_envs, action_dim] floats.
+  void step_cont(const float* actions, float* obs_out, float* next_obs_out,
+                 float* reward_out, uint8_t* done_out, uint8_t* trunc_out,
+                 float* ep_return_out, int32_t* ep_length_out) {
+    const int adim = action_dim();
+    for (int i = 0; i < num_envs; ++i) {
+      bool terminated = false;
+      const float reward =
+          step_env_cont(i, actions + static_cast<size_t>(i) * adim, &terminated);
+      finish_env(i, reward, terminated, obs_out, next_obs_out, reward_out,
+                 done_out, trunc_out, ep_return_out, ep_length_out);
     }
   }
 };
@@ -614,6 +648,71 @@ struct SpaceInvadersVec : VecEnv {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Pendulum-v1 — the continuous-control game (gym classic-control dynamics,
+// matching the pure-JAX twin envs/classic.py Pendulum exactly: g=10, m=l=1,
+// dt=0.05, torque in [-2, 2], never terminates, 200-step truncation).
+// ---------------------------------------------------------------------------
+
+struct PendulumVec : VecEnv {
+  std::vector<float> state;  // [num_envs, 2]: theta, theta_dot
+
+  static constexpr float kMaxSpeed = 8.0f;
+  static constexpr float kMaxTorque = 2.0f;
+  static constexpr float kDt = 0.05f;
+  static constexpr float kG = 10.0f;
+
+  PendulumVec(int n, int max_steps_, uint64_t seed)
+      : VecEnv(n, max_steps_, seed), state(static_cast<size_t>(n) * 2) {}
+
+  int obs_dim() const override { return 3; }
+  void obs_shape(int32_t* out3) const override { out3[0] = 3; out3[1] = 1; out3[2] = 1; }
+  // For continuous games num_actions mirrors action_dim (mask width).
+  int num_actions() const override { return 1; }
+  int action_dim() const override { return 1; }
+  void action_bounds(float* lo, float* hi) const override {
+    *lo = -kMaxTorque;
+    *hi = kMaxTorque;
+  }
+
+  void reset_env(int i) override {
+    std::uniform_real_distribution<float> th(-static_cast<float>(M_PI),
+                                             static_cast<float>(M_PI));
+    std::uniform_real_distribution<float> thdot(-1.0f, 1.0f);
+    state[i * 2] = th(rng);
+    state[i * 2 + 1] = thdot(rng);
+  }
+
+  void write_obs(int i, float* out) const override {
+    const float theta = state[i * 2], thdot = state[i * 2 + 1];
+    out[0] = std::cos(theta);
+    out[1] = std::sin(theta);
+    out[2] = thdot;
+  }
+
+  float step_env(int, int32_t, bool*) override { return 0.0f; }  // continuous only
+
+  float step_env_cont(int i, const float* action, bool* terminated) override {
+    float theta = state[i * 2], thdot = state[i * 2 + 1];
+    const float u = std::fmax(-kMaxTorque, std::fmin(kMaxTorque, action[0]));
+    // Normalize theta into [-pi, pi) with python-modulo semantics (the JAX
+    // twin uses (theta + pi) % (2 pi) - pi; C++ fmod keeps the sign).
+    float wrapped = std::fmod(theta + static_cast<float>(M_PI),
+                              2.0f * static_cast<float>(M_PI));
+    if (wrapped < 0.0f) wrapped += 2.0f * static_cast<float>(M_PI);
+    const float angle_norm = wrapped - static_cast<float>(M_PI);
+    const float cost =
+        angle_norm * angle_norm + 0.1f * thdot * thdot + 0.001f * u * u;
+    thdot += (3.0f * kG / 2.0f * std::sin(theta) + 3.0f * u) * kDt;
+    thdot = std::fmax(-kMaxSpeed, std::fmin(kMaxSpeed, thdot));
+    theta += thdot * kDt;
+    state[i * 2] = theta;
+    state[i * 2 + 1] = thdot;
+    *terminated = false;
+    return -cost;
+  }
+};
+
 VecEnv* make_game(const char* task, int num_envs, int max_steps, uint64_t seed) {
   const std::string name(task ? task : "");
   if (name == "Breakout-minatar")
@@ -624,6 +723,8 @@ VecEnv* make_game(const char* task, int num_envs, int max_steps, uint64_t seed) 
     return new FreewayVec(num_envs, max_steps, seed);
   if (name == "SpaceInvaders-minatar")
     return new SpaceInvadersVec(num_envs, max_steps, seed);
+  if (name == "Pendulum-v1")
+    return new PendulumVec(num_envs, max_steps, seed);
   if (name == "CartPole-v1" || name.empty())
     return new CartPoleVec(num_envs, max_steps, seed);
   return nullptr;
@@ -657,6 +758,23 @@ void cvec_obs_shape(void* handle, int32_t* out3) {
 
 int cvec_num_actions(void* handle) {
   return static_cast<VecEnv*>(handle)->num_actions();
+}
+
+int cvec_action_dim(void* handle) {
+  return static_cast<VecEnv*>(handle)->action_dim();
+}
+
+void cvec_action_bounds(void* handle, float* lo, float* hi) {
+  static_cast<VecEnv*>(handle)->action_bounds(lo, hi);
+}
+
+void cvec_step_cont(void* handle, const float* actions, float* obs_out,
+                    float* next_obs_out, float* reward_out, uint8_t* done_out,
+                    uint8_t* trunc_out, float* ep_return_out,
+                    int32_t* ep_length_out) {
+  static_cast<VecEnv*>(handle)->step_cont(actions, obs_out, next_obs_out,
+                                          reward_out, done_out, trunc_out,
+                                          ep_return_out, ep_length_out);
 }
 
 void cvec_destroy(void* handle) { delete static_cast<VecEnv*>(handle); }

@@ -1,0 +1,76 @@
+"""Continuous-action support in the native C++ pool: Pendulum-v1 must match
+the pure-JAX twin's dynamics (envs/classic.py) step for step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.envs.classic import Pendulum
+from stoix_tpu.envs.cvec import CVecPool
+from stoix_tpu.envs import spaces
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return CVecPool("Pendulum-v1", num_envs=4, seed=7, max_steps=200)
+
+
+def test_continuous_surface(pool):
+    space = pool.action_space()
+    assert isinstance(space, spaces.Box)
+    assert space.shape == (1,)
+    assert float(space.low) == -2.0 and float(space.high) == 2.0
+    ts = pool.reset()
+    assert ts.observation.agent_view.shape == (4, 3)
+
+
+def test_lockstep_with_jax_twin(pool):
+    """Seed the JAX twin from the pool's observed state, drive both with the
+    same torque sequence, compare trajectories (float math: allclose)."""
+    ts = pool.reset()
+    obs = np.asarray(ts.observation.agent_view)  # [4, 3] cos, sin, thdot
+    theta0 = np.arctan2(obs[:, 1], obs[:, 0])
+    thdot0 = obs[:, 2]
+
+    env = Pendulum()
+    jax_step = jax.jit(jax.vmap(env.step))
+    # Build twin states at the pool's exact physics.
+    state, _ = jax.vmap(env.reset)(jax.random.split(jax.random.PRNGKey(0), 4))
+    state = state._replace(
+        physics=jnp.stack([jnp.asarray(theta0), jnp.asarray(thdot0)], axis=-1)
+    )
+
+    rng = np.random.default_rng(3)
+    for t in range(50):
+        torque = rng.uniform(-2.0, 2.0, size=(4, 1)).astype(np.float32)
+        ts_pool = pool.step(torque)
+        state, ts_jax = jax_step(state, jnp.asarray(torque))
+        np.testing.assert_allclose(
+            np.asarray(ts_pool.observation.agent_view),
+            np.asarray(ts_jax.observation.agent_view),
+            atol=2e-4,
+            rtol=2e-4,
+            err_msg=f"diverged at step {t}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(ts_pool.reward), np.asarray(ts_jax.reward), atol=2e-4, rtol=2e-4
+        )
+
+
+def test_pendulum_pool_truncates_never_terminates():
+    pool = CVecPool("Pendulum-v1", num_envs=2, seed=1, max_steps=50)
+    pool.reset()
+    for t in range(50):
+        ts = pool.step(np.zeros((2, 1), np.float32))
+    assert bool(np.all(ts.extras["truncation"]))
+    # Truncation bootstraps: discount stays 1.
+    assert bool(np.all(np.asarray(ts.discount) == 1.0))
+
+
+def test_discrete_games_unaffected():
+    pool = CVecPool("CartPole-v1", num_envs=2, seed=1)
+    assert isinstance(pool.action_space(), spaces.Discrete)
+    pool.reset()
+    ts = pool.step(np.zeros((2,), np.int32))
+    assert ts.observation.agent_view.shape == (2, 4)

@@ -89,3 +89,34 @@ def test_native_cvec_pool_matches_python_dynamics():
             rtol=1e-5,
         )
         assert bool(ts_cpp.discount[0] == 0.0) == bool(ts_py.discount == 0.0)
+
+
+@pytest.mark.slow
+def test_sebulba_ppo_continuous_on_native_pool(devices):
+    """Continuous control end-to-end through the Sebulba stack on the C++
+    pool: Pendulum-v1 with float actions via cvec_step_cont, TanhNormal head
+    inferred from the pool's Box action space."""
+    from stoix_tpu.systems.ppo.sebulba import ff_ppo
+
+    cfg = _compose(
+        [
+            "env=pendulum",
+            "env.backend=cvec",
+            "env.kwargs.max_steps=200",
+            "network=mlp_continuous",
+            "arch.total_num_envs=8",
+            "arch.total_timesteps=2048",
+            "arch.num_evaluation=1",
+            "arch.num_eval_episodes=4",
+            "system.rollout_length=8",
+            "system.num_minibatches=2",
+            "logger.use_console=False",
+            "arch.actor.device_ids=[0]",
+            "arch.actor.actor_per_device=1",
+            "arch.learner.device_ids=[1]",
+            "arch.evaluator_device_id=0",
+        ]
+    )
+    ret = ff_ppo.run_experiment(cfg)
+    assert np.isfinite(ret)
+    assert ret < 0.0  # pendulum returns are negative costs
