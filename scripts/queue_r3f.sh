@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-3 wave 6: sampled search track at proper budgets after the replay
+# rework (sampled_az) + bounded root-noise fix (both).
+cd /root/repo
+# Drain the legacy pgrep-chained waves (they don't take the flock) first.
+while pgrep -f "queue_r3[cde].sh" > /dev/null; do sleep 60; done
+source "$(dirname "$0")/queue_lib.sh"
+
+run sampled_az_replay_1m 240 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum arch.total_timesteps=1000000
+run sampled_mz_1m 240 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum arch.total_timesteps=1000000
+run az_replay_cartpole 120 --module stoix_tpu.systems.search.ff_az \
+  --default default/anakin/default_ff_az.yaml env=cartpole system.use_replay_buffer=true \
+  arch.total_timesteps=500000
+
+echo '{"queue": "wave6 done"}' >> "$QUEUE_OUT"

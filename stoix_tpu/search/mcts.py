@@ -213,6 +213,33 @@ def _search_one(
     return tree, root_value
 
 
+def blend_root_action_noise(
+    rng: Array,
+    actions: Array,
+    fraction: float,
+    minimum: Array,
+    maximum: Array,
+) -> Array:
+    """Sampled-MuZero root exploration over a CONTINUOUS sampled action set:
+    blend each sampled action toward bounded noise, a = (1-f) a + f u with
+    u ~ Uniform[min, max] per dimension (reference
+    stoix/systems/search/ff_sampled_az.py add_gaussian_noise:58-79 blends
+    toward truncated_normal(action_min, action_max) — but those limits are in
+    STANDARD-NORMAL units, so the reference's noise never scales to wide or
+    asymmetric action ranges; uniform over the actual bounds achieves the
+    stated intent). `minimum`/`maximum` broadcast against the trailing action
+    dimension, so per-dimension Box bounds are honored. The convex blend
+    keeps actions inside the action space — additive noise would push
+    samples outside the policy distribution's support, where log-prob
+    targets saturate."""
+    if fraction <= 0.0:
+        return actions
+    lo = jnp.asarray(minimum, actions.dtype)
+    hi = jnp.asarray(maximum, actions.dtype)
+    noise = lo + (hi - lo) * jax.random.uniform(rng, actions.shape, actions.dtype)
+    return (1.0 - fraction) * actions + fraction * noise
+
+
 def _root_with_noise(
     root: RootFnOutput, rng: Array, dirichlet_fraction: float, dirichlet_alpha: float
 ) -> RootFnOutput:

@@ -156,6 +156,24 @@ def trajectory_buffer_sizing(
     return local_envs, sample_batch, max_length
 
 
+def require_first_add_samplable(config: Any) -> None:
+    """Guard for warmup-less sequence-replay learners (AZ/sampled-AZ/MZ
+    variants): the trajectory buffer silently returns ZERO-initialized
+    sequences when no full sequence has been written yet (buffers.py clamps
+    n_periods to >= 1), so the first rollout add must already contain at
+    least one sampleable start — otherwise every epoch of the first update
+    trains on all-zero garbage with no error."""
+    seq = int(config.system.get("sample_sequence_length", 8))
+    rollout = int(config.system.rollout_length)
+    if rollout - seq + 1 <= 0:
+        raise ValueError(
+            f"system.sample_sequence_length ({seq}) must be <= "
+            f"system.rollout_length ({rollout}) for warmup-less replay "
+            "learners: the first buffer add must already contain a full "
+            "sequence, or early updates silently train on zero-filled samples"
+        )
+
+
 def wrap_learn(
     learn_per_shard: Callable,
     mesh: Mesh,

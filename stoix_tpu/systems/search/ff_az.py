@@ -381,6 +381,55 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         actor_optim.init(actor_params), critic_optim.init(critic_params)
     )
 
+    # Pristine simulator env: raw dynamics only (no metrics/auto-reset), so the
+    # search never resets mid-rollout (reference ff_az.py:74-102).
+    sim_env = envs.make_single(
+        config.env.scenario.name
+        if hasattr(config.env.scenario, "name")
+        else config.env.scenario,
+        **dict(config.env.get("kwargs", {}) or {}),
+    )
+
+    if bool(config.system.get("use_replay_buffer", False)):
+        # Replay mode (reference ff_az.py:497): trajectory buffer feeding
+        # sequence-sampled CE/GAE updates.
+        from stoix_tpu.buffers import make_trajectory_buffer
+        from stoix_tpu.systems import off_policy_core as core
+
+        core.require_first_add_samplable(config)
+        local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+            config, mesh, 2 * int(config.system.rollout_length)
+        )
+        buffer = make_trajectory_buffer(
+            add_batch_size=local_envs,
+            sample_batch_size=sample_batch,
+            sample_sequence_length=int(config.system.get("sample_sequence_length", 8)),
+            period=int(config.system.get("sample_period", 1)),
+            max_length_time_axis=max_length,
+        )
+        dummy_item = {
+            "obs": env.observation_value(),
+            "search_policy": jnp.zeros((env.num_actions,), jnp.float32),
+            "reward": jnp.zeros((), jnp.float32),
+            "discount": jnp.zeros((), jnp.float32),
+            "truncated": jnp.zeros((), bool),
+        }
+        buffer_state = buffer.init(dummy_item)
+        learn_per_shard = get_replay_learner_fn(
+            env, sim_env, (actor_network.apply, critic_network.apply),
+            (actor_optim.update, critic_optim.update), buffer, config,
+        )
+        learner_state, state_specs = core.assemble_off_policy_state(
+            config, mesh, env, params, opt_states, buffer_state, key, env_key
+        )
+        learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
+        return AnakinSetup(
+            learn=learn,
+            learner_state=learner_state,
+            eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
+            eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+        )
+
     update_batch = int(config.arch.get("update_batch_size", 1))
     state_specs = OnPolicyLearnerState(
         params=P(), opt_states=P(), key=P("data"),
@@ -395,15 +444,6 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         timestep=timestep,
     )
     learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
-
-    # Pristine simulator env: raw dynamics only (no metrics/auto-reset), so the
-    # search never resets mid-rollout (reference ff_az.py:74-102).
-    sim_env = envs.make_single(
-        config.env.scenario.name
-        if hasattr(config.env.scenario, "name")
-        else config.env.scenario,
-        **dict(config.env.get("kwargs", {}) or {}),
-    )
 
     learn_per_shard = get_learner_fn(
         env, sim_env, (actor_network.apply, critic_network.apply),

@@ -305,6 +305,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     )
     opt_states = MZOptStates(optim.init(params))
 
+    core.require_first_add_samplable(config)
     local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
         config, mesh, 2 * int(config.system.rollout_length)
     )

@@ -8,49 +8,44 @@ samples with -sum_i w_i log pi(a_i | s).
 Each expanded node draws a FRESH action set from the policy at its own state
 (per-node resampling, as in the paper); tree arrays stay static because the
 set size K is fixed.
+
+Training is REPLAY-based, matching the reference: rollouts feed a trajectory
+buffer (total_buffer_size/total_batch_size/sample_sequence_length, reference
+ff_sampled_az.yaml:15-18); each epoch samples sequences and computes
+truncation-aware GAE over the STORED search root values (reference
+ff_sampled_az.py:401-405 uses sequence.search_value, not the live critic) —
+the same stored-search-value bootstrapping that fixed ff_mz in round 2.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from stoix_tpu import envs
 from stoix_tpu.base_types import (
     ActorCriticOptStates,
     ActorCriticParams,
     ExperimentOutput,
-    OnPolicyLearnerState,
+    OffPolicyLearnerState,
 )
+from stoix_tpu.buffers import make_trajectory_buffer
 from stoix_tpu.evaluator import get_distribution_act_fn
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.search import mcts
-from stoix_tpu.systems import anakin
+from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.systems.search.ff_az import unwrap_env_state
 from stoix_tpu.utils import config as config_lib
-from stoix_tpu.utils.jax_utils import tree_merge_leading_dims
 from stoix_tpu.utils.training import make_learning_rate
 
 
-class SampledExItTransition(NamedTuple):
-    done: jax.Array
-    truncated: jax.Array
-    action: jax.Array  # continuous action executed
-    sampled_actions: jax.Array  # [K, A] the search's action set
-    value: jax.Array
-    reward: jax.Array
-    search_policy: jax.Array  # [K] weights over sampled actions
-    obs: Any
-    next_obs: Any
-    info: Dict[str, Any]
-
-
-def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
+def get_learner_fn(env, sim_env, apply_fns, update_fns, buffer, config):
     actor_apply, critic_apply = apply_fns
     actor_update, critic_update = update_fns
     gamma = float(config.system.gamma)
@@ -58,6 +53,10 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
     num_samples = int(config.system.get("num_sampled_actions", 8))
     ent_coef = float(config.system.get("ent_coef", 0.005))
     root_noise = float(config.system.get("root_exploration_fraction", 0.1))
+    space = env.action_space()
+    # Per-dimension bounds, broadcast against the trailing action axis.
+    act_lo = np.asarray(getattr(space, "low", -1.0), np.float32)
+    act_hi = np.asarray(getattr(space, "high", 1.0), np.float32)
     search_method = str(config.system.get("search_method", "muzero"))
     policy_fn = (
         mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
@@ -87,8 +86,8 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         }
         return out, new_embedding
 
-    def _env_step(learner_state: OnPolicyLearnerState, _):
-        params, opt_states, key, env_state, last_timestep = learner_state
+    def _env_step(learner_state: OffPolicyLearnerState, _):
+        params, opt_states, buffer_state, key, env_state, last_timestep = learner_state
         key, sample_key, search_key = jax.random.split(key, 3)
 
         dist = actor_apply(params.actor_params, last_timestep.observation)
@@ -96,12 +95,12 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         sampled = jax.vmap(lambda k: dist.sample(seed=k))(sample_keys)  # [K, E, A]
         sampled = jnp.swapaxes(sampled, 0, 1)  # [E, K, A]
         if root_noise > 0.0:
-            # Root exploration (reference root_exploration_fraction): perturb
-            # the root's sampled action set so the search sees actions a
-            # collapsing policy would never draw.
+            # Root exploration (reference root_exploration_fraction): blend
+            # the root's sampled action set toward bounded noise so the
+            # search sees actions a collapsing policy would never draw.
             key, noise_key = jax.random.split(key)
-            sampled = sampled + root_noise * jax.random.normal(
-                noise_key, sampled.shape, sampled.dtype
+            sampled = mcts.blend_root_action_noise(
+                noise_key, sampled, root_noise, act_lo, act_hi
             )
         value = critic_apply(params.critic_params, last_timestep.observation)
 
@@ -119,21 +118,25 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         )[:, 0]
         env_state_new, timestep = env.step(env_state, action)
 
-        transition = SampledExItTransition(
-            done=timestep.discount == 0.0,
-            truncated=jnp.logical_and(timestep.last(), timestep.discount != 0.0),
-            action=action,
-            sampled_actions=sampled,
-            value=value,
-            reward=timestep.reward,
-            search_policy=search_out.action_weights,
-            obs=last_timestep.observation,
-            next_obs=timestep.extras["next_obs"],
-            info=timestep.extras["episode_metrics"],
-        )
+        data = {
+            "obs": last_timestep.observation,
+            "sampled_actions": sampled,
+            "search_policy": search_out.action_weights,
+            # Root search value: the replay GAE bootstraps from these STORED
+            # values (reference ff_sampled_az.py:258,401-405).
+            "search_value": search_out.search_value,
+            "reward": timestep.reward,
+            "discount": timestep.discount,
+            "truncated": jnp.logical_and(
+                timestep.last(), timestep.discount != 0.0
+            ).astype(jnp.float32),
+            "info": timestep.extras["episode_metrics"],
+        }
         return (
-            OnPolicyLearnerState(params, opt_states, key, env_state_new, timestep),
-            transition,
+            OffPolicyLearnerState(
+                params, opt_states, buffer_state, key, env_state_new, timestep
+            ),
+            data,
         )
 
     def _actor_loss_fn(actor_params, obs, sampled_actions, search_policy):
@@ -152,55 +155,69 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         loss = 0.5 * jnp.mean((value - targets) ** 2)
         return float(config.system.get("vf_coef", 0.5)) * loss, {"value_loss": loss}
 
-    def _update_step(learner_state: OnPolicyLearnerState, _):
+    def _update_epoch(carry, _):
+        params, opt_states, buffer_state, key = carry
+        key, sample_key = jax.random.split(key)
+        seq = buffer.sample(buffer_state, sample_key).experience  # [B, L, ...]
+
+        # Truncation-aware GAE over the STORED search root values — the value
+        # sequence the search actually produced, not the current critic
+        # (reference ff_sampled_az.py:401-405).
+        _, targets = truncated_generalized_advantage_estimation(
+            seq["reward"][:, :-1],
+            gamma * seq["discount"][:, :-1],
+            float(config.system.get("gae_lambda", 0.95)),
+            v_tm1=seq["search_value"][:, :-1],
+            v_t=seq["search_value"][:, 1:],
+            truncation_t=seq["truncated"][:, :-1],
+            batch_major=True,
+        )
+        train_obs = jax.tree.map(lambda x: x[:, :-1], seq["obs"])
+        flatten = lambda x: x.reshape((-1,) + x.shape[2:])  # noqa: E731
+        obs = jax.tree.map(flatten, train_obs)
+        sampled = flatten(seq["sampled_actions"][:, :-1])
+        weights = flatten(seq["search_policy"][:, :-1])
+        tgt = flatten(targets)
+
+        actor_grads, actor_metrics = jax.grad(_actor_loss_fn, has_aux=True)(
+            params.actor_params, obs, sampled, weights
+        )
+        critic_grads, critic_metrics = jax.grad(_critic_loss_fn, has_aux=True)(
+            params.critic_params, obs, tgt
+        )
+        actor_grads, critic_grads = jax.lax.pmean(
+            jax.lax.pmean((actor_grads, critic_grads), axis_name="batch"),
+            axis_name="data",
+        )
+        a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
+        c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
+        params = ActorCriticParams(
+            optax.apply_updates(params.actor_params, a_updates),
+            optax.apply_updates(params.critic_params, c_updates),
+        )
+        return (params, ActorCriticOptStates(a_opt, c_opt), buffer_state, key), {
+            **actor_metrics, **critic_metrics,
+        }
+
+    def _update_step(learner_state: OffPolicyLearnerState, _):
         learner_state, traj = jax.lax.scan(
             _env_step, learner_state, None, int(config.system.rollout_length)
         )
-        params, opt_states, key, env_state, last_timestep = learner_state
-
-        v_t = critic_apply(params.critic_params, traj.next_obs)
-        _, targets = truncated_generalized_advantage_estimation(
-            traj.reward,
-            gamma * (1.0 - traj.done.astype(jnp.float32)),
-            float(config.system.get("gae_lambda", 0.95)),
-            v_tm1=traj.value,
-            v_t=v_t,
-            truncation_t=traj.truncated.astype(jnp.float32),
+        params, opt_states, buffer_state, key, env_state, timestep = learner_state
+        store = {k: v for k, v in traj.items() if k != "info"}
+        buffer_state = buffer.add(
+            buffer_state, jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), store)
         )
-
-        def _epoch(carry, _):
-            params, opt_states, key = carry
-            flat = tree_merge_leading_dims(
-                (traj.obs, traj.sampled_actions, traj.search_policy, targets), 2
-            )
-            obs, sampled, weights, tgt = flat
-            actor_grads, actor_metrics = jax.grad(_actor_loss_fn, has_aux=True)(
-                params.actor_params, obs, sampled, weights
-            )
-            critic_grads, critic_metrics = jax.grad(_critic_loss_fn, has_aux=True)(
-                params.critic_params, obs, tgt
-            )
-            actor_grads, critic_grads = jax.lax.pmean(
-                jax.lax.pmean((actor_grads, critic_grads), axis_name="batch"),
-                axis_name="data",
-            )
-            a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
-            c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
-            params = ActorCriticParams(
-                optax.apply_updates(params.actor_params, a_updates),
-                optax.apply_updates(params.critic_params, c_updates),
-            )
-            return (params, ActorCriticOptStates(a_opt, c_opt), key), {
-                **actor_metrics, **critic_metrics,
-            }
-
-        (params, opt_states, key), loss_info = jax.lax.scan(
-            _epoch, (params, opt_states, key), None, int(config.system.epochs)
+        (params, opt_states, buffer_state, key), loss_info = jax.lax.scan(
+            _update_epoch, (params, opt_states, buffer_state, key), None,
+            int(config.system.epochs),
         )
-        learner_state = OnPolicyLearnerState(params, opt_states, key, env_state, last_timestep)
-        return learner_state, (traj.info, loss_info)
+        learner_state = OffPolicyLearnerState(
+            params, opt_states, buffer_state, key, env_state, timestep
+        )
+        return learner_state, (traj["info"], loss_info)
 
-    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+    def learner_fn(learner_state: OffPolicyLearnerState) -> ExperimentOutput:
         key = learner_state.key[0]
         state = learner_state._replace(key=key)
         state, (episode_info, loss_info) = jax.lax.scan(
@@ -252,20 +269,30 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         actor_optim.init(actor_params), critic_optim.init(critic_params)
     )
 
-    update_batch = int(config.arch.get("update_batch_size", 1))
-    state_specs = OnPolicyLearnerState(
-        params=P(), opt_states=P(), key=P("data"),
-        env_state=P(None, "data"), timestep=P(None, "data"),
+    # Trajectory replay buffer (reference ff_sampled_az.yaml:15-18).
+    num_samples = int(config.system.get("num_sampled_actions", 8))
+    action_dim = int(env.action_value().shape[-1])
+    core.require_first_add_samplable(config)
+    local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+        config, mesh, 2 * int(config.system.rollout_length)
     )
-    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
-    learner_state = OnPolicyLearnerState(
-        params=anakin.broadcast_to_update_batch(params, update_batch),
-        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
-        key=anakin.make_step_keys(key, mesh, config),
-        env_state=env_state,
-        timestep=timestep,
+    buffer = make_trajectory_buffer(
+        add_batch_size=local_envs,
+        sample_batch_size=sample_batch,
+        sample_sequence_length=int(config.system.get("sample_sequence_length", 8)),
+        period=int(config.system.get("sample_period", 1)),
+        max_length_time_axis=max_length,
     )
-    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+    dummy_item = {
+        "obs": env.observation_value(),
+        "sampled_actions": jnp.zeros((num_samples, action_dim), jnp.float32),
+        "search_policy": jnp.zeros((num_samples,), jnp.float32),
+        "search_value": jnp.zeros((), jnp.float32),
+        "reward": jnp.zeros((), jnp.float32),
+        "discount": jnp.zeros((), jnp.float32),
+        "truncated": jnp.zeros((), jnp.float32),
+    }
+    buffer_state = buffer.init(dummy_item)
 
     sim_env = envs.make_single(
         config.env.scenario.name
@@ -275,9 +302,12 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     )
     learn_per_shard = get_learner_fn(
         env, sim_env, (actor_network.apply, critic_network.apply),
-        (actor_optim.update, critic_optim.update), config,
+        (actor_optim.update, critic_optim.update), buffer, config,
     )
-    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+    learner_state, state_specs = core.assemble_off_policy_state(
+        config, mesh, env, params, opt_states, buffer_state, key, env_key
+    )
+    learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
 
     return AnakinSetup(
         learn=learn,

@@ -18,6 +18,7 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh
 
@@ -54,6 +55,10 @@ def get_learner_fn(env, networks, optim_update, buffer, config):
     ent_coef = float(config.system.get("ent_coef", 0.005))
     vf_coef = float(config.system.get("vf_coef", 0.25))
     root_noise = float(config.system.get("root_exploration_fraction", 0.1))
+    space = env.action_space()
+    # Per-dimension bounds, broadcast against the trailing action axis.
+    act_lo = np.asarray(getattr(space, "low", -1.0), np.float32)
+    act_hi = np.asarray(getattr(space, "high", 1.0), np.float32)
     num_atoms = int(config.system.get("num_atoms", 601))
     vmin = float(config.system.get("vmin", -300.0))
     vmax = float(config.system.get("vmax", 300.0))
@@ -102,8 +107,8 @@ def get_learner_fn(env, networks, optim_update, buffer, config):
         )  # [E, K, A]
         if root_noise > 0.0:
             key, noise_key = jax.random.split(key)
-            sampled = sampled + root_noise * jax.random.normal(
-                noise_key, sampled.shape, sampled.dtype
+            sampled = mcts.blend_root_action_noise(
+                noise_key, sampled, root_noise, act_lo, act_hi
             )
         value = critic_pair.apply_inv(value_net.apply(params.value_head, latent))
 
@@ -308,6 +313,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     )
     opt_states = SampledMZOptStates(optim.init(params))
 
+    core.require_first_add_samplable(config)
     local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
         config, mesh, 2 * int(config.system.rollout_length)
     )

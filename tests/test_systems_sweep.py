@@ -57,6 +57,9 @@ SYSTEMS = [
      ["env=identity_game", "system.vmin=0.0", "system.vmax=10.0"] + BUFFER),
     ("stoix_tpu.systems.search.ff_az", "default_ff_az",
      ["env=identity_game", "system.num_simulations=8", "system.num_minibatches=2"]),
+    ("stoix_tpu.systems.search.ff_az", "default_ff_az",
+     ["env=identity_game", "system.num_simulations=8", "system.use_replay_buffer=true",
+      "system.total_buffer_size=4096", "system.total_batch_size=16"]),
     ("stoix_tpu.systems.search.ff_mz", "default_ff_mz",
      ["env=identity_game", "system.num_simulations=8", "system.unroll_steps=2"]),
     ("stoix_tpu.systems.search.ff_sampled_az", "default_ff_sampled_az",
