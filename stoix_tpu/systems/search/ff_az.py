@@ -58,9 +58,11 @@ def unwrap_env_state(state: Any) -> Any:
     return state
 
 
-def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
+def make_search_fn(sim_env, apply_fns, config):
+    """The AZ search step shared by the on-policy and replay learners: build
+    the root from the live actor/critic, run MCTS through the pristine
+    simulator, return (root value, search output)."""
     actor_apply, critic_apply = apply_fns
-    actor_update, critic_update = update_fns
     gamma = float(config.system.gamma)
     num_simulations = int(config.system.get("num_simulations", 16))
     search_method = str(config.system.get("search_method", "muzero"))
@@ -68,32 +70,23 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
     )
 
-    def make_recurrent_fn():
-        def recurrent_fn(params, rng, action, embedding):
-            # embedding: {"state": core env state, "obs": Observation} [B=1,...]
-            state = jax.tree.map(lambda x: x[0], embedding["state"])
-            new_state, ts = sim_env.step(state, action[0])
-            prior = actor_apply(params.actor_params, ts.observation)
-            value = critic_apply(params.critic_params, ts.observation)
-            out = mcts.RecurrentFnOutput(
-                reward=ts.reward[None],
-                discount=gamma * ts.discount[None],
-                prior_logits=prior.logits[None],
-                value=value[None],
-            )
-            new_embedding = {"state": jax.tree.map(lambda x: x[None], new_state)}
-            return out, new_embedding
+    def recurrent_fn(params, rng, action, embedding):
+        # embedding: {"state": core env state} with a leading [B=1] axis.
+        state = jax.tree.map(lambda x: x[0], embedding["state"])
+        new_state, ts = sim_env.step(state, action[0])
+        prior = actor_apply(params.actor_params, ts.observation)
+        value = critic_apply(params.critic_params, ts.observation)
+        out = mcts.RecurrentFnOutput(
+            reward=ts.reward[None],
+            discount=gamma * ts.discount[None],
+            prior_logits=prior.logits[None],
+            value=value[None],
+        )
+        return out, {"state": jax.tree.map(lambda x: x[None], new_state)}
 
-        return recurrent_fn
-
-    recurrent_fn = make_recurrent_fn()
-
-    def _env_step(learner_state: OnPolicyLearnerState, _):
-        params, opt_states, key, env_state, last_timestep = learner_state
-        key, search_key = jax.random.split(key)
-
-        prior = actor_apply(params.actor_params, last_timestep.observation)
-        value = critic_apply(params.critic_params, last_timestep.observation)
+    def search(params, search_key, env_state, observation):
+        prior = actor_apply(params.actor_params, observation)
+        value = critic_apply(params.critic_params, observation)
         root = mcts.RootFnOutput(
             prior_logits=prior.logits,
             value=value,
@@ -102,6 +95,24 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         search_out = policy_fn(
             params, search_key, root, recurrent_fn, num_simulations,
             max_depth=int(config.system.get("max_depth", num_simulations)),
+        )
+        return value, search_out
+
+    return search
+
+
+def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
+    actor_apply, critic_apply = apply_fns
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+    search_fn = make_search_fn(sim_env, apply_fns, config)
+
+    def _env_step(learner_state: OnPolicyLearnerState, _):
+        params, opt_states, key, env_state, last_timestep = learner_state
+        key, search_key = jax.random.split(key)
+
+        value, search_out = search_fn(
+            params, search_key, env_state, last_timestep.observation
         )
         action = search_out.action
         env_state_new, timestep = env.step(env_state, action)
@@ -217,36 +228,13 @@ def get_replay_learner_fn(env, sim_env, apply_fns, update_fns, buffer, config):
     actor_apply, critic_apply = apply_fns
     actor_update, critic_update = update_fns
     gamma = float(config.system.gamma)
-    num_simulations = int(config.system.get("num_simulations", 16))
-    search_method = str(config.system.get("search_method", "muzero"))
-    policy_fn = (
-        mcts.gumbel_muzero_policy if search_method == "gumbel" else mcts.muzero_policy
-    )
-    def recurrent_fn(params, rng, action, embedding):
-        state = jax.tree.map(lambda x: x[0], embedding["state"])
-        new_state, ts = sim_env.step(state, action[0])
-        prior = actor_apply(params.actor_params, ts.observation)
-        value = critic_apply(params.critic_params, ts.observation)
-        out = mcts.RecurrentFnOutput(
-            reward=ts.reward[None],
-            discount=gamma * ts.discount[None],
-            prior_logits=prior.logits[None],
-            value=value[None],
-        )
-        return out, {"state": jax.tree.map(lambda x: x[None], new_state)}
+    search_fn = make_search_fn(sim_env, apply_fns, config)
 
     def _env_step(learner_state: OffPolicyLearnerState, _):
         params, opt_states, buffer_state, key, env_state, last_timestep = learner_state
         key, search_key = jax.random.split(key)
-        prior = actor_apply(params.actor_params, last_timestep.observation)
-        value = critic_apply(params.critic_params, last_timestep.observation)
-        root = mcts.RootFnOutput(
-            prior_logits=prior.logits, value=value,
-            embedding={"state": unwrap_env_state(env_state)},
-        )
-        search_out = policy_fn(
-            params, search_key, root, recurrent_fn, num_simulations,
-            max_depth=int(config.system.get("max_depth", num_simulations)),
+        _, search_out = search_fn(
+            params, search_key, env_state, last_timestep.observation
         )
         env_state_new, timestep = env.step(env_state, search_out.action)
         data = {
