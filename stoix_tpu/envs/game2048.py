@@ -1,0 +1,192 @@
+"""Game2048 — first-party pure-JAX 2048 (Jumanji Game2048-v1 class, reference
+configs/env/jumanji/2048.yaml; external-suite version: env=jumanji/2048).
+
+Board is a 4x4 grid of tile EXPONENTS (0 = empty, k = tile 2^k). Sliding an
+axis compresses non-zero tiles, merges equal neighbors leftmost-first (each
+result tile merges at most once per move), and scores the sum of created
+tile values. A fresh tile (2 w.p. 0.9 else 4) spawns in a uniform random
+empty cell after every VALID move; invalid moves change nothing. The episode
+terminates when no move changes the board.
+
+TPU shape notes: the per-row compress is a stable argsort (order-preserving,
+no data-dependent control flow), the merge cascade is a fixed jnp.where
+chain over the 4 cells, and all four action candidates are evaluated with
+one vmapped move kernel per step — everything static-shape inside the
+rollout scan.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.types import (
+    Observation,
+    TimeStep,
+    restart,
+    select_step,
+    termination,
+    transition,
+    truncation,
+)
+
+_SIZE = 4
+
+
+def _compress_row(row: jax.Array) -> jax.Array:
+    """Slide non-zero tiles left, preserving order. [4] int32 -> [4]."""
+    # Stable argsort on "is empty": non-zeros first, original order kept.
+    perm = jnp.argsort(row == 0, stable=True)
+    return row[perm]
+
+
+def _merge_row(row: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Merge a COMPRESSED row leftmost-first; returns (new row, score).
+
+    2048 semantics: each created tile merges at most once per move, pairs
+    merge left to right ([1,1,1,1] -> [2,2,0,0]; [2,2,2,0] -> [3,2,0,0]).
+    """
+    a, b, c, d = row[0], row[1], row[2], row[3]
+    zero = jnp.zeros((), row.dtype)
+
+    ab = (a > 0) & (a == b)
+    # If (a, b) merged, the next candidate pair is (c, d); otherwise (b, c),
+    # then (c, d) only if (b, c) did not merge.
+    bc = (~ab) & (b > 0) & (b == c)
+    cd = (c > 0) & (c == d) & (ab | ~bc)
+
+    score = jnp.where(ab, 2 ** (a + 1), 0)
+    score = score + jnp.where(bc, 2 ** (b + 1), 0)
+    score = score + jnp.where(cd, 2 ** (c + 1), 0)
+
+    # Assemble the merged (pre-recompress) cells.
+    n0 = jnp.where(ab, a + 1, a)
+    n1 = jnp.where(ab, zero, jnp.where(bc, b + 1, b))
+    n2 = jnp.where(bc, zero, jnp.where(cd, c + 1, c))
+    n3 = jnp.where(cd, zero, d)
+    merged = jnp.stack([n0, n1, n2, n3])
+    return _compress_row(merged), score.astype(jnp.float32)
+
+
+def _move_left(board: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Apply a LEFT move to the [4, 4] board; returns (board, score)."""
+    compressed = jax.vmap(_compress_row)(board)
+    rows, scores = jax.vmap(_merge_row)(compressed)
+    return rows, jnp.sum(scores)
+
+
+def _all_moves(board: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Evaluate all four moves once (actions 0 up, 1 right, 2 down, 3 left —
+    jumanji convention): (boards [4, 4, 4], scores [4], changed [4])."""
+
+    def up(b):
+        nb, s = _move_left(b.T)
+        return nb.T, s
+
+    def right(b):
+        nb, s = _move_left(b[:, ::-1])
+        return nb[:, ::-1], s
+
+    def down(b):
+        nb, s = _move_left(b.T[:, ::-1])
+        return nb[:, ::-1].T, s
+
+    boards, scores = zip(up(board), right(board), down(board), _move_left(board))
+    boards = jnp.stack(boards)
+    scores = jnp.stack(scores)
+    changed = jax.vmap(lambda b: jnp.any(b != board))(boards)
+    return boards, scores, changed
+
+
+def _move(board: jax.Array, action: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One move, as _all_moves indexed by action."""
+    boards, scores, _ = _all_moves(board)
+    return boards[action], scores[action]
+
+
+def _spawn(key: jax.Array, board: jax.Array) -> jax.Array:
+    """Place a 2 (p=0.9) or 4 (p=0.1) tile in a uniform random empty cell."""
+    k_cell, k_val = jax.random.split(key)
+    flat = board.reshape(-1)
+    empty = flat == 0
+    # Uniform over empty cells via masked Gumbel trick (static shapes).
+    gumbel = jax.random.gumbel(k_cell, flat.shape)
+    idx = jnp.argmax(jnp.where(empty, gumbel, -jnp.inf))
+    value = jnp.where(jax.random.uniform(k_val) < 0.9, 1, 2).astype(flat.dtype)
+    return flat.at[idx].set(value).reshape(board.shape)
+
+
+class Game2048State(NamedTuple):
+    key: jax.Array
+    board: jax.Array  # [4, 4] int32 exponents
+    step_count: jax.Array
+    # The four candidate moves of `board`, computed ONCE per step: the action
+    # mask (observation) and the executed move (next step) both need them,
+    # and XLA cannot CSE across lax.scan iterations.
+    move_boards: jax.Array  # [4, 4, 4]
+    move_scores: jax.Array  # [4]
+    move_changed: jax.Array  # [4] bool
+
+
+class Game2048(Environment):
+    """4x4 2048 puzzle; reward = value of tiles created by each move."""
+
+    def __init__(self, max_steps: int = 1000):
+        self._max_steps = int(max_steps)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((_SIZE, _SIZE), jnp.float32),
+            action_mask=spaces.Array((4,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(4)
+
+    def _make_state(self, key: jax.Array, board: jax.Array, step_count: jax.Array) -> Game2048State:
+        boards, scores, changed = _all_moves(board)
+        return Game2048State(key, board, step_count, boards, scores, changed)
+
+    def _observe(self, state: Game2048State) -> Observation:
+        return Observation(
+            agent_view=state.board.astype(jnp.float32),
+            action_mask=state.move_changed.astype(jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[Game2048State, TimeStep]:
+        key, k1, k2 = jax.random.split(key, 3)
+        board = jnp.zeros((_SIZE, _SIZE), jnp.int32)
+        board = _spawn(k1, board)
+        board = _spawn(k2, board)
+        state = self._make_state(key, board, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: Game2048State, action: jax.Array) -> Tuple[Game2048State, TimeStep]:
+        key, spawn_key = jax.random.split(state.key)
+        action = jnp.reshape(action, ()).astype(jnp.int32)
+        valid = state.move_changed[action]
+
+        moved = state.move_boards[action]
+        board = jnp.where(valid, _spawn(spawn_key, moved), state.board)
+        reward = jnp.where(valid, state.move_scores[action], 0.0).astype(jnp.float32)
+
+        next_state = self._make_state(key, board, state.step_count + 1)
+        obs = self._observe(next_state)
+        # Game over: no move changes the board.
+        terminated = ~jnp.any(obs.action_mask > 0)
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts

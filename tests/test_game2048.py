@@ -1,0 +1,137 @@
+"""Game2048 correctness tests (first-party jumanji Game2048 equivalent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.envs.game2048 import (
+    Game2048,
+    _all_moves,
+    _compress_row,
+    _merge_row,
+    _move,
+)
+
+
+@pytest.mark.parametrize(
+    "row,expected",
+    [
+        ([0, 1, 0, 2], [1, 2, 0, 0]),
+        ([0, 0, 0, 0], [0, 0, 0, 0]),
+        ([3, 0, 0, 1], [3, 1, 0, 0]),
+        ([1, 2, 3, 4], [1, 2, 3, 4]),
+    ],
+)
+def test_compress_preserves_order(row, expected):
+    np.testing.assert_array_equal(
+        _compress_row(jnp.asarray(row, jnp.int32)), expected
+    )
+
+
+@pytest.mark.parametrize(
+    "row,expected,score",
+    [
+        ([1, 1, 0, 0], [2, 0, 0, 0], 4.0),
+        ([1, 1, 1, 1], [2, 2, 0, 0], 8.0),
+        ([2, 2, 2, 0], [3, 2, 0, 0], 8.0),  # leftmost pair merges first
+        ([1, 2, 2, 1], [1, 3, 1, 0], 8.0),
+        ([2, 2, 1, 1], [3, 2, 0, 0], 12.0),
+        ([1, 2, 1, 2], [1, 2, 1, 2], 0.0),
+        ([0, 0, 0, 0], [0, 0, 0, 0], 0.0),
+    ],
+)
+def test_merge_semantics(row, expected, score):
+    merged, s = _merge_row(jnp.asarray(row, jnp.int32))
+    np.testing.assert_array_equal(merged, expected)
+    assert float(s) == score
+
+
+def test_move_directions():
+    board = jnp.asarray(
+        [[1, 0, 0, 1],
+         [0, 0, 0, 0],
+         [0, 0, 0, 0],
+         [1, 0, 0, 1]], jnp.int32
+    )
+    left, s = _move(board, jnp.asarray(3))
+    np.testing.assert_array_equal(left[0], [2, 0, 0, 0])
+    np.testing.assert_array_equal(left[3], [2, 0, 0, 0])
+    assert float(s) == 8.0
+    up, s = _move(board, jnp.asarray(0))
+    np.testing.assert_array_equal(up[0], [2, 0, 0, 2])
+    assert float(s) == 8.0
+    down, s = _move(board, jnp.asarray(2))
+    np.testing.assert_array_equal(down[3], [2, 0, 0, 2])
+    right, s = _move(board, jnp.asarray(1))
+    np.testing.assert_array_equal(right[0], [0, 0, 0, 2])
+
+
+def test_action_mask_and_termination():
+    env = Game2048()
+    # Checkerboard of alternating exponents: no move changes anything.
+    dead = jnp.asarray(
+        [[1, 2, 1, 2],
+         [2, 1, 2, 1],
+         [1, 2, 1, 2],
+         [2, 1, 2, 1]], jnp.int32
+    )
+    _, _, changed = _all_moves(dead)
+    assert not bool(jnp.any(changed))
+
+    state = Game2048()._make_state(jax.random.PRNGKey(0), dead, jnp.zeros((), jnp.int32))
+    # Any action on a dead board terminates with zero reward.
+    _, ts = jax.jit(env.step)(state, jnp.asarray(3))
+    assert bool(ts.last()) and float(ts.discount) == 0.0
+    assert float(ts.reward) == 0.0
+
+
+def test_invalid_move_is_noop_without_spawn():
+    env = Game2048()
+    board = jnp.zeros((4, 4), jnp.int32).at[0, 0].set(1).at[1, 0].set(2)
+    state = env._make_state(jax.random.PRNGKey(0), board, jnp.zeros((), jnp.int32))
+    # LEFT changes nothing (everything already left-packed and unmergeable)
+    # but UP/DOWN do, so the episode must not terminate.
+    next_state, ts = jax.jit(env.step)(state, jnp.asarray(3))
+    np.testing.assert_array_equal(next_state.board, board)  # no spawn
+    assert float(ts.reward) == 0.0
+    assert not bool(ts.last())
+
+
+def test_valid_move_spawns_tile_and_scores():
+    env = Game2048()
+    board = jnp.zeros((4, 4), jnp.int32).at[0, 0].set(1).at[0, 3].set(1)
+    state = env._make_state(jax.random.PRNGKey(0), board, jnp.zeros((), jnp.int32))
+    next_state, ts = jax.jit(env.step)(state, jnp.asarray(3))  # left: merge
+    assert float(ts.reward) == 4.0
+    # Merged tile 2 + one spawned tile -> exactly two non-zero cells.
+    assert int(jnp.sum(next_state.board > 0)) == 2
+    assert int(next_state.board[0, 0]) == 2
+
+
+def test_full_episode_random_play():
+    env = Game2048(max_steps=300)
+    state, ts = env.reset(jax.random.PRNGKey(0))
+    step = jax.jit(env.step)
+    total = 0.0
+    for i in range(300):
+        key = jax.random.PRNGKey(i)
+        mask = ts.observation.action_mask
+        # Uniform over valid moves.
+        action = jnp.argmax(jnp.where(mask > 0, jax.random.gumbel(key, (4,)), -jnp.inf))
+        state, ts = step(state, action)
+        total += float(ts.reward)
+        assert bool(jnp.all(state.board >= 0))
+        if bool(ts.last()):
+            break
+    assert total > 0.0
+
+
+def test_vmapped_rollout_static_shapes():
+    env = Game2048()
+    keys = jax.random.split(jax.random.PRNGKey(0), 8)
+    states, ts = jax.jit(jax.vmap(env.reset))(keys)
+    actions = jnp.zeros((8,), jnp.int32)
+    states, ts = jax.jit(jax.vmap(env.step))(states, actions)
+    assert ts.observation.agent_view.shape == (8, 4, 4)
+    assert ts.observation.action_mask.shape == (8, 4)
