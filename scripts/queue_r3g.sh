@@ -1,0 +1,24 @@
+#!/bin/bash
+# Round-3 wave 7: C51-Snake tightened recipe, TD3/D4PG at the recipe that
+# solved DDPG (600k+, sigma 0.15), 2048 + walker2d validation.
+cd /root/repo
+while pgrep -f "queue_r3[cde].sh" > /dev/null; do sleep 60; done
+source "$(dirname "$0")/queue_lib.sh"
+
+run td3_pendulum_v4 120 --module stoix_tpu.systems.ddpg.ff_td3 \
+  --default default/anakin/default_ff_td3.yaml env=pendulum arch.total_timesteps=600000 \
+  system.exploration_sigma=0.15
+run d4pg_pendulum_v4 120 --module stoix_tpu.systems.ddpg.ff_d4pg \
+  --default default/anakin/default_ff_d4pg.yaml env=pendulum arch.total_timesteps=800000 \
+  system.exploration_sigma=0.15 system.vmin=-1700 system.vmax=0
+run c51_snake_v4 120 --module stoix_tpu.systems.q_learning.ff_c51 \
+  --default default/anakin/default_ff_c51.yaml env=snake arch.total_timesteps=1000000 \
+  system.vmin=0 system.vmax=10 system.tau=0.1 system.q_lr=1.0e-3 system.epochs=8 \
+  system.final_epsilon=0.02 system.epsilon_decay_steps=25000
+run ppo_2048_1m 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=game_2048 arch.total_timesteps=1000000
+run ppo_walker2d_norm 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=walker2d \
+  arch.total_timesteps=2000000 system.normalize_observations=true
+
+echo '{"queue": "wave7 done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,254 @@
+"""DoorKey — first-party partially-observable gridworld (minigrid/navix
+DoorKey class, reference configs/env/navix + xland_minigrid suites; the
+external-suite adapters cover the real packages, this is the no-dependency
+stand-in).
+
+A wall splits the room; the agent must find the key, open the door, and
+reach the goal. Observation is a 5x5 EGOCENTRIC view (agent centered on the
+bottom row, facing up) — the layout is randomized per episode, so solving
+requires exploration and (for the full task) memory of what was seen.
+
+TPU shape notes: the layout lives as a dense [N, N, C] channel grid; the
+egocentric view is a pad + dynamic_slice + rot90 (lax.switch over the four
+headings) — all static shapes; actions apply via jnp.where masks, no
+data-dependent control flow.
+
+Actions (minigrid convention, subset): 0 turn left, 1 turn right,
+2 forward, 3 pickup, 4 toggle.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.types import (
+    Observation,
+    TimeStep,
+    restart,
+    select_step,
+    termination,
+    transition,
+    truncation,
+)
+
+_VIEW = 5
+# Channels: 0 wall, 1 closed door, 2 open door, 3 key, 4 goal.
+_C = 5
+# Headings: 0 up, 1 right, 2 down, 3 left (row/col deltas).
+_DR = jnp.asarray([-1, 0, 1, 0])
+_DC = jnp.asarray([0, 1, 0, -1])
+
+
+class DoorKeyState(NamedTuple):
+    key: jax.Array
+    agent_rc: jax.Array  # [2] int32
+    agent_dir: jax.Array  # () int32
+    has_key: jax.Array  # () bool
+    door_open: jax.Array  # () bool
+    key_rc: jax.Array  # [2] (moves off-grid when picked up)
+    door_rc: jax.Array  # [2]
+    goal_rc: jax.Array  # [2]
+    wall_col: jax.Array  # () int32
+    step_count: jax.Array
+
+
+def _masked_choice(key: jax.Array, mask: jax.Array) -> jax.Array:
+    """Uniform index over True cells of a [N, N] mask -> [2] (row, col)."""
+    flat = mask.reshape(-1)
+    gumbel = jax.random.gumbel(key, flat.shape)
+    idx = jnp.argmax(jnp.where(flat, gumbel, -jnp.inf))
+    n = mask.shape[1]
+    return jnp.stack([idx // n, idx % n]).astype(jnp.int32)
+
+
+class DoorKey(Environment):
+    """Key -> door -> goal gridworld with a 5x5 egocentric view."""
+
+    def __init__(self, size: int = 6, max_steps: int = 0):
+        if int(size) < 5:
+            raise ValueError(
+                f"DoorKey needs size >= 5 (got {size}): the layout requires a "
+                "border, an interior wall column, and a free column each side"
+            )
+        self._n = int(size)
+        self._max_steps = int(max_steps) if max_steps else 4 * self._n * self._n
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            # View channels + has_key broadcast as a 6th plane.
+            agent_view=spaces.Array((_VIEW, _VIEW, _C + 1), jnp.float32),
+            action_mask=spaces.Array((5,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(5)
+
+    # -- layout ----------------------------------------------------------
+
+    def _grid(self, state: DoorKeyState) -> jax.Array:
+        """Dense [N, N, C] channel grid from the state."""
+        n = self._n
+        rows = jnp.arange(n)[:, None]
+        cols = jnp.arange(n)[None, :]
+        border = (rows == 0) | (rows == n - 1) | (cols == 0) | (cols == n - 1)
+        wall = border | (cols == state.wall_col)
+        wall = wall & ~(
+            (rows == state.door_rc[0]) & (cols == state.door_rc[1])
+        )
+
+        def at(rc):
+            return (rows == rc[0]) & (cols == rc[1])
+
+        grid = jnp.zeros((n, n, _C), jnp.float32)
+        grid = grid.at[:, :, 0].set(wall.astype(jnp.float32))
+        grid = grid.at[:, :, 1].set(
+            (at(state.door_rc) & ~state.door_open).astype(jnp.float32)
+        )
+        grid = grid.at[:, :, 2].set(
+            (at(state.door_rc) & state.door_open).astype(jnp.float32)
+        )
+        grid = grid.at[:, :, 3].set(at(state.key_rc).astype(jnp.float32))
+        grid = grid.at[:, :, 4].set(at(state.goal_rc).astype(jnp.float32))
+        return grid
+
+    def _observe(self, state: DoorKeyState) -> Observation:
+        """5x5 egocentric view: agent centered on the bottom row, facing up."""
+        grid = self._grid(state)
+        pad = _VIEW  # generous halo so the slice never clips
+        padded = jnp.pad(grid, ((pad, pad), (pad, pad), (0, 0)))
+        # Rotate the WORLD so the agent's heading points up, then slice the
+        # window ahead of the agent. rot90(k) needs static k: lax.switch.
+        r, c = state.agent_rc[0] + pad, state.agent_rc[1] + pad
+        n_pad = padded.shape[0]
+
+        def rot(k):
+            def f():
+                rotated = jnp.rot90(padded, k=k, axes=(0, 1))
+                # Rotating the grid moves the agent's coordinates too.
+                if k == 0:
+                    rr, cc = r, c
+                elif k == 1:
+                    rr, cc = n_pad - 1 - c, r
+                elif k == 2:
+                    rr, cc = n_pad - 1 - r, n_pad - 1 - c
+                else:
+                    rr, cc = c, n_pad - 1 - r
+                return jax.lax.dynamic_slice(
+                    rotated,
+                    (rr - (_VIEW - 1), cc - (_VIEW // 2), 0),
+                    (_VIEW, _VIEW, _C),
+                )
+            return f
+
+        # Heading 0 (up) needs no rotation; heading 1 (right) rotates the
+        # world counter-clockwise once so "right" points up, etc.
+        view = jax.lax.switch(state.agent_dir, [rot(0), rot(1), rot(2), rot(3)])
+        carried = jnp.full((_VIEW, _VIEW, 1), state.has_key, jnp.float32)
+        view = jnp.concatenate([view, carried], axis=-1)
+        return Observation(
+            agent_view=view,
+            action_mask=jnp.ones((5,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    # -- episode ---------------------------------------------------------
+
+    def reset(self, key: jax.Array) -> Tuple[DoorKeyState, TimeStep]:
+        n = self._n
+        key, k_wall, k_door, k_agent, k_key, k_goal, k_dir = jax.random.split(key, 7)
+        # Wall column strictly inside, leaving >= 1 free column each side.
+        wall_col = jax.random.randint(k_wall, (), 2, n - 2)
+        door_row = jax.random.randint(k_door, (), 1, n - 1)
+        door_rc = jnp.stack([door_row, wall_col]).astype(jnp.int32)
+
+        rows = jnp.arange(n)[:, None]
+        cols = jnp.arange(n)[None, :]
+        interior = (rows > 0) & (rows < n - 1) & (cols > 0) & (cols < n - 1)
+        left = interior & (cols < wall_col)
+        right = interior & (cols > wall_col)
+
+        agent_rc = _masked_choice(k_agent, left)
+        key_free = left & ~((rows == agent_rc[0]) & (cols == agent_rc[1]))
+        key_rc = _masked_choice(k_key, key_free)
+        goal_rc = _masked_choice(k_goal, right)
+
+        state = DoorKeyState(
+            key=key,
+            agent_rc=agent_rc,
+            agent_dir=jax.random.randint(k_dir, (), 0, 4),
+            has_key=jnp.zeros((), bool),
+            door_open=jnp.zeros((), bool),
+            key_rc=key_rc,
+            door_rc=door_rc,
+            goal_rc=goal_rc,
+            wall_col=wall_col,
+            step_count=jnp.zeros((), jnp.int32),
+        )
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: DoorKeyState, action: jax.Array) -> Tuple[DoorKeyState, TimeStep]:
+        action = jnp.reshape(action, ()).astype(jnp.int32)
+        d = state.agent_dir
+        ahead = state.agent_rc + jnp.stack([_DR[d], _DC[d]])
+
+        # Turn.
+        new_dir = jnp.where(
+            action == 0, (d - 1) % 4, jnp.where(action == 1, (d + 1) % 4, d)
+        )
+
+        # Forward: blocked by walls, closed door, and the (unpicked) key.
+        grid = self._grid(state)
+        cell = grid[ahead[0], ahead[1]]
+        blocked = (cell[0] > 0) | (cell[1] > 0) | (cell[3] > 0)
+        new_rc = jnp.where((action == 2) & ~blocked, ahead, state.agent_rc)
+
+        # Pickup: facing the key.
+        facing_key = jnp.all(ahead == state.key_rc)
+        picked = (action == 3) & facing_key & ~state.has_key
+        has_key = state.has_key | picked
+        key_rc = jnp.where(picked, jnp.full((2,), -1, jnp.int32), state.key_rc)
+
+        # Toggle: facing the door while carrying the key.
+        facing_door = jnp.all(ahead == state.door_rc)
+        door_open = state.door_open | ((action == 4) & facing_door & has_key)
+
+        next_state = DoorKeyState(
+            key=state.key,
+            agent_rc=new_rc,
+            agent_dir=new_dir,
+            has_key=has_key,
+            door_open=door_open,
+            key_rc=key_rc,
+            door_rc=state.door_rc,
+            goal_rc=state.goal_rc,
+            wall_col=state.wall_col,
+            step_count=state.step_count + 1,
+        )
+
+        at_goal = jnp.all(new_rc == state.goal_rc)
+        # Minigrid-style shaped terminal reward: earlier is better.
+        reward = jnp.where(
+            at_goal,
+            1.0 - 0.9 * next_state.step_count.astype(jnp.float32) / self._max_steps,
+            0.0,
+        ).astype(jnp.float32)
+        terminated = at_goal
+        truncated = jnp.logical_and(
+            next_state.step_count >= self._max_steps, ~terminated
+        )
+        obs = self._observe(next_state)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from stoix_tpu.envs import classic, debug, game2048, locomotion, minatar, snake
+from stoix_tpu.envs import classic, debug, doorkey, game2048, locomotion, minatar, snake
 from stoix_tpu.envs.core import Environment
 from stoix_tpu.envs.wrappers import (
     EpisodeStepLimit,
@@ -37,6 +37,7 @@ ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
     "SpaceInvaders-minatar": minatar.SpaceInvaders,
     "Snake-v1": snake.Snake,
     "Game2048-v1": game2048.Game2048,
+    "DoorKey-v0": doorkey.DoorKey,
     "IdentityGame": debug.IdentityGame,
     "SequenceGame": debug.SequenceGame,
 }

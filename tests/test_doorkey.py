@@ -1,0 +1,132 @@
+"""DoorKey mechanics tests (first-party minigrid/navix DoorKey equivalent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stoix_tpu.envs.doorkey import DoorKey, DoorKeyState
+
+
+def _state(env, agent=(2, 1), direction=1, has_key=False, door_open=False,
+           key=(3, 1), door=(2, 3), goal=(2, 4), wall_col=3):
+    return DoorKeyState(
+        key=jax.random.PRNGKey(0),
+        agent_rc=jnp.asarray(agent, jnp.int32),
+        agent_dir=jnp.asarray(direction, jnp.int32),
+        has_key=jnp.asarray(has_key),
+        door_open=jnp.asarray(door_open),
+        key_rc=jnp.asarray(key, jnp.int32),
+        door_rc=jnp.asarray(door, jnp.int32),
+        goal_rc=jnp.asarray(goal, jnp.int32),
+        wall_col=jnp.asarray(wall_col, jnp.int32),
+        step_count=jnp.zeros((), jnp.int32),
+    )
+
+
+def test_reset_layout_invariants():
+    env = DoorKey(size=6)
+    for seed in range(5):
+        state, ts = env.reset(jax.random.PRNGKey(seed))
+        wall = int(state.wall_col)
+        assert 2 <= wall <= 3
+        assert int(state.agent_rc[1]) < wall
+        assert int(state.key_rc[1]) < wall
+        assert int(state.goal_rc[1]) > wall
+        assert int(state.door_rc[1]) == wall
+        assert ts.observation.agent_view.shape == (5, 5, 6)
+
+
+def test_turns_and_forward_blocked_by_wall():
+    env = DoorKey(size=6)
+    state = _state(env, agent=(2, 2), direction=1)  # facing the wall col 3
+    step = jax.jit(env.step)
+    # Door is at (2,3): facing the CLOSED door -> blocked.
+    next_state, _ = step(state, jnp.asarray(2))
+    np.testing.assert_array_equal(next_state.agent_rc, [2, 2])
+    # Turn right: 1 -> 2 (down).
+    next_state, _ = step(state, jnp.asarray(1))
+    assert int(next_state.agent_dir) == 2
+    # Turn left: 1 -> 0 (up).
+    next_state, _ = step(state, jnp.asarray(0))
+    assert int(next_state.agent_dir) == 0
+
+
+def test_pickup_toggle_goal_sequence():
+    env = DoorKey(size=6)
+    step = jax.jit(env.step)
+
+    # Face the key (below the agent) and pick it up.
+    state = _state(env, agent=(2, 1), direction=2, key=(3, 1))
+    state, _ = step(state, jnp.asarray(3))
+    assert bool(state.has_key)
+    assert int(state.key_rc[0]) == -1  # removed from the grid
+
+    # Face the door and toggle it open.
+    state = state._replace(agent_rc=jnp.asarray([2, 2], jnp.int32),
+                           agent_dir=jnp.asarray(1, jnp.int32))
+    state, _ = step(state, jnp.asarray(4))
+    assert bool(state.door_open)
+
+    # Walk through the open door to the goal at (2, 4).
+    state, ts = step(state, jnp.asarray(2))  # onto the door cell (2,3)
+    np.testing.assert_array_equal(state.agent_rc, [2, 3])
+    state, ts = step(state, jnp.asarray(2))  # onto the goal
+    assert bool(ts.last()) and float(ts.discount) == 0.0
+    assert float(ts.reward) > 0.8  # fast solve keeps most of the reward
+
+
+def test_toggle_requires_key():
+    env = DoorKey(size=6)
+    state = _state(env, agent=(2, 2), direction=1, has_key=False)
+    state, _ = jax.jit(env.step)(state, jnp.asarray(4))
+    assert not bool(state.door_open)
+
+
+def test_egocentric_view_rotates_with_heading():
+    env = DoorKey(size=6)
+    # The wall column is to the agent's EAST; the view cell directly ahead
+    # is (3, 2) (one step up from the bottom-center (4, 2)).
+    ahead = (3, 2)
+    # Facing right (east): wall directly ahead.
+    state = _state(env, agent=(2, 2), direction=1)
+    view = env._observe(state).agent_view
+    assert float(view[ahead][1]) == 1.0  # closed door straight ahead
+    # Facing up (north): the wall is now to the view's right.
+    state = _state(env, agent=(2, 2), direction=0)
+    view = env._observe(state).agent_view
+    assert float(view[3, 3, 0] + view[3, 3, 1]) > 0.0
+    # has_key plane broadcasts.
+    state = _state(env, agent=(2, 2), direction=0, has_key=True)
+    view = env._observe(state).agent_view
+    assert float(view[..., 5].min()) == 1.0
+
+
+def test_truncation_and_vmap():
+    env = DoorKey(size=6, max_steps=10)
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    states, ts = jax.jit(jax.vmap(env.reset))(keys)
+    step = jax.jit(jax.vmap(env.step))
+    for _ in range(10):
+        states, ts = step(states, jnp.zeros((4,), jnp.int32))  # spin in place
+    assert bool(jnp.all(ts.last()))
+    assert bool(jnp.all(ts.extras["truncation"]))
+    assert bool(jnp.all(ts.discount == 1.0))
+
+
+def test_random_policy_rollout_finite():
+    env = DoorKey(size=6)
+    state, ts = env.reset(jax.random.PRNGKey(1))
+    step = jax.jit(env.step)
+    for i in range(100):
+        a = jax.random.randint(jax.random.PRNGKey(i), (), 0, 5)
+        state, ts = step(state, a)
+        assert bool(jnp.all(jnp.isfinite(ts.observation.agent_view)))
+        if bool(ts.last()):
+            break
+
+
+def test_rejects_too_small_size():
+    import pytest
+
+    with pytest.raises(ValueError, match="size >= 5"):
+        DoorKey(size=4)
