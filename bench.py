@@ -53,17 +53,59 @@ def main() -> None:
     import os
     import threading
 
+    # Exactly ONE JSON line may ever be printed. Every exit path (success,
+    # watchdog, probe failure, CPU fallback) must first win this once-lock;
+    # losers exit silently. Without it, a watchdog-triggered fallback (now a
+    # minutes-long window, not microseconds) could race a recovering main
+    # thread and emit two lines.
+    _once = threading.Lock()
+
+    def _emit_and_exit(payload: dict) -> None:
+        print(json.dumps(payload), flush=True)
+        os._exit(0)
+
     def _fail(reason: str) -> None:
+        if not _once.acquire(blocking=False):
+            return  # another exit path already owns the output line
+        watchdog.cancel()  # don't let a second timer re-enter mid-fallback
+        # The accelerator runtime is unavailable (wedged tunnel / init error).
+        # Rather than emitting only a TIMEOUT line, re-run this benchmark on
+        # the forced-CPU backend in a FRESH process (this one is committed to
+        # the dead backend) and forward its measurement, honestly labeled.
+        if "--cpu" not in sys.argv and os.environ.get("STOIX_BENCH_NO_FALLBACK") != "1":
+            import subprocess
+
+            try:
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), *sys.argv[1:], "--cpu"],
+                    capture_output=True,
+                    text=True,
+                    timeout=1800,
+                    env={**os.environ, "STOIX_BENCH_NO_FALLBACK": "1"},
+                )
+                for line in reversed(out.stdout.strip().splitlines()):
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        payload = json.loads(line)
+                    except Exception:
+                        continue  # stray brace-prefixed output; keep scanning
+                    if not payload.get("value"):
+                        break  # the child itself failed: report OUR failure
+                    payload["unit"] = (
+                        f"{payload['unit']} [CPU FALLBACK - device runtime "
+                        f"unavailable: {reason}]"
+                    )
+                    payload["vs_baseline"] = None  # CPU is not the tracked HW
+                    _emit_and_exit(payload)
+            except Exception:
+                pass  # fall through to the structured failure line
         # Structured failure, rc 0: the contract is ONE JSON line, never a
         # traceback — the zero value + reason string in `unit` mark the
         # failure; a nonzero rc would read as "no result at all".
-        print(
-            json.dumps(
-                {"metric": metric, "value": 0.0, "unit": reason, "vs_baseline": 0.0}
-            ),
-            flush=True,
+        _emit_and_exit(
+            {"metric": metric, "value": 0.0, "unit": reason, "vs_baseline": 0.0}
         )
-        os._exit(0)
 
     watchdog = threading.Timer(180.0, _fail, args=("TIMEOUT: backend init/probe unresponsive",))
     watchdog.daemon = True
@@ -105,8 +147,17 @@ def main() -> None:
     watchdog.daemon = True
     watchdog.start()
 
+    def _emit_success(payload: dict) -> None:
+        # Success path competes for the same once-lock: if a failure handler
+        # already owns the output (watchdog fired, fallback in flight), exit
+        # silently rather than printing a second line.
+        if not _once.acquire(blocking=False):
+            os._exit(0)
+        watchdog.cancel()
+        _emit_and_exit(payload)
+
     if sebulba:
-        _run_sebulba(metric, smoke, n_devices)
+        _run_sebulba(metric, smoke, n_devices, _emit_success)
         return
 
     overrides = [
@@ -183,29 +234,25 @@ def main() -> None:
     steps_per_sec = steps_per_call / min(times)
     per_chip = steps_per_sec / n_devices
     baseline_per_chip = 1_000_000 / 64  # BASELINE.json north star on v5e-64
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(steps_per_sec, 1),
-                "unit": f"env_steps/sec ({n_devices} devices, {env_tag})",
-                # The baseline is defined for the tracked ant config only.
-                "vs_baseline": (
-                    None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
-                ),
-            }
-        )
+    _emit_success(
+        {
+            "metric": metric,
+            "value": round(steps_per_sec, 1),
+            "unit": f"env_steps/sec ({n_devices} devices, {env_tag})",
+            # The baseline is defined for the tracked ant config only.
+            "vs_baseline": (
+                None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
+            ),
+        }
     )
 
 
-def _run_sebulba(metric: str, smoke: bool, n_devices: int) -> None:
+def _run_sebulba(metric: str, smoke: bool, n_devices: int, emit) -> None:
     """Sebulba PPO on the native C++ CartPole pool; steady-state SPS.
 
     Device split: with 1 device everything shares it; with 2+ devices actors
     get device 0, the learner the rest (mirrors the validated CI split).
     """
-    import json as _json
-
     from stoix_tpu.systems.ppo.sebulba import ff_ppo as sebulba_ppo
     from stoix_tpu.utils import config as config_lib
 
@@ -231,18 +278,15 @@ def _run_sebulba(metric: str, smoke: bool, n_devices: int) -> None:
     )
     sebulba_ppo.run_experiment(config)
     steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
-    print(
-        _json.dumps(
-            {
-                "metric": metric,
-                "value": round(float(steady), 1) if steady else 0.0,
-                "unit": "env_steps/sec (steady-state, %d devices, C++ pool)" % n_devices,
-                # Sebulba has no tracked numeric baseline (reference publishes
-                # none for its sebulba arch); report the raw number.
-                "vs_baseline": None,
-            }
-        ),
-        flush=True,
+    emit(
+        {
+            "metric": metric,
+            "value": round(float(steady), 1) if steady else 0.0,
+            "unit": "env_steps/sec (steady-state, %d devices, C++ pool)" % n_devices,
+            # Sebulba has no tracked numeric baseline (reference publishes
+            # none for its sebulba arch); report the raw number.
+            "vs_baseline": None,
+        }
     )
 
 
