@@ -64,9 +64,18 @@ def main() -> None:
         print(json.dumps(payload), flush=True)
         os._exit(0)
 
+    def _block_forever() -> None:
+        # Lock loser: the winning exit path owns the process and will
+        # os._exit when its line is out. Returning instead would let the
+        # loser keep running — a recovered main thread would hit later code
+        # (tracebacks / second output lines) and an exiting main thread
+        # would tear down the winner's in-flight fallback subprocess.
+        while True:
+            time.sleep(3600)
+
     def _fail(reason: str) -> None:
         if not _once.acquire(blocking=False):
-            return  # another exit path already owns the output line
+            _block_forever()  # another exit path owns the output line
         watchdog.cancel()  # don't let a second timer re-enter mid-fallback
         # The accelerator runtime is unavailable (wedged tunnel / init error).
         # Rather than emitting only a TIMEOUT line, re-run this benchmark on
@@ -149,10 +158,11 @@ def main() -> None:
 
     def _emit_success(payload: dict) -> None:
         # Success path competes for the same once-lock: if a failure handler
-        # already owns the output (watchdog fired, fallback in flight), exit
-        # silently rather than printing a second line.
+        # already owns the output (watchdog fired, fallback in flight), park
+        # this thread and let the owner finish — os._exit here would kill
+        # the owner's in-flight fallback subprocess with no line emitted.
         if not _once.acquire(blocking=False):
-            os._exit(0)
+            _block_forever()
         watchdog.cancel()
         _emit_and_exit(payload)
 

@@ -12,6 +12,9 @@
 
 QUEUE_OUT=${QUEUE_OUT:-docs/runs_r3.jsonl}
 QUEUE_LOCK=${QUEUE_LOCK:-/tmp/stoix_queue.lock}
+# Launcher: cpu_run.py forces the CPU backend; set
+# QUEUE_RUNNER=scripts/run_exp.py for ambient-platform (TPU) queues.
+QUEUE_RUNNER=${QUEUE_RUNNER:-scripts/cpu_run.py}
 
 run() {
   local tag="$1"; shift
@@ -20,8 +23,25 @@ run() {
   (
     flock 9
     echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
-    RUN_WATCHDOG_MINUTES=$minutes python scripts/cpu_run.py "$@" \
+    RUN_WATCHDOG_MINUTES=$minutes python "$QUEUE_RUNNER" "$@" \
       logger.use_console=False > "$capture" 2>&1
+    local rc=$?
+    local line
+    line=$(grep -E '^\{' "$capture" | tail -1)
+    echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": ${line:-null}, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
+  ) 9>"$QUEUE_LOCK"
+}
+
+# One bench.py invocation under the same lock/record discipline: per-run
+# start marker, rc, and result line (null when the bench emitted nothing).
+run_bench() {
+  local tag="$1"; shift
+  local seconds="$1"; shift
+  local capture="/tmp/q_${tag}.out"
+  (
+    flock 9
+    echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
+    timeout "$seconds" python bench.py "$@" > "$capture" 2>&1
     local rc=$?
     local line
     line=$(grep -E '^\{' "$capture" | tail -1)

@@ -1,0 +1,60 @@
+#!/bin/bash
+# TPU validation queue — fire when the tunnel is healthy again.
+# Everything here is blocked on real-chip throughput: CNN workloads (CPU is
+# ~100x too slow), locomotion gait emergence (needs 10-30M steps), and the
+# long sampled-search budgets. Serialized via the shared flock; every run
+# wrapped in the watchdog (wedge-safe per the tunnel rules).
+#
+# Usage: probe first, then  nohup bash scripts/tpu_queue.sh &
+#   python - <<'EOF'
+#   import jax, jax.numpy as jnp
+#   print(jax.devices()); print(float((jnp.ones((256,256)) @ jnp.ones((256,256))).sum()))
+#   EOF
+cd /root/repo
+export QUEUE_OUT=docs/runs_tpu.jsonl
+# Ambient-platform launcher: run_exp.py uses the TPU when healthy.
+export QUEUE_RUNNER=scripts/run_exp.py
+source "$(dirname "$0")/queue_lib.sh"
+
+# 1. Locomotion at brax-class budgets (minutes per run on the chip).
+run ppo_ant_30m 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=ant \
+  arch.total_timesteps=30000000 system.normalize_observations=true \
+  logger.use_console=False
+run sac_ant_3m 45 --module stoix_tpu.systems.sac.ff_sac \
+  --default default/anakin/default_ff_sac.yaml env=ant arch.total_timesteps=3000000 \
+  logger.use_console=False
+run ppo_hopper_20m 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=hopper \
+  arch.total_timesteps=20000000 system.normalize_observations=true \
+  logger.use_console=False
+run ppo_halfcheetah_20m 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=halfcheetah \
+  arch.total_timesteps=20000000 system.normalize_observations=true \
+  logger.use_console=False
+
+# 2. CNN workloads (held off CPU entirely).
+run dqn_snake_cnn 45 --module stoix_tpu.systems.q_learning.ff_dqn \
+  --default default/anakin/default_ff_dqn.yaml env=snake network=cnn_dqn \
+  'env.wrapper.flatten_observation=false' arch.total_timesteps=2000000 \
+  logger.use_console=False
+run ppo_breakout_minatar 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=breakout_jax network=cnn \
+  arch.total_timesteps=5000000 logger.use_console=False
+
+# 3. Sampled search at real budgets.
+run sampled_az_3m 60 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_timesteps=3000000 logger.use_console=False
+run sampled_mz_3m 60 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
+  arch.total_timesteps=3000000 logger.use_console=False
+
+# 4. Fresh chip throughput numbers for the record. 3900s outer timeout:
+# bench.py's own worst case is the 1800s run watchdog plus an up-to-1800s
+# CPU-fallback subprocess.
+run_bench bench_ant 3900
+run_bench bench_ant_large 3900 --large
+run_bench bench_sebulba 3900 --sebulba
+
+echo '{"queue": "tpu queue done"}' >> "$QUEUE_OUT"
