@@ -1,12 +1,14 @@
 """Anakin TD3 (reference stoix/systems/ddpg/ff_td3.py, 699 LoC).
 
 Distinctives: twin-Q via MultiNetwork with min backup, target-policy smoothing
-noise, and delayed (every `policy_frequency` updates) actor/target updates.
+noise, and a delayed (every `policy_frequency` updates) ACTOR update; target
+polyak updates run every step, like the reference (ff_td3.py:295-301) — see
+the note in update_from_batch.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -21,12 +23,6 @@ from stoix_tpu.systems.ddpg.ff_ddpg import DDPGOptStates, DDPGParams, build_netw
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.training import make_learning_rate
-
-
-class TD3LearnCarry(NamedTuple):
-    params: DDPGParams
-    opt_states: DDPGOptStates
-    update_count: jax.Array
 
 
 def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array):
@@ -95,7 +91,11 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         q_updates, q_opt_state = q_optim.update(q_grads, opt_states.q_opt_state)
         q_online = optax.apply_updates(params.q_params.online, q_updates)
 
-        # Delayed policy + target updates.
+        # Delayed POLICY update only — target polyak updates run every step
+        # (reference ff_td3.py:295-301 vs the masked actor optimizer at
+        # :396-405). Delaying the targets as well (the earlier behavior)
+        # empirically stalls Pendulum completely (-1146 vs -172 with the
+        # delay removed; docs/runs_r3.jsonl td3_diag_*).
         do_policy = (count % policy_frequency) == 0
         actor_grads, actor_metrics = jax.grad(actor_loss_fn, has_aux=True)(
             params.actor_params.online, q_online, batch.obs
@@ -113,16 +113,10 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
             lambda new, old: jnp.where(do_policy, new, old),
             new_actor_opt, opt_states.actor_opt_state,
         )
-        actor_target = jax.tree.map(
-            lambda new, old: jnp.where(do_policy, new, old),
-            optax.incremental_update(actor_online, params.actor_params.target, tau),
-            params.actor_params.target,
+        actor_target = optax.incremental_update(
+            actor_online, params.actor_params.target, tau
         )
-        q_target = jax.tree.map(
-            lambda new, old: jnp.where(do_policy, new, old),
-            optax.incremental_update(q_online, params.q_params.target, tau),
-            params.q_params.target,
-        )
+        q_target = optax.incremental_update(q_online, params.q_params.target, tau)
 
         new_params = DDPGParams(
             OnlineAndTarget(actor_online, actor_target), OnlineAndTarget(q_online, q_target)
