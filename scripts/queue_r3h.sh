@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-3 wave 8: classic-suite breadth (Acrobot), MinAtar-via-MLP (Freeway),
+# the 2048 long-budget degradation probe, and a longer SPO-continuous run.
+cd /root/repo
+source "$(dirname "$0")/queue_lib.sh"
+
+run dqn_acrobot 60 --module stoix_tpu.systems.q_learning.ff_dqn \
+  --default default/anakin/default_ff_dqn.yaml env=acrobot arch.total_timesteps=1000000
+run ppo_freeway_mlp 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=freeway \
+  'env.wrapper.flatten_observation=true' arch.total_timesteps=2000000
+run ppo_2048_decay 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=game_2048 arch.total_timesteps=1000000 \
+  system.decay_learning_rates=true
+run spo_cont_pendulum_1m 150 --module stoix_tpu.systems.spo.ff_spo_continuous \
+  --default default/anakin/default_ff_spo_continuous.yaml env=pendulum \
+  arch.total_timesteps=1000000
+
+echo '{"queue": "wave8 done"}' >> "$QUEUE_OUT"
