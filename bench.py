@@ -1,15 +1,19 @@
-"""Benchmark harness: Anakin PPO env-steps/sec on the available devices.
+"""Benchmark harness for the tracked BASELINE configs.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Default invocation prints ONE JSON line (the north-star Anakin PPO/Ant
+workload): {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+`--all` prints one line per tracked config (5 lines) so replay-buffer, MCTS,
+and Sebulba hot paths are perf-tracked alongside the PPO path
+(BASELINE.md "Tracked configs"):
 
-The tracked workload is PPO on the first-party Ant locomotion env — the
-stand-in for BASELINE.json's north-star config (Anakin PPO on brax ant,
->= 1M aggregate env-steps/sec on a v5e-64, i.e. 15,625 steps/sec/chip).
-vs_baseline is measured per-chip throughput / that per-chip target; it is
-reported as null for the variant workloads (--cartpole, --large), which are
-incommensurable with the ant baseline.
+    anakin_ppo_ant            — north star (vs_baseline = per-chip / 15,625)
+    anakin_c51_snake          — ff_c51 on first-party Snake (sharded replay)
+    anakin_sac_ant            — ff_sac on first-party Ant (off-policy continuous)
+    anakin_mz_cartpole        — ff_mz on CartPole (on-device MCTS in the loop)
+    sebulba_ppo_cartpole      — actor/learner split over the native C++ pool
 
-Usage: python bench.py [--smoke] [--cartpole] [--large] [--sebulba] [--cpu]
+Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba] [--cpu]
+  --all       run all five tracked configs, one JSON line each
   --smoke     tiny budget for CI wiring checks
   --cartpole  the round-1 metric: tiny-MLP CartPole (VPU-bound; kept for
               continuity)
@@ -33,13 +37,18 @@ def main() -> None:
     large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
     cartpole = "--cartpole" in sys.argv
     sebulba = "--sebulba" in sys.argv
+    run_all = "--all" in sys.argv
     if large and cartpole:
         sys.exit("--large is the MXU-bound Ant variant; it does not compose with --cartpole")
     if sebulba and (large or cartpole):
         sys.exit("--sebulba is its own workload; it does not compose with other variants")
+    if run_all and (large or cartpole or sebulba):
+        sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
     env_tag = "cartpole" if cartpole else "ant"
-    if sebulba:
+    if run_all:
+        metric = "bench_all"
+    elif sebulba:
         metric = "sebulba_ppo_cartpole_env_steps_per_sec"
     else:
         metric = f"anakin_ppo_{env_tag}_env_steps_per_sec" + ("_large_bf16" if large else "")
@@ -53,11 +62,11 @@ def main() -> None:
     import os
     import threading
 
-    # Exactly ONE JSON line may ever be printed. Every exit path (success,
+    # Exactly ONE exit path may ever own stdout. Every exit path (success,
     # watchdog, probe failure, CPU fallback) must first win this once-lock;
     # losers exit silently. Without it, a watchdog-triggered fallback (now a
     # minutes-long window, not microseconds) could race a recovering main
-    # thread and emit two lines.
+    # thread and emit duplicate lines.
     _once = threading.Lock()
 
     def _emit_and_exit(payload: dict) -> None:
@@ -89,24 +98,31 @@ def main() -> None:
                     [sys.executable, os.path.abspath(__file__), *sys.argv[1:], "--cpu"],
                     capture_output=True,
                     text=True,
-                    timeout=1800,
+                    timeout=3000 if run_all else 1800,
                     env={**os.environ, "STOIX_BENCH_NO_FALLBACK": "1"},
                 )
-                for line in reversed(out.stdout.strip().splitlines()):
+                lines = []
+                for line in out.stdout.strip().splitlines():
                     if not line.startswith("{"):
                         continue
                     try:
                         payload = json.loads(line)
                     except Exception:
                         continue  # stray brace-prefixed output; keep scanning
-                    if not payload.get("value"):
-                        break  # the child itself failed: report OUR failure
+                    if not payload.get("value") and not run_all:
+                        break  # single-metric child failed: report OUR failure
+                    # --all keeps value-0 workload-failure lines: every
+                    # tracked config gets its line, failed or not.
                     payload["unit"] = (
                         f"{payload['unit']} [CPU FALLBACK - device runtime "
                         f"unavailable: {reason}]"
                     )
                     payload["vs_baseline"] = None  # CPU is not the tracked HW
-                    _emit_and_exit(payload)
+                    lines.append(payload)
+                if lines:
+                    for payload in lines[:-1]:
+                        print(json.dumps(payload), flush=True)
+                    _emit_and_exit(lines[-1])
             except Exception:
                 pass  # fall through to the structured failure line
         # Structured failure, rc 0: the contract is ONE JSON line, never a
@@ -124,8 +140,6 @@ def main() -> None:
 
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
-
-    from stoix_tpu.utils import config as config_lib
 
     # Backend init can also fail outright (round 1: the wedged tunnel made
     # jax.devices() raise). Always emit the structured JSON line, never a
@@ -150,13 +164,17 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001
         _fail(f"DEVICE PROBE FAILED: {type(exc).__name__}: {exc}")
 
-    # Healthy chip: swap in the long-deadline watchdog for the timed run.
+    # Healthy chip: swap in the long-deadline watchdog for the timed run(s).
     watchdog.cancel()
-    watchdog = threading.Timer(1800.0, _fail, args=("TIMEOUT: device runtime unresponsive",))
+    watchdog = threading.Timer(
+        3400.0 if run_all else 1800.0,
+        _fail,
+        args=("TIMEOUT: device runtime unresponsive",),
+    )
     watchdog.daemon = True
     watchdog.start()
 
-    def _emit_success(payload: dict) -> None:
+    def _finish(payloads: list) -> None:
         # Success path competes for the same once-lock: if a failure handler
         # already owns the output (watchdog fired, fallback in flight), park
         # this thread and let the owner finish — os._exit here would kill
@@ -164,47 +182,73 @@ def main() -> None:
         if not _once.acquire(blocking=False):
             _block_forever()
         watchdog.cancel()
-        _emit_and_exit(payload)
+        for payload in payloads[:-1]:
+            print(json.dumps(payload), flush=True)
+        _emit_and_exit(payloads[-1])
 
-    if sebulba:
-        _run_sebulba(metric, smoke, n_devices, _emit_success)
+    if run_all:
+        workloads = [
+            ("anakin_ppo_ant_env_steps_per_sec",
+             lambda: _run_anakin_ppo(smoke, False, False, n_devices)),
+            ("anakin_c51_snake_env_steps_per_sec",
+             lambda: _run_anakin_generic(
+                 "anakin_c51_snake_env_steps_per_sec",
+                 "default/anakin/default_ff_c51.yaml",
+                 _c51_setup, ["env=snake"], smoke, n_devices,
+                 "snake, sharded replay")),
+            ("anakin_sac_ant_env_steps_per_sec",
+             lambda: _run_anakin_generic(
+                 "anakin_sac_ant_env_steps_per_sec",
+                 "default/anakin/default_ff_sac.yaml",
+                 "stoix_tpu.systems.sac.ff_sac", ["env=ant"], smoke, n_devices,
+                 "ant, off-policy replay")),
+            ("anakin_mz_cartpole_env_steps_per_sec",
+             lambda: _run_anakin_generic(
+                 "anakin_mz_cartpole_env_steps_per_sec",
+                 "default/anakin/default_ff_mz.yaml",
+                 "stoix_tpu.systems.search.ff_mz", [], smoke, n_devices,
+                 "cartpole, on-device MCTS")),
+            ("sebulba_ppo_cartpole_env_steps_per_sec",
+             lambda: _run_sebulba(
+                 "sebulba_ppo_cartpole_env_steps_per_sec", smoke, n_devices)),
+        ]
+        payloads = []
+        for name, workload in workloads:
+            # One failing config must not cost the others their lines (or
+            # turn the output into a traceback — the one-line-per-metric
+            # contract): report it as a value-0 structured failure.
+            try:
+                payloads.append(workload())
+            except Exception as exc:  # noqa: BLE001 — reported, not raised
+                payloads.append(
+                    {
+                        "metric": name,
+                        "value": 0.0,
+                        "unit": f"WORKLOAD FAILED: {type(exc).__name__}: {exc}",
+                        "vs_baseline": None,
+                    }
+                )
+        _finish(payloads)
         return
 
-    overrides = [
-        "arch.total_num_envs=%d" % (2048 * n_devices if not smoke else 8 * n_devices),
-        "system.rollout_length=%d" % ((64 if cartpole else 16) if not smoke else 8),
-        "arch.num_evaluation=1",
-        "arch.num_eval_episodes=%d" % max(8, n_devices),
-        "arch.absolute_metric=False",
-        "logger.use_console=False",
-    ]
-    if not cartpole:
-        overrides.append("env=ant")
-    if large:
-        overrides += [
-            "network.actor_network.pre_torso.layer_sizes=[1024,1024]",
-            "network.actor_network.pre_torso.compute_dtype=bfloat16",
-            "network.critic_network.pre_torso.layer_sizes=[1024,1024]",
-            "network.critic_network.pre_torso.compute_dtype=bfloat16",
-        ]
-    default_yaml = (
-        "default/anakin/default_ff_ppo.yaml"
-        if cartpole
-        else "default/anakin/default_ff_ppo_continuous.yaml"
-    )
-    config = config_lib.compose(config_lib.default_config_dir(), default_yaml, overrides)
+    if sebulba:
+        _finish([_run_sebulba(metric, smoke, n_devices)])
+        return
+
+    _finish([_run_anakin_ppo(smoke, cartpole, large, n_devices, metric=metric)])
+
+
+def _timed_anakin_run(config, learner_setup, smoke: bool):
+    """Shared timed-loop core: compose -> setup -> warmup -> best-of-N timing.
+    Returns (steps_per_sec, n_devices_used)."""
+    import jax
+    import numpy as np
 
     from stoix_tpu import envs
     from stoix_tpu.parallel import create_mesh
     from stoix_tpu.utils.timestep_checker import check_total_timesteps
 
-    if cartpole:
-        from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
-    else:
-        from stoix_tpu.systems.ppo.anakin.ff_ppo_continuous import learner_setup
-
     mesh = create_mesh({"data": -1})
-    # Fix the number of updates per timed call.
     updates_per_call = 2 if smoke else 8
     config.arch.num_updates = updates_per_call * (3 if not smoke else 1)
     config.arch.total_timesteps = None
@@ -214,7 +258,15 @@ def main() -> None:
     env, _ = envs.make(config)
     key = jax.random.PRNGKey(0)
     setup = learner_setup(env, config, mesh, key)
+    # Off-policy setups return (AnakinSetup, warmup): run the replay warmup
+    # outside the timed window, exactly as the runner does. AnakinSetup is
+    # itself a NamedTuple, so detect the pair by the missing .learn attribute.
+    warmup = None
+    if not hasattr(setup, "learn"):
+        setup, warmup = setup
     learn, learner_state = setup.learn, setup.learner_state
+    if warmup is not None:
+        learner_state = warmup(learner_state)
 
     steps_per_call = (
         int(config.system.rollout_length)
@@ -241,23 +293,105 @@ def main() -> None:
         learner_state = out.learner_state
         times.append(time.perf_counter() - start)
 
-    steps_per_sec = steps_per_call / min(times)
+    return steps_per_call / min(times)
+
+
+def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
+    from stoix_tpu.utils import config as config_lib
+
+    env_tag = "cartpole" if cartpole else "ant"
+    if metric is None:
+        metric = f"anakin_ppo_{env_tag}_env_steps_per_sec" + ("_large_bf16" if large else "")
+    overrides = [
+        "arch.total_num_envs=%d" % (2048 * n_devices if not smoke else 8 * n_devices),
+        "system.rollout_length=%d" % ((64 if cartpole else 16) if not smoke else 8),
+        "arch.num_evaluation=1",
+        "arch.num_eval_episodes=%d" % max(8, n_devices),
+        "arch.absolute_metric=False",
+        "logger.use_console=False",
+    ]
+    if not cartpole:
+        overrides.append("env=ant")
+    if large:
+        overrides += [
+            "network.actor_network.pre_torso.layer_sizes=[1024,1024]",
+            "network.actor_network.pre_torso.compute_dtype=bfloat16",
+            "network.critic_network.pre_torso.layer_sizes=[1024,1024]",
+            "network.critic_network.pre_torso.compute_dtype=bfloat16",
+        ]
+    default_yaml = (
+        "default/anakin/default_ff_ppo.yaml"
+        if cartpole
+        else "default/anakin/default_ff_ppo_continuous.yaml"
+    )
+    config = config_lib.compose(config_lib.default_config_dir(), default_yaml, overrides)
+
+    if cartpole:
+        from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
+    else:
+        from stoix_tpu.systems.ppo.anakin.ff_ppo_continuous import learner_setup
+
+    steps_per_sec = _timed_anakin_run(config, learner_setup, smoke)
     per_chip = steps_per_sec / n_devices
     baseline_per_chip = 1_000_000 / 64  # BASELINE.json north star on v5e-64
-    _emit_success(
-        {
-            "metric": metric,
-            "value": round(steps_per_sec, 1),
-            "unit": f"env_steps/sec ({n_devices} devices, {env_tag})",
-            # The baseline is defined for the tracked ant config only.
-            "vs_baseline": (
-                None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
-            ),
-        }
-    )
+    return {
+        "metric": metric,
+        "value": round(steps_per_sec, 1),
+        "unit": f"env_steps/sec ({n_devices} devices, {env_tag})",
+        # The baseline is defined for the tracked ant config only.
+        "vs_baseline": (
+            None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
+        ),
+    }
 
 
-def _run_sebulba(metric: str, smoke: bool, n_devices: int, emit) -> None:
+def _c51_setup(env, config, mesh, key):
+    from stoix_tpu.systems.q_learning.ff_c51 import _head_kwargs, c51_loss
+    from stoix_tpu.systems.q_learning.q_family import q_learner_setup
+
+    return q_learner_setup(env, config, mesh, key, c51_loss, _head_kwargs(config))
+
+
+def _run_anakin_generic(
+    metric: str,
+    default_yaml: str,
+    setup_fn,
+    overrides: list,
+    smoke: bool,
+    n_devices: int,
+    unit_tag: str,
+) -> dict:
+    """One tracked non-PPO Anakin config: same timed loop, config-default run
+    shape (the round-3 validated shapes live in the config defaults).
+    `setup_fn` is a module path exposing learner_setup or the callable itself."""
+    import importlib
+
+    from stoix_tpu.utils import config as config_lib
+
+    overrides = overrides + [
+        "arch.num_evaluation=1",
+        "arch.num_eval_episodes=%d" % max(8, n_devices),
+        "arch.absolute_metric=False",
+        "logger.use_console=False",
+    ]
+    if smoke:
+        # rollout 8, not smaller: sequence-replay systems (MZ) need the first
+        # buffer add to hold a full sample_sequence_length (6) sequence.
+        overrides += ["arch.total_num_envs=%d" % (8 * n_devices), "system.rollout_length=8"]
+    config = config_lib.compose(config_lib.default_config_dir(), default_yaml, overrides)
+    if isinstance(setup_fn, str):
+        setup_fn = importlib.import_module(setup_fn).learner_setup
+    steps_per_sec = _timed_anakin_run(config, setup_fn, smoke)
+    return {
+        "metric": metric,
+        "value": round(steps_per_sec, 1),
+        "unit": f"env_steps/sec ({n_devices} devices, {unit_tag})",
+        # Only the PPO/ant north star has a numeric baseline.
+        "vs_baseline": None,
+    }
+
+
+def _run_sebulba(metric: str, smoke: bool, n_devices: int) -> dict:
     """Sebulba PPO on the native C++ CartPole pool; steady-state SPS.
 
     Device split: with 1 device everything shares it; with 2+ devices actors
@@ -288,16 +422,21 @@ def _run_sebulba(metric: str, smoke: bool, n_devices: int, emit) -> None:
     )
     sebulba_ppo.run_experiment(config)
     steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
-    emit(
-        {
-            "metric": metric,
-            "value": round(float(steady), 1) if steady else 0.0,
-            "unit": "env_steps/sec (steady-state, %d devices, C++ pool)" % n_devices,
-            # Sebulba has no tracked numeric baseline (reference publishes
-            # none for its sebulba arch); report the raw number.
-            "vs_baseline": None,
-        }
-    )
+    if steady:
+        unit = "env_steps/sec (steady-state, %d devices, C++ pool)" % n_devices
+    else:
+        # Zero values must carry their failure reason in `unit` (the bench
+        # output contract): a missing steady window means the run ended before
+        # the first eval block opened/closed it.
+        unit = "NO STEADY WINDOW: first eval block never reached"
+    return {
+        "metric": metric,
+        "value": round(float(steady), 1) if steady else 0.0,
+        "unit": unit,
+        # Sebulba has no tracked numeric baseline (reference publishes
+        # none for its sebulba arch); report the raw number.
+        "vs_baseline": None,
+    }
 
 
 if __name__ == "__main__":

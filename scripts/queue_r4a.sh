@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-4 wave 1: cheap validation rows (VERDICT #8) — PPO-penalty CartPole,
+# DPO Pendulum, penalty-continuous Pendulum control.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_penalty_cartpole 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  arch.total_timesteps=1000000 logger.use_console=False
+
+# DPO on Pendulum: PPO-family on-policy methods need the long budget here
+# (SPO-cont solved at 2M; PPO-cont is ~-1100 at 500k) — give DPO 3M.
+run dpo_pendulum_3m 90 --module stoix_tpu.systems.ppo.anakin.ff_dpo_continuous \
+  --default default/anakin/default_ff_dpo_continuous.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=3000000 \
+  system.normalize_observations=true logger.use_console=False
+
+echo '{"queue": "r4a done"}' >> "$QUEUE_OUT"

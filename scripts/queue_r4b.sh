@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-4 wave 2: revalidate SPO after the full-dual-set + off-policy redesign
+# (VERDICT round-3 Weak #7): discrete IdentityGame fast-solve, continuous
+# Pendulum at the round-3 solved budget.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run spo_identity_dual 45 --module stoix_tpu.systems.spo.ff_spo \
+  --default default/anakin/default_ff_spo.yaml env=identity_game \
+  arch.total_num_envs=64 arch.total_timesteps=150000 \
+  logger.use_console=False
+
+run spo_cont_pendulum_dual 120 --module stoix_tpu.systems.spo.ff_spo_continuous \
+  --default default/anakin/default_ff_spo_continuous.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4b done"}' >> "$QUEUE_OUT"

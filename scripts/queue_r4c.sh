@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-4 wave 3: AlphaZero CartPole after the search-value GAE fix
+# (VERDICT #4: reference ff_az.py:268-273 computes GAE over search_value).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run az_cartpole_onpolicy 90 --module stoix_tpu.systems.search.ff_az \
+  --default default/anakin/default_ff_az.yaml env=cartpole \
+  arch.total_num_envs=64 arch.total_timesteps=500000 \
+  logger.use_console=False logger.use_json=True
+
+run az_cartpole_replay 90 --module stoix_tpu.systems.search.ff_az \
+  --default default/anakin/default_ff_az.yaml env=cartpole \
+  system.use_replay_buffer=true \
+  arch.total_num_envs=64 arch.total_timesteps=500000 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4c done"}' >> "$QUEUE_OUT"

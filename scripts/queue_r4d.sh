@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-4 wave 4: SPO revalidation at the reference run shape (epochs 64,
+# rollout/seq 32, epsilon 0.5) + DPO on a PPO-family-solvable task
+# (locomotion; Pendulum is not solvable by the PPO family at these budgets —
+# docs/VALIDATION.md round-3 note).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run spo_identity_refshape 60 --module stoix_tpu.systems.spo.ff_spo \
+  --default default/anakin/default_ff_spo.yaml env=identity_game \
+  arch.total_num_envs=64 arch.total_timesteps=150000 \
+  logger.use_console=False
+
+run dpo_halfcheetah_1m 60 --module stoix_tpu.systems.ppo.anakin.ff_dpo_continuous \
+  --default default/anakin/default_ff_dpo_continuous.yaml env=halfcheetah \
+  arch.total_num_envs=64 arch.total_timesteps=1000000 \
+  system.normalize_observations=true logger.use_console=False
+
+run ppo_penalty_norm_cartpole 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  system.normalize_observations=true \
+  arch.total_timesteps=1000000 logger.use_console=False
+
+echo '{"queue": "r4d done"}' >> "$QUEUE_OUT"

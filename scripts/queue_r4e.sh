@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-4 wave 5: SPO discrete at a full budget (trust-region design learns
+# slower on trivial tasks: 7.45/10 @150k) + AZ replay-mode longer budget.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run spo_identity_500k 90 --module stoix_tpu.systems.spo.ff_spo \
+  --default default/anakin/default_ff_spo.yaml env=identity_game \
+  arch.total_num_envs=64 arch.total_timesteps=500000 \
+  logger.use_console=False
+
+run az_cartpole_replay_1m 120 --module stoix_tpu.systems.search.ff_az \
+  --default default/anakin/default_ff_az.yaml env=cartpole \
+  system.use_replay_buffer=true \
+  arch.total_num_envs=64 arch.total_timesteps=1000000 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4e done"}' >> "$QUEUE_OUT"

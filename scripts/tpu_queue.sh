@@ -42,19 +42,25 @@ run ppo_breakout_minatar 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
   --default default/anakin/default_ff_ppo.yaml env=breakout_jax network=cnn \
   arch.total_timesteps=5000000 logger.use_console=False
 
-# 3. Sampled search at real budgets.
-run sampled_az_3m 60 --module stoix_tpu.systems.search.ff_sampled_az \
+# 3. Sampled search at real budgets (r3 trend extrapolates to solved at
+# 5-10M; K=16 samples is the next lever if 5M stalls).
+run sampled_az_5m 60 --module stoix_tpu.systems.search.ff_sampled_az \
   --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
-  arch.total_timesteps=3000000 logger.use_console=False
-run sampled_mz_3m 60 --module stoix_tpu.systems.search.ff_sampled_mz \
+  arch.total_timesteps=5000000 logger.use_console=False
+run sampled_mz_5m 60 --module stoix_tpu.systems.search.ff_sampled_mz \
   --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
-  arch.total_timesteps=3000000 logger.use_console=False
+  arch.total_timesteps=5000000 logger.use_console=False
 
-# 4. Fresh chip throughput numbers for the record. 3900s outer timeout:
-# bench.py's own worst case is the 1800s run watchdog plus an up-to-1800s
-# CPU-fallback subprocess.
-run_bench bench_ant 3900
+# 3b. SPO at the reference replay intensity (epochs 128 on-chip).
+run spo_cont_pendulum_chip 60 --module stoix_tpu.systems.spo.ff_spo_continuous \
+  --default default/anakin/default_ff_spo_continuous.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 system.epochs=128 \
+  logger.use_console=False
+
+# 4. Fresh chip throughput numbers for the record: all five tracked BASELINE
+# configs in one invocation (one JSON line per config). 4000s outer timeout:
+# bench.py's --all watchdog is 3400s plus fallback margin.
+run_bench bench_all 4000 --all
 run_bench bench_ant_large 3900 --large
-run_bench bench_sebulba 3900 --sebulba
 
 echo '{"queue": "tpu queue done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,25 @@
+#!/bin/bash
+# TPU health watcher: probe every 10 minutes; the moment the tunnel answers,
+# fire the staged TPU queue (scripts/tpu_queue.sh) exactly once and exit.
+# Probe = tiny matmul in a subprocess under timeout (a wedged tunnel HANGS
+# rather than erroring — see docs/VALIDATION.md round-3 preamble).
+cd /root/repo
+LOG=docs/tpu_health.log
+while true; do
+  ts=$(date -u +%FT%TZ)
+  timeout 180 python - <<'EOF' > /tmp/tpu_probe.out 2>&1
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+print("PROBE_OK", d, float((x @ x).sum()))
+EOF
+  rc=$?
+  if [ $rc -eq 0 ] && grep -q PROBE_OK /tmp/tpu_probe.out; then
+    echo "$ts HEALTHY: $(grep PROBE_OK /tmp/tpu_probe.out)" >> "$LOG"
+    echo "$ts launching tpu_queue.sh" >> "$LOG"
+    nohup bash scripts/tpu_queue.sh >> "$LOG" 2>&1 &
+    exit 0
+  fi
+  echo "$ts wedged (rc=$rc)" >> "$LOG"
+  sleep 600
+done

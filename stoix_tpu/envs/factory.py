@@ -115,9 +115,20 @@ class EnvPoolFactory(EnvFactory):
                 "the native CVecEnvFactory (stoix_tpu/envs/cvec.py) for the "
                 "first-party C++ vectorized envs."
             ) from e
+        from stoix_tpu.envs.envpool_adapter import EnvPoolAdapter
+
         seed = self._next_seed(num_envs)
-        return envpool.make(
-            self._task_id, env_type="gymnasium", num_envs=num_envs, seed=seed, **self._kwargs
+        # gym_reset_return_info: reset() -> (obs, info), the API the adapter
+        # consumes (reference env_factory.py:57-66).
+        return EnvPoolAdapter(
+            envpool.make(
+                self._task_id,
+                env_type="gymnasium",
+                num_envs=num_envs,
+                seed=seed,
+                gym_reset_return_info=True,
+                **self._kwargs,
+            )
         )
 
 

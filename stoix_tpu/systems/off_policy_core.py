@@ -218,6 +218,9 @@ def wrap_learn_and_warmup(
     warmup = jax.jit(
         jax.shard_map(
             per_shard_warmup, mesh=mesh, in_specs=(state_specs,),
+            # Same Anakin opt-out as systems/anakin.py: the in-shard
+            # update-batch vmap axis' pmean fails check_vma's internal
+            # assert (JAX limitation, not a spec bug).
             out_specs=state_specs, check_vma=False,
         )
     )

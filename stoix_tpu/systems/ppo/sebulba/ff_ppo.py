@@ -190,7 +190,10 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
             mesh=mesh,
             in_specs=(CoreLearnerState(P(), P(), P(), P()), P(None, "data")),
             out_specs=(CoreLearnerState(P(), P(), P(), P()), P()),
-            check_vma=False,
+            # No in-shard vmap axis here, so the varying-manual-axes
+            # validator runs (Anakin's pmean-over-vmap-axis limitation
+            # does not apply — see systems/anakin.py).
+            check_vma=True,
         )
     )
 
@@ -396,18 +399,6 @@ def run_experiment(
         config, learner_mesh,
     )
 
-    # Evaluation on the dedicated device via the standard sharded evaluator.
-    from stoix_tpu.envs.registry import make_single
-    from stoix_tpu.envs.wrappers import RecordEpisodeMetrics
-
-    eval_env = RecordEpisodeMetrics(
-        make_single(
-            config.env.scenario.name
-            if hasattr(config.env.scenario, "name")
-            else config.env.scenario,
-            **dict(config.env.get("kwargs", {}) or {}),
-        )
-    )
     normalize_obs = bool(config.system.get("normalize_observations", False))
 
     def eval_apply(payload, observation):
@@ -417,9 +408,30 @@ def run_experiment(
             return actor.apply(p, observation)
         return actor.apply(payload, observation)
 
-    eval_fn = get_ff_evaluator_fn(
-        eval_env, get_distribution_act_fn(config, eval_apply), config, eval_mesh
-    )
+    # Evaluation on the dedicated device via the standard sharded evaluator
+    # when the scenario has a JAX env (registry/suites); stateful backends
+    # with no JAX twin (EnvPool Atari ids) evaluate on a factory pool instead
+    # (reference: Sebulba evaluates EnvPool envs on factory envs).
+    from stoix_tpu.envs.registry import make_single
+    from stoix_tpu.envs.wrappers import RecordEpisodeMetrics
+    from stoix_tpu.evaluator import get_stateful_evaluator_fn
+
+    try:
+        eval_env = RecordEpisodeMetrics(
+            make_single(
+                config.env.scenario.name
+                if hasattr(config.env.scenario, "name")
+                else config.env.scenario,
+                **dict(config.env.get("kwargs", {}) or {}),
+            )
+        )
+        eval_fn = get_ff_evaluator_fn(
+            eval_env, get_distribution_act_fn(config, eval_apply), config, eval_mesh
+        )
+    except (ValueError, ImportError):
+        eval_fn = get_stateful_evaluator_fn(
+            env_factory, get_distribution_act_fn(config, eval_apply), config
+        )
 
     logger = StoixLogger(config)
     lifetime = ThreadLifetime()

@@ -6,7 +6,7 @@ like PPO. The reference pairs it with a LayerNorm MLP torso.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +25,41 @@ from stoix_tpu.utils.jax_utils import tree_merge_leading_dims
 from stoix_tpu.utils.training import make_learning_rate
 
 
+class PQNStepCount(NamedTuple):
+    """Dedicated gradient-step counter state, found by type (not by leaf-name
+    pattern matching) so future optimizer-chain changes can't silently change
+    the epsilon annealing rate."""
+
+    count: jax.Array
+
+
+def count_gradient_steps() -> optax.GradientTransformation:
+    """Stateful no-op transform appended to the PQN chain: its PQNStepCount
+    increments exactly once per gradient step."""
+
+    def init(params):
+        del params
+        return PQNStepCount(jnp.zeros((), jnp.int32))
+
+    def update(updates, state, params=None):
+        del params
+        return updates, PQNStepCount(state.count + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
+def _find_step_count(opt_states) -> jax.Array:
+    counts = [
+        leaf.count
+        for leaf in jax.tree.leaves(
+            opt_states, is_leaf=lambda x: isinstance(x, PQNStepCount)
+        )
+        if isinstance(leaf, PQNStepCount)
+    ]
+    assert len(counts) == 1, "expected exactly one PQNStepCount in the optimizer chain"
+    return counts[0]
+
+
 def get_learner_fn(env, q_apply, q_update, config):
     gamma = float(config.system.gamma)
     lam = float(config.system.get("q_lambda", 0.65))
@@ -32,7 +67,7 @@ def get_learner_fn(env, q_apply, q_update, config):
     # Reference PQN anneals epsilon 1.0 -> training_epsilon over
     # exploration_fraction of training (reference
     # configs/system/q_learning/ff_pqn.yaml decay_epsilon/exploration_fraction).
-    # PQN is buffer-free, so progress is read off the optimizer step count.
+    # PQN is buffer-free, so progress is read off the dedicated step counter.
     decay = bool(config.system.get("decay_epsilon", False))
     explore_frac = float(config.system.get("exploration_fraction", 0.5))
     grad_steps_per_update = int(config.system.epochs) * int(config.system.num_minibatches)
@@ -41,16 +76,7 @@ def get_learner_fn(env, q_apply, q_update, config):
     def _epsilon(opt_states):
         if not decay:
             return train_eps
-        # First 'count' leaf by tree path: with decay_learning_rates the
-        # chain holds TWO count leaves (radam's and the LR schedule's), so
-        # optax.tree_utils.tree_get would raise on ambiguity; every count in
-        # the chain increments once per gradient step, any one will do.
-        count = None
-        for path, leaf in jax.tree_util.tree_leaves_with_path(opt_states):
-            if any(getattr(k, "name", None) == "count" for k in path):
-                count = leaf
-                break
-        assert count is not None, "optimizer state has no step count leaf"
+        count = _find_step_count(opt_states)
         frac = jnp.minimum(
             count.astype(jnp.float32) / grad_steps_per_update / decay_updates, 1.0
         )
@@ -151,6 +177,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         optax.radam(make_learning_rate(float(config.system.q_lr), config,
                                        int(config.system.epochs),
                                        int(config.system.num_minibatches))),
+        count_gradient_steps(),
     )
 
     key, net_key, env_key = jax.random.split(key, 3)

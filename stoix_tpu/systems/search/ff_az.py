@@ -173,12 +173,19 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         )
         params, opt_states, key, env_state, last_timestep = learner_state
 
-        v_t = critic_apply(params.critic_params, traj.next_obs)
+        # GAE over the MCTS root SEARCH values (reference ff_az.py:268-273
+        # passes values=sequence.search_value) — the search-improved value
+        # sequence, not the raw critic. v_t is the NEXT step's search value;
+        # at truncations (and the rollout tail) the true successor was never
+        # searched, so bootstrap those from the critic on next_obs.
+        v_t_net = critic_apply(params.critic_params, traj.next_obs)
+        sv_next = jnp.concatenate([traj.search_value[1:], v_t_net[-1:]], axis=0)
+        v_t = jnp.where(traj.truncated.astype(bool), v_t_net, sv_next)
         _, targets = truncated_generalized_advantage_estimation(
             traj.reward,
             gamma * (1.0 - traj.done.astype(jnp.float32)),
             float(config.system.get("gae_lambda", 0.95)),
-            v_tm1=traj.value,
+            v_tm1=traj.search_value,
             v_t=v_t,
             truncation_t=traj.truncated.astype(jnp.float32),
         )
@@ -240,9 +247,20 @@ def get_replay_learner_fn(env, sim_env, apply_fns, update_fns, buffer, config):
         data = {
             "obs": last_timestep.observation,
             "search_policy": search_out.action_weights,
+            "search_value": search_out.search_value,
+            # Critic value of the TRUE successor, recorded at collection time:
+            # the replay GAE needs it at truncations, where the stored next
+            # search value belongs to the following episode's first state.
+            "bootstrap_value": critic_apply(
+                params.critic_params, timestep.extras["next_obs"]
+            ),
             "reward": timestep.reward,
             "discount": timestep.discount,
-            "truncated": jnp.logical_and(timestep.last(), timestep.discount != 0.0),
+            # float32 to match the sampled-AZ/MZ replay buffers (one dtype for
+            # the field across the search family).
+            "truncated": jnp.logical_and(
+                timestep.last(), timestep.discount != 0.0
+            ).astype(jnp.float32),
             "info": timestep.extras["episode_metrics"],
         }
         return (
@@ -255,15 +273,23 @@ def get_replay_learner_fn(env, sim_env, apply_fns, update_fns, buffer, config):
         key, sample_key = jax.random.split(key)
         seq = buffer.sample(buffer_state, sample_key).experience  # [B, L, ...]
 
-        # GAE targets with the CURRENT critic over the sampled sequence.
-        values = critic_apply(params.critic_params, seq["obs"])  # [B, L]
+        # GAE targets over the STORED search root values (reference
+        # ff_az.py:268-273: values=sequence.search_value) — search-improved,
+        # and stable under replay because they don't drift with the critic.
+        # At truncations sv[:, 1:] is the NEXT episode's first root value, so
+        # bootstrap those steps from the stored true-successor critic value.
+        sv = seq["search_value"]  # [B, L]
+        truncated = seq["truncated"][:, :-1].astype(jnp.float32)
+        v_t = jnp.where(
+            truncated > 0, seq["bootstrap_value"][:, :-1], sv[:, 1:]
+        )
         _, targets = truncated_generalized_advantage_estimation(
             seq["reward"][:, :-1],
             gamma * seq["discount"][:, :-1],
             float(config.system.get("gae_lambda", 0.95)),
-            v_tm1=jax.lax.stop_gradient(values[:, :-1]),
-            v_t=jax.lax.stop_gradient(values[:, 1:]),
-            truncation_t=seq["truncated"][:, :-1].astype(jnp.float32),
+            v_tm1=sv[:, :-1],
+            v_t=v_t,
+            truncation_t=truncated,
             batch_major=True,
         )
         train_obs = jax.tree.map(lambda x: x[:, :-1], seq["obs"])
@@ -398,9 +424,11 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         dummy_item = {
             "obs": env.observation_value(),
             "search_policy": jnp.zeros((env.num_actions,), jnp.float32),
+            "search_value": jnp.zeros((), jnp.float32),
+            "bootstrap_value": jnp.zeros((), jnp.float32),
             "reward": jnp.zeros((), jnp.float32),
             "discount": jnp.zeros((), jnp.float32),
-            "truncated": jnp.zeros((), bool),
+            "truncated": jnp.zeros((), jnp.float32),
         }
         buffer_state = buffer.init(dummy_item)
         learn_per_shard = get_replay_learner_fn(

@@ -9,27 +9,44 @@ Core machinery preserved (reference `SPO` class, ff_spo.py:342-983):
     (multinomial) whenever the effective sample size drops below a threshold
     (`resample` :797, `calculate_ess_and_entropy` :950)
   - the SMC-improved distribution over FIRST actions is the policy target,
-    optimized MPO-style with a learnable temperature dual
-    (`spo_types.py:20-29`); the critic trains on truncation-aware GAE.
+    optimized MPO-style with the FULL dual set (reference spo_types.py:20-29):
+    a temperature dual for the E-step AND a KL(target‖online) alpha dual for
+    the M-step trust region (reference ff_spo.py:1243-1281), with polyak
+    target actor/critic networks (:1408-1414)
+  - training is OFF-POLICY from a trajectory buffer of stored search results
+    (reference ff_spo.py:1631-1639): sequences are sampled each epoch and the
+    critic trains on truncation-aware GAE computed with the TARGET critic
+    over the stored sequence (:1310-1318).
 
 Serves discrete and continuous heads from the network config
-(ff_spo_continuous shares this learner, as the reference's twin file).
+(ff_spo_continuous shares this learner, as the reference's twin file);
+continuous KL constraints use the decomposed per-dimension mean/stddev alphas
+shared with MPO (systems/mpo/ff_vmpo.py helpers).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from stoix_tpu import envs
-from stoix_tpu.base_types import ExperimentOutput, OnPolicyLearnerState
+from stoix_tpu.base_types import ExperimentOutput, OffPolicyLearnerState, OnlineAndTarget
+from stoix_tpu.buffers import make_trajectory_buffer
 from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.ops import distributions as dists
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
-from stoix_tpu.systems import anakin
+from stoix_tpu.systems import anakin, off_policy_core as core
+from stoix_tpu.systems.mpo.ff_vmpo import (
+    decoupled_alpha_losses,
+    gaussian_kls_per_dim,
+    gaussian_params,
+    init_log_duals,
+    project_duals,
+)
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.systems.search.ff_az import unwrap_env_state
 from stoix_tpu.utils import config as config_lib
@@ -38,9 +55,10 @@ from stoix_tpu.utils.training import make_learning_rate
 
 
 class SPOParams(NamedTuple):
-    actor_params: Any
-    critic_params: Any
-    log_temperature: jax.Array  # eta dual for the SMC weights
+    actor_params: OnlineAndTarget
+    critic_params: OnlineAndTarget
+    log_temperature: jax.Array  # eta dual for the SMC weights (E-step)
+    log_alpha: jax.Array  # KL trust-region dual (M-step); [2, A] continuous
 
 
 class SPOOptStates(NamedTuple):
@@ -60,32 +78,22 @@ class Particles(NamedTuple):
     alive: jax.Array  # [N] discount-alive mask
 
 
-class SPOTransition(NamedTuple):
-    done: jax.Array
-    truncated: jax.Array
-    action: jax.Array
-    particle_actions: jax.Array  # [N, ...] root actions of the particles
-    particle_weights: jax.Array  # [N]
-    particle_advs: jax.Array  # [N] raw advantage sums (dual loss input)
-    value: jax.Array
-    reward: jax.Array
-    obs: Any
-    next_obs: Any
-    info: Dict[str, Any]
-
-
 def _softplus(x):
     return jax.nn.softplus(x) + 1e-8
 
 
-def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
+def get_learner_fn(env, sim_env, apply_fns, update_fns, buffer, config, continuous: bool):
     actor_apply, critic_apply = apply_fns
     actor_update, critic_update, dual_update = update_fns
     gamma = float(config.system.gamma)
+    tau = float(config.system.get("tau", 0.005))
     num_particles = int(config.system.get("num_particles", 16))
     horizon = int(config.system.get("search_horizon", 4))
     ess_threshold = float(config.system.get("ess_threshold", 0.5))
     eps_eta = float(config.system.get("epsilon_eta", 0.1))
+    eps_alpha = float(config.system.get("epsilon_policy", 1e-3))
+    eps_alpha_mean = float(config.system.get("epsilon_alpha_mean", 0.0075))
+    eps_alpha_stddev = float(config.system.get("epsilon_alpha_stddev", 1e-5))
 
     def _smc_search(params: SPOParams, key, root_state, root_obs):
         """SMC over one env's state: returns (first_actions [N,...], weights [N])."""
@@ -93,18 +101,16 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         tile = lambda x: jnp.broadcast_to(x, (num_particles,) + x.shape)
 
         key, act_key = jax.random.split(key)
-        root_dist = actor_apply(params.actor_params, jax.tree.map(tile, root_obs))
+        root_dist = actor_apply(params.actor_params.online, jax.tree.map(tile, root_obs))
         first_action = root_dist.sample(seed=act_key)
-
-        v_root = critic_apply(params.critic_params, root_obs)
 
         def step_particles(carry, _):
             particles, key, action = carry
             key, next_act_key, resample_key = jax.random.split(key, 3)
 
             new_state, ts = jax.vmap(sim_env.step)(particles.state, action)
-            v_next = critic_apply(params.critic_params, ts.observation)
-            v_cur = critic_apply(params.critic_params, particles.obs)
+            v_next = critic_apply(params.critic_params.online, ts.observation)
+            v_cur = critic_apply(params.critic_params.online, particles.obs)
             # Advantage-shaped incremental weight, masked once a particle's
             # episode has terminated.
             delta = ts.reward + gamma * ts.discount * v_next - v_cur
@@ -142,7 +148,7 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
                 particles,
             )
 
-            next_dist = actor_apply(params.actor_params, particles.obs)
+            next_dist = actor_apply(params.actor_params.online, particles.obs)
             next_action = next_dist.sample(seed=next_act_key)
             return (particles, key, next_action), ess
 
@@ -154,20 +160,20 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
             raw_adv=jnp.zeros((num_particles,)),
             alive=jnp.ones((num_particles,)),
         )
-        (particles, _, _), ess_trace = jax.lax.scan(
+        (particles, _, _), _ess_trace = jax.lax.scan(
             step_particles, (particles, key, first_action), None, horizon
         )
         weights = jax.nn.softmax(particles.log_weight)
-        return particles.first_action, weights, particles.raw_adv, jnp.mean(ess_trace), v_root
+        return particles.first_action, weights, particles.raw_adv
 
-    def _env_step(learner_state: OnPolicyLearnerState, _):
-        params, opt_states, key, env_state, last_timestep = learner_state
+    def _env_step(learner_state: OffPolicyLearnerState, _):
+        params, opt_states, buffer_state, key, env_state, last_timestep = learner_state
         key, search_key, choice_key = jax.random.split(key, 3)
 
         root_state = unwrap_env_state(env_state)
         n_envs = last_timestep.reward.shape[0]
         search_keys = jax.random.split(search_key, n_envs)
-        p_actions, p_weights, p_advs, ess, value = jax.vmap(
+        p_actions, p_weights, p_advs = jax.vmap(
             lambda k, s, o: _smc_search(params, k, s, o)
         )(
             search_keys,
@@ -180,33 +186,46 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
         action = jax.vmap(lambda p, c: p[c])(p_actions, choice)
         env_state_new, timestep = env.step(env_state, action)
 
-        transition = SPOTransition(
-            done=timestep.discount == 0.0,
-            truncated=jnp.logical_and(timestep.last(), timestep.discount != 0.0),
-            action=action,
-            particle_actions=p_actions,
-            particle_weights=p_weights,
-            particle_advs=p_advs,
-            value=value,
-            reward=timestep.reward,
-            obs=last_timestep.observation,
-            next_obs=timestep.extras["next_obs"],
-            info=timestep.extras["episode_metrics"],
-        )
+        data = {
+            "done": (timestep.discount == 0.0).astype(jnp.float32),
+            "truncated": jnp.logical_and(
+                timestep.last(), timestep.discount != 0.0
+            ).astype(jnp.float32),
+            "action": action,
+            "particle_actions": p_actions,
+            "particle_weights": p_weights,
+            "particle_advs": p_advs,
+            "reward": timestep.reward,
+            "obs": last_timestep.observation,
+            "next_obs": timestep.extras["next_obs"],
+            "info": timestep.extras["episode_metrics"],
+        }
         return (
-            OnPolicyLearnerState(params, opt_states, key, env_state_new, timestep),
-            transition,
+            OffPolicyLearnerState(
+                params, opt_states, buffer_state, key, env_state_new, timestep
+            ),
+            data,
         )
 
-    def _policy_loss_fn(learnable, obs, p_actions, p_weights, p_advs):
-        actor_params, log_temperature = learnable
+    def _policy_loss_fn(learnable, params: SPOParams, seq):
+        """CE to SMC weights + temperature dual + KL(target‖online) alpha dual
+        (reference ff_spo.py:1198-1295), over merged [B*L] sequence states."""
+        actor_online, log_temperature, log_alpha = learnable
         eta = _softplus(log_temperature)
-        dist = actor_apply(actor_params, obs)
-        # log pi over each particle's root action: [B, N].
-        log_probs = jax.vmap(dist.log_prob, in_axes=1, out_axes=1)(p_actions)
+        obs = jax.tree.map(lambda x: tree_merge_leading_dims(x, 2), seq["obs"])
+        p_actions = tree_merge_leading_dims(seq["particle_actions"], 2)  # [BL, N, ...]
+        p_weights = tree_merge_leading_dims(seq["particle_weights"], 2)  # [BL, N]
+        p_advs = tree_merge_leading_dims(seq["particle_advs"], 2)  # [BL, N]
+
+        online_dist = actor_apply(actor_online, obs)
+        target_dist = actor_apply(params.actor_params.target, obs)
+
+        # log pi over each particle's root action: [BL, N].
+        log_probs = jax.vmap(online_dist.log_prob, in_axes=1, out_axes=1)(p_actions)
         policy_loss = -jnp.mean(
             jnp.sum(jax.lax.stop_gradient(p_weights) * log_probs, axis=-1)
         )
+
         # Temperature dual on the RAW advantage sums (MPO form): the logsumexp
         # of advantages/eta carries the spread the dual constrains — applying
         # it to already-normalized weights is identically log(1) and would
@@ -216,73 +235,126 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
             jax.nn.logsumexp(jax.lax.stop_gradient(p_advs) / eta, axis=-1)
             - jnp.log(jnp.asarray(n, jnp.float32))
         )
-        entropy = dist.entropy().mean()
-        total = policy_loss + temperature_loss - float(
-            config.system.get("ent_coef", 0.0)
-        ) * entropy
+
+        # M-step trust region: KL(target‖online) with a learned alpha dual
+        # (reference ff_spo.py:1269-1277; continuous decomposed per-dim as in
+        # MPO's continuous_loss).
+        if continuous:
+            b_loc, b_scale = gaussian_params(target_dist)
+            o_loc, o_scale = gaussian_params(online_dist)
+            kl_mean, kl_std = gaussian_kls_per_dim(b_loc, b_scale, o_loc, o_scale)
+            alpha_loss, kl_loss, kl_metric = decoupled_alpha_losses(
+                log_alpha, kl_mean, kl_std, eps_alpha_mean, eps_alpha_stddev
+            )
+        else:
+            kl = jnp.mean(
+                dists.Categorical(target_dist.logits).kl_divergence(online_dist)
+            )
+            alpha = _softplus(log_alpha)
+            alpha_loss = jnp.sum(alpha * (eps_alpha - jax.lax.stop_gradient(kl)))
+            kl_loss = jnp.sum(jax.lax.stop_gradient(alpha) * kl)
+            kl_metric = kl
+
+        entropy = online_dist.entropy().mean()
+        total = (
+            policy_loss
+            + temperature_loss
+            + alpha_loss
+            + kl_loss
+            - float(config.system.get("ent_coef", 0.0)) * entropy
+        )
         return total, {
             "policy_loss": policy_loss,
             "temperature": eta,
+            "kl": kl_metric,
             "entropy": entropy,
         }
 
-    def _critic_loss_fn(critic_params, obs, targets):
-        value = critic_apply(critic_params, obs)
-        loss = 0.5 * jnp.mean((value - targets) ** 2)
+    def _critic_loss_fn(critic_online, params: SPOParams, seq):
+        """GAE targets over the stored sequence computed with the TARGET
+        critic (reference ff_spo.py:1310-1318), l2 to the online critic."""
+        v_tm1 = critic_apply(params.critic_params.target, seq["obs"])  # [B, L]
+        v_t = critic_apply(params.critic_params.target, seq["next_obs"])  # [B, L]
+        _, targets = truncated_generalized_advantage_estimation(
+            jnp.swapaxes(seq["reward"], 0, 1),
+            jnp.swapaxes(gamma * (1.0 - seq["done"]), 0, 1),
+            float(config.system.get("gae_lambda", 0.95)),
+            v_tm1=jnp.swapaxes(v_tm1, 0, 1),
+            v_t=jnp.swapaxes(v_t, 0, 1),
+            truncation_t=jnp.swapaxes(seq["truncated"], 0, 1),
+        )
+        targets = jnp.swapaxes(targets, 0, 1)  # back to [B, L]
+        pred = critic_apply(critic_online, seq["obs"])
+        loss = float(config.system.get("vf_coef", 0.5)) * 0.5 * jnp.mean(
+            (pred - jax.lax.stop_gradient(targets)) ** 2
+        )
         return loss, {"value_loss": loss}
 
-    def _update_step(learner_state: OnPolicyLearnerState, _):
+    def _update_epoch(carry, _):
+        params, opt_states, buffer_state, key = carry
+        key, sample_key = jax.random.split(key)
+        seq = buffer.sample(buffer_state, sample_key).experience  # [B, L, ...]
+
+        learnable = (params.actor_params.online, params.log_temperature, params.log_alpha)
+        p_grads, p_metrics = jax.grad(_policy_loss_fn, has_aux=True)(
+            learnable, params, seq
+        )
+        critic_grads, c_metrics = jax.grad(_critic_loss_fn, has_aux=True)(
+            params.critic_params.online, params, seq
+        )
+        p_grads, critic_grads = jax.lax.pmean(
+            jax.lax.pmean((p_grads, critic_grads), axis_name="batch"), axis_name="data"
+        )
+        actor_grads, temp_grads, alpha_grads = p_grads
+
+        a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
+        actor_online = optax.apply_updates(params.actor_params.online, a_updates)
+        actor_target = optax.incremental_update(
+            actor_online, params.actor_params.target, tau
+        )
+        c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
+        critic_online = optax.apply_updates(params.critic_params.online, c_updates)
+        critic_target = optax.incremental_update(
+            critic_online, params.critic_params.target, tau
+        )
+        d_updates, d_opt = dual_update(
+            (temp_grads, alpha_grads), opt_states.dual_opt_state
+        )
+        log_temperature, log_alpha = optax.apply_updates(
+            (params.log_temperature, params.log_alpha), d_updates
+        )
+        log_temperature, log_alpha = project_duals(log_temperature, log_alpha)
+
+        params = SPOParams(
+            OnlineAndTarget(actor_online, actor_target),
+            OnlineAndTarget(critic_online, critic_target),
+            log_temperature,
+            log_alpha,
+        )
+        return (params, SPOOptStates(a_opt, c_opt, d_opt), buffer_state, key), {
+            **p_metrics,
+            **c_metrics,
+        }
+
+    def _update_step(learner_state: OffPolicyLearnerState, _):
         learner_state, traj = jax.lax.scan(
             _env_step, learner_state, None, int(config.system.rollout_length)
         )
-        params, opt_states, key, env_state, last_timestep = learner_state
+        params, opt_states, buffer_state, key, env_state, timestep = learner_state
+        store = {k: v for k, v in traj.items() if k != "info"}
+        batch = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), store)  # [E, T, ...]
+        buffer_state = buffer.add(buffer_state, batch)
 
-        v_t = critic_apply(params.critic_params, traj.next_obs)
-        _, targets = truncated_generalized_advantage_estimation(
-            traj.reward,
-            gamma * (1.0 - traj.done.astype(jnp.float32)),
-            float(config.system.get("gae_lambda", 0.95)),
-            v_tm1=traj.value,
-            v_t=v_t,
-            truncation_t=traj.truncated.astype(jnp.float32),
+        (params, opt_states, buffer_state, key), loss_info = jax.lax.scan(
+            _update_epoch, (params, opt_states, buffer_state, key), None,
+            int(config.system.epochs),
         )
-
-        def _epoch(carry, _):
-            params, opt_states, key = carry
-            flat_obs, flat_pa, flat_pw, flat_padv, flat_tgt = tree_merge_leading_dims(
-                (traj.obs, traj.particle_actions, traj.particle_weights,
-                 traj.particle_advs, targets), 2
-            )
-            learnable = (params.actor_params, params.log_temperature)
-            grads, p_metrics = jax.grad(_policy_loss_fn, has_aux=True)(
-                learnable, flat_obs, flat_pa, flat_pw, flat_padv
-            )
-            critic_grads, c_metrics = jax.grad(_critic_loss_fn, has_aux=True)(
-                params.critic_params, flat_obs, flat_tgt
-            )
-            grads, critic_grads = jax.lax.pmean(
-                jax.lax.pmean((grads, critic_grads), axis_name="batch"), axis_name="data"
-            )
-            actor_grads, temp_grads = grads
-            a_updates, a_opt = actor_update(actor_grads, opt_states.actor_opt_state)
-            c_updates, c_opt = critic_update(critic_grads, opt_states.critic_opt_state)
-            d_updates, d_opt = dual_update(temp_grads, opt_states.dual_opt_state)
-            params = SPOParams(
-                optax.apply_updates(params.actor_params, a_updates),
-                optax.apply_updates(params.critic_params, c_updates),
-                optax.apply_updates(params.log_temperature, d_updates),
-            )
-            return (params, SPOOptStates(a_opt, c_opt, d_opt), key), {
-                **p_metrics, **c_metrics,
-            }
-
-        (params, opt_states, key), loss_info = jax.lax.scan(
-            _epoch, (params, opt_states, key), None, int(config.system.epochs)
+        learner_state = OffPolicyLearnerState(
+            params, opt_states, buffer_state, key, env_state, timestep
         )
-        learner_state = OnPolicyLearnerState(params, opt_states, key, env_state, last_timestep)
-        return learner_state, (traj.info, loss_info)
+        return learner_state, (traj["info"], loss_info)
 
-    def learner_fn(learner_state: OnPolicyLearnerState) -> ExperimentOutput:
+    def learner_fn(learner_state: OffPolicyLearnerState) -> ExperimentOutput:
         key = learner_state.key[0]
         state = learner_state._replace(key=key)
         state, (episode_info, loss_info) = jax.lax.scan(
@@ -296,10 +368,11 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, config):
     return learner_fn
 
 
-def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array) -> AnakinSetup:
+def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array):
     from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
 
     config.system.action_dim = env.num_actions
+    continuous = hasattr(env.action_space(), "low")
     net_cfg = config.network
     actor_network = FeedForwardActor(
         action_head=config_lib.instantiate(
@@ -330,28 +403,51 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
     actor_params = actor_network.init(actor_key, dummy_obs)
     critic_params = critic_network.init(critic_key, dummy_obs)
-    log_temperature = jnp.asarray(float(config.system.get("init_log_temperature", 1.0)))
-    params = SPOParams(actor_params, critic_params, log_temperature)
+    log_temperature, log_alpha = init_log_duals(config, continuous, int(env.num_actions))
+    params = SPOParams(
+        OnlineAndTarget(actor_params, actor_params),
+        OnlineAndTarget(critic_params, critic_params),
+        log_temperature,
+        log_alpha,
+    )
     opt_states = SPOOptStates(
         actor_optim.init(actor_params),
         critic_optim.init(critic_params),
-        dual_optim.init(log_temperature),
+        dual_optim.init((log_temperature, log_alpha)),
     )
 
-    update_batch = int(config.arch.get("update_batch_size", 1))
-    state_specs = OnPolicyLearnerState(
-        params=P(), opt_states=P(), key=P("data"),
-        env_state=P(None, "data"), timestep=P(None, "data"),
+    # Warmup-less replay: the first rollout add must already contain a full
+    # sampleable sequence (shared guard with the AZ/MZ family).
+    core.require_first_add_samplable(config)
+
+    num_particles = int(config.system.get("num_particles", 16))
+    action_value = jnp.asarray(
+        env.action_value(), jnp.float32 if continuous else jnp.int32
     )
-    env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
-    learner_state = OnPolicyLearnerState(
-        params=anakin.broadcast_to_update_batch(params, update_batch),
-        opt_states=anakin.broadcast_to_update_batch(opt_states, update_batch),
-        key=anakin.make_step_keys(key, mesh, config),
-        env_state=env_state,
-        timestep=timestep,
+    local_envs, sample_batch, max_length = core.trajectory_buffer_sizing(
+        config, mesh, 2 * int(config.system.rollout_length)
     )
-    learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
+    buffer = make_trajectory_buffer(
+        add_batch_size=local_envs,
+        sample_batch_size=sample_batch,
+        sample_sequence_length=int(config.system.get("sample_sequence_length", 8)),
+        period=int(config.system.get("sample_period", 1)),
+        max_length_time_axis=max_length,
+    )
+    dummy_item = {
+        "done": jnp.zeros((), jnp.float32),
+        "truncated": jnp.zeros((), jnp.float32),
+        "action": action_value,
+        "particle_actions": jnp.broadcast_to(
+            action_value, (num_particles,) + action_value.shape
+        ),
+        "particle_weights": jnp.zeros((num_particles,), jnp.float32),
+        "particle_advs": jnp.zeros((num_particles,), jnp.float32),
+        "reward": jnp.zeros((), jnp.float32),
+        "obs": env.observation_value(),
+        "next_obs": env.observation_value(),
+    }
+    buffer_state = buffer.init(dummy_item)
 
     sim_env = envs.make_single(
         config.env.scenario.name
@@ -361,15 +457,19 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
     )
     learn_per_shard = get_learner_fn(
         env, sim_env, (actor_network.apply, critic_network.apply),
-        (actor_optim.update, critic_optim.update, dual_optim.update), config,
+        (actor_optim.update, critic_optim.update, dual_optim.update),
+        buffer, config, continuous,
     )
-    learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
+    learner_state, state_specs = core.assemble_off_policy_state(
+        config, mesh, env, params, opt_states, buffer_state, key, env_key
+    )
+    learn = core.wrap_learn(learn_per_shard, mesh, state_specs)
 
     return AnakinSetup(
         learn=learn,
         learner_state=learner_state,
         eval_act_fn=get_distribution_act_fn(config, actor_network.apply),
-        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params),
+        eval_params_fn=lambda s: anakin.unbatch_params(s.params.actor_params.online),
     )
 
 

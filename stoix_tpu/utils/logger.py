@@ -148,12 +148,17 @@ class WandbSink(BaseSink):
         project: str = "stoix_tpu",
         mode: str = "offline",
         config_dict: Optional[Dict[str, Any]] = None,
+        run_id: Optional[str] = None,
         **init_kwargs: Any,
     ):
         self._start = time.time()
         self._run = None
         self._history = None
         self._summary: Dict[str, Any] = {}
+        # run_id resume (reference logger.py:501-504): resume="allow" attaches
+        # to the existing W&B run — the multi-process / checkpoint-resume flow.
+        if run_id is not None:
+            init_kwargs.update(id=run_id, resume="allow")
         try:
             import wandb
 
@@ -207,6 +212,99 @@ class WandbSink(BaseSink):
             self._history.close()
 
 
+class NeptuneSink(BaseSink):
+    """neptune.ai sink (reference logger.py:222-299 NeptuneLogger).
+
+    With the `neptune` package installed, logs through a real
+    `neptune.init_run` — `run_id` resumes an existing run via `with_id`
+    (reference :257-258, the multi-process / checkpoint-resume flow), sync
+    mode under Sebulba because async neptune logging deadlocks with the
+    thread pools (reference :255). Without the package (this sandbox),
+    writes a neptune-style offline run directory instead:
+
+        <dir>/neptune-run-<stamp>/run-metadata.json   (project/tags/mode)
+        <dir>/neptune-run-<stamp>/history.jsonl       (rows: {key, value, step})
+
+    keeping the event-prefixed key layout identical so downstream readers
+    see the same channel names either way.
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        project: str = "stoix_tpu",
+        tag: Optional[list] = None,
+        group_tag: Optional[list] = None,
+        detailed_logging: bool = False,
+        architecture_name: str = "anakin",
+        run_id: Optional[str] = None,
+        **init_kwargs: Any,
+    ):
+        self._detailed = bool(detailed_logging)
+        self._run = None
+        self._history = None
+        # Async logging deadlocks under Sebulba's thread pools (reference
+        # logger.py:255): sync there, async in the single-threaded Anakin loop.
+        mode = "async" if architecture_name == "anakin" else "sync"
+        try:
+            import neptune
+
+            if run_id is not None:
+                self._run = neptune.init_run(with_id=run_id, project=project, mode=mode)
+            else:
+                self._run = neptune.init_run(
+                    project=project, tags=list(tag or []), mode=mode, **init_kwargs
+                )
+                self._run["sys/group_tags"].add(list(group_tag or []))
+        except ImportError:
+            stamp = time.strftime("%Y%m%d_%H%M%S")
+            base = os.path.join(run_dir, f"neptune-run-{run_id or stamp}")
+            os.makedirs(base, exist_ok=True)
+            with open(os.path.join(base, "run-metadata.json"), "w") as f:
+                json.dump(
+                    {
+                        "project": project,
+                        "mode": mode,
+                        "tags": list(tag or []),
+                        "group_tags": list(group_tag or []),
+                        "resumed_run_id": run_id,
+                        "startedAt": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                        "writer": "stoix_tpu.NeptuneSink (neptune package not installed)",
+                    },
+                    f,
+                    indent=2,
+                )
+            # Append mode: resuming with the same run_id continues the file.
+            self._history = open(os.path.join(base, "history.jsonl"), "a")
+
+    def _is_main_metric(self, key: str) -> bool:
+        # Mean-of-list metrics ('.../mean') and scalar metrics; everything
+        # else (std/min/max) only under detailed_logging (reference :272-276).
+        return "/" not in key or key.endswith("/mean")
+
+    def write(self, metrics: Dict[str, float], t: int, t_eval: int, event: LogEvent) -> None:
+        for k, v in metrics.items():
+            if not self._detailed and not self._is_main_metric(k):
+                continue
+            if not isinstance(v, (int, float, np.floating, np.integer)):
+                continue
+            if self._run is not None:
+                self._run[f"{event.value}/{k}"].log(float(v), step=t)
+            else:
+                self._history.write(
+                    json.dumps({"key": f"{event.value}/{k}", "value": float(v), "step": t})
+                    + "\n"
+                )
+        if self._history is not None:
+            self._history.flush()
+
+    def close(self) -> None:
+        if self._run is not None:
+            self._run.stop()
+        elif self._history is not None:
+            self._history.close()
+
+
 class StoixLogger:
     """Thread-safe fan-out logger. `log` accepts raw (possibly array-valued)
     metrics; non-TRAIN events are described (mean/std/min/max)."""
@@ -242,6 +340,14 @@ class StoixLogger:
             self._sinks.append(
                 WandbSink(os.path.join(exp_dir, "wandb"), config_dict=cfg_snapshot, **kwargs)
             )
+        if logger_cfg.get("use_neptune", False):
+            kwargs = dict(logger_cfg.get("neptune_kwargs") or {})
+            kwargs.setdefault("project", "stoix_tpu")
+            kwargs.setdefault("tag", (logger_cfg.get("kwargs") or {}).get("neptune_tag") or [])
+            kwargs.setdefault(
+                "architecture_name", getattr(config.arch, "architecture_name", "anakin")
+            )
+            self._sinks.append(NeptuneSink(os.path.join(exp_dir, "neptune"), **kwargs))
 
         self._solve_threshold = config.env.get("solved_return_threshold")
 

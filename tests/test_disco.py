@@ -17,7 +17,6 @@ from stoix_tpu.systems.disco.update_rule import (
     DiscoUpdateRule,
     UpdateRuleInputs,
     load_meta_params,
-    unflatten_params,
 )
 
 A, B = 4, 21
@@ -163,13 +162,34 @@ def test_load_meta_params_falls_back_without_network():
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(a.shape, b.shape), params, ref)
 
 
-def test_unflatten_params_matches_reference_layout():
-    flat = {
-        "linear_0/w": np.zeros((3, 4)),
-        "linear_0/b": np.zeros((4,)),
-        "mlp/linear_1/w": np.zeros((4, 2)),
-        "mlp/linear_1/b": np.zeros((2,)),
-    }
-    nested = unflatten_params(flat)
-    assert set(nested) == {"linear_0", "mlp/linear_1"}
-    assert nested["mlp/linear_1"]["w"].shape == (4, 2)
+def test_load_meta_params_roundtrips_npz_fixture(tmp_path):
+    # The pretrained-weights flow end-to-end WITHOUT egress: save this rule's
+    # meta-params as the npz the loader expects, load through load_meta_params
+    # (pretrained=True path), and verify exact round-trip.
+    from stoix_tpu.systems.disco.update_rule import flatten_meta_params
+
+    rule = DiscoUpdateRule(num_actions=A, num_bins=B, mode="meta")
+    saved = rule.init_params(jax.random.PRNGKey(7))
+    path = tmp_path / "disco_103.npz"
+    np.savez(path, **flatten_meta_params(saved))
+
+    loaded, pretrained = load_meta_params(
+        rule, jax.random.PRNGKey(0), local_path=str(path)
+    )
+    assert pretrained
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), loaded, saved)
+
+
+def test_load_meta_params_rejects_incompatible_npz(tmp_path):
+    # A haiku-layout artifact (the published disco_103.npz shape) must NOT be
+    # silently misloaded: structure mismatch -> documented random fallback.
+    rule = DiscoUpdateRule(num_actions=A, num_bins=B, mode="meta")
+    path = tmp_path / "disco_103.npz"
+    np.savez(path, **{"lstm/w": np.zeros((4, 4)), "lstm/b": np.zeros((4,))})
+
+    loaded, pretrained = load_meta_params(
+        rule, jax.random.PRNGKey(0), local_path=str(path)
+    )
+    assert not pretrained
+    ref = rule.init_params(jax.random.PRNGKey(0))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a.shape, b.shape), loaded, ref)

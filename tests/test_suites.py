@@ -292,6 +292,359 @@ class TestJumanjiAdapter:
         assert float(ts.reward) == 5.0
 
 
+# ---------------------------------------------------------------------------
+# xland_minigrid
+# ---------------------------------------------------------------------------
+
+
+class FakeXLandTimeStep(NamedTuple):
+    state: Any
+    step_type: jax.Array
+    reward: jax.Array
+    discount: jax.Array
+    observation: jax.Array
+
+
+class FakeXLandEnv:
+    """Documented xminigrid surface: reset(params, key)/step(params, ts, action)
+    carrying the whole timestep; observation_shape/num_actions(params)."""
+
+    def observation_shape(self, params):
+        return (3, 3, 2)
+
+    def num_actions(self, params):
+        return 5
+
+    def reset(self, params, key):
+        return FakeXLandTimeStep(
+            state=jnp.zeros((), jnp.int32),
+            step_type=jnp.int8(0),
+            reward=jnp.zeros(()),
+            discount=jnp.ones(()),
+            observation=jnp.zeros((3, 3, 2), jnp.float32),
+        )
+
+    def step(self, params, ts, action):
+        count = ts.state + 1
+        terminal = count >= 2
+        truncate = jnp.logical_and(terminal, action == 4)
+        return FakeXLandTimeStep(
+            state=count,
+            step_type=jnp.where(terminal, jnp.int8(2), jnp.int8(1)),
+            reward=jnp.asarray(action, jnp.float32),
+            discount=jnp.where(truncate, 1.0, jnp.where(terminal, 0.0, 1.0)),
+            observation=jnp.full((3, 3, 2), count, jnp.float32),
+        )
+
+
+class TestXLandMiniGridAdapter:
+    def test_spaces_and_semantics(self):
+        from stoix_tpu.envs.suites import XLandMiniGridAdapter
+
+        env = XLandMiniGridAdapter(FakeXLandEnv(), env_params=None)
+        assert isinstance(env.action_space(), spaces.Discrete)
+        assert env.observation_space().agent_view.shape == (3, 3, 2)
+        state, ts = jax.jit(env.reset)(jax.random.PRNGKey(0))
+        assert bool(ts.first())
+        state, ts = jax.jit(env.step)(state, jnp.int32(1))
+        assert bool(ts.mid()) and float(ts.reward) == 1.0
+        state, ts = env.step(state, jnp.int32(0))
+        assert bool(ts.last()) and float(ts.discount) == 0.0
+        # Truncation path (LAST + discount 1).
+        state, ts = env.reset(jax.random.PRNGKey(0))
+        state, ts = env.step(state, jnp.int32(1))
+        state, ts = env.step(state, jnp.int32(4))
+        assert bool(ts.last()) and float(ts.discount) == 1.0
+        assert bool(ts.extras["truncation"])
+
+    def test_under_wrapper_stack(self):
+        from stoix_tpu.envs.suites import XLandMiniGridAdapter
+
+        env = apply_core_wrappers(XLandMiniGridAdapter(FakeXLandEnv(), None), num_envs=2)
+        keys = jax.random.split(jax.random.PRNGKey(0), 2)
+        state, ts = jax.jit(env.reset)(keys)
+        step = jax.jit(env.step)
+        for _ in range(5):
+            state, ts = step(state, jnp.ones((2,), jnp.int32))
+        assert ts.observation.agent_view.shape == (2, 3, 3, 2)
+
+
+# ---------------------------------------------------------------------------
+# navix
+# ---------------------------------------------------------------------------
+
+
+class FakeNavixTimeStep(NamedTuple):
+    t: jax.Array
+    observation: jax.Array
+    reward: jax.Array
+    step_type: jax.Array
+
+
+class _NavixObsSpace:
+    shape = (7, 7, 3)
+
+
+class FakeNavixEnv:
+    """Documented navix surface: reset(key)/step(ts, action) with navix's OWN
+    step codes (0 transition / 1 truncation / 2 termination), action_set."""
+
+    observation_space = _NavixObsSpace()
+    action_set = tuple(range(6))
+
+    def reset(self, key):
+        return FakeNavixTimeStep(
+            t=jnp.zeros((), jnp.int32),
+            observation=jnp.zeros((7, 7, 3), jnp.float32),
+            reward=jnp.zeros(()),
+            step_type=jnp.int8(0),
+        )
+
+    def step(self, ts, action):
+        t = ts.t + 1
+        terminal = t >= 2
+        truncate = jnp.logical_and(terminal, action == 5)
+        step_type = jnp.where(
+            truncate, jnp.int8(1), jnp.where(terminal, jnp.int8(2), jnp.int8(0))
+        )
+        return FakeNavixTimeStep(
+            t=t,
+            observation=jnp.full((7, 7, 3), t, jnp.float32),
+            reward=jnp.asarray(action, jnp.float32),
+            step_type=step_type,
+        )
+
+
+class TestNavixAdapter:
+    def test_step_code_mapping(self):
+        from stoix_tpu.envs.suites import NavixAdapter
+
+        env = NavixAdapter(FakeNavixEnv())
+        assert env.num_actions == 6
+        assert env.observation_space().agent_view.shape == (7, 7, 3)
+        state, ts = jax.jit(env.reset)(jax.random.PRNGKey(0))
+        state, ts = jax.jit(env.step)(state, jnp.int32(1))
+        assert bool(ts.mid())
+        # navix TERMINATION (2) -> LAST + discount 0.
+        state, ts = env.step(state, jnp.int32(0))
+        assert bool(ts.last()) and float(ts.discount) == 0.0
+        assert not bool(ts.extras["truncation"])
+        # navix TRUNCATION (1) -> LAST + discount 1.
+        state, ts = env.reset(jax.random.PRNGKey(0))
+        state, ts = env.step(state, jnp.int32(1))
+        state, ts = env.step(state, jnp.int32(5))
+        assert bool(ts.last()) and float(ts.discount) == 1.0
+        assert bool(ts.extras["truncation"])
+
+
+# ---------------------------------------------------------------------------
+# kinetix
+# ---------------------------------------------------------------------------
+
+
+class FakeKinetixEnv:
+    """Documented kinetix surface: gymnax-flavored reset(key, params)/
+    step(key, state, action, params) with info['truncation']; spaces via
+    observation_space/action_space(params)."""
+
+    def reset(self, key, params):
+        state = jnp.zeros((), jnp.int32)
+        return self._obs(state), state
+
+    def step(self, key, state, action, params):
+        state = state + 1
+        done = state >= 3
+        truncated = jnp.logical_and(done, jnp.sum(action) > 2)
+        return (
+            self._obs(state),
+            state,
+            jnp.ones(()),
+            done,
+            {"truncation": truncated},
+        )
+
+    def _obs(self, state):
+        return jnp.full((8,), state, jnp.float32)
+
+    def observation_space(self, params):
+        return _GymnaxBox(-1.0, 1.0, (8,))
+
+    def action_space(self, params):
+        return _GymnaxDiscrete(4)
+
+
+class TestKinetixAdapter:
+    def test_semantics(self):
+        from stoix_tpu.envs.suites import KinetixAdapter
+
+        env = KinetixAdapter(FakeKinetixEnv(), env_params=None)
+        assert env.num_actions == 4
+        state, ts = jax.jit(env.reset)(jax.random.PRNGKey(0))
+        assert bool(ts.first())
+        step = jax.jit(env.step)
+        state, ts = step(state, jnp.int32(0))
+        state, ts = step(state, jnp.int32(0))
+        state, ts = step(state, jnp.int32(0))
+        assert bool(ts.last()) and float(ts.discount) == 0.0
+        # Truncation flagged through info.
+        state, ts = env.reset(jax.random.PRNGKey(0))
+        state, ts = env.step(state, jnp.int32(0))
+        state, ts = env.step(state, jnp.int32(0))
+        state, ts = env.step(state, jnp.int32(3))
+        assert bool(ts.last()) and float(ts.discount) == 1.0
+        assert bool(ts.extras["truncation"])
+
+
+# ---------------------------------------------------------------------------
+# mujoco_playground
+# ---------------------------------------------------------------------------
+
+
+class FakePlaygroundState(NamedTuple):
+    obs: jax.Array
+    reward: jax.Array
+    done: jax.Array
+
+
+class FakePlaygroundEnv:
+    """Documented playground surface: brax-shaped State, observation_size/
+    action_size, no internal step limit."""
+
+    observation_size = 5
+    action_size = 2
+
+    def reset(self, rng):
+        return FakePlaygroundState(
+            obs=jnp.zeros((5,), jnp.float32), reward=jnp.zeros(()), done=jnp.zeros(())
+        )
+
+    def step(self, state, action):
+        fell = jnp.sum(action) < -1.5
+        return FakePlaygroundState(
+            obs=state.obs + 1.0, reward=jnp.ones(()), done=fell.astype(jnp.float32)
+        )
+
+
+class TestPlaygroundAdapter:
+    def test_step_limit_truncation(self):
+        from stoix_tpu.envs.suites import PlaygroundAdapter
+
+        env = PlaygroundAdapter(FakePlaygroundEnv(), max_episode_steps=3)
+        state, ts = jax.jit(env.reset)(jax.random.PRNGKey(0))
+        step = jax.jit(env.step)
+        # Termination from the env's own done.
+        state, ts = step(state, -jnp.ones((2,)))
+        assert bool(ts.last()) and float(ts.discount) == 0.0
+        # Healthy run to the adapter's step limit -> truncation.
+        state, ts = env.reset(jax.random.PRNGKey(0))
+        for _ in range(3):
+            state, ts = step(state, jnp.ones((2,)))
+        assert bool(ts.last()) and float(ts.discount) == 1.0
+        assert bool(ts.extras["truncation"])
+
+
+# ---------------------------------------------------------------------------
+# stoa-native (jaxarc)
+# ---------------------------------------------------------------------------
+
+
+class FakeStoaSpaceDiscrete:
+    num_values = 3
+
+
+class _FakeStoaObsSpace:
+    shape = (4,)
+    dtype = jnp.float32
+
+
+class FakeStoaTimeStep(NamedTuple):
+    step_type: jax.Array
+    reward: jax.Array
+    discount: jax.Array
+    observation: jax.Array
+
+
+class FakeStoaEnv:
+    """Documented stoa surface: (state, timestep) reset/step with dm_env step
+    types, observation_space()/action_space() methods."""
+
+    def observation_space(self):
+        return _FakeStoaObsSpace()
+
+    def action_space(self):
+        return FakeStoaSpaceDiscrete()
+
+    def reset(self, key):
+        state = jnp.zeros((), jnp.int32)
+        return state, FakeStoaTimeStep(
+            step_type=jnp.int8(0),
+            reward=jnp.zeros(()),
+            discount=jnp.ones(()),
+            observation=jnp.zeros((4,), jnp.float32),
+        )
+
+    def step(self, state, action):
+        state = state + 1
+        terminal = state >= 2
+        return state, FakeStoaTimeStep(
+            step_type=jnp.where(terminal, jnp.int8(2), jnp.int8(1)),
+            reward=jnp.asarray(action, jnp.float32),
+            discount=jnp.where(terminal, 0.0, 1.0),
+            observation=jnp.full((4,), state, jnp.float32),
+        )
+
+
+class TestStoaAdapter:
+    def test_semantics(self):
+        from stoix_tpu.envs.suites import StoaAdapter
+
+        env = StoaAdapter(FakeStoaEnv())
+        assert isinstance(env.action_space(), spaces.Discrete)
+        assert env.observation_space().agent_view.shape == (4,)
+        state, ts = jax.jit(env.reset)(jax.random.PRNGKey(0))
+        assert bool(ts.first())
+        state, ts = jax.jit(env.step)(state, jnp.int32(2))
+        assert bool(ts.mid()) and float(ts.reward) == 2.0
+        state, ts = env.step(state, jnp.int32(1))
+        assert bool(ts.last()) and float(ts.discount) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# start-flag / prev-action augmentation (popjym)
+# ---------------------------------------------------------------------------
+
+
+class TestStartFlagPrevActionWrapper:
+    def test_discrete_augmentation(self):
+        from stoix_tpu.envs.wrappers import StartFlagPrevActionWrapper
+
+        env = StartFlagPrevActionWrapper(GymnaxAdapter(FakeGymnaxEnv()))
+        # base 4 + start flag 1 + one-hot(2) = 7
+        assert env.observation_space().agent_view.shape == (7,)
+        state, ts = jax.jit(env.reset)(jax.random.PRNGKey(0))
+        view = ts.observation.agent_view
+        assert view.shape == (7,)
+        assert float(view[4]) == 1.0  # start flag set at reset
+        assert view[5:].tolist() == [0.0, 0.0]  # zero prev action
+        state, ts = jax.jit(env.step)(state, jnp.int32(1))
+        view = ts.observation.agent_view
+        assert float(view[4]) == 0.0  # start flag cleared
+        assert view[5:].tolist() == [0.0, 1.0]  # one-hot prev action
+
+    def test_under_wrapper_stack(self):
+        from stoix_tpu.envs.wrappers import StartFlagPrevActionWrapper
+
+        env = apply_core_wrappers(
+            StartFlagPrevActionWrapper(GymnaxAdapter(FakeGymnaxEnv())), num_envs=2
+        )
+        keys = jax.random.split(jax.random.PRNGKey(0), 2)
+        state, ts = jax.jit(env.reset)(keys)
+        step = jax.jit(env.step)
+        for _ in range(4):
+            state, ts = step(state, jnp.ones((2,), jnp.int32))
+        assert ts.observation.agent_view.shape == (2, 7)
+
+
 def test_suite_makers_raise_clear_import_errors():
     for suite, maker in SUITE_MAKERS.items():
         with pytest.raises(ImportError, match="not installed"):
@@ -305,3 +658,17 @@ def test_registry_dispatches_suites():
         registry.make_single("CartPole-misc", suite="gymnax")
     with pytest.raises(ValueError, match="Unknown environment"):
         registry.make_single("Nope-v0", suite="classic")
+    # Every reference ENV_MAKERS suite is dispatchable (reference
+    # make_env.py:424-437); the lazy import is the first thing each maker hits.
+    for suite in (
+        "popgym_arcade",
+        "popjym",
+        "craftax",
+        "xland_minigrid",
+        "navix",
+        "kinetix",
+        "mujoco_playground",
+        "jaxarc",
+    ):
+        with pytest.raises(ImportError, match="not installed"):
+            registry.make_single("Anything-v0", suite=suite)

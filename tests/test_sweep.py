@@ -6,6 +6,8 @@ from __future__ import annotations
 import math
 import random
 
+import pytest
+
 from stoix_tpu.sweep import parse_space, sample_point, tpe_next_point
 
 
@@ -82,3 +84,41 @@ def test_tpe_nan_scores_rank_last():
     for _ in range(5):
         p = tpe_next_point(space, history, rng, n_startup=3)
         assert 1e-5 * (1 - 1e-9) <= p["system.lr"] <= 1e-1 * (1 + 1e-9)
+
+
+@pytest.mark.slow
+def test_multirun_sweep_over_real_system(capsys):
+    # Multirun-over-configs integration (reference
+    # configs/default/anakin/hyperparameter_sweep.yaml:8-27: optuna/tpe over
+    # system.clip_eps / gae_lambda / epochs driving real training runs): the
+    # TPE sweeper composes the ff_ppo config per trial, applies the sampled
+    # point TYPED, runs the experiment, and ranks trials by final return.
+    from stoix_tpu.sweep import run_sweep
+
+    best = run_sweep(
+        module="stoix_tpu.systems.ppo.anakin.ff_ppo",
+        default="default/anakin/default_ff_ppo.yaml",
+        space=parse_space(
+            [
+                "system.clip_eps=choice:0.1,0.2,0.3",
+                "system.epochs=choice:1,2",
+            ]
+        ),
+        fixed_overrides=[
+            "env=identity_game",
+            "arch.total_num_envs=8",
+            "arch.total_timesteps=4096",
+            "arch.num_evaluation=1",
+            "arch.num_eval_episodes=8",
+            "logger.use_console=False",
+        ],
+        trials=3,
+        method="tpe",
+        seed=0,
+    )
+    assert best["params"]["system.clip_eps"] in (0.1, 0.2, 0.3)
+    assert best["params"]["system.epochs"] in (1, 2)
+    assert math.isfinite(best["score"])
+    # Every trial line was printed as structured JSON (the multirun record).
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert len(lines) == 4  # 3 trials + best

@@ -67,9 +67,13 @@ SYSTEMS = [
     ("stoix_tpu.systems.search.ff_sampled_mz", "default_ff_sampled_mz",
      ["system.num_simulations=8", "system.num_sampled_actions=4", "system.unroll_steps=2"]),
     ("stoix_tpu.systems.spo.ff_spo", "default_ff_spo",
-     ["env=identity_game", "system.num_particles=8", "system.search_horizon=3"]),
+     ["env=identity_game", "system.num_particles=8", "system.search_horizon=3",
+      "system.rollout_length=8", "system.sample_sequence_length=8",
+      "system.epochs=4"]),
     ("stoix_tpu.systems.spo.ff_spo_continuous", "default_ff_spo_continuous",
-     ["system.num_particles=8", "system.search_horizon=3"]),
+     ["system.num_particles=8", "system.search_horizon=3",
+      "system.rollout_length=8", "system.sample_sequence_length=8",
+      "system.epochs=4"]),
     ("stoix_tpu.systems.disco.ff_disco103", "default_ff_disco103",
      ["env=identity_game", "system.vmax=20.0", "system.num_minibatches=2"]),
 ]
