@@ -33,7 +33,9 @@ run() {
 }
 
 # One bench.py invocation under the same lock/record discipline: per-run
-# start marker, rc, and result line (null when the bench emitted nothing).
+# start marker, rc, and one result record PER emitted JSON line (bench.py
+# --all prints one line per tracked config — recording only the last would
+# drop the rest).
 run_bench() {
   local tag="$1"; shift
   local seconds="$1"; shift
@@ -43,8 +45,13 @@ run_bench() {
     echo "{\"run\": \"$tag\", \"started\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
     timeout "$seconds" python bench.py "$@" > "$capture" 2>&1
     local rc=$?
-    local line
-    line=$(grep -E '^\{' "$capture" | tail -1)
-    echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": ${line:-null}, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
+    local emitted=0
+    while IFS= read -r line; do
+      echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": $line, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
+      emitted=1
+    done < <(grep -E '^\{' "$capture")
+    if [ "$emitted" -eq 0 ]; then
+      echo "{\"run\": \"$tag\", \"rc\": $rc, \"result\": null, \"finished\": \"$(date -u +%FT%TZ)\"}" >> "$QUEUE_OUT"
+    fi
   ) 9>"$QUEUE_LOCK"
 }

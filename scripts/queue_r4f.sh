@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-4 wave 6: sampled-search levers on CPU while the chip is down
+# (VERDICT #4: K=8 -> 16 sampled actions is the staged knob; r3 best was
+# az -873 / mz -792 @2M with monotone convergence).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run sampled_az_k16_2m 150 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  system.num_sampled_actions=16 \
+  logger.use_console=False logger.use_json=True
+
+run sampled_mz_k16_2m 150 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  system.num_sampled_actions=16 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4f done"}' >> "$QUEUE_OUT"

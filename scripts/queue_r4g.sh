@@ -1,0 +1,13 @@
+#!/bin/bash
+# Round-4 wave 7: DPO at the reference config (16 minibatches, ent 0.001,
+# vf 1.0) on halfcheetah; random baseline measured at -206, PPO's r3 mark 184.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run dpo_halfcheetah_refcfg 90 --module stoix_tpu.systems.ppo.anakin.ff_dpo_continuous \
+  --default default/anakin/default_ff_dpo_continuous.yaml env=halfcheetah \
+  arch.total_num_envs=64 arch.total_timesteps=1000000 \
+  system.normalize_observations=true logger.use_console=False
+
+echo '{"queue": "r4g done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-4 wave 8: PPO-penalty longer budget (plateaus at ~308 at 1M with
+# beta 3.0 fixed — the discrete-MPO precedent says give the KL-regularized
+# objective 2M + lr decay).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_penalty_2m 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  arch.total_timesteps=2000000 system.decay_learning_rates=true \
+  logger.use_console=False
+
+echo '{"queue": "r4h done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Round-4 wave 9: CPU-viable pushes on still-open VALIDATION rows —
+# SpaceInvaders flat-MLP at 5M (21.9 @2M, threshold 50, clean slope),
+# locomotion at longer budgets with obs-norm (hopper/walker 54 @1M,
+# halfcheetah 184 @1M).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_spaceinvaders_5m 150 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=space_invaders \
+  arch.total_timesteps=5000000 logger.use_console=False
+
+run ppo_halfcheetah_5m 150 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=halfcheetah \
+  arch.total_num_envs=64 arch.total_timesteps=5000000 \
+  system.normalize_observations=true logger.use_console=False
+
+run ppo_hopper_3m 120 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=hopper \
+  arch.total_num_envs=64 arch.total_timesteps=3000000 \
+  system.normalize_observations=true logger.use_console=False
+
+echo '{"queue": "r4i done"}' >> "$QUEUE_OUT"

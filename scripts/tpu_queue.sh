@@ -58,9 +58,10 @@ run spo_cont_pendulum_chip 60 --module stoix_tpu.systems.spo.ff_spo_continuous \
   logger.use_console=False
 
 # 4. Fresh chip throughput numbers for the record: all five tracked BASELINE
-# configs in one invocation (one JSON line per config). 4000s outer timeout:
-# bench.py's --all watchdog is 3400s plus fallback margin.
-run_bench bench_all 4000 --all
+# configs in one invocation (one JSON line per config). 7000s outer timeout:
+# bench.py's --all worst case is the 3400s device watchdog PLUS a 3000s
+# CPU-fallback subprocess.
+run_bench bench_all 7000 --all
 run_bench bench_ant_large 3900 --large
 
 echo '{"queue": "tpu queue done"}' >> "$QUEUE_OUT"

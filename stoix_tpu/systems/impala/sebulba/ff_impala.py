@@ -85,11 +85,16 @@ def split_env_minibatches(traj: PPOTransition, num_minibatches: int) -> PPOTrans
 
 
 def maybe_normalize_rewards(traj: PPOTransition, config) -> PPOTransition:
-    """Batch reward normalization option (reference ff_impala.py:385-389)."""
+    """Batch reward normalization option (reference ff_impala.py:385-389).
+
+    Statistics are reduced over the "data" mesh axis so the scaling matches
+    the reference's whole-batch normalization regardless of learner device
+    count (per-shard stats would make gradients depend on the sharding)."""
     if not bool(config.system.get("normalize_rewards", False)):
         return traj
-    r_mean = jnp.mean(traj.reward)
-    r_std = jnp.std(traj.reward)
+    r_mean = jax.lax.pmean(jnp.mean(traj.reward), "data")
+    r_sq = jax.lax.pmean(jnp.mean(traj.reward**2), "data")
+    r_std = jnp.sqrt(jnp.maximum(r_sq - r_mean**2, 0.0))
     scale = float(config.system.get("reward_scale", 1.0))
     eps = float(config.system.get("reward_eps", 1e-8))
     return traj._replace(reward=scale * (traj.reward - r_mean) / (r_std + eps))

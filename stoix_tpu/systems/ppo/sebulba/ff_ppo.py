@@ -416,19 +416,26 @@ def run_experiment(
     from stoix_tpu.envs.wrappers import RecordEpisodeMetrics
     from stoix_tpu.evaluator import get_stateful_evaluator_fn
 
-    try:
+    from stoix_tpu.envs import suites
+    from stoix_tpu.envs.registry import ENV_REGISTRY
+
+    scenario = (
+        config.env.scenario.name
+        if hasattr(config.env.scenario, "name")
+        else config.env.scenario
+    )
+    suite = getattr(config.env, "env_name", None)
+    has_jax_twin = scenario in ENV_REGISTRY or suite in suites.SUITE_MAKERS
+    if has_jax_twin:
+        # Genuine construction errors must surface — only the known
+        # no-JAX-twin case (EnvPool/Gymnasium task ids) falls back.
         eval_env = RecordEpisodeMetrics(
-            make_single(
-                config.env.scenario.name
-                if hasattr(config.env.scenario, "name")
-                else config.env.scenario,
-                **dict(config.env.get("kwargs", {}) or {}),
-            )
+            make_single(scenario, suite=suite, **dict(config.env.get("kwargs", {}) or {}))
         )
         eval_fn = get_ff_evaluator_fn(
             eval_env, get_distribution_act_fn(config, eval_apply), config, eval_mesh
         )
-    except (ValueError, ImportError):
+    else:
         eval_fn = get_stateful_evaluator_fn(
             env_factory, get_distribution_act_fn(config, eval_apply), config
         )

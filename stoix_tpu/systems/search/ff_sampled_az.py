@@ -125,6 +125,13 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, buffer, config):
             # Root search value: the replay GAE bootstraps from these STORED
             # values (reference ff_sampled_az.py:258,401-405).
             "search_value": search_out.search_value,
+            # Critic value of the TRUE successor, for truncated steps: the
+            # next stored search value belongs to the following episode (on
+            # Pendulum EVERY episode ends by truncation, so this is the
+            # boundary value at every episode end).
+            "bootstrap_value": critic_apply(
+                params.critic_params, timestep.extras["next_obs"]
+            ),
             "reward": timestep.reward,
             "discount": timestep.discount,
             "truncated": jnp.logical_and(
@@ -162,14 +169,22 @@ def get_learner_fn(env, sim_env, apply_fns, update_fns, buffer, config):
 
         # Truncation-aware GAE over the STORED search root values — the value
         # sequence the search actually produced, not the current critic
-        # (reference ff_sampled_az.py:401-405).
+        # (reference ff_sampled_az.py:401-405). At truncations the next stored
+        # search value is the FOLLOWING episode's root: bootstrap those steps
+        # from the stored true-successor critic value instead.
+        truncated = seq["truncated"][:, :-1]
+        v_t = jnp.where(
+            truncated > 0,
+            seq["bootstrap_value"][:, :-1],
+            seq["search_value"][:, 1:],
+        )
         _, targets = truncated_generalized_advantage_estimation(
             seq["reward"][:, :-1],
             gamma * seq["discount"][:, :-1],
             float(config.system.get("gae_lambda", 0.95)),
             v_tm1=seq["search_value"][:, :-1],
-            v_t=seq["search_value"][:, 1:],
-            truncation_t=seq["truncated"][:, :-1],
+            v_t=v_t,
+            truncation_t=truncated,
             batch_major=True,
         )
         train_obs = jax.tree.map(lambda x: x[:, :-1], seq["obs"])
@@ -288,6 +303,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         "sampled_actions": jnp.zeros((num_samples, action_dim), jnp.float32),
         "search_policy": jnp.zeros((num_samples,), jnp.float32),
         "search_value": jnp.zeros((), jnp.float32),
+        "bootstrap_value": jnp.zeros((), jnp.float32),
         "reward": jnp.zeros((), jnp.float32),
         "discount": jnp.zeros((), jnp.float32),
         "truncated": jnp.zeros((), jnp.float32),

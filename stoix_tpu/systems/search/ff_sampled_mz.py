@@ -126,12 +126,26 @@ def get_learner_fn(env, networks, optim_update, buffer, config):
         )[:, 0]
         env_state_new, timestep = env.step(env_state, action)
 
+        # Model value of the TRUE successor, for truncated steps: n-step
+        # targets must bootstrap through the step-limit boundary (on Pendulum
+        # every episode ends by truncation; a zero bootstrap there biases all
+        # boundary-window value targets toward 0, i.e. UP for negative-return
+        # tasks).
+        boot_latent = wm.apply(
+            params.world_model,
+            timestep.extras["next_obs"].agent_view,
+            method="initial_state",
+        )
+        bootstrap_value = critic_pair.apply_inv(
+            value_net.apply(params.value_head, boot_latent)
+        )
         data = {
             "obs": last_timestep.observation.agent_view,
             "action": action,
             "sampled_actions": sampled,
             "search_policy": search_out.action_weights,
             "search_value": search_out.search_value,
+            "bootstrap_value": bootstrap_value,
             "reward": timestep.reward,
             "done": (timestep.discount == 0.0).astype(jnp.float32),
             "truncated": jnp.logical_and(
@@ -151,10 +165,16 @@ def get_learner_fn(env, networks, optim_update, buffer, config):
         r_t = seq["reward"][:, :-1]
         done = seq["done"].astype(jnp.float32)[:, :-1]
         truncated = seq["truncated"].astype(jnp.float32)[:, :-1]
-        # No bootstrap across the auto-reset boundary (see ff_mz._loss_fn).
+        # No n-step accumulation across the auto-reset boundary (see
+        # ff_mz._loss_fn) — but truncated boundaries still bootstrap: fold
+        # gamma * V(true successor) into the boundary reward for the VALUE
+        # targets only, then cut the chain (r' + cut = r + gamma*V_boot with
+        # no next-episode leakage). The reward model keeps training on the
+        # raw environment reward r_t.
+        value_r = r_t + gamma * truncated * seq["bootstrap_value"][:, :-1]
         d_t = gamma * (1.0 - done) * (1.0 - truncated)
         value_targets = n_step_bootstrapped_returns(
-            r_t, d_t, seq["search_value"][:, 1:], n_steps
+            value_r, d_t, seq["search_value"][:, 1:], n_steps
         )  # [B, L-1]
 
         latent = wm.apply(params.world_model, seq["obs"][:, 0], method="initial_state")
@@ -330,6 +350,7 @@ def learner_setup(env: envs.Environment, config: Any, mesh: Mesh, key: jax.Array
         "sampled_actions": jnp.zeros((num_samples, action_dim), jnp.float32),
         "search_policy": jnp.zeros((num_samples,), jnp.float32),
         "search_value": jnp.zeros((), jnp.float32),
+        "bootstrap_value": jnp.zeros((), jnp.float32),
         "reward": jnp.zeros((), jnp.float32),
         "done": jnp.zeros((), jnp.float32),
         "truncated": jnp.zeros((), jnp.float32),

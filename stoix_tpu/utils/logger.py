@@ -122,6 +122,34 @@ class TensorboardSink(BaseSink):
         self._writer.close()
 
 
+class _OfflineRunDir:
+    """Shared offline-run-directory machinery for the wandb/neptune sinks'
+    package-absent fallbacks: a run directory with a metadata JSON and an
+    append-mode history.jsonl (append so run-id resumes continue the file)."""
+
+    def __init__(
+        self,
+        base: str,
+        metadata: Dict[str, Any],
+        metadata_name: str,
+        history_name: str,
+        files_subdir: Optional[str] = None,
+    ):
+        self.dir = base
+        self.files_dir = os.path.join(base, files_subdir) if files_subdir else base
+        os.makedirs(self.files_dir, exist_ok=True)
+        with open(os.path.join(self.files_dir, metadata_name), "w") as f:
+            json.dump(metadata, f, indent=2)
+        self._history = open(os.path.join(base, history_name), "a")
+
+    def write_row(self, row: Dict[str, Any]) -> None:
+        self._history.write(json.dumps(row) + "\n")
+        self._history.flush()
+
+    def close(self) -> None:
+        self._history.close()
+
+
 class WandbSink(BaseSink):
     """Weights & Biases sink (reference logger.py:188-258).
 
@@ -153,7 +181,7 @@ class WandbSink(BaseSink):
     ):
         self._start = time.time()
         self._run = None
-        self._history = None
+        self._offline: Optional[_OfflineRunDir] = None
         self._summary: Dict[str, Any] = {}
         # run_id resume (reference logger.py:501-504): resume="allow" attaches
         # to the existing W&B run — the multi-process / checkpoint-resume flow.
@@ -167,30 +195,28 @@ class WandbSink(BaseSink):
             )
         except ImportError:
             stamp = time.strftime("%Y%m%d_%H%M%S")
-            base = os.path.join(run_dir, f"offline-run-{stamp}")
-            files = os.path.join(base, "files")
-            os.makedirs(files, exist_ok=True)
-            with open(os.path.join(files, "wandb-metadata.json"), "w") as f:
-                json.dump(
-                    {
-                        "project": project,
-                        "mode": mode,
-                        "startedAt": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                        "writer": "stoix_tpu.WandbSink (wandb package not installed)",
-                    },
-                    f,
-                    indent=2,
-                )
+            self._offline = _OfflineRunDir(
+                base=os.path.join(run_dir, f"offline-run-{stamp}"),
+                metadata={
+                    "project": project,
+                    "mode": mode,
+                    "startedAt": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    "writer": "stoix_tpu.WandbSink (wandb package not installed)",
+                },
+                metadata_name="wandb-metadata.json",
+                history_name="wandb-history.jsonl",
+                files_subdir="files",
+            )
             if config_dict is not None:
                 try:
                     import yaml
 
-                    with open(os.path.join(files, "config.yaml"), "w") as f:
+                    with open(
+                        os.path.join(self._offline.files_dir, "config.yaml"), "w"
+                    ) as f:
                         yaml.safe_dump(config_dict, f)
                 except Exception:  # noqa: BLE001 — config snapshot is best-effort
                     pass
-            self._files_dir = files
-            self._history = open(os.path.join(base, "wandb-history.jsonl"), "a")
 
     def write(self, metrics: Dict[str, float], t: int, t_eval: int, event: LogEvent) -> None:
         row = {f"{event.value}/{k}": v for k, v in metrics.items()}
@@ -199,17 +225,16 @@ class WandbSink(BaseSink):
             return
         now = time.time()
         row.update({"_step": t, "_runtime": now - self._start, "_timestamp": now})
-        self._history.write(json.dumps(row) + "\n")
-        self._history.flush()
+        self._offline.write_row(row)
         self._summary.update(row)
-        with open(os.path.join(self._files_dir, "wandb-summary.json"), "w") as f:
+        with open(os.path.join(self._offline.files_dir, "wandb-summary.json"), "w") as f:
             json.dump(self._summary, f)
 
     def close(self) -> None:
         if self._run is not None:
             self._run.finish()
-        elif self._history is not None:
-            self._history.close()
+        else:
+            self._offline.close()
 
 
 class NeptuneSink(BaseSink):
@@ -220,7 +245,8 @@ class NeptuneSink(BaseSink):
     (reference :257-258, the multi-process / checkpoint-resume flow), sync
     mode under Sebulba because async neptune logging deadlocks with the
     thread pools (reference :255). Without the package (this sandbox),
-    writes a neptune-style offline run directory instead:
+    writes a neptune-style offline run directory instead (shared
+    _OfflineRunDir machinery with the wandb fallback):
 
         <dir>/neptune-run-<stamp>/run-metadata.json   (project/tags/mode)
         <dir>/neptune-run-<stamp>/history.jsonl       (rows: {key, value, step})
@@ -242,7 +268,7 @@ class NeptuneSink(BaseSink):
     ):
         self._detailed = bool(detailed_logging)
         self._run = None
-        self._history = None
+        self._offline: Optional[_OfflineRunDir] = None
         # Async logging deadlocks under Sebulba's thread pools (reference
         # logger.py:255): sync there, async in the single-threaded Anakin loop.
         mode = "async" if architecture_name == "anakin" else "sync"
@@ -258,24 +284,22 @@ class NeptuneSink(BaseSink):
                 self._run["sys/group_tags"].add(list(group_tag or []))
         except ImportError:
             stamp = time.strftime("%Y%m%d_%H%M%S")
-            base = os.path.join(run_dir, f"neptune-run-{run_id or stamp}")
-            os.makedirs(base, exist_ok=True)
-            with open(os.path.join(base, "run-metadata.json"), "w") as f:
-                json.dump(
-                    {
-                        "project": project,
-                        "mode": mode,
-                        "tags": list(tag or []),
-                        "group_tags": list(group_tag or []),
-                        "resumed_run_id": run_id,
-                        "startedAt": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                        "writer": "stoix_tpu.NeptuneSink (neptune package not installed)",
-                    },
-                    f,
-                    indent=2,
-                )
-            # Append mode: resuming with the same run_id continues the file.
-            self._history = open(os.path.join(base, "history.jsonl"), "a")
+            # run_id pins the directory name so a resume appends to the same
+            # history file.
+            self._offline = _OfflineRunDir(
+                base=os.path.join(run_dir, f"neptune-run-{run_id or stamp}"),
+                metadata={
+                    "project": project,
+                    "mode": mode,
+                    "tags": list(tag or []),
+                    "group_tags": list(group_tag or []),
+                    "resumed_run_id": run_id,
+                    "startedAt": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    "writer": "stoix_tpu.NeptuneSink (neptune package not installed)",
+                },
+                metadata_name="run-metadata.json",
+                history_name="history.jsonl",
+            )
 
     def _is_main_metric(self, key: str) -> bool:
         # Mean-of-list metrics ('.../mean') and scalar metrics; everything
@@ -291,18 +315,15 @@ class NeptuneSink(BaseSink):
             if self._run is not None:
                 self._run[f"{event.value}/{k}"].log(float(v), step=t)
             else:
-                self._history.write(
-                    json.dumps({"key": f"{event.value}/{k}", "value": float(v), "step": t})
-                    + "\n"
+                self._offline.write_row(
+                    {"key": f"{event.value}/{k}", "value": float(v), "step": t}
                 )
-        if self._history is not None:
-            self._history.flush()
 
     def close(self) -> None:
         if self._run is not None:
             self._run.stop()
-        elif self._history is not None:
-            self._history.close()
+        else:
+            self._offline.close()
 
 
 class StoixLogger:
