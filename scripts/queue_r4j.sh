@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-4 wave 10: sampled-search replay-reuse lever — SPO's decisive factor
+# (heavy epochs over stored sequences) applied to sampled-AZ/MZ: epochs
+# 16 -> 64 with K=16, the search path's cost stays unchanged.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run sampled_az_e64_2m 180 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  system.num_sampled_actions=16 system.epochs=64 \
+  logger.use_console=False logger.use_json=True
+
+run sampled_mz_e64_2m 180 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  system.num_sampled_actions=16 system.epochs=64 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4j done"}' >> "$QUEUE_OUT"

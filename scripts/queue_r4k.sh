@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-4 wave 11: Gumbel root selection for sampled-AZ — sequential-halving
+# root search is the few-simulations regime's strong policy (the discrete AZ
+# validated both modes; the sampled system has the same switch).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run sampled_az_gumbel_2m 180 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  system.num_sampled_actions=16 system.epochs=64 system.search_method=gumbel \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4k done"}' >> "$QUEUE_OUT"

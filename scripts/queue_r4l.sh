@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-4 wave 12: SpaceInvaders rerun with the flatten override (the r4i
+# attempt dropped it and crashed on obs shape), and PPO-penalty with a
+# smaller KL coefficient (fixed beta 3.0 caps CartPole at ~337; the penalty
+# strength is the tunable, the objective is unchanged).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_spaceinvaders_5m_flat 150 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=space_invaders \
+  'env.wrapper.flatten_observation=true' arch.total_timesteps=5000000 \
+  logger.use_console=False
+
+run ppo_penalty_beta05 60 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  system.kl_beta=0.5 arch.total_timesteps=1000000 \
+  logger.use_console=False
+
+echo '{"queue": "r4l done"}' >> "$QUEUE_OUT"
