@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-4 wave 13 (last sampled-search CPU lever): visit-count ranking needs
+# sims >> K — 50 simulations over K=8 candidates (6 visits each) where the
+# default 25/16 gave ~1.5 visits of pure noise. Gumbel (completed-Q ranking)
+# was WORSE at this budget (-1297 @222k; deterministic root argmax + garbage
+# early Q), so the muzero mode with a meaningful visit budget is the
+# remaining CPU-scale experiment; 5M chip runs stay staged in tpu_queue.sh.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run sampled_az_s50k8_2m 180 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  system.num_simulations=50 system.num_sampled_actions=8 system.epochs=64 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4m done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Round-4 wave 14: seed robustness for the round's headline fixes — IMPALA
+# and on-policy AlphaZero each at a second seed (single-seed solves can be
+# luck; two seeds at 500/500 is a much stronger row).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run impala_cartpole_seed7 90 --module stoix_tpu.systems.impala.sebulba.ff_impala \
+  --default default/sebulba/default_ff_impala.yaml env=cartpole env.backend=cvec \
+  arch.seed=7 arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  system.rollout_length=32 \
+  arch.actor.device_ids='[0]' arch.actor.actor_per_device=2 \
+  arch.learner.device_ids='[1]' arch.evaluator_device_id=2 \
+  logger.use_console=False
+
+run az_cartpole_seed7 90 --module stoix_tpu.systems.search.ff_az \
+  --default default/anakin/default_ff_az.yaml env=cartpole \
+  arch.seed=7 arch.total_num_envs=64 arch.total_timesteps=500000 \
+  logger.use_console=False
+
+echo '{"queue": "r4n done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-4 wave 15: the 50-sims/K=8 recipe at 5M — the 2M run descends
+# steadily (-697 @1.2M, ~-25/100k and accelerating past every earlier
+# variant's plateau); 5M at this rate reaches the solved region.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run sampled_az_s50k8_5m 240 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=5000000 \
+  system.num_simulations=50 system.num_sampled_actions=8 system.epochs=64 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4o done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Round-4 wave 16: PPO-penalty with the analytic full-distribution KL (the
+# reference's form; the sampled k3 estimator's variance stalled refinement
+# at ~308-337 on CartPole).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_penalty_analytic_kl 60 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  arch.total_timesteps=1000000 logger.use_console=False
+
+echo '{"queue": "r4p done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-4 wave 17 (final penalty variant): single-epoch updates — the KL
+# anchor is the PRE-EPOCH policy, so multi-epoch reuse fights the penalty
+# in a way the clip objective tolerates; epochs=1 + 2M + decay tests that.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_penalty_e1_2m 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  system.epochs=1 system.decay_learning_rates=true \
+  arch.total_timesteps=2000000 logger.use_console=False
+
+echo '{"queue": "r4q done"}' >> "$QUEUE_OUT"

@@ -73,7 +73,9 @@ def get_learner_fn(
 ) -> Callable[[PPOLearnerState], ExperimentOutput]:
     """Build the PER-SHARD learner function (wrapped in shard_map by setup).
 
-    policy_loss_fn(dist, action, old_log_prob, gae, config) -> (loss, entropy)
+    policy_loss_fn(dist, action, old_log_prob, gae, config, behavior_dist=...)
+        -> (loss, entropy); behavior_dist is the pre-epoch policy re-applied
+        on the same observations (analytic-KL penalties anchor to it)
     overrides the PPO clip objective (penalty/DPO variants)."""
 
     actor_apply, critic_apply = apply_fns
@@ -117,11 +119,17 @@ def get_learner_fn(
             transition,
         )
 
-    def _actor_loss_fn(actor_params, obs, action, old_log_prob, gae):
+    def _actor_loss_fn(actor_params, behavior_actor_params, obs, action, old_log_prob, gae):
         actor_policy = actor_apply(actor_params, obs)
         if policy_loss_fn is not None:
+            # The behavior distribution (pre-epoch params on the SAME
+            # normalized observations) backs analytic-KL penalties — the
+            # reference's PPO-penalty recomputes it exactly this way
+            # (reference ff_ppo_penalty.py:158).
+            behavior_policy = actor_apply(behavior_actor_params, obs)
             loss_actor, entropy = policy_loss_fn(
-                actor_policy, action, old_log_prob, gae, config
+                actor_policy, action, old_log_prob, gae, config,
+                behavior_dist=behavior_policy,
             )
         else:
             log_prob = actor_policy.log_prob(action)
@@ -143,12 +151,13 @@ def get_learner_fn(
         return float(config.system.vf_coef) * value_loss, value_loss
 
     def _update_minibatch(train_state: Tuple, batch_info: Tuple):
-        params, opt_states = train_state
+        params, opt_states, behavior_actor_params = train_state
         traj_batch, advantages, targets = batch_info
 
         actor_grad_fn = jax.grad(_actor_loss_fn, has_aux=True)
         actor_grads, (loss_actor, entropy) = actor_grad_fn(
             params.actor_params,
+            behavior_actor_params,
             traj_batch.obs,
             traj_batch.action,
             traj_batch.log_prob,
@@ -184,10 +193,13 @@ def get_learner_fn(
         return (
             ActorCriticParams(actor_params, critic_params),
             ActorCriticOptStates(actor_opt_state, critic_opt_state),
+            behavior_actor_params,
         ), loss_info
 
     def _update_epoch(update_state: Tuple, _: Any):
-        params, opt_states, traj_batch, advantages, targets, key = update_state
+        params, opt_states, behavior_actor_params, traj_batch, advantages, targets, key = (
+            update_state
+        )
         key, shuffle_key = jax.random.split(key)
 
         # Flatten [T, E] -> [T*E] and shuffle across both time and envs.
@@ -201,10 +213,12 @@ def get_learner_fn(
             ),
             shuffled,
         )
-        (params, opt_states), loss_info = jax.lax.scan(
-            _update_minibatch, (params, opt_states), minibatches
+        (params, opt_states, behavior_actor_params), loss_info = jax.lax.scan(
+            _update_minibatch, (params, opt_states, behavior_actor_params), minibatches
         )
-        return (params, opt_states, traj_batch, advantages, targets, key), loss_info
+        return (
+            params, opt_states, behavior_actor_params, traj_batch, advantages, targets, key,
+        ), loss_info
 
     def _update_step(learner_state: PPOLearnerState, _: Any):
         learner_state, traj_batch = jax.lax.scan(
@@ -245,11 +259,16 @@ def get_learner_fn(
             standardize_advantages=bool(config.system.get("standardize_advantages", True)),
         )
 
-        update_state = (params, opt_states, traj_batch, advantages, targets, key)
+        # Behavior params (the rollout's) stay FIXED across all epochs: KL
+        # penalties anchor to them, matching the reference's
+        # behaviour_actor_params capture (reference ff_ppo_penalty.py:128).
+        update_state = (
+            params, opt_states, params.actor_params, traj_batch, advantages, targets, key,
+        )
         update_state, loss_info = jax.lax.scan(
             _update_epoch, update_state, None, int(config.system.epochs)
         )
-        params, opt_states, _, _, _, key = update_state
+        params, opt_states, _, _, _, _, key = update_state
         learner_state = PPOLearnerState(
             params, opt_states, key, env_state, last_timestep, obs_stats
         )

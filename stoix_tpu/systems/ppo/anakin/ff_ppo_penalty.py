@@ -1,7 +1,8 @@
 """Anakin PPO-penalty (reference stoix/systems/ppo/anakin/ff_ppo_penalty.py,
 602 LoC): KL-penalty surrogate instead of clipping (reference loss.py:35).
-The KL to the behavior policy is estimated with the low-variance
-(ratio - 1 - log ratio) estimator.
+The KL to the behavior policy is the ANALYTIC full-distribution divergence
+(recomputed from the pre-epoch params, the reference's form); heads without
+a closed form fall back to the (ratio - 1 - log ratio) k3 estimator.
 """
 
 from __future__ import annotations
@@ -16,12 +17,23 @@ from stoix_tpu.systems.runner import run_anakin_experiment
 from stoix_tpu.utils import config as config_lib
 
 
-def penalty_policy_loss(dist, action, old_log_prob, gae, config):
+def penalty_policy_loss(dist, action, old_log_prob, gae, config, behavior_dist=None):
     log_prob = dist.log_prob(action)
-    log_ratio = log_prob - old_log_prob
-    kl_approx = jnp.exp(log_ratio) - 1.0 - log_ratio  # k3 estimator, >= 0
+    kl = None
+    if behavior_dist is not None:
+        # Analytic full-distribution KL(behavior - current), the reference's
+        # form (reference loss.py:44): exact and LOW-variance when the
+        # distributions are close — the sampled k3 estimator's variance
+        # explodes exactly as the policy sharpens, which stalled refinement.
+        try:
+            kl = behavior_dist.kl_divergence(dist)
+        except NotImplementedError:  # continuous heads: no closed form
+            kl = None
+    if kl is None:
+        log_ratio = log_prob - old_log_prob
+        kl = jnp.exp(log_ratio) - 1.0 - log_ratio  # k3 estimator, >= 0
     loss = losses.ppo_penalty_loss(
-        log_prob, old_log_prob, gae, float(config.system.get("kl_beta", 3.0)), kl_approx
+        log_prob, old_log_prob, gae, float(config.system.get("kl_beta", 3.0)), kl
     )
     return loss, dist.entropy().mean()
 

@@ -120,3 +120,41 @@ def test_sebulba_ppo_continuous_on_native_pool(devices):
     ret = ff_ppo.run_experiment(cfg)
     assert np.isfinite(ret)
     assert ret < 0.0  # pendulum returns are negative costs
+
+
+def test_impala_reward_normalization_is_shard_invariant(devices):
+    """maybe_normalize_rewards must produce the GLOBAL-batch normalization
+    regardless of how envs are split across data shards (the pmean over
+    "data"): per-shard stats would make gradients depend on device count."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from stoix_tpu.base_types import PPOTransition
+    from stoix_tpu.systems.impala.sebulba.ff_impala import maybe_normalize_rewards
+    from stoix_tpu.utils import config as config_lib
+
+    cfg = config_lib.Config.from_dict(
+        {"system": {"normalize_rewards": True, "reward_scale": 1.0, "reward_eps": 1e-8}}
+    )
+    rng = np.random.default_rng(0)
+    rewards = jnp.asarray(rng.normal(3.0, 2.0, size=(4, 8)), jnp.float32)  # [T, E]
+    zeros = jnp.zeros_like(rewards)
+    traj = PPOTransition(
+        done=zeros, truncated=zeros, action=zeros, value=zeros,
+        reward=rewards, log_prob=zeros, obs=zeros, next_obs=zeros, info={},
+    )
+
+    def per_shard(tr):
+        return maybe_normalize_rewards(tr, cfg).reward
+
+    for n_shards in (1, 2, 4):
+        mesh = Mesh(np.asarray(jax.devices("cpu")[:n_shards]), ("data",))
+        out = jax.jit(
+            jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(PPOTransition(*([P(None, "data")] * 9)),),
+                out_specs=P(None, "data"),
+            )
+        )(traj)
+        expected = (rewards - rewards.mean()) / (rewards.std() + 1e-8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
