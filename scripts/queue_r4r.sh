@@ -1,0 +1,13 @@
+#!/bin/bash
+# Round-4 wave 18: sampled-MZ at the new recipe (sims 50 / K=8 / epochs 32)
+# — validates on the learned-model variant what the AZ lever study showed.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r4.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+
+run sampled_mz_s50k8_2m 180 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r4r done"}' >> "$QUEUE_OUT"
