@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-5 wave A (CPU): late-training-collapse fix test + sampled-MZ 5M.
+#
+# 1-2. VERDICT r4 item 3: hopper fell to 0.0 at 3M (vs 54 at 1M) and
+#      halfcheetah to -606 at 5M (vs 184 at 1M) — the learn-then-collapse
+#      family. Hypothesis (r4 memory + reference utils/training.py decay
+#      gating): no LR decay on long budgets. Identical r4 shapes, decay on.
+# 3.   VERDICT r4 item 2: sampled-MZ at the sims-50/K=8 recipe x 5M — the
+#      2M curve (-451.7, still descending) says one budget away.
+#
+# Separate lock from the TPU queue: a recovering tunnel must not wait
+# behind a multi-hour CPU run (and vice versa).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r5.jsonl
+export QUEUE_LOCK=/tmp/stoix_cpu_queue.lock
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_hopper_3m_decay 60 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=hopper \
+  arch.total_num_envs=64 arch.total_timesteps=3000000 \
+  system.normalize_observations=true system.decay_learning_rates=true \
+  logger.use_console=False logger.use_json=True
+
+run ppo_halfcheetah_5m_decay 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=halfcheetah \
+  arch.total_num_envs=64 arch.total_timesteps=5000000 \
+  system.normalize_observations=true system.decay_learning_rates=true \
+  logger.use_console=False logger.use_json=True
+
+run sampled_mz_s50k8_5m 330 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=5000000 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r5a done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-5 wave A2 (CPU): the collapse-fix locomotion reruns, relaunched under
+# the fixed timestep checker (num_updates now trims to a multiple of the
+# requested eval count; the first attempts ran with ONE and TWO evals —
+# no curve, and the r4 hopper "0.0 @3M" shares that artifact).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r5.jsonl
+export QUEUE_LOCK=/tmp/stoix_a2_queue.lock
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_hopper_3m_decay_v2 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=hopper \
+  arch.total_num_envs=64 arch.total_timesteps=3000000 \
+  system.normalize_observations=true system.decay_learning_rates=true \
+  logger.use_console=False logger.use_json=True
+
+run ppo_halfcheetah_5m_decay_v2 120 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=halfcheetah \
+  arch.total_num_envs=64 arch.total_timesteps=5000000 \
+  system.normalize_observations=true system.decay_learning_rates=true \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r5a2 done"}' >> "$QUEUE_OUT"

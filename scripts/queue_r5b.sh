@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 wave B (CPU): PPO-penalty cap measurement (VERDICT r4 item 6 /
+# Weak #6). Completes the fixed-beta sweep (r4: 0.5 -> 181, 1 -> 224,
+# 3 -> 337) and tests the adaptive-KL variant (Schulman 2017 §4) with and
+# without obs normalization. CartPole 2M runs, ~3 min each on CPU.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r5.jsonl
+export QUEUE_LOCK=/tmp/stoix_penalty_queue.lock
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_penalty_beta10 30 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  arch.total_timesteps=2000000 system.kl_beta=10.0 \
+  logger.use_console=False logger.use_json=True
+
+run ppo_penalty_beta30 30 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  arch.total_timesteps=2000000 system.kl_beta=30.0 \
+  logger.use_console=False logger.use_json=True
+
+run ppo_penalty_beta01 30 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  arch.total_timesteps=2000000 system.kl_beta=0.1 \
+  logger.use_console=False logger.use_json=True
+
+run ppo_penalty_adaptive 30 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  arch.total_timesteps=2000000 system.adaptive_kl_beta=true \
+  logger.use_console=False logger.use_json=True
+
+run ppo_penalty_adaptive_norm 30 --module stoix_tpu.systems.ppo.anakin.ff_ppo_penalty \
+  --default default/anakin/default_ff_ppo_penalty.yaml env=cartpole \
+  arch.total_timesteps=2000000 system.adaptive_kl_beta=true \
+  system.normalize_observations=true \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r5b done"}' >> "$QUEUE_OUT"

@@ -12,8 +12,9 @@ from stoix_tpu.systems.runner import run_anakin_experiment
 from stoix_tpu.utils import config as config_lib
 
 
-def dpo_policy_loss(dist, action, old_log_prob, gae, config, behavior_dist=None):
-    del behavior_dist  # DPO's drift uses the stored per-sample log-probs
+def dpo_policy_loss(dist, action, old_log_prob, gae, config, behavior_dist=None,
+                    beta=None):
+    del behavior_dist, beta  # DPO's drift uses the stored per-sample log-probs
     log_prob = dist.log_prob(action)
     loss = losses.dpo_loss(
         log_prob,

@@ -54,7 +54,12 @@ from stoix_tpu.utils.training import make_learning_rate
 
 class PPOLearnerState(NamedTuple):
     """OnPolicyLearnerState + observation running statistics (the reference
-    injects this field dynamically, ff_ppo.py:90-94; here it is explicit)."""
+    injects this field dynamically, ff_ppo.py:90-94; here it is explicit).
+
+    `kl_beta` is the KL-penalty coefficient as TRAINED STATE: constant unless
+    `system.adaptive_kl_beta` is set (PPO-penalty's adaptive-KL variant,
+    Schulman et al. 2017 §4), in which case it doubles/halves around
+    `system.kl_target` after every update step. Unused (zero) for clip/DPO."""
 
     params: Any
     opt_states: Any
@@ -62,6 +67,7 @@ class PPOLearnerState(NamedTuple):
     env_state: Any
     timestep: Any
     obs_stats: Any
+    kl_beta: Any
 
 
 def get_learner_fn(
@@ -80,6 +86,14 @@ def get_learner_fn(
 
     actor_apply, critic_apply = apply_fns
     actor_update, critic_update = update_fns
+    adaptive_kl = bool(config.system.get("adaptive_kl_beta", False))
+    if adaptive_kl and not getattr(policy_loss_fn, "uses_kl_beta", False):
+        # Fail fast: adapting beta for a loss that discards it (clip, DPO)
+        # would log a "working" kl_beta while changing nothing.
+        raise ValueError(
+            "system.adaptive_kl_beta=true requires a policy loss that consumes "
+            "kl_beta (the PPO-penalty loss); the configured loss does not."
+        )
     gamma = float(config.system.gamma)
     reward_scale = float(config.system.get("reward_scale", 1.0))
     normalize_obs = bool(config.system.get("normalize_observations", False))
@@ -90,7 +104,11 @@ def get_learner_fn(
         return running_statistics.normalize_observation(observation, obs_stats)
 
     def _env_step(learner_state: PPOLearnerState, _: Any):
-        params, opt_states, key, env_state, last_timestep, obs_stats = learner_state
+        params, opt_states, key = (
+            learner_state.params, learner_state.opt_states, learner_state.key,
+        )
+        env_state, last_timestep = learner_state.env_state, learner_state.timestep
+        obs_stats = learner_state.obs_stats
         key, policy_key = jax.random.split(key)
 
         observation = _maybe_normalize(last_timestep.observation, obs_stats)
@@ -115,11 +133,13 @@ def get_learner_fn(
             info=timestep.extras["episode_metrics"],
         )
         return (
-            PPOLearnerState(params, opt_states, key, env_state, timestep, obs_stats),
+            learner_state._replace(key=key, env_state=env_state, timestep=timestep),
             transition,
         )
 
-    def _actor_loss_fn(actor_params, behavior_actor_params, obs, action, old_log_prob, gae):
+    def _actor_loss_fn(
+        actor_params, behavior_actor_params, obs, action, old_log_prob, gae, kl_beta
+    ):
         actor_policy = actor_apply(actor_params, obs)
         if policy_loss_fn is not None:
             # The behavior distribution (pre-epoch params on the SAME
@@ -129,7 +149,7 @@ def get_learner_fn(
             behavior_policy = actor_apply(behavior_actor_params, obs)
             loss_actor, entropy = policy_loss_fn(
                 actor_policy, action, old_log_prob, gae, config,
-                behavior_dist=behavior_policy,
+                behavior_dist=behavior_policy, beta=kl_beta,
             )
         else:
             log_prob = actor_policy.log_prob(action)
@@ -151,7 +171,7 @@ def get_learner_fn(
         return float(config.system.vf_coef) * value_loss, value_loss
 
     def _update_minibatch(train_state: Tuple, batch_info: Tuple):
-        params, opt_states, behavior_actor_params = train_state
+        params, opt_states, behavior_actor_params, kl_beta = train_state
         traj_batch, advantages, targets = batch_info
 
         actor_grad_fn = jax.grad(_actor_loss_fn, has_aux=True)
@@ -162,6 +182,7 @@ def get_learner_fn(
             traj_batch.action,
             traj_batch.log_prob,
             advantages,
+            kl_beta,
         )
         critic_grad_fn = jax.grad(_critic_loss_fn, has_aux=True)
         critic_grads, value_loss = critic_grad_fn(
@@ -194,12 +215,14 @@ def get_learner_fn(
             ActorCriticParams(actor_params, critic_params),
             ActorCriticOptStates(actor_opt_state, critic_opt_state),
             behavior_actor_params,
+            kl_beta,
         ), loss_info
 
     def _update_epoch(update_state: Tuple, _: Any):
-        params, opt_states, behavior_actor_params, traj_batch, advantages, targets, key = (
-            update_state
-        )
+        (
+            params, opt_states, behavior_actor_params, kl_beta,
+            traj_batch, advantages, targets, key,
+        ) = update_state
         key, shuffle_key = jax.random.split(key)
 
         # Flatten [T, E] -> [T*E] and shuffle across both time and envs.
@@ -213,18 +236,25 @@ def get_learner_fn(
             ),
             shuffled,
         )
-        (params, opt_states, behavior_actor_params), loss_info = jax.lax.scan(
-            _update_minibatch, (params, opt_states, behavior_actor_params), minibatches
+        (params, opt_states, behavior_actor_params, kl_beta), loss_info = jax.lax.scan(
+            _update_minibatch,
+            (params, opt_states, behavior_actor_params, kl_beta),
+            minibatches,
         )
         return (
-            params, opt_states, behavior_actor_params, traj_batch, advantages, targets, key,
+            params, opt_states, behavior_actor_params, kl_beta,
+            traj_batch, advantages, targets, key,
         ), loss_info
 
     def _update_step(learner_state: PPOLearnerState, _: Any):
         learner_state, traj_batch = jax.lax.scan(
             _env_step, learner_state, None, int(config.system.rollout_length)
         )
-        params, opt_states, key, env_state, last_timestep, obs_stats = learner_state
+        params, opt_states, key = (
+            learner_state.params, learner_state.opt_states, learner_state.key,
+        )
+        env_state, last_timestep = learner_state.env_state, learner_state.timestep
+        obs_stats, kl_beta = learner_state.obs_stats, learner_state.kl_beta
 
         # Trajectory obs are stored RAW; normalize them with the PRE-update
         # statistics (identical to what the rollout's log_probs/values used),
@@ -263,14 +293,40 @@ def get_learner_fn(
         # penalties anchor to them, matching the reference's
         # behaviour_actor_params capture (reference ff_ppo_penalty.py:128).
         update_state = (
-            params, opt_states, params.actor_params, traj_batch, advantages, targets, key,
+            params, opt_states, params.actor_params, kl_beta,
+            traj_batch, advantages, targets, key,
         )
         update_state, loss_info = jax.lax.scan(
             _update_epoch, update_state, None, int(config.system.epochs)
         )
-        params, opt_states, _, _, _, _, key = update_state
+        params, opt_states, behavior_actor_params, kl_beta = update_state[:4]
+        key = update_state[7]
+
+        if adaptive_kl:
+            # Adaptive-KL PPO (Schulman et al. 2017 §4): after the full
+            # update, measure the analytic KL(behavior ‖ new policy) over the
+            # rollout batch and double/halve beta around `kl_target`. The KL
+            # is pmeaned over the update-batch and mesh axes FIRST so the
+            # replicated beta state stays bit-identical on every replica.
+            kl_target = float(config.system.get("kl_target", 0.01))
+            new_dist = actor_apply(params.actor_params, traj_batch.obs)
+            behavior_dist = actor_apply(behavior_actor_params, traj_batch.obs)
+            try:
+                measured_kl = jnp.mean(behavior_dist.kl_divergence(new_dist))
+            except NotImplementedError:
+                log_ratio = (
+                    new_dist.log_prob(traj_batch.action) - traj_batch.log_prob
+                )
+                measured_kl = jnp.mean(jnp.exp(log_ratio) - 1.0 - log_ratio)
+            measured_kl = jax.lax.pmean(measured_kl, axis_name="batch")
+            measured_kl = jax.lax.pmean(measured_kl, axis_name="data")
+            kl_beta = jnp.where(measured_kl > 1.5 * kl_target, kl_beta * 2.0, kl_beta)
+            kl_beta = jnp.where(measured_kl < kl_target / 1.5, kl_beta / 2.0, kl_beta)
+            kl_beta = jnp.clip(kl_beta, 1e-3, 1e3)
+            loss_info = {**loss_info, "measured_kl": measured_kl, "kl_beta": kl_beta}
+
         learner_state = PPOLearnerState(
-            params, opt_states, key, env_state, last_timestep, obs_stats
+            params, opt_states, key, env_state, last_timestep, obs_stats, kl_beta
         )
         return learner_state, (traj_batch.info, loss_info)
 
@@ -363,6 +419,7 @@ def learner_setup(
         env_state=P(None, "data"),
         timestep=P(None, "data"),
         obs_stats=P(),
+        kl_beta=P(),
     )
     env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
     obs_stats = running_statistics.init_state(env.observation_value().agent_view)
@@ -377,6 +434,12 @@ def learner_setup(
         env_state=env_state,
         timestep=timestep,
         obs_stats=anakin.broadcast_to_update_batch(obs_stats, update_batch),
+        kl_beta=anakin.broadcast_to_update_batch(
+            # 3.0 matches the penalty loss's historical default so a config
+            # omitting kl_beta keeps the KL penalty ACTIVE (0.0 would
+            # silently disable it). Unused state for clip/DPO losses.
+            jnp.asarray(float(config.system.get("kl_beta", 3.0))), update_batch
+        ),
     )
     learner_state = anakin.place_learner_state(learner_state, mesh, state_specs)
     learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)

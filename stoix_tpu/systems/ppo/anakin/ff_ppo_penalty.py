@@ -17,7 +17,8 @@ from stoix_tpu.systems.runner import run_anakin_experiment
 from stoix_tpu.utils import config as config_lib
 
 
-def penalty_policy_loss(dist, action, old_log_prob, gae, config, behavior_dist=None):
+def penalty_policy_loss(dist, action, old_log_prob, gae, config, behavior_dist=None,
+                        beta=None):
     log_prob = dist.log_prob(action)
     kl = None
     if behavior_dist is not None:
@@ -32,10 +33,15 @@ def penalty_policy_loss(dist, action, old_log_prob, gae, config, behavior_dist=N
     if kl is None:
         log_ratio = log_prob - old_log_prob
         kl = jnp.exp(log_ratio) - 1.0 - log_ratio  # k3 estimator, >= 0
-    loss = losses.ppo_penalty_loss(
-        log_prob, old_log_prob, gae, float(config.system.get("kl_beta", 3.0)), kl
-    )
+    if beta is None:
+        beta = float(config.system.get("kl_beta", 3.0))
+    loss = losses.ppo_penalty_loss(log_prob, old_log_prob, gae, beta, kl)
     return loss, dist.entropy().mean()
+
+
+# Marks the loss as consuming the kl_beta learner-state scalar, which gates
+# system.adaptive_kl_beta (ff_ppo.get_learner_fn rejects the flag otherwise).
+penalty_policy_loss.uses_kl_beta = True
 
 
 def learner_setup(env, config, mesh, key):

@@ -19,7 +19,10 @@ import orbax.checkpoint as ocp
 # 2.0: continuous MPO/V-MPO dual variables changed shape from (2,) to
 # [2, action_dim] (per-dimension KL constraints) — old checkpoints cannot
 # restore into the new template.
-CHECKPOINTER_VERSION = 2.0
+# 3.0: PPOLearnerState grew a `kl_beta` leaf (adaptive-KL PPO-penalty state)
+# — pre-3.0 PPO/DPO/penalty checkpoints lack it and cannot restore into the
+# new template.
+CHECKPOINTER_VERSION = 3.0
 
 
 class Checkpointer:

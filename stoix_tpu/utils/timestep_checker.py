@@ -46,17 +46,32 @@ def check_total_timesteps(config: Any, num_data_shards: int) -> Any:
     num_evaluation = max(1, int(arch.get("num_evaluation", 1)))
     num_updates = int(arch.num_updates)
     if num_updates % num_evaluation != 0:
-        # Round DOWN to the nearest divisor of num_updates rather than falling
-        # back to a single eval: one eval fuses every update into one compiled
-        # program, which for long runs is both unobservable and big enough to
-        # hit device-runtime execution limits.
-        requested_evals = num_evaluation
-        while num_updates % num_evaluation != 0:
-            num_evaluation -= 1
-        print(
-            f"[timestep-check] num_evaluation adjusted {requested_evals} -> "
-            f"{num_evaluation} (nearest divisor of num_updates={num_updates})"
-        )
+        if num_updates >= num_evaluation:
+            # Keep the REQUESTED eval cadence and trim num_updates down to a
+            # multiple of it (costs < one eval period of budget). The old
+            # round-evals-down-to-a-divisor rule degenerated on awkward
+            # update counts: e.g. 2929 updates (divisors 1/29/101/2929) at 20
+            # requested evals collapsed to ONE eval — every update fused into
+            # one compiled program (unobservable, and big enough to hit
+            # device-runtime execution limits: the round-2 TPU wedge), which
+            # is exactly what this check exists to prevent.
+            trimmed = (num_updates // num_evaluation) * num_evaluation
+            print(
+                f"[timestep-check] num_updates adjusted {num_updates} -> "
+                f"{trimmed} (multiple of num_evaluation={num_evaluation}; "
+                f"total_timesteps {arch.total_timesteps} -> "
+                f"{trimmed * steps_per_update})"
+            )
+            num_updates = trimmed
+            arch.num_updates = trimmed
+            arch.total_timesteps = trimmed * steps_per_update
+        else:
+            requested_evals = num_evaluation
+            num_evaluation = num_updates  # one eval per update
+            print(
+                f"[timestep-check] num_evaluation adjusted {requested_evals} "
+                f"-> {num_evaluation} (run has only {num_updates} updates)"
+            )
     arch.num_evaluation = num_evaluation
     arch.num_updates_per_eval = int(arch.num_updates) // num_evaluation
     return config

@@ -102,3 +102,32 @@ def test_rec_ppo_and_dqn_decay_paths(devices):
     )
     with pytest.raises(ValueError, match="final_epsilon"):
         ff_dqn.run_experiment(cfg)
+
+
+@pytest.mark.slow
+def test_ppo_penalty_adaptive_kl_beta_runs(devices):
+    """Adaptive-KL PPO-penalty (Schulman 2017 §4): beta is trained state that
+    doubles/halves around kl_target. The run must complete and learn above
+    random on IdentityGame with the adaptation active."""
+    from stoix_tpu.systems.ppo.anakin.ff_ppo_penalty import (
+        run_experiment as run_penalty,
+    )
+
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo_penalty.yaml",
+        [
+            "env=identity_game",
+            "arch.total_num_envs=64",
+            "arch.total_timesteps=65536",
+            "arch.num_evaluation=1",
+            "arch.num_eval_episodes=32",
+            "arch.absolute_metric=False",
+            "system.rollout_length=16",
+            "system.adaptive_kl_beta=true",
+            "system.kl_target=0.01",
+            "logger.use_console=False",
+        ],
+    )
+    final_return = run_penalty(cfg)
+    assert final_return > 4.0, f"adaptive-KL penalty failed to learn: {final_return}"
