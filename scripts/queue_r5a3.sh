@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-5 wave A3 (CPU): locomotion reruns under BOTH stability fixes —
+# the log-ratio clamp (ops/losses.py, NaN-proofing) and reward_scale 0.1
+# (Brax-recipe return compression: the instrumented hopper run showed the
+# critic chasing 30 -> 630-scale returns, value-loss spikes ~3e5, and the
+# entropy bonus then inflating sigma unchecked). Decay + obs-norm kept.
+# Queues behind wave A2's halfcheetah (the unclamped decay-only control).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r5.jsonl
+export QUEUE_LOCK=/tmp/stoix_a2_queue.lock
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_hopper_3m_v3 90 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=hopper \
+  arch.total_num_envs=64 arch.total_timesteps=3000000 \
+  system.normalize_observations=true system.decay_learning_rates=true \
+  system.reward_scale=0.1 \
+  logger.use_console=False logger.use_json=True
+
+run ppo_halfcheetah_5m_v3 120 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=halfcheetah \
+  arch.total_num_envs=64 arch.total_timesteps=5000000 \
+  system.normalize_observations=true system.decay_learning_rates=true \
+  system.reward_scale=0.1 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r5a3 done"}' >> "$QUEUE_OUT"

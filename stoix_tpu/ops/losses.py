@@ -31,9 +31,24 @@ def huber_loss(x: Array, delta: float = 1.0) -> Array:
 # --------------------------------------------------------------------------- #
 
 
+# Numerical guard for exp(log_ratio) in the ratio-based surrogates. The clip
+# region only ever involves |log_ratio| <= log(1 +/- eps) ~ 0.2, so clamping
+# at +/-20 (ratio <= 4.9e8) is semantically free — but it keeps the loss and
+# its gradients FINITE when a sharpened continuous policy (sigma -> min_scale)
+# meets a stale minibatch sample. Without it the loss overflows (observed:
+# 3.4e27 on hopper+obs-norm at 192k steps), the global-norm clip divides by
+# inf, and the params go NaN — the root cause of the "0.0 forever" locomotion
+# runs (a NaN action terminates the episode at step 1 with return exactly 0).
+_LOG_RATIO_CLAMP = 20.0
+
+
+def _safe_ratio(log_prob: Array, old_log_prob: Array) -> Array:
+    return jnp.exp(jnp.clip(log_prob - old_log_prob, -_LOG_RATIO_CLAMP, _LOG_RATIO_CLAMP))
+
+
 def ppo_clip_loss(log_prob: Array, old_log_prob: Array, advantage: Array, epsilon: float) -> Array:
     """PPO clipped surrogate objective (Schulman et al. 2017)."""
-    ratio = jnp.exp(log_prob - old_log_prob)
+    ratio = _safe_ratio(log_prob, old_log_prob)
     unclipped = ratio * advantage
     clipped = jnp.clip(ratio, 1.0 - epsilon, 1.0 + epsilon) * advantage
     return -jnp.mean(jnp.minimum(unclipped, clipped))
@@ -43,7 +58,7 @@ def ppo_penalty_loss(
     log_prob: Array, old_log_prob: Array, advantage: Array, beta: float, kl_approx: Array
 ) -> Array:
     """PPO with a KL penalty instead of clipping."""
-    ratio = jnp.exp(log_prob - old_log_prob)
+    ratio = _safe_ratio(log_prob, old_log_prob)
     return -jnp.mean(ratio * advantage - beta * kl_approx)
 
 
@@ -52,7 +67,7 @@ def dpo_loss(
 ) -> Array:
     """Drift-based PPO alternative (DPO, Garcin et al.): asymmetric drift
     penalties replace the hard clip."""
-    log_ratio = log_prob - old_log_prob
+    log_ratio = jnp.clip(log_prob - old_log_prob, -_LOG_RATIO_CLAMP, _LOG_RATIO_CLAMP)
     ratio = jnp.exp(log_ratio)
     drift_pos = jax.nn.relu((ratio - 1.0) * advantage - alpha * jnp.tanh((ratio - 1.0) * advantage / alpha))
     drift_neg = jax.nn.relu(log_ratio * advantage - beta * jnp.tanh(log_ratio * advantage / beta))

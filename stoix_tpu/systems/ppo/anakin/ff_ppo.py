@@ -314,8 +314,9 @@ def get_learner_fn(
             try:
                 measured_kl = jnp.mean(behavior_dist.kl_divergence(new_dist))
             except NotImplementedError:
-                log_ratio = (
-                    new_dist.log_prob(traj_batch.action) - traj_batch.log_prob
+                log_ratio = jnp.clip(
+                    new_dist.log_prob(traj_batch.action) - traj_batch.log_prob,
+                    -losses._LOG_RATIO_CLAMP, losses._LOG_RATIO_CLAMP,
                 )
                 measured_kl = jnp.mean(jnp.exp(log_ratio) - 1.0 - log_ratio)
             measured_kl = jax.lax.pmean(measured_kl, axis_name="batch")

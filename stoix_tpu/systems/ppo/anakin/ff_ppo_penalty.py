@@ -31,7 +31,9 @@ def penalty_policy_loss(dist, action, old_log_prob, gae, config, behavior_dist=N
         except NotImplementedError:  # continuous heads: no closed form
             kl = None
     if kl is None:
-        log_ratio = log_prob - old_log_prob
+        log_ratio = jnp.clip(  # finite guard, same bound as the surrogates
+            log_prob - old_log_prob, -losses._LOG_RATIO_CLAMP, losses._LOG_RATIO_CLAMP
+        )
         kl = jnp.exp(log_ratio) - 1.0 - log_ratio  # k3 estimator, >= 0
     if beta is None:
         beta = float(config.system.get("kl_beta", 3.0))
