@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-5 wave D (CPU): sampled-AZ stability run (VERDICT r4 Weak #3) —
+# split out of wave C so it can be fired only if the core has room
+# (sampled-MZ 5M owns the overnight budget; see VALIDATION round-5 notes).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r5.jsonl
+export QUEUE_LOCK=/tmp/stoix_d_queue.lock
+source "$(dirname "$0")/queue_lib.sh"
+
+run sampled_az_5m_decay 400 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=5000000 \
+  system.decay_learning_rates=true \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r5d done"}' >> "$QUEUE_OUT"

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 wave E (CPU): CNN learning evidence + SAC ant — fired
+# opportunistically when the core frees up (see wave D note).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r5.jsonl
+export QUEUE_LOCK=/tmp/stoix_e_queue.lock
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_spaceinvaders_cnn_2m 300 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=space_invaders network=cnn \
+  'env.wrapper.flatten_observation=false' \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 \
+  logger.use_console=False logger.use_json=True
+
+run sac_ant_3m_64env 150 --module stoix_tpu.systems.sac.ff_sac \
+  --default default/anakin/default_ff_sac.yaml env=ant \
+  arch.total_num_envs=64 arch.total_timesteps=3000000 \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r5e done"}' >> "$QUEUE_OUT"
