@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-5 wave A4 (CPU): DPO/hopper — PPO-hopper is the one locomotion env
+# still unstable after the clamp (policy decays to ~4 under reward_scale,
+# explodes without it); DPO's drift objective has been far more stable on
+# this class (halfcheetah 543.8 r4, Ant ~4700 r5). 1M at the DPO reference
+# config puts hopper locomotion on the board independently of PPO.
+cd /root/repo
+export QUEUE_OUT=docs/runs_r5.jsonl
+export QUEUE_LOCK=/tmp/stoix_a2_queue.lock
+source "$(dirname "$0")/queue_lib.sh"
+
+run dpo_hopper_1m 60 --module stoix_tpu.systems.ppo.anakin.ff_dpo_continuous \
+  --default default/anakin/default_ff_dpo_continuous.yaml env=hopper \
+  arch.total_num_envs=64 arch.total_timesteps=1000000 \
+  system.normalize_observations=true \
+  logger.use_console=False logger.use_json=True
+
+echo '{"queue": "r5a4 done"}' >> "$QUEUE_OUT"

@@ -42,6 +42,11 @@ run ppo_breakout_minatar 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
   --default default/anakin/default_ff_ppo.yaml env=breakout_jax network=cnn \
   arch.total_timesteps=5000000 logger.use_console=False
 
+run ppo_spaceinvaders_cnn 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=space_invaders network=cnn \
+  'env.wrapper.flatten_observation=false' arch.total_timesteps=5000000 \
+  logger.use_console=False
+
 # 3. Sampled search at real budgets (r3 trend extrapolates to solved at
 # 5-10M; K=16 samples is the next lever if 5M stalls).
 run sampled_az_5m 60 --module stoix_tpu.systems.search.ff_sampled_az \
