@@ -37,17 +37,20 @@ def main() -> None:
     large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
     cartpole = "--cartpole" in sys.argv
     sebulba = "--sebulba" in sys.argv
+    pixel = "--pixel" in sys.argv  # Sebulba on 84x84x4 frames + Nature CNN
     run_all = "--all" in sys.argv
     if large and cartpole:
         sys.exit("--large is the MXU-bound Ant variant; it does not compose with --cartpole")
-    if sebulba and (large or cartpole):
-        sys.exit("--sebulba is its own workload; it does not compose with other variants")
-    if run_all and (large or cartpole or sebulba):
+    if (sebulba or pixel) and (large or cartpole) or (sebulba and pixel):
+        sys.exit("--sebulba/--pixel are their own workloads; they do not compose")
+    if run_all and (large or cartpole or sebulba or pixel):
         sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
     env_tag = "cartpole" if cartpole else "ant"
     if run_all:
         metric = "bench_all"
+    elif pixel:
+        metric = "sebulba_ppo_breakout_pixel_env_steps_per_sec"
     elif sebulba:
         metric = "sebulba_ppo_cartpole_env_steps_per_sec"
     else:
@@ -231,6 +234,17 @@ def main() -> None:
         _finish(payloads)
         return
 
+    if pixel:
+        _finish([
+            _run_sebulba(
+                metric, smoke, n_devices,
+                env_overrides=["env=breakout_pixel", "network=cnn_atari"],
+                num_envs=16 if smoke else 128,
+                pool_desc="84x84x4 C++ pixel pool, Nature CNN",
+            )
+        ])
+        return
+
     if sebulba:
         _finish([_run_sebulba(metric, smoke, n_devices)])
         return
@@ -391,8 +405,17 @@ def _run_anakin_generic(
     }
 
 
-def _run_sebulba(metric: str, smoke: bool, n_devices: int) -> dict:
-    """Sebulba PPO on the native C++ CartPole pool; steady-state SPS.
+def _run_sebulba(
+    metric: str,
+    smoke: bool,
+    n_devices: int,
+    env_overrides: list | None = None,
+    num_envs: int | None = None,
+    pool_desc: str = "C++ pool",
+) -> dict:
+    """Sebulba PPO on the native C++ pool; steady-state SPS. Default workload
+    is the CartPole pool; `--pixel` swaps in the full-resolution 84x84x4
+    Breakout-atari frames + Nature-DQN CNN (the EnvPool-Atari-shaped config).
 
     Device split: with 1 device everything shares it; with 2+ devices actors
     get device 0, the learner the rest (mirrors the validated CI split).
@@ -402,9 +425,8 @@ def _run_sebulba(metric: str, smoke: bool, n_devices: int) -> dict:
 
     learner_ids = [0] if n_devices == 1 else list(range(1, n_devices))
     overrides = [
-        "env=cartpole",
-        "env.backend=cvec",
-        "arch.total_num_envs=%d" % (16 if smoke else 512),
+        *(env_overrides or ["env=cartpole", "env.backend=cvec"]),
+        "arch.total_num_envs=%d" % (num_envs or (16 if smoke else 512)),
         "arch.actor.device_ids=[0]",
         "arch.actor.actor_per_device=%d" % (1 if smoke else 2),
         "arch.learner.device_ids=%s" % str(learner_ids).replace(" ", ""),
@@ -423,7 +445,7 @@ def _run_sebulba(metric: str, smoke: bool, n_devices: int) -> dict:
     sebulba_ppo.run_experiment(config)
     steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
     if steady:
-        unit = "env_steps/sec (steady-state, %d devices, C++ pool)" % n_devices
+        unit = "env_steps/sec (steady-state, %d devices, %s)" % (n_devices, pool_desc)
     else:
         # Zero values must carry their failure reason in `unit` (the bench
         # output contract): a missing steady window means the run ended before

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Round-5 chip queue — tunnel verified healthy 2026-07-31T03:45Z (matmul ok).
+# Reordered from tpu_queue.sh: bench numbers FIRST (the perf record has been
+# chip-stale for two rounds; if the tunnel wedges mid-queue we still get the
+# headline throughput refresh), then learning workloads.
+cd /root/repo
+export QUEUE_OUT=docs/runs_tpu.jsonl
+export QUEUE_RUNNER=scripts/run_exp.py
+source "$(dirname "$0")/queue_lib.sh"
+
+# 0. Fresh chip throughput for all five tracked BASELINE configs + large Ant.
+run_bench bench_all_chip 7000 --all
+run_bench bench_ant_large_chip 3900 --large
+
+# 1. CNN workloads (held off CPU entirely — VERDICT r4 weak #5).
+run ppo_breakout_minatar 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=breakout_jax network=cnn \
+  arch.total_timesteps=5000000 logger.use_console=False
+run ppo_spaceinvaders_cnn 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=space_invaders network=cnn \
+  'env.wrapper.flatten_observation=false' arch.total_timesteps=5000000 \
+  logger.use_console=False
+run dqn_snake_cnn 45 --module stoix_tpu.systems.q_learning.ff_dqn \
+  --default default/anakin/default_ff_dqn.yaml env=snake network=cnn_dqn \
+  'env.wrapper.flatten_observation=false' arch.total_timesteps=2000000 \
+  logger.use_console=False
+
+# 2. Locomotion at brax-class budgets (VERDICT r4 next #4).
+run ppo_ant_30m 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=ant \
+  arch.total_timesteps=30000000 system.normalize_observations=true \
+  logger.use_console=False
+run sac_ant_3m 45 --module stoix_tpu.systems.sac.ff_sac \
+  --default default/anakin/default_ff_sac.yaml env=ant arch.total_timesteps=3000000 \
+  logger.use_console=False
+run ppo_hopper_20m 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=hopper \
+  arch.total_timesteps=20000000 system.normalize_observations=true \
+  logger.use_console=False
+run ppo_halfcheetah_20m 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
+  --default default/anakin/default_ff_ppo_continuous.yaml env=halfcheetah \
+  arch.total_timesteps=20000000 system.normalize_observations=true \
+  logger.use_console=False
+
+# 3. Sampled search at real budgets, sims-50/K=8 recipe (VERDICT r4 next #2).
+run sampled_mz_s50k8_5m_chip 60 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
+  arch.total_timesteps=5000000 logger.use_console=False
+run sampled_az_s50k8_8m_chip 90 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_timesteps=8000000 logger.use_console=False
+
+# 3b. SPO at the reference replay intensity.
+run spo_cont_pendulum_chip 60 --module stoix_tpu.systems.spo.ff_spo_continuous \
+  --default default/anakin/default_ff_spo_continuous.yaml env=pendulum \
+  arch.total_num_envs=64 arch.total_timesteps=2000000 system.epochs=128 \
+  logger.use_console=False
+
+echo '{"queue": "r5 chip queue done"}' >> "$QUEUE_OUT"

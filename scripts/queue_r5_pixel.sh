@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-5 wave 2: the full-resolution pixel workload at depth on chip.
+# Sebulba PPO + Nature-DQN CNN on Breakout-atari (84x84x4 frames from the
+# native C++ pool) — closes VERDICT r4 Missing #2's "no full-resolution
+# pixel workload has ever run at depth". Serialized behind the main chip
+# queue by the shared flock.
+cd /root/repo
+export QUEUE_OUT=docs/runs_tpu.jsonl
+export QUEUE_RUNNER=scripts/run_exp.py
+source "$(dirname "$0")/queue_lib.sh"
+
+run sebulba_breakout_pixel_5m 60 --module stoix_tpu.systems.ppo.sebulba.ff_ppo \
+  --default default/sebulba/default_ff_ppo.yaml env=breakout_pixel \
+  network=cnn_atari arch.total_timesteps=5000000 \
+  logger.use_console=False
+
+echo '{"queue": "r5 pixel queue done"}' >> "$QUEUE_OUT"

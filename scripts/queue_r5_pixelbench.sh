@@ -1,0 +1,8 @@
+#!/bin/bash
+# Round-5 wave 3: chip throughput for the full-resolution pixel Sebulba
+# workload (84x84x4 frames + Nature CNN) — the EnvPool-Atari-shaped bench.
+cd /root/repo
+export QUEUE_OUT=docs/runs_tpu.jsonl
+source "$(dirname "$0")/queue_lib.sh"
+run_bench bench_pixel_chip 1900 --pixel
+echo '{"queue": "r5 pixelbench done"}' >> "$QUEUE_OUT"

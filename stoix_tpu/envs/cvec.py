@@ -5,10 +5,12 @@ auto-reset bookkeeping, numpy episode metrics, stoa-style TimeSteps).
 
 Games: "CartPole-v1" (4-float obs), "Pendulum-v1" (continuous torque — the
 Sebulba continuous-control workload, float actions through cvec_step_cont),
-and the 10x10x4-pixel MinAtar-class set "Breakout-minatar",
-"Asterix-minatar", "Freeway-minatar", "SpaceInvaders-minatar" — the
-Atari-class workloads for the Sebulba CNN path, each with a (bit-)identical
-pure-JAX twin in envs/minatar.py / envs/classic.py. The shared library is
+the 10x10x4-pixel MinAtar-class set "Breakout-minatar",
+"Asterix-minatar", "Freeway-minatar", "SpaceInvaders-minatar" — each with a
+(bit-)identical pure-JAX twin in envs/minatar.py / envs/classic.py — and
+"Breakout-atari", the FULL-RESOLUTION pixel workload: 84x84x4 frame-stacked
+grayscale observations, the exact tensor shape the reference's EnvPool Atari
+path trains on (reference configs/env/envpool/*.yaml). The shared library is
 compiled on first use with g++ and cached next to the source; no
 Python-level per-env loops exist anywhere on the hot path.
 """

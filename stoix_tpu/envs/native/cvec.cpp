@@ -20,6 +20,10 @@
 //                         stream across rows, gold +1 / enemies kill, on a
 //                         deterministic spawn schedule (lockstep-equal with
 //                         the JAX twin).
+//   "Breakout-atari"    — 84x84x4 frame-stacked grayscale pixel Breakout:
+//                         the full-resolution EnvPool-Atari-shaped workload
+//                         (same observation tensor as the reference's
+//                         envpool configs) rendered and stepped natively.
 //
 // Build: g++ -O3 -march=native -shared -fPIC cvec.cpp -o libcvec.so
 
@@ -106,8 +110,14 @@ struct VecEnv {
       reset_env(i);
       step_count[i] = 0;
       ep_return[i] = 0.0f;
+      write_obs(i, obs_out + i * dim);
+    } else {
+      // No reset -> the post-step observation IS the successor observation;
+      // copy it instead of re-rasterizing (for the 84x84x4 pixel game
+      // write_obs is a 28k-float strided transpose — the pool's hot path).
+      std::memcpy(obs_out + i * dim, next_obs_out + i * dim,
+                  dim * sizeof(float));
     }
-    write_obs(i, obs_out + i * dim);
   }
 
   // One synchronous step for every env with auto-reset. Outputs:
@@ -726,10 +736,172 @@ struct PendulumVec : VecEnv {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Breakout-atari — full-resolution pixel Breakout: 84x84x4 frame-stacked
+// grayscale observations, the exact tensor shape the reference's EnvPool
+// Atari path trains on (reference stoix/wrappers/envpool.py:8-30 consumes
+// EnvPool's (84, 84, stack) image obs; configs/env/envpool/*.yaml). Unlike
+// the 10x10 MinAtar-class games above, this is a true pixel workload: the
+// agent sees rendered frames (paddle/ball/brick sprites at distinct gray
+// levels), not feature planes, and the CNN must learn from an 84x84x4
+// stack exactly as it would from ALE frames. Game logic is an original
+// pixel-physics breakout, not an ALE port:
+//   - 84x84 playfield; paddle 12x2 at row 80, moves +/-3 px/step (3 actions).
+//   - 2x2 ball at 2 px/step; direction set by paddle-hit offset (outer third
+//     of the paddle sends the ball out at the steep +/-2 horizontal speed,
+//     the center third at the shallow +/-1) — control depth comes from aiming.
+//   - 6x14 brick wall (each brick 6x3 px, rows 18..35); +1 per brick, wall
+//     refreshes when cleared; ball lost below the paddle ends the episode.
+//   - Frame stack: ring buffer of the last 4 rendered frames, exposed
+//     oldest->newest as channels (the envpool stacked-frame layout).
+// ---------------------------------------------------------------------------
+
+constexpr int kPix = 84;                  // frame height/width
+constexpr int kStack = 4;                 // stacked frames = obs channels
+constexpr int kPadW = 12, kPadH = 2;      // paddle sprite
+constexpr int kPadRow = 80;               // paddle top row
+constexpr int kPadSpeed = 3;              // px per action step
+constexpr int kBallSz = 2;                // 2x2 ball sprite
+constexpr int kBrickW = 6, kBrickH = 3;   // brick sprite
+constexpr int kBrickCols = kPix / kBrickW;    // 14
+constexpr int kBrickRowsPx = 6;               // brick rows
+constexpr int kBrickTop = 18;                 // first brick row (px)
+
+struct BreakoutPixelVec : VecEnv {
+  struct EnvState {
+    int ball_r, ball_c;   // top-left of the 2x2 ball sprite
+    int dr, dc;           // velocity, px/step (dr in {-2,+2}, dc in {-2,-1,+1,+2})
+    int paddle;           // leftmost column of the paddle
+    uint8_t bricks[kBrickRowsPx * kBrickCols];
+    uint8_t frames[kStack][kPix * kPix];  // grayscale ring buffer
+    int head;                             // index of the OLDEST frame
+  };
+  std::vector<EnvState> envs;
+
+  BreakoutPixelVec(int n, int max_steps_, uint64_t seed)
+      : VecEnv(n, max_steps_, seed), envs(n) {}
+
+  int obs_dim() const override { return kPix * kPix * kStack; }
+  void obs_shape(int32_t* out3) const override {
+    out3[0] = kPix; out3[1] = kPix; out3[2] = kStack;
+  }
+  int num_actions() const override { return 3; }  // left, stay, right
+
+  // Rasterize the current state into the newest slot of the frame ring.
+  void render(EnvState& e) {
+    uint8_t* f = e.frames[(e.head + kStack - 1) % kStack];
+    std::memset(f, 0, kPix * kPix);
+    // Brick wall: gray level graded by row so depth is visible to the CNN.
+    for (int br = 0; br < kBrickRowsPx; ++br)
+      for (int bc = 0; bc < kBrickCols; ++bc) {
+        if (!e.bricks[br * kBrickCols + bc]) continue;
+        const uint8_t shade = static_cast<uint8_t>(110 + 20 * br);
+        const int r0 = kBrickTop + br * kBrickH, c0 = bc * kBrickW;
+        for (int r = r0; r < r0 + kBrickH; ++r)
+          // 1-px gutter on the right edge keeps bricks visually distinct.
+          for (int c = c0; c < c0 + kBrickW - 1; ++c) f[r * kPix + c] = shade;
+      }
+    // Paddle.
+    for (int r = kPadRow; r < kPadRow + kPadH; ++r)
+      for (int c = e.paddle; c < e.paddle + kPadW; ++c) f[r * kPix + c] = 200;
+    // Ball (drawn last, on top).
+    for (int r = e.ball_r; r < e.ball_r + kBallSz; ++r)
+      for (int c = e.ball_c; c < e.ball_c + kBallSz; ++c)
+        if (r >= 0 && r < kPix && c >= 0 && c < kPix) f[r * kPix + c] = 255;
+  }
+
+  // Advance the ring and render into the freed slot.
+  void push_frame(EnvState& e) {
+    e.head = (e.head + 1) % kStack;
+    render(e);
+  }
+
+  void reset_env(int i) override {
+    EnvState& e = envs[i];
+    std::uniform_int_distribution<int> col(8, kPix - 8 - kBallSz);
+    std::uniform_int_distribution<int> dir(0, 1);
+    e.ball_r = kBrickTop + kBrickRowsPx * kBrickH + 4;  // below the wall
+    e.ball_c = col(rng);
+    e.dr = 2;                                           // serve downward
+    e.dc = dir(rng) ? 1 : -1;
+    e.paddle = (kPix - kPadW) / 2;
+    std::fill(e.bricks, e.bricks + kBrickRowsPx * kBrickCols, uint8_t{1});
+    e.head = 0;
+    // Fill the whole stack with the serve frame (envpool resets the same way:
+    // the first stacked observation repeats the initial frame).
+    render(e);
+    for (int s = 0; s < kStack - 1; ++s) push_frame(e);
+  }
+
+  void write_obs(int i, float* out) const override {
+    const EnvState& e = envs[i];
+    // HWC layout, channel = stack index oldest->newest, scaled to [0, 1].
+    for (int s = 0; s < kStack; ++s) {
+      const uint8_t* f = e.frames[(e.head + s) % kStack];
+      for (int p = 0; p < kPix * kPix; ++p)
+        out[p * kStack + s] = f[p] * (1.0f / 255.0f);
+    }
+  }
+
+  float step_env(int i, int32_t action, bool* terminated) override {
+    EnvState& e = envs[i];
+    e.paddle = std::clamp(e.paddle + (action - 1) * kPadSpeed, 0, kPix - kPadW);
+
+    float reward = 0.0f;
+    *terminated = false;
+    int nr = e.ball_r + e.dr;
+    int nc = e.ball_c + e.dc;
+
+    // Side walls.
+    if (nc < 0) { nc = -nc; e.dc = -e.dc; }
+    if (nc > kPix - kBallSz) { nc = 2 * (kPix - kBallSz) - nc; e.dc = -e.dc; }
+    // Ceiling.
+    if (nr < 0) { nr = -nr; e.dr = 2; }
+
+    // Brick band: test the ball center cell against the brick grid.
+    const int cr = nr + kBallSz / 2, cc = nc + kBallSz / 2;
+    if (cr >= kBrickTop && cr < kBrickTop + kBrickRowsPx * kBrickH) {
+      const int br = (cr - kBrickTop) / kBrickH;
+      const int bc = std::min(cc / kBrickW, kBrickCols - 1);
+      if (e.bricks[br * kBrickCols + bc]) {
+        e.bricks[br * kBrickCols + bc] = 0;
+        reward = 1.0f;
+        e.dr = -e.dr;
+        nr = e.ball_r;  // reflect back toward the incoming side
+        bool any = false;
+        for (int b = 0; b < kBrickRowsPx * kBrickCols; ++b)
+          any |= (e.bricks[b] != 0);
+        if (!any)
+          std::fill(e.bricks, e.bricks + kBrickRowsPx * kBrickCols, uint8_t{1});
+      }
+    } else if (e.dr > 0 && nr + kBallSz > kPadRow && e.ball_r + kBallSz <= kPadRow) {
+      // Crossing the paddle plane this step.
+      if (cc >= e.paddle && cc < e.paddle + kPadW) {
+        e.dr = -2;
+        nr = kPadRow - kBallSz;
+        // Aim by hit offset: outer thirds send the ball out steeply.
+        const int off = cc - e.paddle;
+        if (off < kPadW / 3) e.dc = -2;
+        else if (off >= 2 * kPadW / 3) e.dc = 2;
+        else e.dc = (e.dc >= 0) ? 1 : -1;
+      }
+    } else if (nr >= kPix - kBallSz) {
+      *terminated = true;  // ball lost below the paddle
+    }
+
+    e.ball_r = nr;
+    e.ball_c = nc;
+    push_frame(e);
+    return reward;
+  }
+};
+
 VecEnv* make_game(const char* task, int num_envs, int max_steps, uint64_t seed) {
   const std::string name(task ? task : "");
   if (name == "Breakout-minatar")
     return new BreakoutVec(num_envs, max_steps, seed);
+  if (name == "Breakout-atari")
+    return new BreakoutPixelVec(num_envs, max_steps, seed);
   if (name == "Asterix-minatar")
     return new AsterixVec(num_envs, max_steps, seed);
   if (name == "Freeway-minatar")

@@ -1,0 +1,112 @@
+"""Breakout-atari: the full-resolution 84x84x4 pixel workload from the native
+C++ pool — the reference's EnvPool-Atari observation shape (reference
+configs/env/envpool/*.yaml, stoix/wrappers/envpool.py:8-30) produced
+first-party. Covers the game contract (shape, frame-stack semantics, reward
+gradient between random and oracle play) and the end-to-end Sebulba CNN path
+at full resolution."""
+
+import numpy as np
+import pytest
+
+from stoix_tpu.envs.cvec import CVecPool
+
+
+def _track_ball_actions(view: np.ndarray) -> np.ndarray:
+    """Scripted oracle: move the paddle toward the ball's column."""
+    newest = view[..., -1]
+    acts = []
+    for i in range(view.shape[0]):
+        ball = np.argwhere(newest[i] == 1.0)
+        bc = ball[:, 1].mean() if len(ball) else 42.0
+        pad = np.argwhere(np.abs(newest[i] - 200.0 / 255.0) < 1e-3)
+        pc = pad[:, 1].mean() if len(pad) else 42.0
+        acts.append(0 if bc < pc - 1 else (2 if bc > pc + 1 else 1))
+    return np.asarray(acts, np.int32)
+
+
+def test_pixel_breakout_observation_contract():
+    pool = CVecPool("Breakout-atari", 4, seed=0, max_steps=500)
+    ts = pool.reset()
+    view = ts.observation.agent_view
+    assert view.shape == (4, 84, 84, 4)
+    assert view.dtype == np.float32
+    assert view.min() >= 0.0 and view.max() <= 1.0
+    # At reset every stacked channel repeats the serve frame (the envpool
+    # stacked-reset convention).
+    for s in range(3):
+        np.testing.assert_array_equal(view[..., s], view[..., s + 1])
+    # The frame actually contains sprites: ball (1.0), paddle (200/255),
+    # and the graded brick wall.
+    newest = view[0, :, :, -1]
+    assert (newest == 1.0).sum() >= 1
+    assert (np.abs(newest - 200.0 / 255.0) < 1e-3).sum() > 0
+    assert (newest > 0.4).sum() > 200  # brick band pixels
+
+
+def test_pixel_breakout_frame_stack_shifts():
+    pool = CVecPool("Breakout-atari", 2, seed=3, max_steps=500)
+    before = pool.reset().observation.agent_view
+    after = pool.step(np.ones((2,), np.int32)).observation.agent_view
+    # One step shifts the ring: new channels 0..2 are the old channels 1..3.
+    for s in range(3):
+        np.testing.assert_array_equal(after[..., s], before[..., s + 1])
+    # And the newest frame differs (the ball moved).
+    assert not np.array_equal(after[..., 3], before[..., 3])
+
+
+def test_pixel_breakout_reward_gradient():
+    """A ball-tracking oracle must far outscore random play — the learning
+    signal a CNN policy closes."""
+
+    def run(policy, seed):
+        pool = CVecPool("Breakout-atari", 8, seed=seed, max_steps=500)
+        ts = pool.reset()
+        rng = np.random.default_rng(seed)
+        rets = []
+        for _ in range(700):
+            view = ts.observation.agent_view
+            acts = policy(view, rng)
+            ts = pool.step(acts)
+            metrics = ts.extras["episode_metrics"]
+            done = metrics["is_terminal_step"]
+            if done.any():
+                rets += list(metrics["episode_return"][done])
+        return float(np.mean(rets)) if rets else 0.0
+
+    oracle = run(lambda v, rng: _track_ball_actions(v), seed=0)
+    random = run(lambda v, rng: rng.integers(0, 3, 8).astype(np.int32), seed=1)
+    assert oracle > 5.0, f"oracle too weak: {oracle}"
+    assert random < 1.0, f"random too strong: {random}"
+    assert oracle > 10 * max(random, 0.05)
+
+
+@pytest.mark.slow
+def test_sebulba_cnn_full_resolution_pixels(devices):
+    """End-to-end: Sebulba PPO with the Nature-DQN CNN torso trains on REAL
+    84x84x4 frames from the native pool — the full-resolution pixel workload
+    the reference runs through EnvPool (reference systems/ppo/sebulba/
+    ff_ppo.py + wrappers/envpool.py), with no fake anywhere in the loop."""
+    from stoix_tpu.systems.ppo.sebulba import ff_ppo
+    from stoix_tpu.utils import config as config_lib
+
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/sebulba/default_ff_ppo.yaml",
+        [
+            "env=breakout_pixel",
+            "network=cnn_atari",
+            "arch.total_num_envs=8",
+            "arch.total_timesteps=1024",
+            "arch.num_evaluation=1",
+            "arch.num_eval_episodes=2",
+            "system.rollout_length=8",
+            "system.epochs=1",
+            "arch.actor.device_ids=[0]",
+            "arch.actor.actor_per_device=1",
+            "arch.learner.device_ids=[1]",
+            "arch.evaluator_device_id=2",
+            "logger.use_console=False",
+        ],
+    )
+    ret = ff_ppo.run_experiment(cfg)
+    assert np.isfinite(ret)
