@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-5: full-resolution pixel learning AT DEPTH, the TPU-native way —
+# Anakin PPO on the pure-JAX Breakout-atari twin: env stepping, 84x84
+# rendering, and the Nature CNN fused into one on-device XLA program
+# (zero host<->device observation traffic; the Sebulba C++-pool variant is
+# tunnel-bandwidth-bound in this sandbox, ~14MB obs per pool step).
+cd /root/repo
+export QUEUE_OUT=docs/runs_tpu.jsonl
+export QUEUE_RUNNER=scripts/run_exp.py
+source "$(dirname "$0")/queue_lib.sh"
+
+run anakin_breakout_pixel_5m 60 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=breakout_pixel_jax \
+  network=cnn_atari arch.total_num_envs=256 arch.total_timesteps=5000000 \
+  system.rollout_length=16 logger.use_console=False
+
+echo '{"queue": "r5 pixel anakin done"}' >> "$QUEUE_OUT"

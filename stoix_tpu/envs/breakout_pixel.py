@@ -1,0 +1,243 @@
+"""Breakout-atari, implemented natively in JAX — the full-resolution pixel
+workload that runs ENTIRELY on-device.
+
+The reference's full-resolution Atari path ships 84x84x4 frames from an
+external C++ EnvPool to the device every step (reference
+stoix/wrappers/envpool.py:8-30, configs/env/envpool/*.yaml). This module is
+the TPU-native answer for the Anakin architecture: the same game as the
+native pool's "Breakout-atari" (envs/native/cvec.cpp BreakoutPixelVec),
+RULE FOR RULE, but rendered with vectorized jnp masks so rollout, rendering,
+and the Nature-CNN forward all fuse into one on-device XLA program — zero
+host<->device observation traffic. Stepping and rendering are bit-identical
+with the C++ engine GIVEN a serve index (pinned by the lockstep test in
+tests/test_breakout_pixel.py); serve selection is backend-local — the pool
+walks a deterministic per-env counter, this twin derives the index from the
+reset key so auto-reset episodes stay diverse (the MinAtar-twin precedent).
+
+Game (identical to the C++ twin): 84x84 playfield; 12x2 paddle at row 80
+moving +/-3 px/step (3 actions); 2x2 ball at 2 px/step with aim-by-hit-offset
+paddle control; 6x14 brick wall (6x3 px bricks, rows 18..35, 1-px right
+gutter, row-graded gray), +1 per brick, wall refreshes when cleared; losing
+the ball below the paddle terminates. Observations are a 4-frame grayscale
+stack in [0, 1], channels oldest->newest — the EnvPool-Atari tensor layout.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.envs import spaces
+from stoix_tpu.envs.core import Environment
+from stoix_tpu.envs.types import (
+    Observation,
+    TimeStep,
+    restart,
+    select_step,
+    termination,
+    transition,
+    truncation,
+)
+
+_PIX = 84
+_STACK = 4
+_PAD_W = 12
+_PAD_H = 2
+_PAD_ROW = 80
+_PAD_SPEED = 3
+_BALL = 2
+_BRICK_W = 6
+_BRICK_H = 3
+_BRICK_COLS = _PIX // _BRICK_W  # 14
+_BRICK_ROWS = 6
+_BRICK_TOP = 18
+_SERVE_RANGE = _PIX - 16 - _BALL + 1  # 67 (mirrors the C++ serve window)
+
+
+class BreakoutPixelState(NamedTuple):
+    key: jax.Array
+    ball_r: jax.Array  # [] int32, top-left of the 2x2 sprite
+    ball_c: jax.Array
+    dr: jax.Array  # {-2, +2}
+    dc: jax.Array  # {-2, -1, +1, +2}
+    paddle: jax.Array  # leftmost paddle column
+    serves: jax.Array  # episodes served — drives the deterministic serve
+    bricks: jax.Array  # [6, 14] int32 in {0, 1}
+    frames: jax.Array  # [84, 84, 4] float32 stack, channels oldest->newest
+    step_count: jax.Array
+
+
+def _render(ball_r, ball_c, paddle, bricks) -> jax.Array:
+    """Rasterize one 84x84 grayscale frame (vectorized mask composition)."""
+    r = jnp.arange(_PIX, dtype=jnp.int32)[:, None]
+    c = jnp.arange(_PIX, dtype=jnp.int32)[None, :]
+    # Brick wall: row-graded shade, 1-px right gutter per brick.
+    band_row = jnp.clip((r - _BRICK_TOP) // _BRICK_H, 0, _BRICK_ROWS - 1)
+    in_band = jnp.logical_and(
+        r >= _BRICK_TOP, r < _BRICK_TOP + _BRICK_ROWS * _BRICK_H
+    )
+    alive = bricks[band_row, c // _BRICK_W] == 1
+    gutter = (c % _BRICK_W) == (_BRICK_W - 1)
+    # Multiply by the reciprocal (not divide) so gray levels are bit-identical
+    # with the C++ pool's `uint8 * (1.0f / 255.0f)` conversion.
+    inv = jnp.float32(1.0 / 255.0)
+    shade = (110.0 + 20.0 * band_row.astype(jnp.float32)) * inv
+    frame = jnp.where(in_band & alive & ~gutter, shade, 0.0)
+    # Paddle.
+    pad = (
+        (r >= _PAD_ROW) & (r < _PAD_ROW + _PAD_H) & (c >= paddle) & (c < paddle + _PAD_W)
+    )
+    frame = jnp.where(pad, jnp.float32(200.0) * inv, frame)
+    # Ball, drawn last (on top).
+    ball = (r >= ball_r) & (r < ball_r + _BALL) & (c >= ball_c) & (c < ball_c + _BALL)
+    return jnp.where(ball, 1.0, frame)
+
+
+class BreakoutPixel(Environment):
+    """JAX twin of the native pool's Breakout-atari (see module docstring)."""
+
+    def __init__(self, max_steps: int = 500):
+        self._max_steps = int(max_steps)
+
+    def observation_space(self) -> Observation:
+        return Observation(
+            agent_view=spaces.Array((_PIX, _PIX, _STACK), jnp.float32),
+            action_mask=spaces.Array((3,), jnp.float32),
+            step_count=spaces.Array((), jnp.int32),
+        )
+
+    def action_space(self) -> spaces.Discrete:
+        return spaces.Discrete(3)
+
+    def _observe(self, state: BreakoutPixelState) -> Observation:
+        return Observation(
+            agent_view=state.frames,
+            action_mask=jnp.ones((3,), jnp.float32),
+            step_count=state.step_count,
+        )
+
+    def _serve(self, key: jax.Array, serves: jax.Array) -> BreakoutPixelState:
+        # Deterministic serve (mirrors cvec.cpp BreakoutPixelVec::reset_env):
+        # column walks the 67-wide range by a coprime stride, direction
+        # alternates with the serve counter.
+        k = serves.astype(jnp.int32)
+        ball_r = jnp.asarray(_BRICK_TOP + _BRICK_ROWS * _BRICK_H + 4, jnp.int32)
+        ball_c = (8 + (k * 37) % _SERVE_RANGE).astype(jnp.int32)
+        dc = jnp.where(k % 2 == 0, 1, -1).astype(jnp.int32)
+        paddle = jnp.asarray((_PIX - _PAD_W) // 2, jnp.int32)
+        bricks = jnp.ones((_BRICK_ROWS, _BRICK_COLS), jnp.int32)
+        frame = _render(ball_r, ball_c, paddle, bricks)
+        # The stacked reset repeats the serve frame (envpool convention).
+        frames = jnp.repeat(frame[:, :, None], _STACK, axis=2)
+        return BreakoutPixelState(
+            key=key,
+            ball_r=ball_r,
+            ball_c=ball_c,
+            dr=jnp.asarray(2, jnp.int32),
+            dc=dc,
+            paddle=paddle,
+            serves=k + 1,
+            bricks=bricks,
+            frames=frames,
+            step_count=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[BreakoutPixelState, TimeStep]:
+        # The serve index is key-derived so episodes stay diverse under the
+        # auto-reset wrappers (which call reset() with a fresh key each
+        # episode boundary) and across vmapped envs. Stepping/rendering are
+        # bit-identical with the C++ pool GIVEN a serve index (the lockstep
+        # test drives both engines through explicit indices); serve SELECTION
+        # is backend-local, as with the MinAtar twins.
+        serve = jax.random.randint(key, (), 0, 2 * _SERVE_RANGE, jnp.int32)
+        state = self._serve(key, serve)
+        ts = restart(self._observe(state))
+        ts.extras["truncation"] = jnp.zeros((), bool)
+        return state, ts
+
+    def step(
+        self, state: BreakoutPixelState, action: jax.Array
+    ) -> Tuple[BreakoutPixelState, TimeStep]:
+        # Mirrors cvec.cpp BreakoutPixelVec::step_env exactly.
+        paddle = jnp.clip(
+            state.paddle + (jnp.asarray(action, jnp.int32) - 1) * _PAD_SPEED,
+            0,
+            _PIX - _PAD_W,
+        )
+        nr = state.ball_r + state.dr
+        nc = state.ball_c + state.dc
+        dr, dc = state.dr, state.dc
+
+        # Side walls (reflective fold keeps motion exact at any speed).
+        dc = jnp.where(nc < 0, -dc, dc)
+        nc = jnp.where(nc < 0, -nc, nc)
+        over = nc > _PIX - _BALL
+        dc = jnp.where(over, -dc, dc)
+        nc = jnp.where(over, 2 * (_PIX - _BALL) - nc, nc)
+        # Ceiling.
+        ceil = nr < 0
+        dr = jnp.where(ceil, 2, dr)
+        nr = jnp.where(ceil, -nr, nr)
+
+        # Brick band: test the ball-center cell against the brick grid.
+        cr = nr + _BALL // 2
+        cc = nc + _BALL // 2
+        in_band = jnp.logical_and(
+            cr >= _BRICK_TOP, cr < _BRICK_TOP + _BRICK_ROWS * _BRICK_H
+        )
+        br = jnp.clip((cr - _BRICK_TOP) // _BRICK_H, 0, _BRICK_ROWS - 1)
+        bc = jnp.minimum(cc // _BRICK_W, _BRICK_COLS - 1)
+        hit = jnp.logical_and(in_band, state.bricks[br, bc] == 1)
+        bricks = state.bricks.at[br, bc].set(jnp.where(hit, 0, state.bricks[br, bc]))
+        reward = jnp.where(hit, 1.0, 0.0).astype(jnp.float32)
+        dr = jnp.where(hit, -dr, dr)
+        nr = jnp.where(hit, state.ball_r, nr)
+        # Wall cleared -> refresh (play continues).
+        bricks = jnp.where(jnp.any(bricks == 1), bricks, jnp.ones_like(bricks))
+
+        # Paddle-plane crossing (only tested when not in the brick band).
+        crossing = (
+            ~in_band
+            & (dr > 0)
+            & (nr + _BALL > _PAD_ROW)
+            & (state.ball_r + _BALL <= _PAD_ROW)
+        )
+        caught = crossing & (cc >= paddle) & (cc < paddle + _PAD_W)
+        dr = jnp.where(caught, -2, dr)
+        nr = jnp.where(caught, _PAD_ROW - _BALL, nr)
+        # Aim by hit offset: outer thirds send the ball out steeply.
+        off = cc - paddle
+        aimed_dc = jnp.where(
+            off < _PAD_W // 3,
+            -2,
+            jnp.where(off >= 2 * (_PAD_W // 3), 2, jnp.where(dc >= 0, 1, -1)),
+        )
+        dc = jnp.where(caught, aimed_dc, dc)
+        # Ball lost below the paddle (the final else branch in C++).
+        terminated = ~in_band & ~crossing & (nr >= _PIX - _BALL)
+
+        frame = _render(nr, nc, paddle, bricks)
+        frames = jnp.concatenate([state.frames[:, :, 1:], frame[:, :, None]], axis=2)
+        next_state = BreakoutPixelState(
+            key=state.key,
+            ball_r=nr,
+            ball_c=nc,
+            dr=dr,
+            dc=dc,
+            paddle=paddle,
+            serves=state.serves,
+            bricks=bricks,
+            frames=frames,
+            step_count=state.step_count + 1,
+        )
+        obs = self._observe(next_state)
+        truncated = jnp.logical_and(next_state.step_count >= self._max_steps, ~terminated)
+        ts = select_step(
+            terminated,
+            termination(reward, obs),
+            select_step(truncated, truncation(reward, obs), transition(reward, obs)),
+        )
+        ts.extras["truncation"] = truncated
+        return next_state, ts

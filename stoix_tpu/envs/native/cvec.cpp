@@ -772,6 +772,7 @@ struct BreakoutPixelVec : VecEnv {
     int ball_r, ball_c;   // top-left of the 2x2 ball sprite
     int dr, dc;           // velocity, px/step (dr in {-2,+2}, dc in {-2,-1,+1,+2})
     int paddle;           // leftmost column of the paddle
+    int serves;           // episodes served — drives the DETERMINISTIC serve
     uint8_t bricks[kBrickRowsPx * kBrickCols];
     uint8_t frames[kStack][kPix * kPix];  // grayscale ring buffer
     int head;                             // index of the OLDEST frame
@@ -779,7 +780,11 @@ struct BreakoutPixelVec : VecEnv {
   std::vector<EnvState> envs;
 
   BreakoutPixelVec(int n, int max_steps_, uint64_t seed)
-      : VecEnv(n, max_steps_, seed), envs(n) {}
+      : VecEnv(n, max_steps_, seed), envs(n) {
+    // Stagger the deterministic serve walk by env index so a fresh pool's
+    // envs start decorrelated (adjacent k values land 37 columns apart).
+    for (int i = 0; i < n; ++i) envs[i].serves = i;
+  }
 
   int obs_dim() const override { return kPix * kPix * kStack; }
   void obs_shape(int32_t* out3) const override {
@@ -818,12 +823,16 @@ struct BreakoutPixelVec : VecEnv {
 
   void reset_env(int i) override {
     EnvState& e = envs[i];
-    std::uniform_int_distribution<int> col(8, kPix - 8 - kBallSz);
-    std::uniform_int_distribution<int> dir(0, 1);
+    // DETERMINISTIC serve schedule (Asterix precedent): column walks the
+    // 67-wide serve range via a coprime stride, direction alternates. Keeps
+    // the pure-JAX twin (envs/breakout_pixel.py) bit-identical under
+    // lockstep with no shared RNG.
+    const int k = e.serves;
     e.ball_r = kBrickTop + kBrickRowsPx * kBrickH + 4;  // below the wall
-    e.ball_c = col(rng);
+    e.ball_c = 8 + (k * 37) % (kPix - 16 - kBallSz + 1);
     e.dr = 2;                                           // serve downward
-    e.dc = dir(rng) ? 1 : -1;
+    e.dc = (k % 2 == 0) ? 1 : -1;
+    e.serves = k + 1;
     e.paddle = (kPix - kPadW) / 2;
     std::fill(e.bricks, e.bricks + kBrickRowsPx * kBrickCols, uint8_t{1});
     e.head = 0;

@@ -10,7 +10,16 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from stoix_tpu.envs import classic, debug, doorkey, game2048, locomotion, minatar, snake
+from stoix_tpu.envs import (
+    breakout_pixel,
+    classic,
+    debug,
+    doorkey,
+    game2048,
+    locomotion,
+    minatar,
+    snake,
+)
 from stoix_tpu.envs.core import Environment
 from stoix_tpu.envs.wrappers import (
     EpisodeStepLimit,
@@ -32,6 +41,7 @@ ENV_REGISTRY: Dict[str, Callable[..., Environment]] = {
     "Walker2d": locomotion.Walker2d,
     "HalfCheetah": locomotion.HalfCheetah,
     "Breakout-minatar": minatar.Breakout,
+    "Breakout-atari": breakout_pixel.BreakoutPixel,
     "Asterix-minatar": minatar.Asterix,
     "Freeway-minatar": minatar.Freeway,
     "SpaceInvaders-minatar": minatar.SpaceInvaders,
