@@ -235,11 +235,18 @@ def main() -> None:
         return
 
     if pixel:
+        # Pixel frames are ~113KB/env/step host->device; size the run so a
+        # steady-state window closes within the watchdog even when the
+        # device link is a network tunnel (the full --sebulba shape's 524k
+        # steps never finished on the tunneled sandbox chip).
         _finish([
             _run_sebulba(
                 metric, smoke, n_devices,
                 env_overrides=["env=breakout_pixel", "network=cnn_atari"],
                 num_envs=16 if smoke else 128,
+                num_updates=4 if smoke else 16,
+                rollout_length=8 if smoke else 32,
+                num_evaluation=2 if smoke else 4,
                 pool_desc="84x84x4 C++ pixel pool, Nature CNN",
             )
         ])
@@ -411,6 +418,9 @@ def _run_sebulba(
     n_devices: int,
     env_overrides: list | None = None,
     num_envs: int | None = None,
+    num_updates: int | None = None,
+    rollout_length: int | None = None,
+    num_evaluation: int | None = None,
     pool_desc: str = "C++ pool",
 ) -> dict:
     """Sebulba PPO on the native C++ pool; steady-state SPS. Default workload
@@ -426,17 +436,21 @@ def _run_sebulba(
     learner_ids = [0] if n_devices == 1 else list(range(1, n_devices))
     overrides = [
         *(env_overrides or ["env=cartpole", "env.backend=cvec"]),
-        "arch.total_num_envs=%d" % (num_envs or (16 if smoke else 512)),
+        "arch.total_num_envs=%d"
+        % (num_envs if num_envs is not None else (16 if smoke else 512)),
         "arch.actor.device_ids=[0]",
         "arch.actor.actor_per_device=%d" % (1 if smoke else 2),
         "arch.learner.device_ids=%s" % str(learner_ids).replace(" ", ""),
         "arch.evaluator_device_id=0",
-        "arch.num_updates=%d" % (4 if smoke else 64),
+        "arch.num_updates=%d"
+        % (num_updates if num_updates is not None else (4 if smoke else 64)),
         "arch.total_timesteps=~",
-        "arch.num_evaluation=%d" % (2 if smoke else 8),
+        "arch.num_evaluation=%d"
+        % (num_evaluation if num_evaluation is not None else (2 if smoke else 8)),
         "arch.num_eval_episodes=8",
         "arch.absolute_metric=False",
-        "system.rollout_length=%d" % (8 if smoke else 64),
+        "system.rollout_length=%d"
+        % (rollout_length if rollout_length is not None else (8 if smoke else 64)),
         "logger.use_console=False",
     ]
     config = config_lib.compose(

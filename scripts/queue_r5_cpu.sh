@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 CPU-feasible wave (tunnel wedged again at ~04:36Z): own lock so a
+# healed tunnel's chip queue is never blocked behind multi-hour CPU runs.
+# Order: bounded CNN-beats-flat-MLP evidence first (VERDICT r4 item 5 CPU
+# fallback), then the sampled-search stability budgets (item 2).
+cd /root/repo
+export QUEUE_OUT=docs/runs_r5.jsonl
+export QUEUE_LOCK=/tmp/stoix_cpu_queue.lock
+export QUEUE_RUNNER=scripts/cpu_run.py
+source "$(dirname "$0")/queue_lib.sh"
+
+run ppo_spaceinvaders_cnn_cpu 150 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=space_invaders network=cnn \
+  'env.wrapper.flatten_observation=false' arch.total_timesteps=3000000 \
+  logger.use_console=False
+
+run sampled_mz_s50k8_5m_cpu 330 --module stoix_tpu.systems.search.ff_sampled_mz \
+  --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
+  arch.total_timesteps=5000000 logger.use_console=False
+
+run sampled_az_s50k8_8m_cpu 330 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_timesteps=8000000 logger.use_console=False
+
+echo '{"queue": "r5 cpu wave done"}' >> "$QUEUE_OUT"

@@ -1,22 +1,33 @@
 #!/bin/bash
-# TPU validation queue — fire when the tunnel is healthy again.
-# Everything here is blocked on real-chip throughput: CNN workloads (CPU is
-# ~100x too slow), locomotion gait emergence (needs 10-30M steps), and the
-# long sampled-search budgets. Serialized via the shared flock; every run
-# wrapped in the watchdog (wedge-safe per the tunnel rules).
-#
-# Usage: probe first, then  nohup bash scripts/tpu_queue.sh &
-#   python - <<'EOF'
-#   import jax, jax.numpy as jnp
-#   print(jax.devices()); print(float((jnp.ones((256,256)) @ jnp.ones((256,256))).sum()))
-#   EOF
+# TPU validation queue — REMAINING round-5 chip work; fired by tpu_watch.sh
+# the moment the tunnel answers a probe. (The 03:45-04:35Z healthy window
+# already captured bench.py --all full shapes at HEAD: PPO/ant 1.03M
+# steps/s + first chip numbers for all five tracked configs.)
 cd /root/repo
 export QUEUE_OUT=docs/runs_tpu.jsonl
-# Ambient-platform launcher: run_exp.py uses the TPU when healthy.
 export QUEUE_RUNNER=scripts/run_exp.py
 source "$(dirname "$0")/queue_lib.sh"
 
-# 1. Locomotion at brax-class budgets (minutes per run on the chip).
+# 0. The on-device full-resolution pixel run (zero-transfer JAX twin).
+run anakin_breakout_pixel_5m 60 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=breakout_pixel_jax \
+  network=cnn_atari arch.total_num_envs=256 arch.total_timesteps=5000000 \
+  system.rollout_length=16 logger.use_console=False
+
+# 1. MinAtar CNN workloads.
+run ppo_breakout_minatar 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=breakout_jax network=cnn \
+  arch.total_timesteps=5000000 logger.use_console=False
+run ppo_spaceinvaders_cnn 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
+  --default default/anakin/default_ff_ppo.yaml env=space_invaders network=cnn \
+  'env.wrapper.flatten_observation=false' arch.total_timesteps=5000000 \
+  logger.use_console=False
+run dqn_snake_cnn 45 --module stoix_tpu.systems.q_learning.ff_dqn \
+  --default default/anakin/default_ff_dqn.yaml env=snake network=cnn_dqn \
+  'env.wrapper.flatten_observation=false' arch.total_timesteps=2000000 \
+  logger.use_console=False
+
+# 2. Locomotion at brax-class budgets.
 run ppo_ant_30m 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuous \
   --default default/anakin/default_ff_ppo_continuous.yaml env=ant \
   arch.total_timesteps=30000000 system.normalize_observations=true \
@@ -33,40 +44,25 @@ run ppo_halfcheetah_20m 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo_continuo
   arch.total_timesteps=20000000 system.normalize_observations=true \
   logger.use_console=False
 
-# 2. CNN workloads (held off CPU entirely).
-run dqn_snake_cnn 45 --module stoix_tpu.systems.q_learning.ff_dqn \
-  --default default/anakin/default_ff_dqn.yaml env=snake network=cnn_dqn \
-  'env.wrapper.flatten_observation=false' arch.total_timesteps=2000000 \
-  logger.use_console=False
-run ppo_breakout_minatar 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
-  --default default/anakin/default_ff_ppo.yaml env=breakout_jax network=cnn \
-  arch.total_timesteps=5000000 logger.use_console=False
-
-run ppo_spaceinvaders_cnn 45 --module stoix_tpu.systems.ppo.anakin.ff_ppo \
-  --default default/anakin/default_ff_ppo.yaml env=space_invaders network=cnn \
-  'env.wrapper.flatten_observation=false' arch.total_timesteps=5000000 \
-  logger.use_console=False
-
-# 3. Sampled search at real budgets (r3 trend extrapolates to solved at
-# 5-10M; K=16 samples is the next lever if 5M stalls).
-run sampled_az_5m 60 --module stoix_tpu.systems.search.ff_sampled_az \
-  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
-  arch.total_timesteps=5000000 logger.use_console=False
-run sampled_mz_5m 60 --module stoix_tpu.systems.search.ff_sampled_mz \
+# 3. Sampled search at real budgets (sims-50/K=8 defaults).
+run sampled_mz_s50k8_5m_chip 60 --module stoix_tpu.systems.search.ff_sampled_mz \
   --default default/anakin/default_ff_sampled_mz.yaml env=pendulum \
   arch.total_timesteps=5000000 logger.use_console=False
+run sampled_az_s50k8_8m_chip 90 --module stoix_tpu.systems.search.ff_sampled_az \
+  --default default/anakin/default_ff_sampled_az.yaml env=pendulum \
+  arch.total_timesteps=8000000 logger.use_console=False
 
-# 3b. SPO at the reference replay intensity (epochs 128 on-chip).
+# 3b. SPO at the reference replay intensity.
 run spo_cont_pendulum_chip 60 --module stoix_tpu.systems.spo.ff_spo_continuous \
   --default default/anakin/default_ff_spo_continuous.yaml env=pendulum \
   arch.total_num_envs=64 arch.total_timesteps=2000000 system.epochs=128 \
   logger.use_console=False
 
-# 4. Fresh chip throughput numbers for the record: all five tracked BASELINE
-# configs in one invocation (one JSON line per config). 7000s outer timeout:
-# bench.py's --all worst case is the 3400s device watchdog PLUS a 3000s
-# CPU-fallback subprocess.
-run_bench bench_all 7000 --all
-run_bench bench_ant_large 3900 --large
+# 4. The tunnel-feasible Sebulba pixel bench shape.
+run_bench bench_pixel_chip_v2 1900 --pixel
 
-echo '{"queue": "tpu queue done"}' >> "$QUEUE_OUT"
+# 5. The MXU-bound large-model shape (its only recorded result so far is a
+# CPU fallback from the 04:36Z wedge).
+run_bench bench_ant_large_chip_v2 3900 --large
+
+echo '{"queue": "tpu queue (r5 remaining) done"}' >> "$QUEUE_OUT"
