@@ -317,6 +317,49 @@ def _timed_anakin_run(config, learner_setup, smoke: bool):
     return steps_per_call / min(times)
 
 
+def _phase_breakdown_probe(
+    default_yaml: str, setup_module: str, env_overrides: list, smoke: bool, n_devices: int
+) -> dict:
+    """Run ONE tiny experiment through the pipelined Anakin runner to capture
+    the per-phase host-loop breakdown (compile_s/learn_s/eval_s/fetch_s/
+    ckpt_s). The headline SPS stays the timed learn-loop measurement; this
+    probe is what surfaces where host time goes per eval window. Failures are
+    reported in-band (zeroed phases + probe_error) — the bench contract is
+    JSON lines, never a traceback."""
+    import importlib
+
+    from stoix_tpu.systems import runner as anakin_runner
+    from stoix_tpu.utils import config as config_lib
+
+    try:
+        overrides = list(env_overrides) + [
+            "arch.total_num_envs=%d" % (8 * n_devices),
+            "system.rollout_length=8",
+            "arch.num_updates=%d" % (2 * (2 if smoke else 8)),
+            "arch.total_timesteps=~",
+            "arch.num_evaluation=2",
+            "arch.num_eval_episodes=%d" % n_devices,
+            "arch.eval_max_steps=128",
+            "arch.absolute_metric=False",
+            "logger.use_console=False",
+        ]
+        config = config_lib.compose(
+            config_lib.default_config_dir(), default_yaml, overrides
+        )
+        module = importlib.import_module(setup_module)
+        anakin_runner.run_anakin_experiment(config, module.learner_setup)
+        stats = anakin_runner.LAST_RUN_STATS
+        return {**stats["phase_breakdown"], "steady_state_sps": round(
+            float(stats["steady_state_sps"]), 1
+        )}
+    except Exception as exc:  # noqa: BLE001 — reported in-band, never raised
+        return {
+            "compile_s": 0.0, "learn_s": 0.0, "eval_s": 0.0,
+            "fetch_s": 0.0, "ckpt_s": 0.0, "steady_state_sps": 0.0,
+            "probe_error": f"{type(exc).__name__}: {exc}",
+        }
+
+
 def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
     from stoix_tpu.utils import config as config_lib
 
@@ -333,13 +376,16 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
     ]
     if not cartpole:
         overrides.append("env=ant")
+    probe_overrides = [] if cartpole else ["env=ant"]
     if large:
-        overrides += [
+        large_overrides = [
             "network.actor_network.pre_torso.layer_sizes=[1024,1024]",
             "network.actor_network.pre_torso.compute_dtype=bfloat16",
             "network.critic_network.pre_torso.layer_sizes=[1024,1024]",
             "network.critic_network.pre_torso.compute_dtype=bfloat16",
         ]
+        overrides += large_overrides
+        probe_overrides += large_overrides  # phase attribution for the SAME regime
     default_yaml = (
         "default/anakin/default_ff_ppo.yaml"
         if cartpole
@@ -362,6 +408,11 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
         # The baseline is defined for the tracked ant config only.
         "vs_baseline": (
             None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
+        ),
+        # Host-loop phase attribution from a tiny pipelined-runner probe run
+        # (2 eval windows); see systems/runner.py LAST_RUN_STATS.
+        "phase_breakdown": _phase_breakdown_probe(
+            default_yaml, learner_setup.__module__, probe_overrides, smoke, n_devices,
         ),
     }
 

@@ -9,7 +9,12 @@ pyproject.toml, so installing them upgrades the gate with zero changes here):
   1. syntax: every file must compile (py_compile);
   2. unused imports (AST-based, flake8 F401 equivalent; `# noqa` respected);
   3. hygiene: no tabs in indentation, no trailing whitespace, max line
-     length 100 (warnings only).
+     length 100 (warnings only);
+  4. host-sync ownership (STX001): Anakin system files must not call
+     `jax.block_until_ready` / `checkpointer.wait()` / `wait_until_finished`
+     — the pipelined runner (systems/runner.py) owns ALL host-sync points, so
+     future systems stay off the accelerator critical path by construction
+     (Sebulba files are exempt: their actor/learner threads own their syncs).
 
 Exit code 0 = clean, 1 = findings. Run: python scripts/lint.py [paths...]
 """
@@ -117,6 +122,48 @@ def check_hygiene(path: str, source: str) -> Tuple[List[str], List[str]]:
     return errors, warnings
 
 
+# Host-sync calls that stall the accelerator; only the shared runner (which
+# schedules them off the critical path) may contain them. Sebulba system files
+# are exempt — their actor/learner threads own their own sync points.
+_HOST_SYNC_OWNER = os.path.join("stoix_tpu", "systems", "runner.py")
+
+
+def _is_host_sync_call(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("block_until_ready", "wait_until_finished"):
+            return True
+        # <anything named like a checkpointer>.wait(...)
+        if fn.attr == "wait" and isinstance(fn.value, ast.Name):
+            return "checkpoint" in fn.value.id.lower()
+        return False
+    return isinstance(fn, ast.Name) and fn.id == "block_until_ready"
+
+
+def check_host_sync_ownership(path: str, source: str, tree: ast.AST) -> List[str]:
+    rel = os.path.relpath(path, REPO)
+    systems_prefix = os.path.join("stoix_tpu", "systems") + os.sep
+    if not rel.startswith(systems_prefix) or rel == _HOST_SYNC_OWNER:
+        return []
+    if "sebulba" in rel.split(os.sep):
+        return []
+    lines = source.splitlines()
+    findings = []
+    # AST-based (not substring): docstrings/comments DISCUSSING these calls
+    # must not trip the gate.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_host_sync_call(node):
+            continue
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        if "noqa" in line:
+            continue
+        findings.append(
+            f"{rel}:{node.lineno}: host-sync call in an Anakin system file — the "
+            f"pipelined runner (systems/runner.py) owns all host-sync points (STX001)"
+        )
+    return findings
+
+
 def run_external(tool: str, args: List[str]) -> List[str]:
     try:
         __import__(tool)
@@ -149,6 +196,7 @@ def main(argv: List[str]) -> int:
             continue
         tree = ast.parse(source)
         errors.extend(check_unused_imports(path, source, tree))
+        errors.extend(check_host_sync_ownership(path, source, tree))
         errs, warns = check_hygiene(path, source)
         errors.extend(errs)
         warnings.extend(warns)

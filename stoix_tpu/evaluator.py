@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from stoix_tpu.envs.core import Environment
+from stoix_tpu.parallel.mesh import shard_map
 
 # act_fn(params, observation, key) -> action  (single unbatched observation)
 ActFn = Callable[[Any, Any, jax.Array], jax.Array]
@@ -176,7 +177,7 @@ def get_ff_evaluator_fn(
         return jax.vmap(eval_one_episode, in_axes=(None, 0, 0))(params, keys, idxs)
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             _shard_eval,
             mesh=mesh,
             in_specs=(P(), P("data"), P("data")),
@@ -189,6 +190,10 @@ def get_ff_evaluator_fn(
         keys = jax.random.split(key, episodes_global)
         return sharded(params, keys, jnp.arange(episodes_global))
 
+    # Pure-JAX and stateless: the runner may inline this into the jitted learn
+    # program under arch.fused_eval (RNN/stateful evaluators never set this —
+    # they fall back to the snapshot-overlap path, systems/runner.py).
+    evaluator.supports_fusion = True
     return evaluator
 
 
@@ -275,7 +280,7 @@ def get_rnn_evaluator_fn(
         return jax.vmap(eval_one_episode, in_axes=(None, 0, 0))(params, keys, idxs)
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             _shard_eval, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P("data"),
             check_vma=False,
         )

@@ -124,14 +124,18 @@ def _fold_heads(x: jax.Array, b: int, h: int, d: int) -> jax.Array:
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
 
 
-def _out_vma(*arrays: jax.Array) -> frozenset:
-    """Union of the inputs' varying-mesh-axes: under shard_map (where vma
-    checking applies) a pallas_call's out_shape must state how the output
-    varies; it varies wherever any input does. Empty outside shard_map."""
+def _out_struct(shape, dtype, *arrays: jax.Array) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct for a pallas_call out_shape, carrying the union of
+    the inputs' varying-mesh-axes where this JAX tracks them: under shard_map
+    (where vma checking applies) the out_shape must state how the output
+    varies; it varies wherever any input does. Legacy JAX (no `jax.typeof`,
+    no `vma=` kwarg) validates with check_rep instead and needs neither."""
+    if not hasattr(jax, "typeof"):
+        return jax.ShapeDtypeStruct(shape, dtype)
     vma: frozenset = frozenset()
     for a in arrays:
         vma = vma | getattr(jax.typeof(a), "vma", frozenset())
-    return vma
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
 def _pad_axis(x: jax.Array, axis: int, multiple: int) -> jax.Array:
@@ -182,9 +186,7 @@ def flash_attention(
             pl.BlockSpec((None, s_kv_pad, d), lambda i, j: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct(
-            (b * h, s_q_pad, d), q.dtype, vma=_out_vma(qf, kf, vf)
-        ),
+        out_shape=_out_struct((b * h, s_q_pad, d), q.dtype, qf, kf, vf),
         interpret=interpret,
     )(qf, kf, vf)
 
@@ -286,7 +288,6 @@ def flash_attention_chunk(
     kernel = functools.partial(
         _flash_chunk_kernel, scale=scale, block_k=block_k, causal=causal
     )
-    vma = _out_vma(qf, kf, vf, qpos, kpos)
     pv, m, l = pl.pallas_call(
         kernel,
         grid=(b * h, s_q // block_q),
@@ -303,9 +304,9 @@ def flash_attention_chunk(
             pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_q, d), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32, vma=vma),
+            _out_struct((b * h, s_q, d), jnp.float32, qf, kf, vf, qpos, kpos),
+            _out_struct((b * h, s_q, 1), jnp.float32, qf, kf, vf, qpos, kpos),
+            _out_struct((b * h, s_q, 1), jnp.float32, qf, kf, vf, qpos, kpos),
         ],
         interpret=interpret,
     )(qf, kf, vf, qpos, kpos)

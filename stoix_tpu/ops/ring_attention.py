@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from stoix_tpu.parallel.mesh import shard_map
+
 
 def full_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
@@ -109,10 +111,13 @@ def ring_attention(
     m_acc = jnp.full((b, h, s), -jnp.inf, jnp.float32)  # running max
     l_acc = jnp.zeros((b, h, s), jnp.float32)  # running normalizer
     o_acc = jnp.zeros((b, s, h, d), jnp.float32)  # unnormalized output
-    vma = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
-    m_acc, l_acc, o_acc = jax.lax.pcast(
-        (m_acc, l_acc, o_acc), vma, to="varying"
-    )
+    if hasattr(jax, "typeof") and hasattr(jax.lax, "pcast"):
+        # Legacy JAX has neither vma tracking nor pcast; its check_rep
+        # validation needs no varying-ness cast here.
+        vma = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
+        m_acc, l_acc, o_acc = jax.lax.pcast(
+            (m_acc, l_acc, o_acc), vma, to="varying"
+        )
 
     q_pos = my_idx * s_local + jnp.arange(s_local)  # global query positions
 
@@ -176,7 +181,7 @@ def make_ring_attention(mesh: Mesh, axis: str = "data", causal: bool = False):
     seq_spec = P(None, axis)
 
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             partial(ring_attention, axis_name=axis, causal=causal),
             mesh=mesh,
             in_specs=(seq_spec, seq_spec, seq_spec),

@@ -6,6 +6,9 @@ from stoix_tpu.parallel.distributed import (
 from stoix_tpu.parallel.mesh import (
     assemble_global_array,
     fetch_global,
+    fetch_global_async,
+    materialize,
+    shard_map,
     axis_size,
     create_mesh,
     data_sharding,
@@ -20,6 +23,9 @@ __all__ = [
     "process_allgather",
     "assemble_global_array",
     "fetch_global",
+    "fetch_global_async",
+    "materialize",
+    "shard_map",
     "axis_size",
     "create_mesh",
     "data_sharding",

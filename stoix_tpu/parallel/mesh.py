@@ -25,6 +25,32 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def shard_map(fn, mesh: Mesh, in_specs: Any, out_specs: Any, check_vma: bool = True):
+    """`jax.shard_map` across JAX versions.
+
+    Newer JAX exposes `jax.shard_map` with a `check_vma` validation toggle;
+    older releases ship it as `jax.experimental.shard_map.shard_map` where the
+    same toggle is spelled `check_rep`. Every stoix_tpu shard_map goes through
+    this seam so the whole stack runs on both.
+
+    Legacy caveat: old shard_map's autodiff TRANSPOSES a loss-level cross-shard
+    pmean/psum to an axis-size-scaled gradient (2x on a 2-shard axis,
+    regardless of check_rep). Differentiate per-shard and pmean the GRADS —
+    the pattern every stoix_tpu learner uses — which is exact on both APIs;
+    tests/test_tp.py::test_backward_matches_oracle covers the unsupported
+    pattern and is skipped on legacy JAX.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    return _legacy_shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )
+
+
 def create_mesh(
     axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:
@@ -104,18 +130,21 @@ _FETCH_GLOBAL_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
 _FETCH_GLOBAL_CACHE_SIZE = 64
 
 
-def fetch_global(tree: Any, mesh: Mesh) -> Any:
-    """Bring (possibly sharded) global arrays to the host as numpy.
+def fetch_global_async(tree: Any, mesh: Mesh) -> Any:
+    """DISPATCH the device half of a global fetch without touching the host.
 
-    Single-process: plain device fetch. Multi-process: replicate via an
-    all-gather-shaped jit first (sharded globals span non-addressable devices
-    and cannot be fetched directly) — every process must call this, it runs a
-    collective. Distinct from distributed.process_allgather, which gathers
-    HOST-LOCAL values. The jitted identity is memoized per tree signature so
+    Single-process: the tree is returned as-is — device arrays fetch directly
+    at materialize() time. Multi-process: enqueue the replicate collective
+    (sharded globals span non-addressable devices and cannot be fetched
+    directly) and return the still-on-device replicated tree; every process
+    must call this, it runs a collective. Splitting dispatch from the host
+    copy lets the pipelined Anakin host loop enqueue the collective BEFORE the
+    next `learn` dispatch, so materialize() never queues behind a full
+    training window. The jitted identity is memoized per tree signature so
     repeated host-loop calls hit the compile cache.
     """
     if jax.process_count() == 1:
-        return jax.tree.map(np.asarray, tree)
+        return tree
     leaves, treedef = jax.tree.flatten(tree)
     cache_key = (treedef, tuple((l.shape, str(l.dtype)) for l in leaves), id(mesh))
     fn = _FETCH_GLOBAL_CACHE.get(cache_key)
@@ -127,4 +156,21 @@ def fetch_global(tree: Any, mesh: Mesh) -> Any:
         _FETCH_GLOBAL_CACHE[cache_key] = fn
     else:
         _FETCH_GLOBAL_CACHE.move_to_end(cache_key)
-    return jax.tree.map(np.asarray, fn(tree))
+    return fn(tree)
+
+
+def materialize(tree: Any) -> Any:
+    """Host-materialize a (possibly in-flight) device tree as numpy — the
+    blocking half of fetch_global_async. Blocks only until the arrays' own
+    producers finish, not until the whole device queue drains."""
+    return jax.tree.map(np.asarray, tree)
+
+
+def fetch_global(tree: Any, mesh: Mesh) -> Any:
+    """Bring (possibly sharded) global arrays to the host as numpy.
+
+    Distinct from distributed.process_allgather, which gathers HOST-LOCAL
+    values. Synchronous convenience wrapper; the pipelined host loop uses the
+    fetch_global_async / materialize halves separately.
+    """
+    return materialize(fetch_global_async(tree, mesh))

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput
+from stoix_tpu.parallel.mesh import shard_map
 
 
 def head_kwargs_for_env(head_cfg: Any, env: envs.Environment) -> dict:
@@ -98,12 +99,21 @@ def shardmap_learner(
     copies live across the update. Validated on a healthy v5e runtime
     (round 2); an earlier WEDGED tunneled runtime deadlocked with donation on,
     so STOIX_TPU_NO_DONATE=1 is the kill-switch for broken runtimes.
+
+    Snapshot-vs-donation invariant (the pipelined runner depends on it):
+    anything read AFTER the next `learn(state)` dispatch — eval params, best
+    params, the checkpoint state — must be an on-device COPY taken from the
+    device stream BEFORE that dispatch (systems/runner.py _tree_copy). The
+    copy is enqueued ahead of the donating program, so the runtime orders the
+    read before the buffers are reused; reading the donated tree itself after
+    the dispatch is a use-after-free. tests/test_runner_pipeline.py guards
+    this with donation on and off.
     """
     import os
 
     donate = {} if os.environ.get("STOIX_TPU_NO_DONATE") else {"donate_argnums": (0,)}
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             learn_per_shard,
             mesh=mesh,
             in_specs=(state_specs,),

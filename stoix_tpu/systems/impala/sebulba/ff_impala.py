@@ -20,6 +20,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
 from stoix_tpu.ops import running_statistics
 from stoix_tpu.ops.multistep import vtrace_td_error_and_advantage
+from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.systems.ppo.sebulba.ff_ppo import CoreLearnerState, run_experiment as _run
 from stoix_tpu.utils import config as config_lib
 
@@ -149,7 +150,7 @@ def get_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: M
         return CoreLearnerState(params, opt_states, state.key, obs_stats), metrics
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             per_shard,
             mesh=mesh,
             in_specs=(CoreLearnerState(P(), P(), P(), P()), P(None, "data")),

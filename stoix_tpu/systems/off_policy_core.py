@@ -19,6 +19,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput, OffPolicyLearnerState, Transition
 from stoix_tpu.buffers import make_item_buffer
+from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.systems import anakin
 from stoix_tpu.utils.jax_utils import tree_merge_leading_dims
 
@@ -216,7 +217,7 @@ def wrap_learn_and_warmup(
         )
 
     warmup = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_shard_warmup, mesh=mesh, in_specs=(state_specs,),
             # Same Anakin opt-out as systems/anakin.py: the in-shard
             # update-batch vmap axis' pmean fails check_vma's internal

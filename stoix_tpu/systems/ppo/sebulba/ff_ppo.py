@@ -35,6 +35,7 @@ from stoix_tpu.evaluator import get_distribution_act_fn, get_ff_evaluator_fn
 from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.parallel import assemble_global_array
+from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.sebulba.core import (
     AsyncEvaluator,
     OnPolicyPipeline,
@@ -185,7 +186,7 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
         return CoreLearnerState(params, opt_states, key, obs_stats), metrics
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             per_shard,
             mesh=mesh,
             in_specs=(CoreLearnerState(P(), P(), P(), P()), P(None, "data")),

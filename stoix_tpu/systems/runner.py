@@ -1,15 +1,58 @@
-"""Shared Anakin host loop.
+"""Shared Anakin host loop — a PIPELINED dispatcher.
 
 The reference repeats `run_experiment` in every system file (deliberate
 duplication, reference README.md:50-52); here the host loop — the part that is
 genuinely identical across systems — is shared, while each system file keeps
 its full learner (`get_learner_fn`) and setup (`learner_setup`) for
-hackability. The loop matches reference ff_ppo.py:554-705: learn / log /
-evaluate / checkpoint / absolute metric.
+hackability.
+
+The Podracer/Anakin promise is that the accelerator never idles, yet the
+original synchronous loop serialized every eval window:
+
+    learn -> block_until_ready -> 2x collective fetch -> eval launch
+          -> checkpointer.save + wait  (state donated to the next learn)
+
+Every host-side phase in that chain was dead accelerator time. This loop is a
+one-window-deep software pipeline instead. Per eval window it DISPATCHES
+
+    learn_k -> snapshot_k (on-device params/state copy) -> eval_k
+            -> fetch_k (ONE coalesced collective over episode+train+eval
+               metrics)
+
+and only THEN processes window k-1 on the host (materialize metrics, log,
+update best params, hand the checkpoint snapshot to orbax). JAX async dispatch
+overlaps all of that host work with the device executing window k. The
+invariants that make it legal:
+
+  * Donation stays legal: `snapshot_k` is a fresh on-device copy taken from
+    the stream BEFORE `learn_{k+1}` is dispatched, so eval, best-params
+    tracking, and orbax serialization read buffers no later program donates.
+    The forced `checkpointer.wait()` on the hot path is gone — async saves
+    serialize the snapshot, not the donated state (utils/checkpointing.py).
+  * Bit-identical training: the sequence of `learn` calls, their inputs, and
+    the per-window eval key splits are exactly those of the synchronous loop
+    (`arch.pipelined_loop=false` keeps that loop as a debug fallback;
+    tests/test_runner_pipeline.py pins trajectory equality).
+  * The learner is AOT-compiled (utils/jax_utils.aot_warmup) before the timed
+    loop, so the first window's logged steps_per_second no longer includes
+    XLA compile time; `LAST_RUN_STATS["steady_state_sps"]` additionally
+    reports the post-first-window rate.
+
+`arch.fused_eval` folds a fusion-capable (FF) evaluator INTO the jitted learn
+program — classic Anakin, one XLA launch per window; RNN/stateful evaluators
+fall back to the snapshot-overlap path automatically.
+
+Observability: per-phase host-side wall time (learn_s/eval_s/fetch_s/ckpt_s +
+compile_s) accumulates into `LAST_RUN_STATS["phase_breakdown"]` (bench.py
+forwards it), and STOIX_TPU_PROFILE_DIR=<dir> wraps one steady-state eval
+window in `jax.profiler.trace`. In the pipelined loop the phases are HOST
+attribution: device time spent in learn/eval surfaces as fetch_s (the
+materialize wait), while learn_s/eval_s shrink to dispatch cost.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, NamedTuple, Optional
 
@@ -18,10 +61,23 @@ import jax.numpy as jnp
 
 from stoix_tpu import envs
 from stoix_tpu.evaluator import evaluator_setup, get_rnn_evaluator_fn
-from stoix_tpu.parallel import create_mesh, fetch_global, is_coordinator, maybe_initialize_distributed
+from stoix_tpu.parallel import (
+    create_mesh,
+    fetch_global,
+    fetch_global_async,
+    is_coordinator,
+    materialize,
+    maybe_initialize_distributed,
+)
 from stoix_tpu.utils.checkpointing import checkpointer_from_config
+from stoix_tpu.utils.jax_utils import aot_warmup
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
 from stoix_tpu.utils.timestep_checker import check_total_timesteps
+
+# Stats of the most recent run_anakin_experiment call (this process):
+# phase_breakdown {compile_s, learn_s, eval_s, fetch_s, ckpt_s},
+# steady_state_sps, pipelined, fused_eval. bench.py reads this.
+LAST_RUN_STATS: dict = {}
 
 
 class AnakinSetup(NamedTuple):
@@ -34,6 +90,29 @@ class AnakinSetup(NamedTuple):
 
 
 SetupFn = Callable[[envs.Environment, Any, Any, jax.Array], AnakinSetup]
+
+
+class _Window(NamedTuple):
+    """Everything dispatched for one eval window, processed one iteration
+    later (pipelined) or immediately (synchronous fallback)."""
+
+    eval_idx: int
+    t: int  # global env-step count at window end
+    snapshot: Any  # on-device copy of eval params (donation-safe)
+    ckpt_state: Any  # on-device copy of the full learner state, or None
+    metrics: Any  # ONE coalesced device tree: episode/train/eval metrics
+
+
+# ONE jit instance so per-window snapshot copies hit the compile cache
+# (jax.jit memoizes per input tree structure/avals).
+_TREE_COPY = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+
+def _tree_copy(tree: Any) -> Any:
+    """On-device snapshot: a jitted whole-tree copy (shardings preserved).
+    The copy is enqueued in the device stream BEFORE the next learn dispatch,
+    so donating the source buffers afterwards is legal."""
+    return _TREE_COPY(tree)
 
 
 def run_anakin_experiment(
@@ -89,50 +168,183 @@ def run_anakin_experiment(
         * int(config.arch.total_num_envs)
         * int(config.arch.num_updates_per_eval)
     )
+    num_evaluation = int(config.arch.num_evaluation)
 
-    best_params = jax.tree.map(jnp.copy, setup.eval_params_fn(learner_state))
+    pipelined = bool(config.arch.get("pipelined_loop", True))
+    fused = bool(config.arch.get("fused_eval", False)) and getattr(
+        evaluator, "supports_fusion", False
+    )
+    # arch.ckpt_snapshot=false: memory fallback for states too big to copy
+    # (off-policy replay buffers near HBM capacity). No on-device snapshot is
+    # taken; the loop runs synchronously and saves the LIVE state + wait()
+    # before the next donating dispatch — the pre-pipeline semantics.
+    snapshot_ckpt = bool(config.arch.get("ckpt_snapshot", True))
+    if checkpointer is not None and not snapshot_ckpt:
+        pipelined = False
+
+    learn = setup.learn
+    phases = {"compile_s": 0.0, "learn_s": 0.0, "eval_s": 0.0, "fetch_s": 0.0, "ckpt_s": 0.0}
+
+    if fused:
+        # One XLA program per window: learn + eval-params selection + the FF
+        # evaluator, donated like the bare learner. The system's jit wrapper
+        # is unwrapped so donation lives ONLY on this outer jit.
+        learn_inner = getattr(learn, "__wrapped__", learn)
+        donate = {} if os.environ.get("STOIX_TPU_NO_DONATE") else {"donate_argnums": (0,)}
+
+        def _fused_step(state: Any, eval_key: jax.Array):
+            output = learn_inner(state)
+            eval_metrics = evaluator(setup.eval_params_fn(output.learner_state), eval_key)
+            return output, eval_metrics
+
+        fused_step = jax.jit(_fused_step, **donate)
+
+    # AOT warmup: pay the learner's XLA compile before the timed loop so the
+    # first window's steps_per_second is throughput, not compile time.
+    t0 = time.perf_counter()
+    if fused:
+        # Aval-identical stand-in for the per-window eval keys below.
+        example_key = jax.random.split(jax.random.PRNGKey(0))[1]
+        fused_step = aot_warmup(fused_step, learner_state, example_key)
+    else:
+        learn = aot_warmup(learn, learner_state)
+    phases["compile_s"] = time.perf_counter() - t0
+
+    best_params = _tree_copy(setup.eval_params_fn(learner_state))
     best_return = -jnp.inf
     final_return = 0.0
 
-    for eval_idx in range(int(config.arch.num_evaluation)):
-        start = time.time()
-        output = setup.learn(learner_state)
-        jax.block_until_ready(output.learner_state)
+    profile_dir = os.environ.get("STOIX_TPU_PROFILE_DIR")
+    # Profile a steady-state window (the second) when there is one; the first
+    # window still carries one-off costs (evaluator/fetch compiles).
+    profile_window = (1 if num_evaluation > 1 else 0) if profile_dir else -1
+
+    window_walls: list = []
+    window_done_at = time.perf_counter()
+    # Step of the most recent window we DECIDED to checkpoint (the save is
+    # issued one window later): orbax's own latest_step lags by that window,
+    # so should_save consults this to avoid a spurious full-state copy.
+    last_save_t: Optional[int] = None
+
+    def dispatch_window(eval_idx: int) -> _Window:
+        """Enqueue one full eval window on the device stream; never blocks on
+        device results (post-compile, each call is dispatch cost only)."""
+        nonlocal learner_state, key, last_save_t
+        key, eval_key = jax.random.split(key)
+        ts = time.perf_counter()
+        if fused:
+            output, eval_metrics = fused_step(learner_state, eval_key)
+        else:
+            output = learn(learner_state)
+        phases["learn_s"] += time.perf_counter() - ts
         learner_state = output.learner_state
-        elapsed = time.time() - start
         t = start_step + (eval_idx + 1) * steps_per_eval
 
-        # Collective fetch: sharded global metrics are not host-addressable
-        # under multi-process runs; every process participates.
-        episode_metrics = envs.get_final_step_metrics(
-            fetch_global(dict(output.episode_metrics), mesh)
+        # On-device snapshots, enqueued BEFORE the next learn dispatch ever
+        # happens: donation of learner_state stays legal while eval/best/ckpt
+        # consumers read the copies at their leisure. The full-state copy is
+        # only taken for windows orbax's save policy will actually accept.
+        snapshot = _tree_copy(setup.eval_params_fn(learner_state))
+        take_ckpt = (
+            checkpointer is not None
+            and snapshot_ckpt
+            and checkpointer.should_save(t, last_issued=last_save_t)
         )
-        train_metrics = fetch_global(dict(output.train_metrics), mesh)
-        sps = steps_per_eval / elapsed
-        if is_coordinator():
-            logger.log({**episode_metrics, "steps_per_second": sps}, t, eval_idx, LogEvent.ACT)
-            logger.log(
-                jax.tree.map(lambda x: x.mean(), train_metrics), t, eval_idx, LogEvent.TRAIN
-            )
+        if take_ckpt:
+            last_save_t = t
+        ckpt_state = _tree_copy(learner_state) if take_ckpt else None
 
-        trained_params = setup.eval_params_fn(learner_state)
-        key, ek = jax.random.split(key)
-        eval_metrics = fetch_global(evaluator(trained_params, ek), mesh)
+        if not fused:
+            ts = time.perf_counter()
+            eval_metrics = evaluator(snapshot, eval_key)
+            phases["eval_s"] += time.perf_counter() - ts
+
+        # ONE coalesced collective fetch for the whole window (episode, train,
+        # and eval metrics ride a single pytree -> a single host-sync point).
+        ts = time.perf_counter()
+        metrics = fetch_global_async(
+            {
+                "episode": dict(output.episode_metrics),
+                "train": dict(output.train_metrics),
+                "eval": dict(eval_metrics),
+            },
+            mesh,
+        )
+        phases["fetch_s"] += time.perf_counter() - ts
+        return _Window(eval_idx, t, snapshot, ckpt_state, metrics)
+
+    def process_window(window: _Window) -> None:
+        """Host half: materialize the window's metrics, log, track best
+        params, and hand the checkpoint snapshot to orbax (async, no wait)."""
+        nonlocal best_params, best_return, final_return, window_done_at
+        ts = time.perf_counter()
+        fetched = materialize(window.metrics)
+        phases["fetch_s"] += time.perf_counter() - ts
+
+        now = time.perf_counter()
+        wall = now - window_done_at
+        window_done_at = now
+        window_walls.append(wall)
+
+        episode_metrics = envs.get_final_step_metrics(fetched["episode"])
+        train_metrics = fetched["train"]
+        eval_metrics = fetched["eval"]
+        sps = steps_per_eval / wall
         if is_coordinator():
-            logger.log(eval_metrics, t, eval_idx, LogEvent.EVAL)
+            logger.log(
+                {**episode_metrics, "steps_per_second": sps},
+                window.t, window.eval_idx, LogEvent.ACT,
+            )
+            logger.log(
+                jax.tree.map(lambda x: x.mean(), train_metrics),
+                window.t, window.eval_idx, LogEvent.TRAIN,
+            )
+            logger.log(eval_metrics, window.t, window.eval_idx, LogEvent.EVAL)
 
         mean_return = float(eval_metrics["episode_return"].mean())
         final_return = mean_return
         if mean_return >= float(best_return):
             best_return = mean_return
-            best_params = jax.tree.map(jnp.copy, trained_params)
+            best_params = window.snapshot  # already a donation-safe copy
 
-        # Orbax saves sharded globals collectively: ALL processes call save.
         if checkpointer is not None:
-            checkpointer.save(t, learner_state, mean_return)
-            # The state is donated to the next learn() call — an async save
-            # still serializing those buffers would read deleted memory.
-            checkpointer.wait()
+            # Orbax saves sharded globals collectively: ALL processes call
+            # save. The snapshot is not donated to anything, so the async save
+            # needs no wait() here — serialization overlaps the next window.
+            ts = time.perf_counter()
+            if window.ckpt_state is not None:
+                checkpointer.save(window.t, window.ckpt_state, mean_return)
+            elif not snapshot_ckpt and checkpointer.should_save(window.t):
+                # ckpt_snapshot=false forced the loop synchronous: the live
+                # state is not yet donated here, so save it directly and wait
+                # before the next dispatch can donate it (old semantics).
+                checkpointer.save(window.t, learner_state, mean_return)
+                checkpointer.wait()
+            phases["ckpt_s"] += time.perf_counter() - ts
+
+        if window.eval_idx == profile_window:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — profiling must never kill a run
+                pass
+
+    pending: Optional[_Window] = None
+    for eval_idx in range(num_evaluation):
+        if eval_idx == profile_window:
+            try:
+                jax.profiler.start_trace(profile_dir)
+            except Exception:  # noqa: BLE001
+                profile_window = -1
+        window = dispatch_window(eval_idx)
+        if pipelined:
+            # Process LAST window's host work while the device runs this one.
+            if pending is not None:
+                process_window(pending)
+            pending = window
+        else:
+            process_window(window)
+    if pending is not None:
+        process_window(pending)
 
     if bool(config.arch.get("absolute_metric", True)):
         key, ek = jax.random.split(key)
@@ -141,16 +353,31 @@ def run_anakin_experiment(
             logger.log(
                 abs_metrics,
                 start_step + int(config.arch.total_timesteps),
-                int(config.arch.num_evaluation),
+                num_evaluation,
                 LogEvent.ABSOLUTE,
             )
         final_return = float(abs_metrics["episode_return"].mean())
 
     if checkpointer is not None:
-        # Wait for in-flight async saves; otherwise interpreter shutdown races
+        # Drain in-flight async saves; otherwise interpreter shutdown races
         # orbax's executor ("cannot schedule new futures after shutdown").
         checkpointer.close()
     logger.close()
+
+    steady = (
+        steps_per_eval * (len(window_walls) - 1) / sum(window_walls[1:])
+        if len(window_walls) > 1
+        else (steps_per_eval / window_walls[0] if window_walls else 0.0)
+    )
+    LAST_RUN_STATS.clear()
+    LAST_RUN_STATS.update(
+        {
+            "phase_breakdown": {k: round(v, 6) for k, v in phases.items()},
+            "steady_state_sps": steady,
+            "pipelined": pipelined,
+            "fused_eval": fused,
+        }
+    )
     return final_return
 
 

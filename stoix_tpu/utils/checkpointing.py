@@ -63,13 +63,41 @@ class Checkpointer:
         )
         metadata = dict(metadata or {})
         metadata["checkpointer_version"] = CHECKPOINTER_VERSION
+        self._save_interval_steps = int(save_interval_steps)
         self._manager = ocp.CheckpointManager(
             self.directory,
             options=options,
             metadata=json.loads(json.dumps(metadata, default=str)),
         )
 
+    def should_save(self, timestep: int, last_issued: Optional[int] = None) -> bool:
+        """Whether the manager's save policy (save_interval_steps etc.) will
+        accept a save at `timestep`. The pipelined runner checks this BEFORE
+        taking the on-device state snapshot, so skipped windows don't pay the
+        full-state copy.
+
+        `last_issued` is the step of a save the CALLER has already decided on
+        but orbax may not have registered yet (the pipelined loop decides one
+        window ahead of issuing): the interval policy is applied against it
+        first, since the manager's latest_step is stale until that save
+        lands."""
+        if (
+            last_issued is not None
+            and timestep - last_issued < self._save_interval_steps
+        ):
+            return False
+        try:
+            return bool(self._manager.should_save(timestep))
+        except Exception:  # noqa: BLE001 — older orbax: assume it saves
+            return True
+
     def save(self, timestep: int, state: Any, episode_return: float = 0.0) -> bool:
+        """Hand `state` to orbax; serialization may complete asynchronously.
+
+        Callers must pass buffers that no later XLA program donates: the
+        Anakin runner saves an on-device SNAPSHOT copy of the learner state
+        (systems/runner.py), which is what makes the save safely async — the
+        hot path never calls wait()."""
         return self._manager.save(
             timestep,
             args=ocp.args.StandardSave(jax.tree.map(jax.numpy.asarray, state)),
@@ -102,10 +130,10 @@ class Checkpointer:
             )
 
     def wait(self) -> None:
-        """Block until in-flight (async) saves complete. The Anakin host loop
-        calls this after each save: the learner state is DONATED to the next
-        `learn` call, which would invalidate buffers an async save is still
-        serializing (systems/anakin.py shardmap_learner)."""
+        """Block until in-flight (async) saves complete. NOT on the Anakin hot
+        path anymore: the runner saves from a donation-safe snapshot copy, so
+        only tests and external callers that need save-visible-on-disk
+        ordering (and close()) should call this."""
         self._manager.wait_until_finished()
 
     def close(self) -> None:

@@ -42,3 +42,20 @@ def aot_compile(fn: Any, *example_args: Any) -> Any:
     except Exception:
         pass
     return compiled
+
+
+def aot_warmup(jit_fn: Any, *example_args: Any) -> Any:
+    """AOT-compile an ALREADY-jitted callable for the given example arguments
+    and return the compiled executable; the jitted fn itself is returned when
+    AOT lowering is unsupported (non-jitted wrappers, exotic backends), in
+    which case compilation happens on the first call instead.
+
+    Donation declared on the jit (donate_argnums) is preserved by the compiled
+    executable. The Anakin runner uses this to pay the learner's XLA compile
+    BEFORE the timed host loop, so the first eval window's steps_per_second is
+    a real throughput number rather than compile time (the compile used to
+    pollute it, runner.py)."""
+    try:
+        return jit_fn.lower(*example_args).compile()
+    except Exception:  # noqa: BLE001 — any lowering failure degrades gracefully
+        return jit_fn

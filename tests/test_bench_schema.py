@@ -1,0 +1,47 @@
+"""bench.py output-schema gate.
+
+Runs `bench.py --smoke --cpu` in a subprocess (the bench contract is a
+standalone process emitting JSON lines) and validates the payload schema,
+including the per-phase host-loop breakdown added by the pipelined runner —
+so bench output can never silently regress shape again.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PHASE_KEYS = ("compile_s", "learn_s", "eval_s", "fetch_s", "ckpt_s")
+
+
+def test_bench_smoke_payload_schema():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke", "--cpu"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "STOIX_BENCH_NO_FALLBACK": "1"},
+    )
+    assert proc.returncode == 0, f"bench.py --smoke failed:\n{proc.stdout}\n{proc.stderr}"
+
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, f"expected exactly one JSON line:\n{proc.stdout}"
+    payload = json.loads(json_lines[0])
+
+    # Core contract (BASELINE.md): one measurement per line.
+    assert payload["metric"] == "anakin_ppo_ant_env_steps_per_sec"
+    assert isinstance(payload["value"], (int, float)) and payload["value"] > 0, payload
+    assert isinstance(payload["unit"], str) and "env_steps/sec" in payload["unit"]
+    assert "vs_baseline" in payload
+
+    # Pipelined-runner phase attribution: all phases present, numeric, >= 0,
+    # and the probe actually ran (no probe_error, nonzero compile).
+    phases = payload["phase_breakdown"]
+    assert "probe_error" not in phases, phases
+    for key in PHASE_KEYS:
+        assert isinstance(phases[key], (int, float)) and phases[key] >= 0.0, phases
+    assert phases["compile_s"] > 0.0, phases
+    assert phases["steady_state_sps"] > 0.0, phases

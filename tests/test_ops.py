@@ -1,6 +1,8 @@
 """Tests for distributions, losses, value transforms, running statistics."""
 
 import jax
+
+from stoix_tpu.parallel import shard_map
 import jax.numpy as jnp
 import numpy as np
 import scipy.stats
@@ -237,7 +239,7 @@ def test_running_statistics_psum_over_mesh(devices):
         return running_statistics.update(state, batch, axis_names=("data",))
 
     state = running_statistics.init_state(template)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_update,
         mesh=mesh,
         in_specs=(P(), P("data")),

@@ -108,7 +108,7 @@ def test_ring_attention_matches_full_attention(use_flash):
     from jax.sharding import PartitionSpec as P
 
     from stoix_tpu.ops.ring_attention import ring_attention
-    from stoix_tpu.parallel import create_mesh
+    from stoix_tpu.parallel import shard_map, create_mesh
 
     mesh = create_mesh({"data": -1})  # all 8 virtual CPU devices
     b, s, h, d = 1, 64, 2, 16
@@ -120,7 +120,7 @@ def test_ring_attention_matches_full_attention(use_flash):
     # workaround). The compiled Mosaic path on real TPU never interprets the
     # kernel body, so the check stays on everywhere else.
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             partial(
                 ring_attention, axis_name="data", causal=True, use_flash=use_flash
             ),

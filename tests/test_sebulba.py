@@ -2,6 +2,8 @@
 multi-device split, plus the native C++ env pool."""
 
 import jax
+
+from stoix_tpu.parallel import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -150,7 +152,7 @@ def test_impala_reward_normalization_is_shard_invariant(devices):
     for n_shards in (1, 2, 4):
         mesh = Mesh(np.asarray(jax.devices("cpu")[:n_shards]), ("data",))
         out = jax.jit(
-            jax.shard_map(
+            shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(PPOTransition(*([P(None, "data")] * 9)),),
                 out_specs=P(None, "data"),
