@@ -319,15 +319,20 @@ def _timed_anakin_run(config, learner_setup, smoke: bool):
 
 def _phase_breakdown_probe(
     default_yaml: str, setup_module: str, env_overrides: list, smoke: bool, n_devices: int
-) -> dict:
+) -> tuple:
     """Run ONE tiny experiment through the pipelined Anakin runner to capture
     the per-phase host-loop breakdown (compile_s/learn_s/eval_s/fetch_s/
     ckpt_s). The headline SPS stays the timed learn-loop measurement; this
-    probe is what surfaces where host time goes per eval window. Failures are
-    reported in-band (zeroed phases + probe_error) — the bench contract is
-    JSON lines, never a traceback."""
+    probe is what surfaces where host time goes per eval window. The probe
+    runs with telemetry ENABLED (stoix_tpu/observability), so the payload
+    also carries the telemetry self-check: span count, registry series
+    count, and whether the exported trace validates against the Chrome
+    trace-event schema. Failures are reported in-band (zeroed phases +
+    probe_error) — the bench contract is JSON lines, never a traceback.
+    Returns (phase_breakdown, telemetry)."""
     import importlib
 
+    from stoix_tpu import observability
     from stoix_tpu.systems import runner as anakin_runner
     from stoix_tpu.utils import config as config_lib
 
@@ -342,6 +347,7 @@ def _phase_breakdown_probe(
             "arch.eval_max_steps=128",
             "arch.absolute_metric=False",
             "logger.use_console=False",
+            "logger.telemetry.enabled=True",
         ]
         config = config_lib.compose(
             config_lib.default_config_dir(), default_yaml, overrides
@@ -349,15 +355,31 @@ def _phase_breakdown_probe(
         module = importlib.import_module(setup_module)
         anakin_runner.run_anakin_experiment(config, module.learner_setup)
         stats = anakin_runner.LAST_RUN_STATS
-        return {**stats["phase_breakdown"], "steady_state_sps": round(
+        phases = {**stats["phase_breakdown"], "steady_state_sps": round(
             float(stats["steady_state_sps"]), 1
         )}
-    except Exception as exc:  # noqa: BLE001 — reported in-band, never raised
-        return {
-            "compile_s": 0.0, "learn_s": 0.0, "eval_s": 0.0,
-            "fetch_s": 0.0, "ckpt_s": 0.0, "steady_state_sps": 0.0,
-            "probe_error": f"{type(exc).__name__}: {exc}",
+        telemetry = {
+            "spans": observability.get_recorder().event_count(),
+            "metric_series": observability.get_registry().series_count(),
+            "trace_valid": not observability.validate_chrome_trace(
+                observability.to_chrome_trace()
+            ),
         }
+        return phases, telemetry
+    except Exception as exc:  # noqa: BLE001 — reported in-band, never raised
+        return (
+            {
+                "compile_s": 0.0, "learn_s": 0.0, "eval_s": 0.0,
+                "fetch_s": 0.0, "ckpt_s": 0.0, "steady_state_sps": 0.0,
+                "probe_error": f"{type(exc).__name__}: {exc}",
+            },
+            {"spans": 0, "metric_series": 0, "trace_valid": False},
+        )
+    finally:
+        # The TelemetrySink only shuts telemetry down on a CLEAN run end; a
+        # probe crash must not leave span recording + the poller thread on
+        # for the subsequent timed workloads. Idempotent after a clean end.
+        observability.shutdown()
 
 
 def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
@@ -401,6 +423,12 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
     steps_per_sec = _timed_anakin_run(config, learner_setup, smoke)
     per_chip = steps_per_sec / n_devices
     baseline_per_chip = 1_000_000 / 64  # BASELINE.json north star on v5e-64
+    # Host-loop phase attribution + telemetry self-check from a tiny
+    # pipelined-runner probe run (2 eval windows, telemetry enabled); see
+    # systems/runner.py LAST_RUN_STATS and stoix_tpu/observability.
+    phase_breakdown, telemetry = _phase_breakdown_probe(
+        default_yaml, learner_setup.__module__, probe_overrides, smoke, n_devices,
+    )
     return {
         "metric": metric,
         "value": round(steps_per_sec, 1),
@@ -409,11 +437,8 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
         "vs_baseline": (
             None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
         ),
-        # Host-loop phase attribution from a tiny pipelined-runner probe run
-        # (2 eval windows); see systems/runner.py LAST_RUN_STATS.
-        "phase_breakdown": _phase_breakdown_probe(
-            default_yaml, learner_setup.__module__, probe_overrides, smoke, n_devices,
-        ),
+        "phase_breakdown": phase_breakdown,
+        "telemetry": telemetry,
     }
 
 
@@ -507,8 +532,26 @@ def _run_sebulba(
     config = config_lib.compose(
         config_lib.default_config_dir(), "default/sebulba/default_ff_ppo.yaml", overrides
     )
+    # Queue health from the metrics registry (stoix_tpu/observability):
+    # learner-side rollout get-wait is THE Sebulba backpressure signal —
+    # near-zero means actors keep the learner fed. The registry is
+    # process-cumulative, so report THIS run's delta (count/sum are
+    # monotonic); shutdown-drain gets are uninstrumented by construction
+    # (OnPolicyPipeline.drain), so they cannot deflate the mean.
+    from stoix_tpu.observability import get_registry
+
+    wait_hist = get_registry().histogram("stoix_tpu_sebulba_queue_get_wait_seconds")
+    wait_labels = {"queue": "rollout", "actor": "0"}
+    before = wait_hist.summary(wait_labels)
     sebulba_ppo.run_experiment(config)
     steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
+    after = wait_hist.summary(wait_labels)
+    d_count = int(after.get("count", 0)) - int(before.get("count", 0))
+    d_sum = float(after.get("sum", 0.0)) - float(before.get("sum", 0.0))
+    telemetry = {
+        "rollout_get_wait_mean_s": round(d_sum / d_count, 6) if d_count else 0.0,
+        "rollout_get_wait_count": d_count,
+    }
     if steady:
         unit = "env_steps/sec (steady-state, %d devices, %s)" % (n_devices, pool_desc)
     else:
@@ -523,6 +566,7 @@ def _run_sebulba(
         # Sebulba has no tracked numeric baseline (reference publishes
         # none for its sebulba arch); report the raw number.
         "vs_baseline": None,
+        "telemetry": telemetry,
     }
 
 

@@ -14,7 +14,16 @@ pyproject.toml, so installing them upgrades the gate with zero changes here):
      `jax.block_until_ready` / `checkpointer.wait()` / `wait_until_finished`
      — the pipelined runner (systems/runner.py) owns ALL host-sync points, so
      future systems stay off the accelerator critical path by construction
-     (Sebulba files are exempt: their actor/learner threads own their syncs).
+     (Sebulba files are exempt: their actor/learner threads own their syncs);
+  5. observability ownership (STX002): `stoix_tpu/` library code must not use
+     bare `print(` (status lines go through `observability.get_logger`,
+     metrics through the registry — stdout belongs to machine-readable
+     output contracts) nor declare ad-hoc module-level stats accumulators
+     (ALL_CAPS names bound to empty `{}`/`dict()` — the `LAST_RUN_STATS`
+     pattern; publish to the metrics registry and expose an
+     `observability.RunStats` view instead). Allowlisted: utils/logger.py
+     (the ConsoleSink IS the console) and sweep.py (JSON-lines stdout
+     contract); scripts/ and bench.py are not library code.
 
 Exit code 0 = clean, 1 = findings. Run: python scripts/lint.py [paths...]
 """
@@ -128,14 +137,31 @@ def check_hygiene(path: str, source: str) -> Tuple[List[str], List[str]]:
 _HOST_SYNC_OWNER = os.path.join("stoix_tpu", "systems", "runner.py")
 
 
+def _receiver_names(node: ast.AST) -> List[str]:
+    """All identifier parts of a dotted receiver: self.checkpointer ->
+    ['self', 'checkpointer']."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts
+
+
 def _is_host_sync_call(node: ast.Call) -> bool:
     fn = node.func
     if isinstance(fn, ast.Attribute):
         if fn.attr in ("block_until_ready", "wait_until_finished"):
             return True
-        # <anything named like a checkpointer>.wait(...)
-        if fn.attr == "wait" and isinstance(fn.value, ast.Name):
-            return "checkpoint" in fn.value.id.lower()
+        # <anything named like a checkpointer>.wait(...) — including
+        # attribute-qualified receivers (self.checkpointer.wait(),
+        # setup.ckpt.wait()).
+        if fn.attr == "wait":
+            return any(
+                "checkpoint" in part.lower() or "ckpt" in part.lower()
+                for part in _receiver_names(fn.value)
+            )
         return False
     return isinstance(fn, ast.Name) and fn.id == "block_until_ready"
 
@@ -161,6 +187,75 @@ def check_host_sync_ownership(path: str, source: str, tree: ast.AST) -> List[str
             f"{rel}:{node.lineno}: host-sync call in an Anakin system file — the "
             f"pipelined runner (systems/runner.py) owns all host-sync points (STX001)"
         )
+    return findings
+
+
+# STX002: library code must not print to stdout or grow ad-hoc module-level
+# stats dicts. Allowlist: the ConsoleSink's own file and the sweep driver
+# whose stdout IS its output contract (like bench.py, which is not scanned —
+# the rule covers stoix_tpu/ only).
+_STX002_ALLOWLIST = {
+    os.path.join("stoix_tpu", "utils", "logger.py"),
+    os.path.join("stoix_tpu", "sweep.py"),
+}
+
+
+def _is_empty_dict_value(node: ast.AST) -> bool:
+    if isinstance(node, ast.Dict) and not node.keys:
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "dict"
+        and not node.args
+        and not node.keywords
+    )
+
+
+def check_observability_ownership(path: str, source: str, tree: ast.AST) -> List[str]:
+    rel = os.path.relpath(path, REPO)
+    if not rel.startswith("stoix_tpu" + os.sep) or rel in _STX002_ALLOWLIST:
+        return []
+    lines = source.splitlines()
+    findings = []
+
+    def _line_ok(lineno: int) -> bool:
+        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        return "noqa" in line
+
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+            and not _line_ok(node.lineno)
+        ):
+            findings.append(
+                f"{rel}:{node.lineno}: bare print() in library code — use "
+                f"observability.get_logger (status) or the metrics registry "
+                f"(STX002)"
+            )
+    # Module-level ALL_CAPS empty-dict accumulators (body-level only: class
+    # attributes and function locals are fine).
+    for node in getattr(tree, "body", []):
+        targets, value = [], None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for target in targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id.isupper()
+                and value is not None
+                and _is_empty_dict_value(value)
+                and not _line_ok(node.lineno)
+            ):
+                findings.append(
+                    f"{rel}:{node.lineno}: ad-hoc module-level stats dict "
+                    f"'{target.id}' — publish to the metrics registry and "
+                    f"expose an observability.RunStats view (STX002)"
+                )
     return findings
 
 
@@ -197,6 +292,7 @@ def main(argv: List[str]) -> int:
         tree = ast.parse(source)
         errors.extend(check_unused_imports(path, source, tree))
         errors.extend(check_host_sync_ownership(path, source, tree))
+        errors.extend(check_observability_ownership(path, source, tree))
         errs, warns = check_hygiene(path, source)
         errors.extend(errs)
         warnings.extend(warns)

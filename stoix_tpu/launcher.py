@@ -25,6 +25,8 @@ import subprocess
 import sys
 from typing import List
 
+from stoix_tpu.observability import get_logger
+
 SBATCH_TEMPLATE = """#!/bin/bash
 #SBATCH --job-name={job_name}
 #SBATCH --output={log_dir}/{job_name}_%j.out
@@ -68,8 +70,11 @@ def main(argv: List[str] | None = None) -> None:
     args = parser.parse_args(argv)
 
     jobs = build_jobs(args)
-    print(f"[launcher] {len(jobs)} jobs: "
-          f"{len(args.systems)} systems x {len(args.envs)} envs x {len(args.seeds)} seeds")
+    log = get_logger("stoix_tpu.launcher")
+    log.info(
+        "[launcher] %d jobs: %d systems x %d envs x %d seeds",
+        len(jobs), len(args.systems), len(args.envs), len(args.seeds),
+    )
 
     if args.local:
         # Make the repo importable from any working directory.
@@ -77,7 +82,7 @@ def main(argv: List[str] | None = None) -> None:
         env = dict(os.environ)
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         for job in jobs:
-            print(f"[launcher] running {job['name']}")
+            log.info("[launcher] running %s", job["name"])
             subprocess.run(
                 [sys.executable, "-m", job["module"], *job["overrides"]],
                 check=True,
@@ -105,9 +110,9 @@ def main(argv: List[str] | None = None) -> None:
             f.write(script)
         if args.submit:
             subprocess.run(["sbatch", path], check=True)
-            print(f"[launcher] submitted {path}")
+            log.info("[launcher] submitted %s", path)
         else:
-            print(f"[launcher] wrote {path} (pass --submit to sbatch)")
+            log.info("[launcher] wrote %s (pass --submit to sbatch)", path)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,133 @@
+"""Unified telemetry for both Podracer architectures (docs/DESIGN.md §2.2).
+
+Three pillars, all zero-dependency and off by default:
+
+  * **Tracing** (trace.py / trace_export.py): host-side `span()` context
+    managers — thread-aware, monotonic-clock — exported as Chrome-trace/
+    Perfetto JSON so host threads load alongside the `jax.profiler` device
+    trace. `annotate()` tags jitted code at epoch/minibatch boundaries.
+  * **Metrics** (registry.py / exporters.py): process-wide counters, gauges,
+    and histograms with labels, snapshot-on-demand, Prometheus text
+    exposition + JSONL sinks. `RunStats` is the dict-compatible per-run view
+    that replaced the ad-hoc module-level stats dicts (lint STX002).
+  * **Introspection** (introspect.py / health.py): a device-telemetry poller
+    (memory_stats, live buffers) sampled off the hot path, plus Sebulba
+    heartbeats and a stall detector that names the starved component.
+
+`configure(cfg.logger.telemetry)` is the single switch — called by
+StoixLogger on construction. Disabled (the default), spans are shared no-op
+context managers, no poller thread starts, and no files are written: behavior
+is bit-identical to a build without telemetry (tests/test_observability.py
+pins this) and PR 1's pipelined-loop no-host-sync guarantees are untouched —
+every instrument here is host-memory only.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+from typing import Any, Optional
+
+from stoix_tpu.observability.exporters import (  # noqa: F401 — public API
+    JsonlMetricsWriter,
+    flatten_snapshot,
+    to_prometheus_text,
+    write_prometheus,
+)
+from stoix_tpu.observability.health import (  # noqa: F401
+    ActorStarvationError,
+    HeartbeatBoard,
+    StallDetector,
+)
+from stoix_tpu.observability.introspect import (  # noqa: F401
+    DeviceTelemetryPoller,
+    sample_device_telemetry,
+)
+from stoix_tpu.observability.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    RunStats,
+    get_registry,
+)
+from stoix_tpu.observability.trace import (  # noqa: F401
+    annotate,
+    device_annotation,
+    get_recorder,
+    instant,
+    is_enabled,
+    set_enabled,
+    span,
+)
+from stoix_tpu.observability.trace_export import (  # noqa: F401
+    to_chrome_trace,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+
+_lock = threading.Lock()
+_poller: Optional[DeviceTelemetryPoller] = None
+
+
+def get_logger(name: str = "stoix_tpu") -> logging.Logger:
+    """Library status-line logger. Library code uses this instead of bare
+    print() — lint rule STX002 — so stdout stays reserved for machine-readable
+    output contracts (bench.py, sweep.py) and the ConsoleSink.
+
+    Defers to the application's logging config when one exists: if the root
+    logger (or the 'stoix_tpu' logger itself) already has handlers, nothing
+    is attached and records propagate normally. Only in the bare-CLI case —
+    no handlers anywhere — does this attach a message-only stderr handler at
+    INFO (the behavior the old print() calls had). Call this at the log
+    site, not at module import, so an app's logging.basicConfig() wins."""
+    root = logging.getLogger("stoix_tpu")
+    with _lock:
+        if not root.handlers and not logging.getLogger().handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(logging.Formatter("%(message)s"))
+            root.addHandler(handler)
+            root.setLevel(logging.INFO)
+            root.propagate = False
+    return logging.getLogger(name)
+
+
+def configure(telemetry_cfg: Any = None) -> bool:
+    """Apply a `logger.telemetry` config block (a plain/Config dict or None).
+    Returns whether telemetry is enabled. Idempotent: reconfiguring replaces
+    the poller; disabling stops it and turns span recording off. Output
+    paths are the TelemetrySink's concern (utils/logger.py wires them)."""
+    cfg = telemetry_cfg or {}
+    enabled = bool(cfg.get("enabled", False))
+    global _poller
+    with _lock:
+        set_enabled(enabled)
+        if _poller is not None:
+            _poller.stop()
+            _poller = None
+        if enabled:
+            # Fresh span buffer per enabled run: without this, a second
+            # telemetry run in the same process would export the previous
+            # run's spans too (the buffer survives shutdown() so the LAST
+            # run stays exportable).
+            get_recorder().clear()
+            interval = float(cfg.get("device_poll_interval_s", 5.0) or 0.0)
+            if interval > 0:
+                _poller = DeviceTelemetryPoller(interval_s=interval)
+                _poller.start()
+            # Seed one synchronous sample so even short runs snapshot device
+            # memory series (the poller's first tick is one interval away).
+            sample_device_telemetry()
+    return enabled
+
+
+def shutdown() -> None:
+    """Stop the poller and disable span recording (buffer/registry contents
+    are kept — the caller may still export them)."""
+    global _poller
+    with _lock:
+        if _poller is not None:
+            _poller.stop()
+            _poller = None
+        set_enabled(False)

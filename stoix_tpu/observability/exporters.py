@@ -1,0 +1,130 @@
+"""Registry exporters: Prometheus text exposition + JSONL snapshots.
+
+Both render `MetricsRegistry.snapshot()` output — point-in-time copies, so an
+export never holds instrument locks while doing file I/O.
+
+Prometheus text format (version 0.0.4): `# HELP` / `# TYPE` comment lines,
+then one `name{label="value",...} value` sample per series. Histograms emit
+the standard `_bucket{le=...}` cumulative series plus `_sum`/`_count`. The
+file is written atomically (tmp + rename) so a scraper or test never reads a
+half-written snapshot.
+
+The JSONL sink appends one row per snapshot — `{"t": step, "time": unix,
+"metrics": {flat_name: value}}` — flattening labeled series into
+`name{k=v,...}` keys, for offline steps-per-second forensics without a
+Prometheus server.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Dict, Optional
+
+from stoix_tpu.observability.registry import MetricsRegistry, get_registry
+
+
+def _fmt_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v != v:  # NaN
+        return "NaN"
+    return repr(float(v))
+
+
+def _fmt_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in sorted(merged.items())
+    )
+    return "{%s}" % inner
+
+
+def to_prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    registry = registry or get_registry()
+    lines = []
+    for name, family in sorted(registry.snapshot().items()):
+        if family["help"]:
+            lines.append(f"# HELP {name} {family['help']}")
+        lines.append(f"# TYPE {name} {family['kind']}")
+        for series in family["series"]:
+            labels = series["labels"]
+            if family["kind"] == "histogram":
+                for bound, count in sorted(series["buckets"].items()):
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels(labels, {'le': _fmt_value(bound)})} {count}"
+                    )
+                lines.append(f"{name}_sum{_fmt_labels(labels)} "
+                             f"{_fmt_value(series['summary']['sum'])}")
+                lines.append(f"{name}_count{_fmt_labels(labels)} "
+                             f"{series['summary']['count']}")
+            else:
+                lines.append(
+                    f"{name}{_fmt_labels(labels)} {_fmt_value(series['value'])}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str, registry: Optional[MetricsRegistry] = None) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(to_prometheus_text(registry))
+    os.replace(tmp, path)
+    return path
+
+
+def flatten_snapshot(snapshot: Dict[str, Any]) -> Dict[str, float]:
+    """{name{k=v,...}: value} — histograms contribute _count/_sum/_mean/_max."""
+    flat: Dict[str, float] = {}
+    for name, family in snapshot.items():
+        for series in family["series"]:
+            labels = series["labels"]
+            suffix = (
+                "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+                if labels
+                else ""
+            )
+            if family["kind"] == "histogram":
+                summary = series["summary"]
+                flat[f"{name}_count{suffix}"] = float(summary["count"])
+                flat[f"{name}_sum{suffix}"] = float(summary["sum"])
+                if summary["count"]:
+                    flat[f"{name}_mean{suffix}"] = float(summary["mean"])
+                    flat[f"{name}_max{suffix}"] = float(summary["max"])
+            else:
+                flat[f"{name}{suffix}"] = float(series["value"])
+    return flat
+
+
+class JsonlMetricsWriter:
+    """Append-mode JSONL snapshot log (one row per call, flushed so a killed
+    run keeps everything written so far)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._file = open(path, "a")
+        self.path = path
+
+    def write_snapshot(
+        self, t: int, registry: Optional[MetricsRegistry] = None
+    ) -> None:
+        registry = registry or get_registry()
+        row = {
+            "t": int(t),
+            "time": time.time(),
+            "metrics": flatten_snapshot(registry.snapshot()),
+        }
+        self._file.write(json.dumps(row) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        self._file.close()

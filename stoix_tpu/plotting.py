@@ -18,6 +18,8 @@ import os
 from collections import defaultdict
 from typing import Dict, List
 
+from stoix_tpu.observability import get_logger
+
 
 def load_runs(paths: List[str]) -> Dict[str, Dict[str, Dict[int, List[float]]]]:
     """-> {task: {system: {step: [returns across seeds/episodes]}}}"""
@@ -69,7 +71,7 @@ def plot(curves, out_dir: str) -> List[str]:
         fig.savefig(path, dpi=120)
         plt.close(fig)
         written.append(path)
-        print(f"[plotting] wrote {path}")
+        get_logger("stoix_tpu.plotting").info("[plotting] wrote %s", path)
     return written
 
 

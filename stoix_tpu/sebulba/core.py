@@ -13,15 +13,49 @@ TPU-native difference (SURVEY.md §7.1.3): trajectory hand-off builds GLOBAL
 arrays with jax.make_array_from_single_device_arrays via
 parallel.assemble_global_array, so the learner's jit consumes a correctly
 sharded batch with no host concat.
+
+Telemetry (docs/DESIGN.md §2.2): every queue hand-off records depth and
+put/get wait series (`stoix_tpu_sebulba_queue_*`), every component beats a
+HeartbeatBoard, and a collect timeout surfaces as ActorStarvationError naming
+the starved side (actor dead vs pipeline wedged vs params stale) instead of
+an anonymous `queue.Empty`. All instruments are host-memory only — no device
+syncs — and span recording is a no-op unless telemetry is enabled.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, List, Optional
 
 import jax
+
+from stoix_tpu.observability import (
+    ActorStarvationError,
+    HeartbeatBoard,
+    StallDetector,
+    get_registry,
+    span,
+)
+
+
+def _queue_instruments():
+    registry = get_registry()
+    return (
+        registry.gauge(
+            "stoix_tpu_sebulba_queue_depth",
+            "Items currently buffered per Sebulba queue",
+        ),
+        registry.histogram(
+            "stoix_tpu_sebulba_queue_put_wait_seconds",
+            "Producer-side blocking time per queue put",
+        ),
+        registry.histogram(
+            "stoix_tpu_sebulba_queue_get_wait_seconds",
+            "Consumer-side blocking time per queue get",
+        ),
+    )
 
 
 class ThreadLifetime:
@@ -40,40 +74,118 @@ class OnPolicyPipeline:
 
     def __init__(self, num_actors: int, max_size: int = 1):
         self._queues: List[queue.Queue] = [queue.Queue(maxsize=max_size) for _ in range(num_actors)]
+        self.heartbeats = HeartbeatBoard()
+        self._depth, self._put_wait, self._get_wait = _queue_instruments()
 
     def send_rollout(self, actor_id: int, payload: Any, timeout: Optional[float] = None) -> None:
-        self._queues[actor_id].put(payload, timeout=timeout)
+        labels = {"queue": "rollout", "actor": str(actor_id)}
+        start = time.perf_counter()
+        try:
+            with span("pipeline_put", actor=actor_id):
+                self._queues[actor_id].put(payload, timeout=timeout)
+        finally:
+            # finally: a queue.Full timeout is the worst-case backpressure
+            # sample — the one this histogram exists to capture.
+            self._put_wait.observe(time.perf_counter() - start, labels)
+            self._depth.set(self._queues[actor_id].qsize(), labels)
+        self.heartbeats.beat(f"actor-{actor_id}")
 
     def collect_rollouts(self, timeout: float = 180.0) -> List[Any]:
-        """Blocks until every actor has contributed one rollout; an actor that
-        died surfaces here as Empty (reference sebulba_utils.py:85)."""
-        return [q.get(timeout=timeout) for q in self._queues]
+        """Blocks until every actor has contributed one rollout. A timeout
+        names the starved actor and its last-heartbeat age (reference
+        sebulba_utils.py:85 surfaced a bare queue.Empty here)."""
+        detector = StallDetector(self.heartbeats, stale_after_s=max(1.0, timeout / 4))
+        payloads = []
+        for actor_id, q in enumerate(self._queues):
+            labels = {"queue": "rollout", "actor": str(actor_id)}
+            start = time.perf_counter()
+            try:
+                with span("pipeline_get", actor=actor_id):
+                    payloads.append(q.get(timeout=timeout))
+            except queue.Empty:
+                raise ActorStarvationError(
+                    actor_id,
+                    timeout,
+                    detector.diagnose(waiting_on=f"actor-{actor_id}"),
+                    self.heartbeats.age(f"actor-{actor_id}"),
+                ) from None
+            self._get_wait.observe(time.perf_counter() - start, labels)
+            self._depth.set(q.qsize(), labels)
+        self.heartbeats.beat("learner")
+        return payloads
+
+    def drain(self, timeout: float = 0.5) -> int:
+        """Shutdown-path drain: unblock producers stuck in put() WITHOUT
+        recording wait/depth series or heartbeats — drain gets are teardown
+        artifacts, not backpressure signal. Returns items drained; stops at
+        the first empty queue (matching the old best-effort loop)."""
+        drained = 0
+        for q in self._queues:
+            try:
+                q.get(timeout=timeout)
+                drained += 1
+            except queue.Empty:
+                break
+        return drained
 
 
 class ParameterServer:
     """Latest-params distribution to actor devices."""
 
-    def __init__(self, actor_devices: List[jax.Device], actors_per_device: int):
+    def __init__(
+        self,
+        actor_devices: List[jax.Device],
+        actors_per_device: int,
+        heartbeats: Optional[HeartbeatBoard] = None,
+    ):
         self._devices = [d for d in actor_devices for _ in range(actors_per_device)]
         self._queues: List[queue.Queue] = [queue.Queue(maxsize=1) for _ in self._devices]
+        self.heartbeats = heartbeats if heartbeats is not None else HeartbeatBoard()
+        self._depth, self._put_wait, self._get_wait = _queue_instruments()
+        self._pushes = get_registry().counter(
+            "stoix_tpu_sebulba_param_pushes_total",
+            "Parameter versions pushed to each actor queue",
+        )
+        self._transfer = get_registry().histogram(
+            "stoix_tpu_sebulba_param_transfer_seconds",
+            "Host-side device_put time per param push (NOT queue blocking)",
+        )
 
     @property
     def num_actors(self) -> int:
         return len(self._queues)
 
     def distribute_params(self, params: Any) -> None:
-        for device, q in zip(self._devices, self._queues):
-            local = jax.device_put(params, device)
-            # Keep only the freshest params: drop a stale entry if present.
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                pass
-            q.put(local)
+        with span("param_push", actors=len(self._queues)):
+            for actor_id, (device, q) in enumerate(zip(self._devices, self._queues)):
+                labels = {"queue": "params", "actor": str(actor_id)}
+                # Transfer cost and queue blocking are separate series: a
+                # slow push must be attributable to the right cause (large
+                # params vs an actor not draining its queue).
+                start = time.perf_counter()
+                local = jax.device_put(params, device)
+                self._transfer.observe(time.perf_counter() - start, labels)
+                start = time.perf_counter()
+                # Keep only the freshest params: drop a stale entry if present.
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                q.put(local)
+                self._put_wait.observe(time.perf_counter() - start, labels)
+                self._depth.set(q.qsize(), labels)
+                self._pushes.inc(labels={"actor": str(actor_id)})
+        self.heartbeats.beat("param-server")
 
     def get_params(self, actor_id: int, timeout: Optional[float] = None) -> Any:
         """Returns fresh params, or None (shutdown sentinel)."""
-        return self._queues[actor_id].get(timeout=timeout)
+        labels = {"queue": "params", "actor": str(actor_id)}
+        start = time.perf_counter()
+        with span("param_get", actor=actor_id):
+            params = self._queues[actor_id].get(timeout=timeout)
+        self._get_wait.observe(time.perf_counter() - start, labels)
+        self._depth.set(self._queues[actor_id].qsize(), labels)
+        return params
 
     def shutdown(self) -> None:
         for q in self._queues:
@@ -92,6 +204,7 @@ class AsyncEvaluator:
         evaluate: Callable[[Any, jax.Array], dict],
         lifetime: ThreadLifetime,
         on_result: Callable[[dict, Any, int], None],
+        heartbeats: Optional[HeartbeatBoard] = None,
     ):
         self._evaluate = evaluate
         self._lifetime = lifetime
@@ -99,11 +212,17 @@ class AsyncEvaluator:
         self._requests: queue.Queue = queue.Queue()
         self._idle = threading.Event()
         self._idle.set()
+        self.heartbeats = heartbeats if heartbeats is not None else HeartbeatBoard()
+        self._depth = get_registry().gauge(
+            "stoix_tpu_sebulba_queue_depth",
+            "Items currently buffered per Sebulba queue",
+        )
         self.thread = threading.Thread(target=self._run, name="async-evaluator", daemon=True)
 
     def submit(self, params: Any, key: jax.Array, t: int) -> None:
         self._idle.clear()
         self._requests.put((params, key, t))
+        self._depth.set(self._requests.qsize(), {"queue": "eval_requests"})
 
     def _run(self) -> None:
         while not self._lifetime.should_stop():
@@ -112,8 +231,27 @@ class AsyncEvaluator:
             except queue.Empty:
                 self._idle.set()
                 continue
-            metrics = self._evaluate(params, key)
-            self._on_result(metrics, params, t)
+            self._depth.set(self._requests.qsize(), {"queue": "eval_requests"})
+            try:
+                with span("async_eval", t=t):
+                    metrics = self._evaluate(params, key)
+                    self._on_result(metrics, params, t)
+                self.heartbeats.beat("evaluator")
+            except Exception:  # noqa: BLE001 — a lost eval window must not
+                # kill the thread silently nor wedge shutdown on a cleared
+                # _idle flag (mirrors rollout_thread's crash telemetry).
+                import traceback
+
+                get_registry().counter(
+                    "stoix_tpu_sebulba_evaluator_errors_total",
+                    "Async evaluation requests that raised",
+                ).inc()
+                from stoix_tpu.observability import get_logger
+
+                get_logger("stoix_tpu.sebulba").error(
+                    "[async-evaluator] eval at t=%d FAILED:\n%s",
+                    t, traceback.format_exc(),
+                )
             if self._requests.empty():
                 self._idle.set()
 

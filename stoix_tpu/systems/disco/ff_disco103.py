@@ -28,6 +28,7 @@ from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput
 from stoix_tpu.evaluator import get_distribution_act_fn
 from stoix_tpu.networks.disco import DiscoAgentOutput
+from stoix_tpu.observability import get_logger
 from stoix_tpu.ops import distributions as dists
 from stoix_tpu.parallel import is_coordinator
 from stoix_tpu.systems.disco.update_rule import (
@@ -256,8 +257,10 @@ def learner_setup(
         rule, meta_key, local_path=config.system.get("meta_params_path")
     )
     if rule.mode == "meta" and not pretrained and is_coordinator():
-        print("[disco] WARNING: meta mode with random meta-params — machinery "
-              "runs but targets are uninformative")
+        get_logger("stoix_tpu.disco").warning(
+            "[disco] WARNING: meta mode with random meta-params — machinery "
+            "runs but targets are uninformative"
+        )
 
     learn_per_shard = get_learner_fn(
         env, network.apply, optim.update, rule, meta_params, config
@@ -287,8 +290,11 @@ def learner_setup(
     learn = anakin.shardmap_learner(learn_per_shard, mesh, state_specs)
 
     if is_coordinator():
-        print(f"[setup] {count_parameters(params):,} parameters | mesh "
-              f"{dict(mesh.shape)} | {config.arch.total_num_envs} global envs")
+        get_logger("stoix_tpu.setup").info(
+            "[setup] %s parameters | mesh %s | %s global envs",
+            f"{count_parameters(params):,}", dict(mesh.shape),
+            config.arch.total_num_envs,
+        )
 
     def eval_apply(params, observation):
         return dists.Categorical(logits=network.apply(params, observation).logits)

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from stoix_tpu.networks.disco import DiscoAgentOutput
+from stoix_tpu.observability import get_logger
 from stoix_tpu.ops.losses import categorical_l2_project
 
 DISCO103_URL = (
@@ -375,8 +376,9 @@ def load_meta_params(rule: DiscoUpdateRule, key: jax.Array, local_path: str | No
             flat = dict(np.load(f))
         return _params_from_flat(flat, template), True
     except Exception as exc:  # noqa: BLE001 — any fetch/structure failure falls back
-        print(
-            f"[disco] pretrained meta-params unavailable ({type(exc).__name__}: {exc}); "
-            "falling back to random init — use mode='grounded' for learning"
+        get_logger("stoix_tpu.disco").warning(
+            "[disco] pretrained meta-params unavailable (%s: %s); "
+            "falling back to random init — use mode='grounded' for learning",
+            type(exc).__name__, exc,
         )
         return template, False

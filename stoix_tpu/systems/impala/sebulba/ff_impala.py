@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
+from stoix_tpu.observability import annotate
 from stoix_tpu.ops import running_statistics
 from stoix_tpu.ops.multistep import vtrace_td_error_and_advantage
 from stoix_tpu.parallel.mesh import shard_map
@@ -129,6 +130,7 @@ def get_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: M
         def loss_fn(params: ActorCriticParams, mb: PPOTransition):
             return impala_loss(params.actor_params, params.critic_params, mb)
 
+        @annotate("impala_minibatch")
         def _minibatch(carry, mb: PPOTransition):
             params, opt_states = carry
             grads, metrics = jax.grad(loss_fn, has_aux=True)(params, mb)

@@ -43,6 +43,7 @@ from stoix_tpu.base_types import (
     PPOTransition,
 )
 from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.observability import annotate, get_logger
 from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.parallel import is_coordinator
@@ -170,6 +171,7 @@ def get_learner_fn(
             value_loss = jnp.mean((value - targets) ** 2)
         return float(config.system.vf_coef) * value_loss, value_loss
 
+    @annotate("ppo_minibatch")
     def _update_minibatch(train_state: Tuple, batch_info: Tuple):
         params, opt_states, behavior_actor_params, kl_beta = train_state
         traj_batch, advantages, targets = batch_info
@@ -218,6 +220,7 @@ def get_learner_fn(
             kl_beta,
         ), loss_info
 
+    @annotate("ppo_epoch")
     def _update_epoch(update_state: Tuple, _: Any):
         (
             params, opt_states, behavior_actor_params, kl_beta,
@@ -447,8 +450,10 @@ def learner_setup(
 
     if is_coordinator():
         n_params = count_parameters(actor_params) + count_parameters(critic_params)
-        print(f"[setup] {n_params:,} parameters | mesh {dict(mesh.shape)} | "
-              f"{config.arch.total_num_envs} global envs")
+        get_logger("stoix_tpu.setup").info(
+            "[setup] %s parameters | mesh %s | %s global envs",
+            f"{n_params:,}", dict(mesh.shape), config.arch.total_num_envs,
+        )
 
     normalize_obs = bool(config.system.get("normalize_observations", False))
     if normalize_obs:

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
 from stoix_tpu.envs.factory import make_factory
 from stoix_tpu.evaluator import get_distribution_act_fn, get_ff_evaluator_fn
+from stoix_tpu.observability import RunStats, annotate, get_logger, get_registry, span
 from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.parallel import assemble_global_array
@@ -49,8 +50,10 @@ from stoix_tpu.utils.training import make_learning_rate
 
 # Throughput stats of the most recent run_experiment call in this process
 # (steady-state window: after the first eval block, i.e. post-compile).
-# Read by bench.py --sebulba; a dict so callers can ignore it entirely.
-LAST_RUN_STATS: dict = {}
+# Read by bench.py --sebulba; dict-compatible (RunStats) so callers can
+# ignore it entirely. The underlying series live in the metrics registry
+# (stoix_tpu_sebulba_*).
+LAST_RUN_STATS = RunStats()
 
 
 class CoreLearnerState(NamedTuple):
@@ -123,6 +126,7 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
             standardize_advantages=bool(config.system.get("standardize_advantages", True)),
         )
 
+        @annotate("ppo_minibatch")
         def _minibatch(carry, batch):
             params, opt_states = carry
             mb_traj, mb_adv, mb_tgt = batch
@@ -158,6 +162,7 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
                 "actor_loss": a_loss, "value_loss": v_loss, "entropy": entropy,
             }
 
+        @annotate("ppo_epoch")
         def _epoch(carry, _):
             params, opt_states, key = carry
             key, shuffle_key = jax.random.split(key)
@@ -227,8 +232,13 @@ def rollout_thread(
     except Exception:
         import traceback
 
-        print(f"[actor-{actor_id}] CRASHED:", flush=True)
-        traceback.print_exc()
+        get_registry().counter(
+            "stoix_tpu_sebulba_actor_crashes_total",
+            "Actor threads that died with an exception",
+        ).inc(labels={"actor": str(actor_id)})
+        get_logger("stoix_tpu.sebulba").error(
+            "[actor-%d] CRASHED:\n%s", actor_id, traceback.format_exc()
+        )
         lifetime.stop()
 
 
@@ -266,7 +276,7 @@ def _rollout_body(
                         break
                     params = fetched
             traj: List[PPOTransition] = []
-            with timer.time("rollout"):
+            with span("actor_rollout", actor=actor_id, idx=rollout_idx), timer.time("rollout"):
                 for _ in range(rollout_length):
                     key, act_key = jax.random.split(key)
                     with timer.time("inference"):
@@ -294,7 +304,7 @@ def _rollout_body(
                     )
                     timestep = next_timestep
 
-            with timer.time("prepare_data"):
+            with span("actor_prepare_data", actor=actor_id), timer.time("prepare_data"):
                 # Stack [T, E] then split the env axis across learner devices
                 # as single-device shards for global-array assembly.
                 stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *traj)
@@ -316,7 +326,10 @@ def _rollout_body(
             metrics_sink.put(
                 {
                     "episode_metrics": jax.tree.map(np.asarray, stacked.info),
-                    "timings": timer.all_means(prefix=f"actor{actor_id}_"),
+                    "timings": {
+                        **timer.all_means(prefix=f"actor{actor_id}_"),
+                        **timer.all_percentiles(prefix=f"actor{actor_id}_"),
+                    },
                 }
             )
             rollout_idx += 1
@@ -444,7 +457,12 @@ def run_experiment(
     logger = StoixLogger(config)
     lifetime = ThreadLifetime()
     pipeline = OnPolicyPipeline(num_actors)
-    param_server = ParameterServer(actor_devices, actors_per_device)
+    # One heartbeat board for the whole run: actor beats come from the
+    # pipeline, param-server and evaluator beats land on the same board so
+    # the stall detector sees every component's age.
+    param_server = ParameterServer(
+        actor_devices, actors_per_device, heartbeats=pipeline.heartbeats
+    )
     metrics_sink: "queue.Queue" = queue.Queue()
 
     eval_results: List[float] = []
@@ -453,7 +471,9 @@ def run_experiment(
         logger.log(metrics, t, len(eval_results), LogEvent.EVAL)
         eval_results.append(float(jnp.mean(metrics["episode_return"])))
 
-    async_evaluator = AsyncEvaluator(eval_fn, lifetime, on_eval_result)
+    async_evaluator = AsyncEvaluator(
+        eval_fn, lifetime, on_eval_result, heartbeats=pipeline.heartbeats
+    )
     async_evaluator.thread.start()
 
     param_server.distribute_params((params, obs_stats))
@@ -483,7 +503,7 @@ def run_experiment(
         for update_idx in range(int(config.arch.num_updates)):
             with timer.time("rollout_get"):
                 payloads = pipeline.collect_rollouts()
-            with timer.time("assemble"):
+            with span("learner_assemble", update=update_idx), timer.time("assemble"):
                 # Per learner device: concat all actors' shards, then build one
                 # global array per leaf.
                 def to_global(*leaves):
@@ -505,7 +525,7 @@ def run_experiment(
                 ]
                 batch = jax.tree.unflatten(treedef, merged_leaves)
 
-            with timer.time("learn"):
+            with span("learner_update", update=update_idx), timer.time("learn"):
                 learner_state, train_metrics = learn_step(learner_state, batch)
                 jax.block_until_ready(train_metrics)
             param_server.distribute_params(
@@ -528,8 +548,14 @@ def run_experiment(
                                update_idx, LogEvent.ACT)
                 logger.log(jax.tree.map(lambda x: jnp.mean(x), train_metrics),
                            t_steps, update_idx, LogEvent.TRAIN)
-                logger.log({**timings, **timer.all_means(prefix="learner_")},
-                           t_steps, update_idx, LogEvent.MISC)
+                logger.log(
+                    {
+                        **timings,
+                        **timer.all_means(prefix="learner_"),
+                        **timer.all_percentiles(prefix="learner_"),
+                    },
+                    t_steps, update_idx, LogEvent.MISC,
+                )
                 key, ek = jax.random.split(key)
                 if normalize_obs:
                     eval_payload = (
@@ -553,11 +579,10 @@ def run_experiment(
     finally:
         lifetime.stop()
         param_server.shutdown()
-        # Unblock actors waiting to enqueue.
+        # Unblock actors waiting to enqueue (uninstrumented: drain gets are
+        # teardown artifacts and must not pollute the queue-wait series).
         for _ in range(2):
-            try:
-                pipeline.collect_rollouts(timeout=0.5)
-            except Exception:
+            if pipeline.drain(timeout=0.5) == 0:
                 break
         for t in actor_threads:
             t.join(timeout=10.0)
@@ -567,6 +592,10 @@ def run_experiment(
         steady = (t_steps - steady_start_steps) / (
             steady_end_time - steady_start_time
         )
+        get_registry().gauge(
+            "stoix_tpu_sebulba_steps_per_sec_steady",
+            "Post-compile steady-state env-steps/sec of the most recent run",
+        ).set(steady)
         LAST_RUN_STATS["steps_per_sec_steady"] = steady
         LAST_RUN_STATS["steady_window_steps"] = t_steps - steady_start_steps
 

@@ -42,12 +42,19 @@ invariants that make it legal:
 program — classic Anakin, one XLA launch per window; RNN/stateful evaluators
 fall back to the snapshot-overlap path automatically.
 
-Observability: per-phase host-side wall time (learn_s/eval_s/fetch_s/ckpt_s +
-compile_s) accumulates into `LAST_RUN_STATS["phase_breakdown"]` (bench.py
-forwards it), and STOIX_TPU_PROFILE_DIR=<dir> wraps one steady-state eval
-window in `jax.profiler.trace`. In the pipelined loop the phases are HOST
-attribution: device time spent in learn/eval surfaces as fetch_s (the
-materialize wait), while learn_s/eval_s shrink to dispatch cost.
+Observability (stoix_tpu/observability, docs/DESIGN.md §2.2): per-phase
+host-side wall time (learn_s/eval_s/fetch_s/ckpt_s + compile_s) accumulates
+into the process-wide metrics registry
+(`stoix_tpu_runner_phase_seconds_total{phase=...}`) and is mirrored into the
+dict-compatible `LAST_RUN_STATS["phase_breakdown"]` view at run end (bench.py
+forwards it). With `logger.telemetry.enabled=true` every dispatcher phase
+also records a host span (learn_dispatch / snapshot_dispatch / eval_dispatch
+/ fetch_dispatch / fetch_materialize / log / ckpt_save), exported as
+Perfetto-loadable JSON next to the `jax.profiler` device trace that
+STOIX_TPU_PROFILE_DIR=<dir> wraps around one steady-state eval window. In the
+pipelined loop the phases are HOST attribution: device time spent in
+learn/eval surfaces as fetch_s (the materialize wait), while learn_s/eval_s
+shrink to dispatch cost.
 """
 
 from __future__ import annotations
@@ -61,6 +68,13 @@ import jax.numpy as jnp
 
 from stoix_tpu import envs
 from stoix_tpu.evaluator import evaluator_setup, get_rnn_evaluator_fn
+from stoix_tpu.observability import (
+    RunStats,
+    device_annotation,
+    get_logger,
+    get_registry,
+    span,
+)
 from stoix_tpu.parallel import (
     create_mesh,
     fetch_global,
@@ -76,8 +90,37 @@ from stoix_tpu.utils.timestep_checker import check_total_timesteps
 
 # Stats of the most recent run_anakin_experiment call (this process):
 # phase_breakdown {compile_s, learn_s, eval_s, fetch_s, ckpt_s},
-# steady_state_sps, pipelined, fused_eval. bench.py reads this.
-LAST_RUN_STATS: dict = {}
+# steady_state_sps, pipelined, fused_eval. bench.py reads this. The values
+# are published to the process-wide metrics registry during the run
+# (stoix_tpu_runner_* series — the source of truth) and refreshed into this
+# dict-compatible view at run end.
+LAST_RUN_STATS = RunStats()
+
+_PHASE_NAMES = ("compile_s", "learn_s", "eval_s", "fetch_s", "ckpt_s")
+
+
+class _PhaseClock:
+    """Per-run view over the cumulative registry phase counter: records into
+    `stoix_tpu_runner_phase_seconds_total{phase=...}` and reports this run's
+    deltas (the registry is process-wide; LAST_RUN_STATS is per-run)."""
+
+    def __init__(self) -> None:
+        self._counter = get_registry().counter(
+            "stoix_tpu_runner_phase_seconds_total",
+            "Cumulative Anakin host-loop wall time per phase",
+        )
+        self._base = {
+            name: self._counter.value({"phase": name}) for name in _PHASE_NAMES
+        }
+
+    def add(self, name: str, seconds: float) -> None:
+        self._counter.inc(seconds, {"phase": name})
+
+    def breakdown(self) -> dict:
+        return {
+            name: self._counter.value({"phase": name}) - self._base[name]
+            for name in _PHASE_NAMES
+        }
 
 
 class AnakinSetup(NamedTuple):
@@ -156,7 +199,9 @@ def run_anakin_experiment(
             learner_state, load_args.get("timestep")
         )
         if is_coordinator():
-            print(f"[checkpoint] restored state from step {start_step}")
+            get_logger("stoix_tpu.checkpoint").info(
+                "[checkpoint] restored state from step %d", start_step
+            )
 
     make_evaluators = evaluator_setup_fn or evaluator_setup
     evaluator, absolute_evaluator = make_evaluators(eval_env, setup.eval_act_fn, config, mesh)
@@ -183,7 +228,11 @@ def run_anakin_experiment(
         pipelined = False
 
     learn = setup.learn
-    phases = {"compile_s": 0.0, "learn_s": 0.0, "eval_s": 0.0, "fetch_s": 0.0, "ckpt_s": 0.0}
+    phases = _PhaseClock()
+    compile_counter = get_registry().counter(
+        "stoix_tpu_runner_compile_seconds_total",
+        "Cumulative XLA compile time paid by AOT warmup",
+    )
 
     if fused:
         # One XLA program per window: learn + eval-params selection + the FF
@@ -202,13 +251,16 @@ def run_anakin_experiment(
     # AOT warmup: pay the learner's XLA compile before the timed loop so the
     # first window's steps_per_second is throughput, not compile time.
     t0 = time.perf_counter()
-    if fused:
-        # Aval-identical stand-in for the per-window eval keys below.
-        example_key = jax.random.split(jax.random.PRNGKey(0))[1]
-        fused_step = aot_warmup(fused_step, learner_state, example_key)
-    else:
-        learn = aot_warmup(learn, learner_state)
-    phases["compile_s"] = time.perf_counter() - t0
+    with span("aot_warmup", fused=fused):
+        if fused:
+            # Aval-identical stand-in for the per-window eval keys below.
+            example_key = jax.random.split(jax.random.PRNGKey(0))[1]
+            fused_step = aot_warmup(fused_step, learner_state, example_key)
+        else:
+            learn = aot_warmup(learn, learner_state)
+    compile_s = time.perf_counter() - t0
+    phases.add("compile_s", compile_s)
+    compile_counter.inc(compile_s)
 
     best_params = _tree_copy(setup.eval_params_fn(learner_state))
     best_return = -jnp.inf
@@ -232,11 +284,16 @@ def run_anakin_experiment(
         nonlocal learner_state, key, last_save_t
         key, eval_key = jax.random.split(key)
         ts = time.perf_counter()
-        if fused:
-            output, eval_metrics = fused_step(learner_state, eval_key)
-        else:
-            output = learn(learner_state)
-        phases["learn_s"] += time.perf_counter() - ts
+        # device_annotation: names this dispatch in the jax.profiler device
+        # trace (STOIX_TPU_PROFILE_DIR) so host spans and TraceMe rows share
+        # the taxonomy; a TraceMe is nanoseconds when no profiler is active.
+        with span("learn_dispatch", window=eval_idx, fused=fused), \
+                device_annotation("learn_dispatch"):
+            if fused:
+                output, eval_metrics = fused_step(learner_state, eval_key)
+            else:
+                output = learn(learner_state)
+        phases.add("learn_s", time.perf_counter() - ts)
         learner_state = output.learner_state
         t = start_step + (eval_idx + 1) * steps_per_eval
 
@@ -244,33 +301,36 @@ def run_anakin_experiment(
         # happens: donation of learner_state stays legal while eval/best/ckpt
         # consumers read the copies at their leisure. The full-state copy is
         # only taken for windows orbax's save policy will actually accept.
-        snapshot = _tree_copy(setup.eval_params_fn(learner_state))
-        take_ckpt = (
-            checkpointer is not None
-            and snapshot_ckpt
-            and checkpointer.should_save(t, last_issued=last_save_t)
-        )
-        if take_ckpt:
-            last_save_t = t
-        ckpt_state = _tree_copy(learner_state) if take_ckpt else None
+        with span("snapshot_dispatch", window=eval_idx):
+            snapshot = _tree_copy(setup.eval_params_fn(learner_state))
+            take_ckpt = (
+                checkpointer is not None
+                and snapshot_ckpt
+                and checkpointer.should_save(t, last_issued=last_save_t)
+            )
+            if take_ckpt:
+                last_save_t = t
+            ckpt_state = _tree_copy(learner_state) if take_ckpt else None
 
         if not fused:
             ts = time.perf_counter()
-            eval_metrics = evaluator(snapshot, eval_key)
-            phases["eval_s"] += time.perf_counter() - ts
+            with span("eval_dispatch", window=eval_idx):
+                eval_metrics = evaluator(snapshot, eval_key)
+            phases.add("eval_s", time.perf_counter() - ts)
 
         # ONE coalesced collective fetch for the whole window (episode, train,
         # and eval metrics ride a single pytree -> a single host-sync point).
         ts = time.perf_counter()
-        metrics = fetch_global_async(
-            {
-                "episode": dict(output.episode_metrics),
-                "train": dict(output.train_metrics),
-                "eval": dict(eval_metrics),
-            },
-            mesh,
-        )
-        phases["fetch_s"] += time.perf_counter() - ts
+        with span("fetch_dispatch", window=eval_idx):
+            metrics = fetch_global_async(
+                {
+                    "episode": dict(output.episode_metrics),
+                    "train": dict(output.train_metrics),
+                    "eval": dict(eval_metrics),
+                },
+                mesh,
+            )
+        phases.add("fetch_s", time.perf_counter() - ts)
         return _Window(eval_idx, t, snapshot, ckpt_state, metrics)
 
     def process_window(window: _Window) -> None:
@@ -278,8 +338,9 @@ def run_anakin_experiment(
         params, and hand the checkpoint snapshot to orbax (async, no wait)."""
         nonlocal best_params, best_return, final_return, window_done_at
         ts = time.perf_counter()
-        fetched = materialize(window.metrics)
-        phases["fetch_s"] += time.perf_counter() - ts
+        with span("fetch_materialize", window=window.eval_idx):
+            fetched = materialize(window.metrics)
+        phases.add("fetch_s", time.perf_counter() - ts)
 
         now = time.perf_counter()
         wall = now - window_done_at
@@ -290,16 +351,21 @@ def run_anakin_experiment(
         train_metrics = fetched["train"]
         eval_metrics = fetched["eval"]
         sps = steps_per_eval / wall
+        get_registry().gauge(
+            "stoix_tpu_runner_steps_per_second",
+            "Env-steps/sec over the most recent eval window",
+        ).set(sps)
         if is_coordinator():
-            logger.log(
-                {**episode_metrics, "steps_per_second": sps},
-                window.t, window.eval_idx, LogEvent.ACT,
-            )
-            logger.log(
-                jax.tree.map(lambda x: x.mean(), train_metrics),
-                window.t, window.eval_idx, LogEvent.TRAIN,
-            )
-            logger.log(eval_metrics, window.t, window.eval_idx, LogEvent.EVAL)
+            with span("log", window=window.eval_idx):
+                logger.log(
+                    {**episode_metrics, "steps_per_second": sps},
+                    window.t, window.eval_idx, LogEvent.ACT,
+                )
+                logger.log(
+                    jax.tree.map(lambda x: x.mean(), train_metrics),
+                    window.t, window.eval_idx, LogEvent.TRAIN,
+                )
+                logger.log(eval_metrics, window.t, window.eval_idx, LogEvent.EVAL)
 
         mean_return = float(eval_metrics["episode_return"].mean())
         final_return = mean_return
@@ -312,15 +378,17 @@ def run_anakin_experiment(
             # save. The snapshot is not donated to anything, so the async save
             # needs no wait() here — serialization overlaps the next window.
             ts = time.perf_counter()
-            if window.ckpt_state is not None:
-                checkpointer.save(window.t, window.ckpt_state, mean_return)
-            elif not snapshot_ckpt and checkpointer.should_save(window.t):
-                # ckpt_snapshot=false forced the loop synchronous: the live
-                # state is not yet donated here, so save it directly and wait
-                # before the next dispatch can donate it (old semantics).
-                checkpointer.save(window.t, learner_state, mean_return)
-                checkpointer.wait()
-            phases["ckpt_s"] += time.perf_counter() - ts
+            with span("ckpt_save", window=window.eval_idx):
+                if window.ckpt_state is not None:
+                    checkpointer.save(window.t, window.ckpt_state, mean_return)
+                elif not snapshot_ckpt and checkpointer.should_save(window.t):
+                    # ckpt_snapshot=false forced the loop synchronous: the live
+                    # state is not yet donated here, so save it directly and
+                    # wait before the next dispatch can donate it (old
+                    # semantics).
+                    checkpointer.save(window.t, learner_state, mean_return)
+                    checkpointer.wait()
+            phases.add("ckpt_s", time.perf_counter() - ts)
 
         if window.eval_idx == profile_window:
             try:
@@ -369,10 +437,14 @@ def run_anakin_experiment(
         if len(window_walls) > 1
         else (steps_per_eval / window_walls[0] if window_walls else 0.0)
     )
+    get_registry().gauge(
+        "stoix_tpu_runner_steady_state_sps",
+        "Post-first-window env-steps/sec of the most recent Anakin run",
+    ).set(steady)
     LAST_RUN_STATS.clear()
     LAST_RUN_STATS.update(
         {
-            "phase_breakdown": {k: round(v, 6) for k, v in phases.items()},
+            "phase_breakdown": {k: round(v, 6) for k, v in phases.breakdown().items()},
             "steady_state_sps": steady,
             "pipelined": pipelined,
             "fused_eval": fused,

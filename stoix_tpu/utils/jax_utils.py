@@ -38,7 +38,9 @@ def aot_compile(fn: Any, *example_args: Any) -> Any:
         cost = compiled.cost_analysis()
         flops = cost.get("flops") if isinstance(cost, dict) else cost[0].get("flops")
         if flops:
-            print(f"[aot] estimated FLOPs/call: {flops:.3e}")
+            from stoix_tpu.observability import get_logger
+
+            get_logger("stoix_tpu.aot").info("[aot] estimated FLOPs/call: %.3e", flops)
     except Exception:
         pass
     return compiled

@@ -29,14 +29,24 @@ class LogEvent(enum.Enum):
 
 def describe(x: Any) -> Dict[str, float]:
     arr = np.asarray(x, dtype=np.float32).reshape(-1)
-    if arr.size == 0:
-        return {}
-    return {
-        "mean": float(arr.mean()),
-        "std": float(arr.std()),
-        "min": float(arr.min()),
-        "max": float(arr.max()),
+    # Mask non-finite entries: one NaN/inf episode metric (a diverged env,
+    # an inf-return overflow) must not poison all four summary stats.
+    finite = arr[np.isfinite(arr)]
+    if finite.size == 0:
+        return (
+            {}
+            if arr.size == 0
+            else {"non_finite_count": float(arr.size)}
+        )
+    stats = {
+        "mean": float(finite.mean()),
+        "std": float(finite.std()),
+        "min": float(finite.min()),
+        "max": float(finite.max()),
     }
+    if finite.size != arr.size:
+        stats["non_finite_count"] = float(arr.size - finite.size)
+    return stats
 
 
 class BaseSink:
@@ -369,6 +379,26 @@ class StoixLogger:
                 "architecture_name", getattr(config.arch, "architecture_name", "anakin")
             )
             self._sinks.append(NeptuneSink(os.path.join(exp_dir, "neptune"), **kwargs))
+
+        # Telemetry (observability package): configure is the single switch —
+        # disabled (default) records nothing and starts no threads. Enabled,
+        # a TelemetrySink fans registry snapshots into Prometheus/JSONL files
+        # and exports the span trace on close (docs/DESIGN.md §2.2).
+        from stoix_tpu import observability
+
+        telemetry_cfg = logger_cfg.get("telemetry") or {}
+        if observability.configure(telemetry_cfg):
+            from stoix_tpu.observability.sink import TelemetrySink
+
+            telemetry_dir = telemetry_cfg.get("dir") or os.path.join(exp_dir, "telemetry")
+            self._sinks.append(
+                TelemetrySink(
+                    telemetry_dir,
+                    min_write_interval_s=float(
+                        telemetry_cfg.get("min_write_interval_s", 0.0) or 0.0
+                    ),
+                )
+            )
 
         self._solve_threshold = config.env.get("solved_return_threshold")
 

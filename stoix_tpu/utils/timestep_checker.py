@@ -13,6 +13,14 @@ from __future__ import annotations
 
 from typing import Any
 
+from stoix_tpu.observability import get_logger
+
+
+def _log():
+    # Resolved at the log site, not import time, so an application's
+    # logging config (basicConfig/root handlers) wins (see get_logger).
+    return get_logger("stoix_tpu.timestep_check")
+
 
 def check_total_timesteps(config: Any, num_data_shards: int) -> Any:
     arch = config.arch
@@ -37,7 +45,7 @@ def check_total_timesteps(config: Any, num_data_shards: int) -> Any:
     requested = arch.get("total_timesteps")
     arch.total_timesteps = int(arch.num_updates) * steps_per_update
     if requested is not None and int(float(requested)) != arch.total_timesteps:
-        print(
+        _log().info(
             f"[timestep-check] total_timesteps adjusted {int(float(requested))} -> "
             f"{arch.total_timesteps} (num_updates={arch.num_updates}, "
             f"steps/update={steps_per_update})"
@@ -56,7 +64,7 @@ def check_total_timesteps(config: Any, num_data_shards: int) -> Any:
             # device-runtime execution limits: the round-2 TPU wedge), which
             # is exactly what this check exists to prevent.
             trimmed = (num_updates // num_evaluation) * num_evaluation
-            print(
+            _log().info(
                 f"[timestep-check] num_updates adjusted {num_updates} -> "
                 f"{trimmed} (multiple of num_evaluation={num_evaluation}; "
                 f"total_timesteps {arch.total_timesteps} -> "
@@ -68,7 +76,7 @@ def check_total_timesteps(config: Any, num_data_shards: int) -> Any:
         else:
             requested_evals = num_evaluation
             num_evaluation = num_updates  # one eval per update
-            print(
+            _log().info(
                 f"[timestep-check] num_evaluation adjusted {requested_evals} "
                 f"-> {num_evaluation} (run has only {num_updates} updates)"
             )

@@ -34,3 +34,25 @@ class TimingTracker:
 
     def all_means(self, prefix: str = "") -> Dict[str, float]:
         return {f"{prefix}{k}_time": self.mean(k) for k in self._times}
+
+    def percentiles(self, name: str) -> Dict[str, float]:
+        """p50/p95/max over the current rolling window (nearest-rank on the
+        sorted window: p50 of a single sample is that sample). Empty window
+        -> {} so callers can `.update()` unconditionally."""
+        times = self._times.get(name)
+        if not times:
+            return {}
+        ordered = sorted(times)
+        n = len(ordered)
+
+        def rank(q: float) -> float:
+            return ordered[min(n - 1, max(0, int(q * n + 0.5) - 1))]
+
+        return {"p50": rank(0.50), "p95": rank(0.95), "max": ordered[-1]}
+
+    def all_percentiles(self, prefix: str = "") -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name in self._times:
+            for stat, value in self.percentiles(name).items():
+                out[f"{prefix}{name}_{stat}"] = value
+        return out

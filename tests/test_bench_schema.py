@@ -45,3 +45,11 @@ def test_bench_smoke_payload_schema():
         assert isinstance(phases[key], (int, float)) and phases[key] >= 0.0, phases
     assert phases["compile_s"] > 0.0, phases
     assert phases["steady_state_sps"] > 0.0, phases
+
+    # Telemetry self-check (the probe runs with logger.telemetry.enabled):
+    # host spans were recorded, the registry carries series, and the exported
+    # trace validates against the Chrome trace-event schema.
+    telemetry = payload["telemetry"]
+    assert telemetry["spans"] > 0, telemetry
+    assert telemetry["metric_series"] > 0, telemetry
+    assert telemetry["trace_valid"] is True, telemetry

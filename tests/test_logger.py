@@ -38,6 +38,26 @@ def test_describe_stats():
     stats = describe(np.array([1.0, 2.0, 3.0, 4.0]))
     assert stats["mean"] == 2.5
     assert stats["min"] == 1.0 and stats["max"] == 4.0
+    assert "non_finite_count" not in stats  # clean input -> clean schema
+
+
+def test_describe_masks_nan_and_inf():
+    # Regression: a single non-finite episode metric (diverged env, inf
+    # return) used to poison ALL four summary stats with NaN/inf.
+    stats = describe(np.array([1.0, np.nan, 3.0]))
+    assert stats["mean"] == 2.0 and stats["std"] == 1.0
+    assert stats["min"] == 1.0 and stats["max"] == 3.0
+    assert stats["non_finite_count"] == 1.0
+
+    stats = describe(np.array([2.0, np.inf, -np.inf, 4.0]))
+    assert stats["mean"] == 3.0 and stats["min"] == 2.0 and stats["max"] == 4.0
+    assert stats["non_finite_count"] == 2.0
+
+    # All-non-finite input: no fake stats, only the count.
+    stats = describe(np.array([np.nan, np.inf]))
+    assert stats == {"non_finite_count": 2.0}
+    # Empty input unchanged.
+    assert describe(np.array([])) == {}
 
 
 def test_json_sink_layout_and_solve_rate(tmp_path):
