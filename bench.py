@@ -259,6 +259,27 @@ def main() -> None:
     _finish([_run_anakin_ppo(smoke, cartpole, large, n_devices, metric=metric)])
 
 
+def _resilience_selfcheck(config, skipped_before: float) -> dict:
+    """Resilience posture of the benched run (docs/DESIGN.md §2.3), recorded
+    so a BENCH_*.json number can never silently hide an active divergence
+    guard (guard selection adds ops) or a run that trained through skipped
+    updates: guard mode, skipped-update count during this workload, and
+    whether the config could emergency-checkpoint+resume on preemption."""
+    from stoix_tpu.resilience import guards
+
+    return {
+        "update_guard": guards.resolve_mode(config),
+        "skipped_updates": guards.skipped_counter().value() - skipped_before,
+        "resume_capable": bool(config.logger.checkpointing.get("save_model", False)),
+    }
+
+
+def _skipped_updates_base() -> float:
+    from stoix_tpu.resilience import guards
+
+    return guards.skipped_counter().value()
+
+
 def _timed_anakin_run(config, learner_setup, smoke: bool):
     """Shared timed-loop core: compose -> setup -> warmup -> best-of-N timing.
     Returns (steps_per_sec, n_devices_used)."""
@@ -420,6 +441,7 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
     else:
         from stoix_tpu.systems.ppo.anakin.ff_ppo_continuous import learner_setup
 
+    skipped_before = _skipped_updates_base()
     steps_per_sec = _timed_anakin_run(config, learner_setup, smoke)
     per_chip = steps_per_sec / n_devices
     baseline_per_chip = 1_000_000 / 64  # BASELINE.json north star on v5e-64
@@ -439,6 +461,7 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
         ),
         "phase_breakdown": phase_breakdown,
         "telemetry": telemetry,
+        "resilience": _resilience_selfcheck(config, skipped_before),
     }
 
 
@@ -478,6 +501,7 @@ def _run_anakin_generic(
     config = config_lib.compose(config_lib.default_config_dir(), default_yaml, overrides)
     if isinstance(setup_fn, str):
         setup_fn = importlib.import_module(setup_fn).learner_setup
+    skipped_before = _skipped_updates_base()
     steps_per_sec = _timed_anakin_run(config, setup_fn, smoke)
     return {
         "metric": metric,
@@ -485,6 +509,7 @@ def _run_anakin_generic(
         "unit": f"env_steps/sec ({n_devices} devices, {unit_tag})",
         # Only the PPO/ant north star has a numeric baseline.
         "vs_baseline": None,
+        "resilience": _resilience_selfcheck(config, skipped_before),
     }
 
 
@@ -543,6 +568,7 @@ def _run_sebulba(
     wait_hist = get_registry().histogram("stoix_tpu_sebulba_queue_get_wait_seconds")
     wait_labels = {"queue": "rollout", "actor": "0"}
     before = wait_hist.summary(wait_labels)
+    skipped_before = _skipped_updates_base()
     sebulba_ppo.run_experiment(config)
     steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
     after = wait_hist.summary(wait_labels)
@@ -559,6 +585,14 @@ def _run_sebulba(
         # output contract): a missing steady window means the run ended before
         # the first eval block opened/closed it.
         unit = "NO STEADY WINDOW: first eval block never reached"
+    # The run records its own resilience posture (guard mode, skipped count,
+    # supervisor restarts — a restart mid-bench means the number was measured
+    # through a recovery, which must be visible); fall back to the config
+    # view only if the run never got far enough to publish it.
+    resilience = dict(
+        sebulba_ppo.LAST_RUN_STATS.get("resilience")
+        or _resilience_selfcheck(config, skipped_before)
+    )
     return {
         "metric": metric,
         "value": round(float(steady), 1) if steady else 0.0,
@@ -567,6 +601,7 @@ def _run_sebulba(
         # none for its sebulba arch); report the raw number.
         "vs_baseline": None,
         "telemetry": telemetry,
+        "resilience": resilience,
     }
 
 

@@ -24,6 +24,15 @@ pyproject.toml, so installing them upgrades the gate with zero changes here):
      `observability.RunStats` view instead). Allowlisted: utils/logger.py
      (the ConsoleSink IS the console) and sweep.py (JSON-lines stdout
      contract); scripts/ and bench.py are not library code.
+  6. no swallowed exceptions (STX003): `stoix_tpu/` library code must not
+     catch a BROAD exception type (bare `except:`, `except Exception`,
+     `except BaseException`) and do nothing with it (`pass`/`...` body).
+     Silently eaten failures are how a wedged actor or a half-written
+     checkpoint turns into a 180s-timeout mystery — either narrow the type
+     (e.g. `except queue.Empty`), handle it (log/counter/re-raise), or
+     carry a `# noqa` with a reason on the except line. Allowlisted:
+     resilience/faultinject.py (the chaos layer must never let its own
+     bookkeeping mask the failure it is injecting).
 
 Exit code 0 = clean, 1 = findings. Run: python scripts/lint.py [paths...]
 """
@@ -259,6 +268,61 @@ def check_observability_ownership(path: str, source: str, tree: ast.AST) -> List
     return findings
 
 
+# STX003: broad except + do-nothing body = a swallowed failure. Only the
+# fault injector may do this (its own bookkeeping must never mask the fault
+# it injects).
+_STX003_ALLOWLIST = {
+    os.path.join("stoix_tpu", "resilience", "faultinject.py"),
+}
+_BROAD_EXCEPTION_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:  # bare `except:`
+        return True
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) else [handler.type]
+    for node in types:
+        if isinstance(node, ast.Name) and node.id in _BROAD_EXCEPTION_NAMES:
+            return True
+    return False
+
+
+def _body_swallows(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+def check_exception_swallowing(path: str, source: str, tree: ast.AST) -> List[str]:
+    rel = os.path.relpath(path, REPO)
+    if not rel.startswith("stoix_tpu" + os.sep) or rel in _STX003_ALLOWLIST:
+        return []
+    lines = source.splitlines()
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (_is_broad_handler(node) and _body_swallows(node)):
+            continue
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        if "noqa" in line:
+            continue
+        findings.append(
+            f"{rel}:{node.lineno}: broad exception swallowed (`except "
+            f"Exception: pass`) in library code — narrow the type, handle "
+            f"it, or add a reasoned noqa (STX003)"
+        )
+    return findings
+
+
 def run_external(tool: str, args: List[str]) -> List[str]:
     try:
         __import__(tool)
@@ -293,6 +357,7 @@ def main(argv: List[str]) -> int:
         errors.extend(check_unused_imports(path, source, tree))
         errors.extend(check_host_sync_ownership(path, source, tree))
         errors.extend(check_observability_ownership(path, source, tree))
+        errors.extend(check_exception_swallowing(path, source, tree))
         errs, warns = check_hygiene(path, source)
         errors.extend(errs)
         warnings.extend(warns)

@@ -33,6 +33,7 @@ SBATCH_TEMPLATE = """#!/bin/bash
 #SBATCH --nodes={nodes}
 #SBATCH --ntasks-per-node=1
 #SBATCH --time={time}
+#SBATCH --signal=TERM@{preempt_grace}
 {partition_line}{extra_lines}
 # Multi-host JAX coordination: process 0's host is the coordinator. The
 # per-task process id must be read INSIDE the srun'd command (the batch shell's
@@ -63,6 +64,18 @@ def main(argv: List[str] | None = None) -> None:
     parser.add_argument("--nodes", type=int, default=1)
     parser.add_argument("--time", default="04:00:00")
     parser.add_argument("--partition", default=None)
+    parser.add_argument(
+        "--preempt-grace",
+        type=int,
+        default=90,
+        help="seconds of SIGTERM warning before SLURM kills the job "
+        "(#SBATCH --signal=TERM@N — no B: prefix, so the signal reaches the "
+        "srun'd training processes themselves, not just the batch shell). "
+        "The in-process preemption handler "
+        "(stoix_tpu/resilience/preemption.py) uses this window to drain the "
+        "dispatcher and write an emergency checkpoint, so a preempted run "
+        "resumes instead of losing up to a checkpoint interval of work.",
+    )
     parser.add_argument("--sbatch-extra", nargs="*", default=[], help="raw #SBATCH lines")
     parser.add_argument("--script-dir", default="launcher_scripts")
     parser.add_argument("--log-dir", default="launcher_logs")
@@ -100,6 +113,7 @@ def main(argv: List[str] | None = None) -> None:
             log_dir=args.log_dir,
             nodes=args.nodes,
             time=args.time,
+            preempt_grace=args.preempt_grace,
             partition_line=partition_line,
             extra_lines=extra_lines,
             module=job["module"],

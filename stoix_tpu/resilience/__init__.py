@@ -1,0 +1,42 @@
+"""Fault tolerance for both Podracer architectures (docs/DESIGN.md §2.3).
+
+Zero-dependency, off-by-default-transparent. Four pillars:
+
+  * **Divergence guards** (guards.py): `system.update_guard=off|skip|halt`
+    wraps the gradient step of the PPO/IMPALA/DQN-family systems with
+    non-finite detection on loss + global grad-norm; `skip` no-ops bad
+    updates (counter: `stoix_tpu_learner_skipped_updates`), `halt` raises
+    DivergenceError on the host naming step/loss/metric.
+  * **Preemption-safe stop/resume** (preemption.py): SIGTERM/SIGINT request a
+    graceful stop at the next window boundary; the Anakin runner drains its
+    pipelined dispatcher, writes an emergency checkpoint, and exits cleanly.
+    Restore (utils/checkpointing.py) validates integrity and falls back to
+    the newest VALID checkpoint when the latest is corrupt.
+  * **Sebulba supervision** (supervisor.py): crashed actors restart with
+    bounded exponential backoff; unrecoverable/wedged actors propagate a
+    typed ComponentFailure poison-pill so the learner fails fast instead of
+    burning the collect timeout.
+  * **Fault injection** (faultinject.py): `STOIX_TPU_FAULT=actor_crash:3,...`
+    deterministically injects crashes, wedges, NaN losses, checkpoint
+    corruption, and SIGTERM so tests/test_resilience.py proves every
+    recovery path end-to-end.
+
+With everything at defaults (`update_guard=off`, no faults armed, no crashes)
+training is bit-identical to a build without this package — guards add zero
+ops, the signal handler only reacts to signals, and supervision only acts on
+failures (tests/test_resilience.py pins the trajectory equality).
+"""
+
+from stoix_tpu.resilience import faultinject, guards  # noqa: F401 — public API
+from stoix_tpu.resilience.errors import (  # noqa: F401
+    CheckpointIntegrityError,
+    ComponentFailure,
+    DivergenceError,
+    EvaluatorStallError,
+    InjectedFault,
+)
+from stoix_tpu.resilience.preemption import PreemptionHandler  # noqa: F401
+from stoix_tpu.resilience.supervisor import (  # noqa: F401
+    ActorSupervisor,
+    supervisor_from_config,
+)

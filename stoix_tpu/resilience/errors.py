@@ -1,0 +1,85 @@
+"""Typed failure vocabulary for the fault-tolerance layer (docs/DESIGN.md §2.3).
+
+Every recovery path in stoix_tpu/resilience raises (or propagates) one of
+these instead of an anonymous RuntimeError/queue.Empty/orbax exception, so
+callers — and the fault-injection test-suite — can assert on the FAILURE
+CLASS, not on message strings. This module imports nothing from the rest of
+the package; it is safe to import from utils/, sebulba/, and systems/ without
+creating cycles.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class DivergenceError(RuntimeError):
+    """Raised on the host by `system.update_guard=halt` when the in-jit guard
+    flags a non-finite loss or global grad-norm. Names the step, the loss,
+    and the offending metric so the operator knows WHAT diverged, not just
+    that something did."""
+
+    def __init__(self, step: int, loss: float, grad_norm: float, metric: str):
+        self.step = int(step)
+        self.loss = float(loss)
+        self.grad_norm = float(grad_norm)
+        self.metric = metric
+        super().__init__(
+            f"learner diverged at step {self.step}: non-finite {metric} "
+            f"(loss={self.loss}, grad_norm={self.grad_norm}); the guarded "
+            f"update was NOT applied (update_guard=halt). Re-run with "
+            f"system.update_guard=skip to drop bad updates instead of halting."
+        )
+
+
+class ComponentFailure(RuntimeError):
+    """Poison-pill for Sebulba: a component (actor thread, evaluator) failed
+    unrecoverably. Propagated through OnPolicyPipeline/ParameterServer queues
+    so the peer FAILS FAST on its next get instead of burning a full collect
+    timeout against a dead producer."""
+
+    def __init__(self, component: str, reason: str, cause: Optional[BaseException] = None):
+        self.component = component
+        self.reason = reason
+        self.__cause__ = cause
+        detail = f": {type(cause).__name__}: {cause}" if cause is not None else ""
+        super().__init__(f"{component} failed unrecoverably ({reason}){detail}")
+
+
+class EvaluatorStallError(RuntimeError):
+    """AsyncEvaluator.wait_until_idle timed out: evaluation work is still
+    in flight (or wedged) at shutdown. Carries the evaluator's last-heartbeat
+    age so the caller can tell slow-but-alive from dead."""
+
+    def __init__(self, timeout: float, heartbeat_age: Optional[float], pending: int):
+        self.timeout = float(timeout)
+        self.heartbeat_age = heartbeat_age
+        self.pending = int(pending)
+        age = (
+            "never completed an evaluation"
+            if heartbeat_age is None
+            else f"last finished one {heartbeat_age:.1f}s ago"
+        )
+        super().__init__(
+            f"async evaluator still busy after {timeout:.0f}s "
+            f"({pending} request(s) queued; {age}) — shutdown would drop "
+            f"in-flight evaluation work"
+        )
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A restored checkpoint failed validation (tree-structure mismatch or a
+    non-finite value where the template is finite). Restore falls back to the
+    newest VALID checkpoint when one exists; this error surfaces only when no
+    candidate passes."""
+
+    def __init__(self, step: int, reason: str):
+        self.step = int(step)
+        self.reason = reason
+        super().__init__(f"checkpoint at step {step} failed integrity validation: {reason}")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the fault-injection harness (resilience/faultinject.py) at an
+    armed injection point. Distinct from real failures so supervision tests
+    can assert the recovery path fired on THIS fault and not a genuine bug."""

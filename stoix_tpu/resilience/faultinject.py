@@ -1,0 +1,233 @@
+"""Deterministic fault injection (chaos layer) for the resilience test-suite.
+
+Armed via the `STOIX_TPU_FAULT` env var or `arch.fault_spec` config key, e.g.
+
+    STOIX_TPU_FAULT=actor_crash:3,nan_loss:50,ckpt_corrupt,sigterm:2
+
+Spec grammar: comma-separated `name[:arg]` entries (a mapping
+`{actor_crash: 3, ...}` is accepted from config overrides, where YAML parses
+`key:value` into a dict). Faults and their deterministic trigger points:
+
+  actor_crash:N   actor 0 raises InjectedFault at the top of rollout N
+                  (one-shot: a supervised replacement does NOT re-crash)
+  queue_stall:N   actor 0 wedges (sleeps, still alive) at the top of
+                  rollout N — exercises heartbeat wedge detection, which a
+                  crash cannot
+  nan_loss:N      the in-jit divergence guard poisons the loss AND the
+                  parameter update with NaN at optimizer step-count N
+                  (resilience/guards.py reads `poison_step()` at trace time)
+  ckpt_corrupt    the next Checkpointer.save() waits for serialization and
+                  then overwrites the saved step's files with garbage
+                  (one-shot) — exercises restore fallback
+  sigterm:N       the host loop delivers SIGTERM to its own process after
+                  dispatching eval window N (one-shot) — exercises the
+                  preemption handler end-to-end, signal delivery included
+
+All injection points are no-ops (a single None check) when no plan is armed,
+and `configure()` is called once per experiment so one-shot state never leaks
+across runs in the same process. This module is the ONLY place in stoix_tpu/
+allowed to swallow broad exceptions (lint STX003 allowlist): a broken chaos
+layer must never mask the failure it was injecting.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from stoix_tpu.observability import get_logger, get_registry
+from stoix_tpu.resilience.errors import InjectedFault
+
+ENV_VAR = "STOIX_TPU_FAULT"
+
+_KNOWN = ("actor_crash", "queue_stall", "nan_loss", "ckpt_corrupt", "sigterm")
+
+
+class FaultPlan:
+    """Parsed fault spec plus one-shot consumption state (thread-safe)."""
+
+    def __init__(self, faults: Dict[str, Optional[int]]):
+        unknown = set(faults) - set(_KNOWN)
+        if unknown:
+            raise ValueError(
+                f"unknown fault(s) {sorted(unknown)}; known: {list(_KNOWN)}"
+            )
+        self.faults = dict(faults)
+        self._lock = threading.Lock()
+        self._consumed: set = set()
+
+    def arg(self, name: str) -> Optional[int]:
+        """The fault's trigger argument, or None when the fault is not armed.
+        `ckpt_corrupt` is armed with arg 0 (no argument needed)."""
+        if name not in self.faults:
+            return None
+        value = self.faults[name]
+        return 0 if value is None else int(value)
+
+    def consume(self, name: str) -> bool:
+        """One-shot gate: True exactly once per armed fault per plan."""
+        with self._lock:
+            if name not in self.faults or name in self._consumed:
+                return False
+            self._consumed.add(name)
+            return True
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({self.faults})"
+
+
+def parse_spec(spec: Any) -> Optional[FaultPlan]:
+    """Parse a spec string (`name:arg,name`) or mapping into a FaultPlan;
+    None/empty means no faults."""
+    if not spec:
+        return None
+    if isinstance(spec, dict):
+        return FaultPlan({str(k): (None if v is None else int(v)) for k, v in spec.items()})
+    faults: Dict[str, Optional[int]] = {}
+    for entry in str(spec).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, arg = entry.partition(":")
+        faults[name.strip()] = int(arg) if arg else None
+    return FaultPlan(faults) if faults else None
+
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+
+
+def configure(config_spec: Any = None) -> Optional[FaultPlan]:
+    """Install the process-wide plan for one experiment run. The env var wins
+    over the config spec so an operator can chaos-test any entry point without
+    editing configs. Resets one-shot state; call at run start."""
+    global _plan
+    spec = os.environ.get(ENV_VAR) or config_spec
+    with _lock:
+        _plan = parse_spec(spec)
+        if _plan is not None:
+            get_logger("stoix_tpu.resilience").warning(
+                "[faultinject] CHAOS ACTIVE: %s", _plan
+            )
+    return _plan
+
+
+def get_plan() -> Optional[FaultPlan]:
+    with _lock:
+        return _plan
+
+
+def reset() -> None:
+    global _plan
+    with _lock:
+        _plan = None
+
+
+def _injected_counter():
+    return get_registry().counter(
+        "stoix_tpu_resilience_faults_injected_total",
+        "Faults fired by the injection harness, by fault name",
+    )
+
+
+def poison_step() -> Optional[int]:
+    """Optimizer step-count at which the guard should poison the loss/update,
+    or None. Read at TRACE time by resilience/guards.py — `configure()` must
+    run before the learner is built (both runners do)."""
+    plan = get_plan()
+    return None if plan is None else plan.arg("nan_loss")
+
+
+def maybe_crash_actor(actor_id: int, rollout_idx: int) -> None:
+    """Raise InjectedFault when `actor_crash:N` is armed, actor 0, rollout N.
+    One-shot: the supervised replacement thread does not re-crash."""
+    plan = get_plan()
+    if plan is None or actor_id != 0:
+        return
+    at = plan.arg("actor_crash")
+    if at is not None and rollout_idx == at and plan.consume("actor_crash"):
+        _injected_counter().inc(labels={"fault": "actor_crash"})
+        raise InjectedFault(
+            f"injected actor crash (actor-{actor_id}, rollout {rollout_idx})"
+        )
+
+
+def maybe_stall_queue(
+    actor_id: int,
+    rollout_idx: int,
+    should_abort: Optional[Callable[[], bool]] = None,
+    max_stall_s: float = 600.0,
+) -> None:
+    """Wedge (sleep, thread stays alive) when `queue_stall:N` is armed, actor
+    0, rollout N — the silent-stall failure mode heartbeat wedge detection
+    exists for. Aborts early when `should_abort()` turns true (shutdown)."""
+    plan = get_plan()
+    if plan is None or actor_id != 0:
+        return
+    at = plan.arg("queue_stall")
+    if at is None or rollout_idx != at or not plan.consume("queue_stall"):
+        return
+    _injected_counter().inc(labels={"fault": "queue_stall"})
+    get_logger("stoix_tpu.resilience").warning(
+        "[faultinject] actor-%d wedged at rollout %d", actor_id, rollout_idx
+    )
+    deadline = time.monotonic() + max_stall_s
+    while time.monotonic() < deadline:
+        if should_abort is not None and should_abort():
+            return
+        time.sleep(0.05)
+
+
+def maybe_sigterm(window_idx: int) -> None:
+    """Deliver SIGTERM to this process after eval window N (`sigterm:N`)."""
+    plan = get_plan()
+    if plan is None:
+        return
+    at = plan.arg("sigterm")
+    if at is not None and window_idx == at and plan.consume("sigterm"):
+        _injected_counter().inc(labels={"fault": "sigterm"})
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def ckpt_corrupt_armed() -> bool:
+    plan = get_plan()
+    return plan is not None and plan.arg("ckpt_corrupt") is not None
+
+
+def consume_ckpt_corrupt() -> bool:
+    plan = get_plan()
+    return plan is not None and plan.consume("ckpt_corrupt")
+
+
+def corrupt_checkpoint_files(step_dir: str) -> int:
+    """Overwrite the checkpoint payload files under `step_dir` with garbage
+    bytes (truncation + bad magic), returning how many files were mangled.
+    `_CHECKPOINT_METADATA` and the `metrics/` item are left intact: orbax
+    parses BOTH when merely CONSTRUCTING a manager over the directory, and a
+    run must be able to OPEN a corrupt checkpoint store to fall back past it
+    — the realistic preemption victim is the (large, slow-to-write) array
+    payload, not the tiny metadata files. Used by the `ckpt_corrupt` fault
+    and directly by tests."""
+    mangled = 0
+    for root, _dirs, files in os.walk(step_dir):
+        if "metrics" in os.path.relpath(root, step_dir).split(os.sep):
+            continue
+        for name in sorted(files):
+            if name == "_CHECKPOINT_METADATA":
+                continue
+            path = os.path.join(root, name)
+            try:
+                with open(path, "wb") as f:
+                    f.write(b"\x00CORRUPTED-BY-FAULT-INJECTION\x00")
+                mangled += 1
+            except OSError:  # noqa: STX003 — chaos must not crash the host loop
+                pass
+    if mangled:
+        _injected_counter().inc(labels={"fault": "ckpt_corrupt"})
+        get_logger("stoix_tpu.resilience").warning(
+            "[faultinject] corrupted %d file(s) under %s", mangled, step_dir
+        )
+    return mangled

@@ -1,0 +1,217 @@
+"""In-jit divergence guards for the gradient step (docs/DESIGN.md §2.3).
+
+A single non-finite gradient silently poisons params forever: NaN propagates
+through optax's update into every weight, and every later loss is NaN while
+the run keeps "training". The guard wraps the minibatch update of the
+PPO/IMPALA/DQN-family systems with non-finite detection on the LOSS and the
+GLOBAL GRAD-NORM, selected by `system.update_guard`:
+
+  off    (default) bit-identical: the guard adds ZERO ops and no metrics
+  skip   `jnp.where` the whole (params, opt_states) update to a no-op when
+         the signal is non-finite; the optimizer step-count still advances
+         (a skipped batch is a consumed batch — bias-correction schedules
+         keep moving); a `skipped_updates` flag rides the train metrics and
+         the host sums it into the `stoix_tpu_learner_skipped_updates`
+         counter
+  halt   same in-jit selection (params stay finite for the emergency
+         checkpoint), plus the host raises DivergenceError naming the step,
+         the loss, and the offending metric as soon as the window's metrics
+         are materialized
+
+Cross-replica consistency: params are REPLICATED over every axis their
+gradients are pmean'ed over — the mesh "data" axis always, and the in-shard
+`vmap("batch")` update-batch axis in the Anakin systems (grads sync over
+both, so the [U] replicas stay bit-identical and `unbatch_params` may take
+replica 0). Every replica must therefore make the SAME keep/skip decision:
+the detection loss is `lax.pmean`ed over `axis_names` — which must match the
+system's gradient-sync axes — before the finiteness test (NaN anywhere
+pmean-propagates everywhere); the grad-norm is computed from the
+already-pmeaned gradients the caller passes, identical per replica by
+construction. Axes in `metric_axes` (the vmap subset of `axis_names` whose
+replicas materialize as entries in the emitted metrics tree) pre-divide the
+skipped-update flag by their size so the host-side sum counts each skipped
+update exactly once, not once per replica.
+
+Fault injection (`nan_loss:N`, resilience/faultinject.py) lives inside the
+guard: at optimizer step-count N the loss AND every floating leaf of the
+update are poisoned with NaN — under `off` this demonstrably NaNs the params
+(the failure mode the guard exists for); under `skip`/`halt` the guard must
+catch it. The step count is discovered inside the optimizer state (optax's
+`ScaleByAdamState.count` etc.) so injection is deterministic with no carry
+changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from stoix_tpu.observability import get_registry
+from stoix_tpu.resilience import faultinject
+from stoix_tpu.resilience.errors import DivergenceError
+
+VALID_MODES = ("off", "skip", "halt")
+SKIPPED_COUNTER = "stoix_tpu_learner_skipped_updates"
+
+
+def resolve_mode(config: Any) -> str:
+    """Validated `system.update_guard` ('off' when unset)."""
+    raw = config.system.get("update_guard", "off")
+    mode = "off" if raw in (None, False, "~") else str(raw).lower()
+    if mode not in VALID_MODES:
+        raise ValueError(
+            f"system.update_guard={raw!r} is not one of {list(VALID_MODES)}"
+        )
+    return mode
+
+
+def find_step_count(tree: Any) -> Optional[Any]:
+    """First leaf bound to a NamedTuple field named 'count' (optax keeps the
+    optimizer step there, e.g. ScaleByAdamState.count). Depth-first through
+    NamedTuples/tuples/lists/dicts; None when absent."""
+    if hasattr(tree, "_fields"):
+        for field in tree._fields:
+            value = getattr(tree, field)
+            if field == "count" and not hasattr(value, "_fields"):
+                return value
+            found = find_step_count(value)
+            if found is not None:
+                return found
+    elif isinstance(tree, (tuple, list)):
+        for value in tree:
+            found = find_step_count(value)
+            if found is not None:
+                return found
+    elif isinstance(tree, dict):
+        for value in tree.values():
+            found = find_step_count(value)
+            if found is not None:
+                return found
+    return None
+
+
+def _advance_counts(selected: Any, new: Any) -> Any:
+    """Return `selected` with every NamedTuple field named 'count' taken from
+    `new`: a skipped update keeps old params/moments but still consumes the
+    step (otherwise a fault pinned to step N would re-fire forever because
+    the count never passes N)."""
+    if hasattr(selected, "_fields"):
+        return type(selected)(*(
+            getattr(new, f) if f == "count" and not hasattr(getattr(selected, f), "_fields")
+            else _advance_counts(getattr(selected, f), getattr(new, f))
+            for f in selected._fields
+        ))
+    if isinstance(selected, tuple):
+        return type(selected)(_advance_counts(s, n) for s, n in zip(selected, new))
+    if isinstance(selected, list):
+        return [_advance_counts(s, n) for s, n in zip(selected, new)]
+    if isinstance(selected, dict):
+        return {k: _advance_counts(selected[k], new[k]) for k in selected}
+    return selected
+
+
+def guard_update(
+    mode: str,
+    *,
+    new: Any,
+    old: Any,
+    loss: Any,
+    grads: Any,
+    opt_state: Any = None,
+    axis_names: Sequence[str] = ("data",),
+    metric_axes: Sequence[str] = (),
+) -> Tuple[Any, Dict[str, Any]]:
+    """In-jit guard around one minibatch update.
+
+    `new`/`old` are matching (params, opt_states) pytrees (post/pre update);
+    `loss` is the minibatch loss (scalar, may be per-replica — it is
+    pmean'ed over `axis_names`, which MUST match the system's gradient-sync
+    axes, for a replica-consistent verdict); `grads` must be the SYNCED
+    (already pmean'ed) gradients; `opt_state` (the pre-update one) is only
+    used to locate the optimizer step-count for deterministic fault
+    injection; `metric_axes` are the vmap axes among `axis_names` whose
+    replicas appear as separate entries in the emitted metrics (the flag is
+    pre-divided by their size so the host sum is an exact count). Returns
+    (selected_carry, guard_metrics) — metrics is `{}` under mode 'off' with
+    no fault armed, keeping the train-metrics tree (and therefore the whole
+    program) bit-identical.
+    """
+    poison_at = faultinject.poison_step()
+    if mode == "off" and poison_at is None:
+        return new, {}
+
+    loss = jnp.asarray(loss, jnp.float32)
+    if poison_at is not None:
+        count = find_step_count(opt_state)
+        if count is None:
+            poison = jnp.float32(jnp.nan)  # no counter found: poison always
+        else:
+            poison = jnp.where(
+                jnp.asarray(count) == poison_at, jnp.nan, 0.0
+            ).astype(jnp.float32)
+        loss = loss + poison
+        # (poison * 0) is NaN when armed, 0.0 otherwise: adding it to every
+        # floating leaf makes the injected fault a REAL poisoned update, not
+        # just a poisoned detection signal.
+        taint = poison * 0.0
+        new = jax.tree.map(
+            lambda x: x + taint.astype(x.dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else x,
+            new,
+        )
+    if mode == "off":
+        return new, {}
+
+    grad_norm = jnp.asarray(optax.global_norm(grads), jnp.float32)
+    for axis in axis_names:
+        loss = jax.lax.pmean(loss, axis_name=axis)
+    bad = jnp.logical_not(jnp.isfinite(loss) & jnp.isfinite(grad_norm))
+    selected = jax.tree.map(lambda n, o: jnp.where(bad, o, n), new, old)
+    selected = _advance_counts(selected, new)
+    flag = bad.astype(jnp.float32)
+    for axis in metric_axes:
+        # The flag is identical across this vmap axis (the verdict is synced
+        # over it) but each replica emits its own metrics entry: pre-divide
+        # so the host-side sum counts the skip once, not axis-size times.
+        flag = flag / jax.lax.psum(1, axis_name=axis)
+    metrics = {
+        "skipped_updates": flag,
+        "guard_loss": loss,
+        "guard_grad_norm": grad_norm,
+    }
+    return selected, metrics
+
+
+def skipped_counter():
+    return get_registry().counter(
+        SKIPPED_COUNTER,
+        "Gradient updates no-op'ed by the divergence guard (update_guard=skip/halt)",
+    )
+
+
+def publish_guard_metrics(mode: str, train_metrics: Any, step: int) -> float:
+    """Host-side half of the guard, called once per window/update with the
+    MATERIALIZED train metrics: folds the window's skipped-update flags into
+    the registry counter and, under 'halt', raises DivergenceError at the
+    first flagged entry. Returns the number of skips seen this call."""
+    if mode == "off":
+        return 0.0
+    flags = train_metrics.get("skipped_updates") if hasattr(train_metrics, "get") else None
+    if flags is None:
+        return 0.0
+    flags = np.asarray(flags, np.float64).reshape(-1)
+    skipped = float(flags.sum())
+    if skipped:
+        skipped_counter().inc(skipped)
+        if mode == "halt":
+            losses = np.asarray(train_metrics["guard_loss"], np.float64).reshape(-1)
+            norms = np.asarray(train_metrics["guard_grad_norm"], np.float64).reshape(-1)
+            idx = int(np.argmax(flags > 0.0))
+            metric = "loss" if not np.isfinite(losses[idx]) else "grad_norm"
+            raise DivergenceError(step, losses[idx], norms[idx], metric)
+    return skipped

@@ -1,0 +1,110 @@
+"""Preemption-safe graceful stop (docs/DESIGN.md §2.3).
+
+TPU fleet schedulers (and SLURM with `--signal=TERM@grace`) deliver SIGTERM
+shortly before reclaiming a slot. Without handling, a mid-window SIGTERM
+kills the process and throws away up to a full checkpoint interval of work.
+`PreemptionHandler` converts SIGTERM/SIGINT into a REQUEST: the host loop
+checks `stop_requested()` at each window boundary, drains the pipelined
+dispatcher, writes an emergency checkpoint, and returns normally (exit code
+0) so the run can auto-resume from the saved state.
+
+Signal-handler discipline: the handler body only writes plain attributes
+(GIL-atomic) — no locks, no logging, no registry calls — because Python runs
+handlers between bytecodes of the MAIN thread, and re-entering a lock the
+interrupted frame holds would deadlock. Counters and log lines are emitted by
+the consumer (the host loop) after it observes the flag. A second signal
+restores the previous handler and re-raises, so a stuck drain can still be
+killed interactively.
+
+Installation is a no-op (with a warning) outside the main thread: Sebulba's
+learner loop runs in the main thread, but embedders driving experiments from
+worker threads keep their own signal ownership.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Dict, Optional
+
+from stoix_tpu.observability import get_logger, get_registry
+
+_HANDLED = (signal.SIGTERM, signal.SIGINT)
+
+
+class PreemptionHandler:
+    """Graceful-stop flag fed by SIGTERM/SIGINT. Use as a context manager or
+    via install()/uninstall(); always uninstall so later code (pytest, a
+    second experiment) sees the original handlers."""
+
+    def __init__(self) -> None:
+        self._flag = False
+        self._signum: Optional[int] = None
+        self._prev: Dict[int, object] = {}
+        self._installed = False
+
+    # -- signal side (async-signal-safe: attribute writes only) --------------
+    def _on_signal(self, signum, frame) -> None:
+        if self._flag:
+            # Second signal: the operator really means it. Put the previous
+            # handler back and re-deliver so default semantics (kill /
+            # KeyboardInterrupt) apply immediately.
+            prev = self._prev.get(signum)
+            signal.signal(signum, prev if prev is not None else signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        self._flag = True
+        self._signum = signum
+
+    # -- host-loop side ------------------------------------------------------
+    def install(self) -> "PreemptionHandler":
+        if threading.current_thread() is not threading.main_thread():
+            get_logger("stoix_tpu.resilience").warning(
+                "[preemption] not the main thread — signal handlers not "
+                "installed; graceful preemption disabled for this run"
+            )
+            return self
+        for signum in _HANDLED:
+            self._prev[signum] = signal.signal(signum, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for signum, prev in self._prev.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, TypeError):  # interpreter teardown / exotic prev
+                continue
+        self._prev.clear()
+        self._installed = False
+
+    def stop_requested(self) -> bool:
+        return self._flag
+
+    @property
+    def signal_name(self) -> Optional[str]:
+        if self._signum is None:
+            return None
+        return signal.Signals(self._signum).name
+
+    def acknowledge(self, step: int) -> None:
+        """Called by the host loop when it first observes the flag: emits the
+        log line + counter the signal handler could not safely emit itself."""
+        get_registry().counter(
+            "stoix_tpu_resilience_preemptions_total",
+            "Graceful stops triggered by SIGTERM/SIGINT",
+        ).inc(labels={"signal": self.signal_name or "unknown"})
+        get_logger("stoix_tpu.resilience").warning(
+            "[preemption] %s received — graceful stop requested at step %d: "
+            "draining dispatcher, then emergency checkpoint",
+            self.signal_name, step,
+        )
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc_info) -> None:
+        self.uninstall()

@@ -20,6 +20,16 @@ HeartbeatBoard, and a collect timeout surfaces as ActorStarvationError naming
 the starved side (actor dead vs pipeline wedged vs params stale) instead of
 an anonymous `queue.Empty`. All instruments are host-memory only — no device
 syncs — and span recording is a no-op unless telemetry is enabled.
+
+Fault tolerance (docs/DESIGN.md §2.3): both queue layers carry typed
+`ComponentFailure` poison-pills — the supervisor injects one when an actor is
+unrecoverable (crash budget exhausted, or wedged), and the peer RAISES it on
+its next get instead of burning a full collect timeout against a dead
+producer. `ParameterServer.reprime` re-feeds the latest params to a
+supervisor-restarted actor so the restart can never deadlock against a
+learner already blocked in collect. `AsyncEvaluator.wait_until_idle` raises
+EvaluatorStallError on timeout instead of silently letting shutdown proceed
+with dangling evaluation work.
 """
 
 from __future__ import annotations
@@ -27,7 +37,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
@@ -38,6 +48,21 @@ from stoix_tpu.observability import (
     get_registry,
     span,
 )
+from stoix_tpu.resilience.errors import ComponentFailure, EvaluatorStallError
+
+
+def _replace_nowait(q: "queue.Queue", item: Any) -> None:
+    """Best-effort freshest-wins replacement on a maxsize-1 queue: drop a
+    stale entry if present, then put without blocking (a concurrent producer
+    winning the slot is fine — its item is at least as fresh)."""
+    try:
+        q.get_nowait()
+    except queue.Empty:
+        pass
+    try:
+        q.put_nowait(item)
+    except queue.Full:
+        pass
 
 
 def _queue_instruments():
@@ -76,6 +101,19 @@ class OnPolicyPipeline:
         self._queues: List[queue.Queue] = [queue.Queue(maxsize=max_size) for _ in range(num_actors)]
         self.heartbeats = HeartbeatBoard()
         self._depth, self._put_wait, self._get_wait = _queue_instruments()
+        self._failures: Dict[int, ComponentFailure] = {}
+        self._failure_lock = threading.Lock()
+
+    def fail(self, actor_id: int, failure: ComponentFailure) -> None:
+        """Poison-pill injection (supervisor path): record the failure and
+        wake a learner blocked on this actor's queue. A payload already
+        buffered may be dropped to make room — on the failure path the batch
+        is lost anyway."""
+        with self._failure_lock:
+            self._failures[actor_id] = failure
+        # Best-effort wake; collect_rollouts consults _failures before
+        # blocking, so a lost put is not a lost failure.
+        _replace_nowait(self._queues[actor_id], failure)
 
     def send_rollout(self, actor_id: int, payload: Any, timeout: Optional[float] = None) -> None:
         labels = {"queue": "rollout", "actor": str(actor_id)}
@@ -97,11 +135,18 @@ class OnPolicyPipeline:
         detector = StallDetector(self.heartbeats, stale_after_s=max(1.0, timeout / 4))
         payloads = []
         for actor_id, q in enumerate(self._queues):
+            with self._failure_lock:
+                failure = self._failures.get(actor_id)
+            if failure is not None:
+                raise failure
             labels = {"queue": "rollout", "actor": str(actor_id)}
             start = time.perf_counter()
             try:
                 with span("pipeline_get", actor=actor_id):
-                    payloads.append(q.get(timeout=timeout))
+                    payload = q.get(timeout=timeout)
+                    if isinstance(payload, ComponentFailure):
+                        raise payload
+                    payloads.append(payload)
             except queue.Empty:
                 raise ActorStarvationError(
                     actor_id,
@@ -140,6 +185,7 @@ class ParameterServer:
     ):
         self._devices = [d for d in actor_devices for _ in range(actors_per_device)]
         self._queues: List[queue.Queue] = [queue.Queue(maxsize=1) for _ in self._devices]
+        self._latest: Any = None  # last distributed params, for reprime()
         self.heartbeats = heartbeats if heartbeats is not None else HeartbeatBoard()
         self._depth, self._put_wait, self._get_wait = _queue_instruments()
         self._pushes = get_registry().counter(
@@ -156,6 +202,7 @@ class ParameterServer:
         return len(self._queues)
 
     def distribute_params(self, params: Any) -> None:
+        self._latest = params
         with span("param_push", actors=len(self._queues)):
             for actor_id, (device, q) in enumerate(zip(self._devices, self._queues)):
                 labels = {"queue": "params", "actor": str(actor_id)}
@@ -177,14 +224,37 @@ class ParameterServer:
                 self._pushes.inc(labels={"actor": str(actor_id)})
         self.heartbeats.beat("param-server")
 
+    def reprime(self, actor_id: int) -> bool:
+        """Re-feed the LATEST distributed params to one actor queue (the
+        supervisor calls this before starting a replacement actor). Never
+        blocks: a concurrent learner push wins the maxsize-1 slot, which is
+        at least as fresh."""
+        if self._latest is None:
+            return False
+        local = jax.device_put(self._latest, self._devices[actor_id])
+        _replace_nowait(self._queues[actor_id], local)
+        return True
+
+    def fail(self, failure: ComponentFailure, actor_id: int) -> None:
+        """Poison one actor's param queue: an actor blocked in get_params
+        raises `failure` instead of waiting on params that will never come.
+        The supervisor uses this for the failed actor itself — a wedge
+        blocked in get_params dies with a typed error instead of lingering
+        forever. (Orderly teardown of HEALTHY actors stays shutdown()'s
+        None-sentinel job.)"""
+        _replace_nowait(self._queues[actor_id], failure)
+
     def get_params(self, actor_id: int, timeout: Optional[float] = None) -> Any:
-        """Returns fresh params, or None (shutdown sentinel)."""
+        """Returns fresh params, or None (shutdown sentinel); raises a
+        ComponentFailure poison-pill if the learner failed unrecoverably."""
         labels = {"queue": "params", "actor": str(actor_id)}
         start = time.perf_counter()
         with span("param_get", actor=actor_id):
             params = self._queues[actor_id].get(timeout=timeout)
         self._get_wait.observe(time.perf_counter() - start, labels)
         self._depth.set(self._queues[actor_id].qsize(), labels)
+        if isinstance(params, ComponentFailure):
+            raise params
         return params
 
     def shutdown(self) -> None:
@@ -212,6 +282,12 @@ class AsyncEvaluator:
         self._requests: queue.Queue = queue.Queue()
         self._idle = threading.Event()
         self._idle.set()
+        # Guards the (queue-state, _idle) pair: submit makes the queue
+        # non-empty and clears _idle atomically, _maybe_set_idle only sets
+        # _idle while the queue is observably empty — without it a submit
+        # racing the evaluator's own empty-check could leave _idle set with a
+        # request queued, and wait_until_idle would return with dangling work.
+        self._idle_lock = threading.Lock()
         self.heartbeats = heartbeats if heartbeats is not None else HeartbeatBoard()
         self._depth = get_registry().gauge(
             "stoix_tpu_sebulba_queue_depth",
@@ -220,16 +296,26 @@ class AsyncEvaluator:
         self.thread = threading.Thread(target=self._run, name="async-evaluator", daemon=True)
 
     def submit(self, params: Any, key: jax.Array, t: int) -> None:
-        self._idle.clear()
-        self._requests.put((params, key, t))
+        with self._idle_lock:
+            self._idle.clear()
+            self._requests.put((params, key, t))
         self._depth.set(self._requests.qsize(), {"queue": "eval_requests"})
 
+    def _maybe_set_idle(self) -> None:
+        with self._idle_lock:
+            if self._requests.empty():
+                self._idle.set()
+
     def _run(self) -> None:
-        while not self._lifetime.should_stop():
+        # Drain-on-stop: a lifetime stop with requests still queued finishes
+        # them first — shutdown must not DROP submitted evaluation work (the
+        # final eval of a run is submitted right before the learner loop
+        # ends, and wait_until_idle now treats dangling work as an error).
+        while not (self._lifetime.should_stop() and self._requests.empty()):
             try:
                 params, key, t = self._requests.get(timeout=1.0)
             except queue.Empty:
-                self._idle.set()
+                self._maybe_set_idle()
                 continue
             self._depth.set(self._requests.qsize(), {"queue": "eval_requests"})
             try:
@@ -252,8 +338,15 @@ class AsyncEvaluator:
                     "[async-evaluator] eval at t=%d FAILED:\n%s",
                     t, traceback.format_exc(),
                 )
-            if self._requests.empty():
-                self._idle.set()
+            self._maybe_set_idle()
+        self._maybe_set_idle()
 
     def wait_until_idle(self, timeout: float = 600.0) -> None:
-        self._idle.wait(timeout=timeout)
+        """Block until all submitted evaluations completed. A timeout RAISES
+        (EvaluatorStallError with the evaluator's last-heartbeat age) instead
+        of silently returning — shutdown must not proceed while evaluation
+        work is still dangling (it would be dropped unreported)."""
+        if not self._idle.wait(timeout=timeout):
+            raise EvaluatorStallError(
+                timeout, self.heartbeats.age("evaluator"), self._requests.qsize()
+            )

@@ -22,6 +22,7 @@ from stoix_tpu.observability import annotate
 from stoix_tpu.ops import running_statistics
 from stoix_tpu.ops.multistep import vtrace_td_error_and_advantage
 from stoix_tpu.parallel.mesh import shard_map
+from stoix_tpu.resilience import guards
 from stoix_tpu.systems.ppo.sebulba.ff_ppo import CoreLearnerState, run_experiment as _run
 from stoix_tpu.utils import config as config_lib
 
@@ -107,6 +108,7 @@ def get_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: M
 
     normalize_obs = bool(config.system.get("normalize_observations", False))
     num_minibatches = int(config.system.get("num_minibatches", 1))
+    guard_mode = guards.resolve_mode(config)
     impala_loss = build_impala_loss(actor_apply, critic_apply, config)
 
     def per_shard(state: CoreLearnerState, traj: PPOTransition):
@@ -133,22 +135,42 @@ def get_impala_learn_step(actor_apply, critic_apply, update_fns, config, mesh: M
         @annotate("impala_minibatch")
         def _minibatch(carry, mb: PPOTransition):
             params, opt_states = carry
-            grads, metrics = jax.grad(loss_fn, has_aux=True)(params, mb)
+            # value_and_grad: the guard needs the total loss (DCE'd when the
+            # guard is off — jax.grad is a value_and_grad that drops it).
+            (total_loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb
+            )
             grads, metrics = jax.lax.pmean((grads, metrics), axis_name="data")
             a_updates, a_opt = actor_update(grads.actor_params, opt_states.actor_opt_state)
             c_updates, c_opt = critic_update(grads.critic_params, opt_states.critic_opt_state)
-            params = ActorCriticParams(
+            new_params = ActorCriticParams(
                 optax.apply_updates(params.actor_params, a_updates),
                 optax.apply_updates(params.critic_params, c_updates),
             )
-            return (params, ActorCriticOptStates(a_opt, c_opt)), metrics
+            # Divergence guard (resilience/guards.py): shard-consistent
+            # skip/halt of non-finite updates on the replicated params.
+            (params, opt_states), guard_metrics = guards.guard_update(
+                guard_mode,
+                new=(new_params, ActorCriticOptStates(a_opt, c_opt)),
+                old=(params, opt_states),
+                loss=total_loss,
+                grads=grads,
+                opt_state=opt_states,
+                axis_names=("data",),
+            )
+            return (params, opt_states), {**metrics, **guard_metrics}
 
         (params, opt_states), metrics = jax.lax.scan(
             _minibatch,
             (state.params, state.opt_states),
             split_env_minibatches(traj, num_minibatches),
         )
-        metrics = jax.tree.map(jnp.mean, metrics)
+        # skipped_updates is a COUNT (summed on the host into the registry
+        # counter); everything else reports as a per-minibatch mean.
+        metrics = {
+            k: (jnp.sum(v) if k == "skipped_updates" else jnp.mean(v))
+            for k, v in metrics.items()
+        }
         return CoreLearnerState(params, opt_states, state.key, obs_stats), metrics
 
     return jax.jit(

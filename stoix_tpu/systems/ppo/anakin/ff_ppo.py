@@ -47,6 +47,7 @@ from stoix_tpu.observability import annotate, get_logger
 from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.parallel import is_coordinator
+from stoix_tpu.resilience import guards
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.jax_utils import count_parameters, tree_merge_leading_dims
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
@@ -98,6 +99,7 @@ def get_learner_fn(
     gamma = float(config.system.gamma)
     reward_scale = float(config.system.get("reward_scale", 1.0))
     normalize_obs = bool(config.system.get("normalize_observations", False))
+    guard_mode = guards.resolve_mode(config)
 
     def _maybe_normalize(observation, obs_stats):
         if not normalize_obs:
@@ -207,15 +209,36 @@ def get_learner_fn(
         )
         critic_params = optax.apply_updates(params.critic_params, critic_updates)
 
+        # Divergence guard (resilience/guards.py): select the pre-update
+        # (params, opt_states) when loss/grad-norm is non-finite. Zero added
+        # ops and no extra metrics under the default update_guard=off.
+        # Grads sync over BOTH ("batch", "data") above, so the [U] replicas
+        # are bit-identical and the guard verdict must be too — a per-replica
+        # decision would silently desync the replicated params forever.
+        (params, opt_states), guard_metrics = guards.guard_update(
+            guard_mode,
+            new=(
+                ActorCriticParams(actor_params, critic_params),
+                ActorCriticOptStates(actor_opt_state, critic_opt_state),
+            ),
+            old=(params, opt_states),
+            loss=loss_actor + value_loss,
+            grads=(actor_grads, critic_grads),
+            opt_state=opt_states,
+            axis_names=("batch", "data"),
+            metric_axes=("batch",),
+        )
+
         loss_info = {
             "total_loss": loss_actor + value_loss,
             "actor_loss": loss_actor,
             "value_loss": value_loss,
             "entropy": entropy,
+            **guard_metrics,
         }
         return (
-            ActorCriticParams(actor_params, critic_params),
-            ActorCriticOptStates(actor_opt_state, critic_opt_state),
+            params,
+            opt_states,
             behavior_actor_params,
             kl_beta,
         ), loss_info

@@ -14,11 +14,19 @@ TPU-native differences from the reference (SURVEY.md §7.1.3):
     learner mesh rather than pmap.
   - actor->learner backpressure (queue maxsize=1) and the skip-fetch-on-first-
     rollout pipelining (reference :202-214) are preserved.
+
+Fault tolerance (stoix_tpu/resilience, docs/DESIGN.md §2.3): actor threads
+are owned by an ActorSupervisor (crash -> bounded-backoff restart with a
+fresh env and re-primed params; budget exhausted or heartbeat wedge -> typed
+ComponentFailure poison-pill so the learner fails fast), SIGTERM/SIGINT stop
+the learner loop at the next update boundary, and `system.update_guard`
+guards the gradient step against non-finite losses/grads.
 """
 
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from typing import Any, Callable, List, NamedTuple
@@ -37,6 +45,13 @@ from stoix_tpu.ops import losses, running_statistics
 from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
 from stoix_tpu.parallel import assemble_global_array
 from stoix_tpu.parallel.mesh import shard_map
+from stoix_tpu.resilience import (
+    PreemptionHandler,
+    faultinject,
+    guards,
+    supervisor_from_config,
+)
+from stoix_tpu.resilience.errors import EvaluatorStallError
 from stoix_tpu.sebulba.core import (
     AsyncEvaluator,
     OnPolicyPipeline,
@@ -96,6 +111,7 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
     actor_update, critic_update = update_fns
     gamma = float(config.system.gamma)
     normalize_obs = bool(config.system.get("normalize_observations", False))
+    guard_mode = guards.resolve_mode(config)
 
     def _maybe_normalize(observation, obs_stats):
         if not normalize_obs:
@@ -147,19 +163,37 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
                 )
                 return float(config.system.vf_coef) * loss, loss
 
-            a_grads, (a_loss, entropy) = jax.grad(actor_loss_fn, has_aux=True)(
-                params.actor_params
-            )
-            c_grads, v_loss = jax.grad(critic_loss_fn, has_aux=True)(params.critic_params)
+            # value_and_grad: the divergence guard needs the total losses;
+            # unused under update_guard=off, so XLA DCEs them (jax.grad is
+            # itself a value_and_grad that drops the value).
+            (a_total, (a_loss, entropy)), a_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True
+            )(params.actor_params)
+            (c_total, v_loss), c_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True
+            )(params.critic_params)
             a_grads, c_grads = jax.lax.pmean((a_grads, c_grads), axis_name="data")
             a_updates, a_opt = actor_update(a_grads, opt_states.actor_opt_state)
             c_updates, c_opt = critic_update(c_grads, opt_states.critic_opt_state)
-            params = ActorCriticParams(
+            new_params = ActorCriticParams(
                 optax.apply_updates(params.actor_params, a_updates),
                 optax.apply_updates(params.critic_params, c_updates),
             )
-            return (params, ActorCriticOptStates(a_opt, c_opt)), {
+            # Divergence guard (resilience/guards.py): the per-shard loss is
+            # pmean'ed over "data" inside the guard so every shard makes the
+            # same keep/skip decision on the replicated params.
+            (params, opt_states), guard_metrics = guards.guard_update(
+                guard_mode,
+                new=(new_params, ActorCriticOptStates(a_opt, c_opt)),
+                old=(params, opt_states),
+                loss=a_total + c_total,
+                grads=(a_grads, c_grads),
+                opt_state=opt_states,
+                axis_names=("data",),
+            )
+            return (params, opt_states), {
                 "actor_loss": a_loss, "value_loss": v_loss, "entropy": entropy,
+                **guard_metrics,
             }
 
         @annotate("ppo_epoch")
@@ -218,6 +252,7 @@ def rollout_thread(
     lifetime: ThreadLifetime,
     seed: int,
     metrics_sink: "queue.Queue",
+    supervisor: Any = None,
 ) -> None:
     envs_per_actor = int(config.arch.actor.envs_per_actor)
     rollout_length = int(config.system.rollout_length)
@@ -229,7 +264,7 @@ def rollout_thread(
             config, pipeline, param_server, learner_devices, learner_mesh,
             lifetime, seed, metrics_sink, envs_per_actor, rollout_length, timer,
         )
-    except Exception:
+    except Exception as exc:
         import traceback
 
         get_registry().counter(
@@ -239,7 +274,12 @@ def rollout_thread(
         get_logger("stoix_tpu.sebulba").error(
             "[actor-%d] CRASHED:\n%s", actor_id, traceback.format_exc()
         )
-        lifetime.stop()
+        if supervisor is not None:
+            # Supervised: restart with backoff, or propagate a typed
+            # ComponentFailure poison-pill (resilience/supervisor.py).
+            supervisor.report_crash(actor_id, exc)
+        else:
+            lifetime.stop()
 
 
 def _rollout_body(
@@ -267,6 +307,13 @@ def _rollout_body(
         params = param_server.get_params(actor_id)
         rollout_idx = 0
         while not lifetime.should_stop():
+            # Chaos injection points (no-ops unless STOIX_TPU_FAULT armed):
+            # a deterministic crash exercises supervised restart, a
+            # deterministic wedge exercises heartbeat wedge detection.
+            faultinject.maybe_crash_actor(actor_id, rollout_idx)
+            faultinject.maybe_stall_queue(
+                actor_id, rollout_idx, should_abort=lifetime.should_stop
+            )
             # Pipelining: skip the param fetch on the second rollout so actors
             # run ahead while the learner computes (reference :202-214).
             if rollout_idx > 1:
@@ -341,6 +388,11 @@ def run_experiment(
     networks_builder: Callable = None,
 ) -> float:
     LAST_RUN_STATS.clear()
+    # Resilience (docs/DESIGN.md §2.3): arm the chaos plan before anything is
+    # traced (the in-jit nan_loss fault binds at trace time) and resolve the
+    # divergence-guard mode for the learner loop's host-side checks.
+    faultinject.configure(config.arch.get("fault_spec"))
+    guard_mode = guards.resolve_mode(config)
     devices = jax.devices()
     actor_devices = [devices[i] for i in config.arch.actor.device_ids]
     learner_devices = [devices[i] for i in config.arch.learner.device_ids]
@@ -478,25 +530,51 @@ def run_experiment(
 
     param_server.distribute_params((params, obs_stats))
 
-    actor_threads = []
-    for d_idx, device in enumerate(actor_devices):
-        for a_idx in range(actors_per_device):
-            actor_id = d_idx * actors_per_device + a_idx
-            t = threading.Thread(
+    # Actor threads are owned by the supervisor (arch.supervision, on by
+    # default): a crashed actor is respawned from its factory — fresh thread,
+    # fresh env instance, re-primed params — with bounded backoff; past the
+    # restart budget (or on a heartbeat wedge) a ComponentFailure poison-pill
+    # makes the learner fail fast instead of burning the collect timeout.
+    supervisor = supervisor_from_config(config, lifetime, pipeline, param_server)
+    actor_threads: List[threading.Thread] = []
+
+    def _actor_factory(actor_id: int, device) -> Callable[[], threading.Thread]:
+        def make() -> threading.Thread:
+            return threading.Thread(
                 target=rollout_thread,
                 args=(
                     actor_id, device, env_factory, actor.apply, critic.apply,
                     config, pipeline, param_server, learner_devices, learner_mesh,
                     lifetime, int(config.arch.seed) + 7919 * actor_id, metrics_sink,
+                    supervisor,
                 ),
                 name=f"actor-{actor_id}",
                 daemon=True,
             )
-            t.start()
-            actor_threads.append(t)
+
+        return make
+
+    for d_idx, device in enumerate(actor_devices):
+        for a_idx in range(actors_per_device):
+            actor_id = d_idx * actors_per_device + a_idx
+            factory = _actor_factory(actor_id, device)
+            if supervisor is not None:
+                supervisor.register(actor_id, factory)
+            else:
+                t = factory()
+                t.start()
+                actor_threads.append(t)
+    if supervisor is not None:
+        supervisor.start_watchdog(pipeline.heartbeats)
+
+    # Graceful preemption: SIGTERM/SIGINT stop the learner loop at the next
+    # update boundary and run the orderly shutdown path (lifetime stop, queue
+    # drain, evaluator drain) instead of dying mid-handoff.
+    preempt = PreemptionHandler().install()
 
     timer = TimingTracker()
     t_steps = 0
+    skipped_base = guards.skipped_counter().value()
     steady_start_time = None  # set after the first eval block (post-compile)
     steady_start_steps = 0
     try:
@@ -532,6 +610,13 @@ def run_experiment(
                 (learner_state.params, learner_state.obs_stats)
             )
             t_steps += steps_per_update
+            # Divergence guard, host half: count skipped updates; halt mode
+            # raises DivergenceError here (metrics are already materialized
+            # by the block_until_ready above — no extra sync).
+            guards.publish_guard_metrics(guard_mode, train_metrics, t_steps)
+            if preempt.stop_requested():
+                preempt.acknowledge(t_steps)
+                break
 
             if (update_idx + 1) % int(config.arch.num_updates_per_eval) == 0:
                 # Drain actor metrics and log.
@@ -577,6 +662,7 @@ def run_experiment(
         # deflate the steady-state number.
         steady_end_time = time.perf_counter()
     finally:
+        preempt.uninstall()
         lifetime.stop()
         param_server.shutdown()
         # Unblock actors waiting to enqueue (uninstrumented: drain gets are
@@ -584,9 +670,26 @@ def run_experiment(
         for _ in range(2):
             if pipeline.drain(timeout=0.5) == 0:
                 break
+        if supervisor is not None:
+            supervisor.join_all(timeout=10.0)
         for t in actor_threads:
             t.join(timeout=10.0)
-        async_evaluator.wait_until_idle(timeout=120.0)
+        # Capture BEFORE our own try: inside the except block sys.exc_info()
+        # would report the stall error itself, not the failure (if any) that
+        # brought us into this finally.
+        failure_propagating = sys.exc_info()[0] is not None
+        try:
+            async_evaluator.wait_until_idle(timeout=120.0)
+        except EvaluatorStallError:
+            # Raising from a finally would REPLACE the failure that brought
+            # us here (actor ComponentFailure, learner divergence); surface
+            # the stall as the primary error only on the clean-exit path.
+            if not failure_propagating:
+                raise
+            get_logger("stoix_tpu.sebulba").error(
+                "[shutdown] evaluator still busy while handling another "
+                "failure — dropping its in-flight work"
+            )
 
     if steady_start_time is not None and t_steps > steady_start_steps:
         steady = (t_steps - steady_start_steps) / (
@@ -598,6 +701,15 @@ def run_experiment(
         ).set(steady)
         LAST_RUN_STATS["steps_per_sec_steady"] = steady
         LAST_RUN_STATS["steady_window_steps"] = t_steps - steady_start_steps
+    LAST_RUN_STATS["resilience"] = {
+        "update_guard": guard_mode,
+        "skipped_updates": guards.skipped_counter().value() - skipped_base,
+        "actor_restarts": supervisor.restart_count() if supervisor is not None else 0,
+        "preempted": preempt.stop_requested(),
+        # Sebulba has no checkpoint path yet: a preemption stops cleanly but
+        # cannot resume mid-run.
+        "resume_capable": False,
+    }
 
     logger.close()
     return eval_results[-1] if eval_results else 0.0

@@ -19,6 +19,7 @@ from jax.sharding import Mesh
 from stoix_tpu import envs
 from stoix_tpu.base_types import OffPolicyLearnerState, OnlineAndTarget, Transition
 from stoix_tpu.evaluator import get_distribution_act_fn
+from stoix_tpu.resilience import guards
 from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils import config as config_lib
@@ -115,18 +116,38 @@ def q_learner_setup(
 
     buffer, buffer_state = core.build_buffer(env, config, mesh, discrete_actions=True)
 
+    guard_mode = guards.resolve_mode(config)
+
     def update_from_batch(params: OnlineAndTarget, opt_states, batch: Transition, key):
         del key
 
         def wrapped_loss(online_params):
             return loss_fn(online_params, params.target, batch, q_network.apply, config)
 
-        grads, loss_info = jax.grad(wrapped_loss, has_aux=True)(params.online)
+        # value_and_grad instead of grad: the divergence guard needs the loss
+        # VALUE; with update_guard=off the value is unused and XLA dead-code-
+        # eliminates it (grad is itself a value_and_grad that drops the value,
+        # so the traced program is unchanged).
+        (loss, loss_info), grads = jax.value_and_grad(wrapped_loss, has_aux=True)(
+            params.online
+        )
         grads = core.pmean_grads(grads)
-        updates, opt_states = q_optim.update(grads, opt_states)
+        updates, new_opt_states = q_optim.update(grads, opt_states)
         online = optax.apply_updates(params.online, updates)
         target = optax.incremental_update(online, params.target, tau)
-        return (OnlineAndTarget(online, target), opt_states), loss_info
+        # Divergence guard (resilience/guards.py): no-op the whole
+        # (params, opt_state) update when loss/grad-norm is non-finite.
+        (guarded_params, guarded_opt), guard_metrics = guards.guard_update(
+            guard_mode,
+            new=(OnlineAndTarget(online, target), new_opt_states),
+            old=(params, opt_states),
+            loss=loss,
+            grads=grads,
+            opt_state=opt_states,
+            axis_names=("batch", "data"),
+            metric_axes=("batch",),
+        )
+        return (guarded_params, guarded_opt), {**loss_info, **guard_metrics}
 
     def act_in_env(params: OnlineAndTarget, observation, key, buffer_state=None):
         # Linear epsilon decay keyed on per-shard experience count (reference

@@ -55,6 +55,14 @@ STOIX_TPU_PROFILE_DIR=<dir> wraps around one steady-state eval window. In the
 pipelined loop the phases are HOST attribution: device time spent in
 learn/eval surfaces as fetch_s (the materialize wait), while learn_s/eval_s
 shrink to dispatch cost.
+
+Resilience (stoix_tpu/resilience, docs/DESIGN.md §2.3): SIGTERM/SIGINT
+request a graceful stop at the next window boundary — the loop drains the
+one-window-deep dispatcher, force-saves an emergency checkpoint of the live
+state, and returns cleanly so the run resumes instead of losing the window.
+`system.update_guard` wires the in-jit divergence guard's host half through
+process_window (skip counting / halt raising), and STOIX_TPU_FAULT /
+arch.fault_spec arms the deterministic chaos layer.
 """
 
 from __future__ import annotations
@@ -83,6 +91,7 @@ from stoix_tpu.parallel import (
     materialize,
     maybe_initialize_distributed,
 )
+from stoix_tpu.resilience import PreemptionHandler, faultinject, guards
 from stoix_tpu.utils.checkpointing import checkpointer_from_config
 from stoix_tpu.utils.jax_utils import aot_warmup
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
@@ -166,6 +175,12 @@ def run_anakin_experiment(
 ) -> float:
     """Generic Anakin experiment: returns final eval episode-return mean."""
     maybe_initialize_distributed(config)
+    # Resilience (docs/DESIGN.md §2.3): arm the chaos plan (no-op unless
+    # STOIX_TPU_FAULT / arch.fault_spec is set) BEFORE the learner is built —
+    # the in-jit nan_loss guard reads it at trace time — and resolve the
+    # divergence-guard mode for the host-side checks below.
+    faultinject.configure(config.arch.get("fault_spec"))
+    guard_mode = guards.resolve_mode(config)
     mesh = create_mesh(dict(config.arch.get("mesh") or {"data": -1}))
     config = check_total_timesteps(config, int(mesh.shape["data"]))
     config.logger.system_name = config.system.system_name
@@ -336,7 +351,7 @@ def run_anakin_experiment(
     def process_window(window: _Window) -> None:
         """Host half: materialize the window's metrics, log, track best
         params, and hand the checkpoint snapshot to orbax (async, no wait)."""
-        nonlocal best_params, best_return, final_return, window_done_at
+        nonlocal best_params, best_return, final_return, window_done_at, last_save_t
         ts = time.perf_counter()
         with span("fetch_materialize", window=window.eval_idx):
             fetched = materialize(window.metrics)
@@ -350,6 +365,10 @@ def run_anakin_experiment(
         episode_metrics = envs.get_final_step_metrics(fetched["episode"])
         train_metrics = fetched["train"]
         eval_metrics = fetched["eval"]
+        # Divergence guard, host half: fold this window's skipped-update flags
+        # into the registry counter; update_guard=halt raises DivergenceError
+        # here, naming the step and the offending metric.
+        guards.publish_guard_metrics(guard_mode, train_metrics, window.t)
         sps = steps_per_eval / wall
         get_registry().gauge(
             "stoix_tpu_runner_steps_per_second",
@@ -385,9 +404,11 @@ def run_anakin_experiment(
                     # ckpt_snapshot=false forced the loop synchronous: the live
                     # state is not yet donated here, so save it directly and
                     # wait before the next dispatch can donate it (old
-                    # semantics).
+                    # semantics). Record the step so the preemption path does
+                    # not force-rewrite an identical emergency checkpoint.
                     checkpointer.save(window.t, learner_state, mean_return)
                     checkpointer.wait()
+                    last_save_t = window.t
             phases.add("ckpt_s", time.perf_counter() - ts)
 
         if window.eval_idx == profile_window:
@@ -396,41 +417,83 @@ def run_anakin_experiment(
             except Exception:  # noqa: BLE001 — profiling must never kill a run
                 pass
 
+    # Graceful preemption: SIGTERM/SIGINT set a flag; the loop observes it at
+    # the next window boundary, drains the one-window-deep dispatcher, writes
+    # an emergency checkpoint, and returns normally (exit code 0) so the run
+    # resumes from the saved state instead of losing the window.
+    preempt = PreemptionHandler().install()
+    preempted = False
+    skipped_base = guards.skipped_counter().value()
+    dispatched_t = start_step
     pending: Optional[_Window] = None
-    for eval_idx in range(num_evaluation):
-        if eval_idx == profile_window:
-            try:
-                jax.profiler.start_trace(profile_dir)
-            except Exception:  # noqa: BLE001
-                profile_window = -1
-        window = dispatch_window(eval_idx)
-        if pipelined:
-            # Process LAST window's host work while the device runs this one.
-            if pending is not None:
-                process_window(pending)
-            pending = window
-        else:
-            process_window(window)
-    if pending is not None:
-        process_window(pending)
+    try:
+        for eval_idx in range(num_evaluation):
+            if eval_idx == profile_window:
+                try:
+                    jax.profiler.start_trace(profile_dir)
+                except Exception:  # noqa: BLE001
+                    profile_window = -1
+            window = dispatch_window(eval_idx)
+            dispatched_t = window.t
+            faultinject.maybe_sigterm(eval_idx)
+            if pipelined:
+                # Process LAST window's host work while the device runs this one.
+                if pending is not None:
+                    process_window(pending)
+                pending = window
+            else:
+                process_window(window)
+            if preempt.stop_requested():
+                preempted = True
+                break
+        # Drain the dispatcher: the final (or preemption-interrupted) window's
+        # host half — metrics, logging, and its pending checkpoint snapshot.
+        if pending is not None:
+            process_window(pending)
+            pending = None
 
-    if bool(config.arch.get("absolute_metric", True)):
-        key, ek = jax.random.split(key)
-        abs_metrics = fetch_global(absolute_evaluator(best_params, ek), mesh)
-        if is_coordinator():
-            logger.log(
-                abs_metrics,
-                start_step + int(config.arch.total_timesteps),
-                num_evaluation,
-                LogEvent.ABSOLUTE,
-            )
-        final_return = float(abs_metrics["episode_return"].mean())
-
-    if checkpointer is not None:
-        # Drain in-flight async saves; otherwise interpreter shutdown races
-        # orbax's executor ("cannot schedule new futures after shutdown").
-        checkpointer.close()
-    logger.close()
+        if preempted:
+            preempt.acknowledge(dispatched_t)
+            if checkpointer is not None:
+                if last_save_t != dispatched_t:
+                    # The regular cadence did not cover the last completed
+                    # window: force an emergency save of the live state (no
+                    # later program donates it — nothing was dispatched after
+                    # it) and block until it is on disk.
+                    with span("emergency_ckpt", step=dispatched_t):
+                        checkpointer.save(
+                            dispatched_t, learner_state, final_return, force=True
+                        )
+                        checkpointer.wait()
+                get_logger("stoix_tpu.resilience").warning(
+                    "[preemption] emergency state secured at step %d — exiting "
+                    "cleanly; resume with logger.checkpointing.load_model=true",
+                    dispatched_t,
+                )
+            else:
+                get_logger("stoix_tpu.resilience").warning(
+                    "[preemption] checkpointing disabled "
+                    "(logger.checkpointing.save_model=false): stopping "
+                    "cleanly at step %d WITHOUT saving state", dispatched_t,
+                )
+        elif bool(config.arch.get("absolute_metric", True)):
+            key, ek = jax.random.split(key)
+            abs_metrics = fetch_global(absolute_evaluator(best_params, ek), mesh)
+            if is_coordinator():
+                logger.log(
+                    abs_metrics,
+                    start_step + int(config.arch.total_timesteps),
+                    num_evaluation,
+                    LogEvent.ABSOLUTE,
+                )
+            final_return = float(abs_metrics["episode_return"].mean())
+    finally:
+        preempt.uninstall()
+        if checkpointer is not None:
+            # Drain in-flight async saves; otherwise interpreter shutdown races
+            # orbax's executor ("cannot schedule new futures after shutdown").
+            checkpointer.close()
+        logger.close()
 
     steady = (
         steps_per_eval * (len(window_walls) - 1) / sum(window_walls[1:])
@@ -448,6 +511,12 @@ def run_anakin_experiment(
             "steady_state_sps": steady,
             "pipelined": pipelined,
             "fused_eval": fused,
+            "resilience": {
+                "update_guard": guard_mode,
+                "skipped_updates": guards.skipped_counter().value() - skipped_base,
+                "preempted": preempted,
+                "resume_capable": checkpointer is not None,
+            },
         }
     )
     return final_return

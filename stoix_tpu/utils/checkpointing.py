@@ -5,16 +5,28 @@ config-as-metadata with a major-version compatibility check. TPU-native
 difference from the reference: states are GLOBAL (sharded) arrays — orbax
 handles sharded save/restore natively, so there is no unreplicate step
 (SURVEY.md §7.1.1).
+
+Resilience (docs/DESIGN.md §2.3): `restore` validates what it loads —
+tree-structure against the template plus a finiteness spot-check (leaves
+whose TEMPLATE is fully finite must restore fully finite; leaves where the
+template itself carries inf/nan sentinels are exempt) — and, when the newest
+checkpoint is corrupt or truncated (a preempted save, a chaos-injected
+`ckpt_corrupt`), automatically falls back to the newest VALID step instead
+of dying on a bare orbax error.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
+
+from stoix_tpu.resilience.errors import CheckpointIntegrityError
 
 # 2.0: continuous MPO/V-MPO dual variables changed shape from (2,) to
 # [2, action_dim] (per-dimension KL constraints) — old checkpoints cannot
@@ -91,28 +103,140 @@ class Checkpointer:
         except Exception:  # noqa: BLE001 — older orbax: assume it saves
             return True
 
-    def save(self, timestep: int, state: Any, episode_return: float = 0.0) -> bool:
+    def save(
+        self,
+        timestep: int,
+        state: Any,
+        episode_return: float = 0.0,
+        force: bool = False,
+    ) -> bool:
         """Hand `state` to orbax; serialization may complete asynchronously.
 
         Callers must pass buffers that no later XLA program donates: the
         Anakin runner saves an on-device SNAPSHOT copy of the learner state
         (systems/runner.py), which is what makes the save safely async — the
-        hot path never calls wait()."""
-        return self._manager.save(
+        hot path never calls wait(). `force=True` bypasses the save-interval
+        policy (the preemption handler's emergency checkpoint must land
+        regardless of cadence)."""
+        saved = self._manager.save(
             timestep,
             args=ocp.args.StandardSave(jax.tree.map(jax.numpy.asarray, state)),
             metrics={"episode_return": float(episode_return)},
+            force=force,
         )
+        # Chaos hook (`STOIX_TPU_FAULT=ckpt_corrupt`, one-shot): mangle this
+        # step's files AFTER serialization completes, so the restore-fallback
+        # path is exercised against a real on-disk layout.
+        from stoix_tpu.resilience import faultinject
 
-    def restore(self, template: Any, timestep: Optional[int] = None) -> Tuple[Any, int]:
-        """Restore into the shape/sharding of `template`; returns (state, step)."""
-        step = timestep if timestep is not None else self._manager.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"No checkpoints under {self.directory}")
-        restored = self._manager.restore(
-            step, args=ocp.args.StandardRestore(template)
+        if saved and faultinject.consume_ckpt_corrupt():
+            self._manager.wait_until_finished()
+            faultinject.corrupt_checkpoint_files(
+                os.path.join(self.directory, str(timestep))
+            )
+        return saved
+
+    def all_steps(self) -> List[int]:
+        """Ascending steps with a checkpoint on disk."""
+        return sorted(int(s) for s in self._manager.all_steps())
+
+    @staticmethod
+    def _validate(restored: Any, template: Any, step: int) -> None:
+        """Integrity gate: identical tree structure, and every float leaf
+        whose TEMPLATE is fully finite must restore fully finite. Template
+        leaves that legitimately carry inf/nan (masks, bound sentinels) are
+        exempt — the template defines what 'finite' means for this state."""
+        got = jax.tree.structure(restored)
+        want = jax.tree.structure(template)
+        if got != want:
+            raise CheckpointIntegrityError(
+                step, f"tree structure mismatch: restored {got} != template {want}"
+            )
+        def _as_float_array(leaf: Any):
+            """Host float array for finiteness checks, or None for non-float
+            leaves. jnp.issubdtype (not np.) so ml_dtypes floats — bfloat16,
+            the common TPU param dtype — are validated, not skipped; they are
+            widened to float32 because numpy ufuncs don't cover them."""
+            arr = np.asarray(leaf)
+            if not jnp.issubdtype(arr.dtype, jnp.floating):
+                return None
+            if arr.dtype not in (np.float16, np.float32, np.float64):
+                arr = arr.astype(np.float32)
+            return arr
+
+        restored_leaves = jax.tree_util.tree_flatten_with_path(restored)[0]
+        template_leaves = jax.tree.leaves(template)
+        for (path, leaf), ref in zip(restored_leaves, template_leaves):
+            if not getattr(leaf, "is_fully_addressable", True):
+                continue  # multi-host shard not local to this process
+            arr = _as_float_array(leaf)
+            if arr is None or np.isfinite(arr).all():
+                continue
+            ref_arr = _as_float_array(ref)
+            if ref_arr is not None and not np.isfinite(ref_arr).all():
+                continue  # the template itself carries non-finite sentinels
+            raise CheckpointIntegrityError(
+                step,
+                f"non-finite values in leaf {jax.tree_util.keystr(path)} "
+                f"(template expects finite values here)",
+            )
+
+    def restore(
+        self,
+        template: Any,
+        timestep: Optional[int] = None,
+        validate: bool = True,
+        fallback: bool = True,
+    ) -> Tuple[Any, int]:
+        """Restore into the shape/sharding of `template`; returns (state, step).
+
+        Latest-step restores walk newest-to-oldest past corrupt/truncated/
+        non-finite checkpoints (each rejection logged) until one validates —
+        a preempted or chaos-corrupted save costs one checkpoint interval,
+        not the run. An EXPLICIT `timestep` never falls back: a missing step
+        raises FileNotFoundError listing what IS available, and a corrupt one
+        raises its own error (the caller asked for that step by name)."""
+        from stoix_tpu.observability import get_logger
+
+        steps = self.all_steps()
+        if timestep is not None:
+            if int(timestep) not in steps:
+                raise FileNotFoundError(
+                    f"No checkpoint at timestep {timestep} under "
+                    f"{self.directory}; available steps: {steps or '[]'}"
+                )
+            candidates = [int(timestep)]
+            fallback = False
+        else:
+            if not steps:
+                raise FileNotFoundError(f"No checkpoints under {self.directory}")
+            candidates = steps[::-1]
+
+        last_error: Optional[Exception] = None
+        for step in candidates:
+            try:
+                restored = self._manager.restore(
+                    step, args=ocp.args.StandardRestore(template)
+                )
+                if validate:
+                    self._validate(restored, template, step)
+                return restored, int(step)
+            except Exception as exc:  # noqa: BLE001 — each candidate's failure
+                # mode differs (orbax I/O error, msgpack truncation, integrity
+                # rejection); all mean "try the next-newest".
+                if not fallback:
+                    raise
+                last_error = exc
+                get_logger("stoix_tpu.checkpoint").warning(
+                    "[checkpoint] step %d unusable (%s: %s) — falling back to "
+                    "the next-newest checkpoint",
+                    step, type(exc).__name__, exc,
+                )
+        raise CheckpointIntegrityError(
+            candidates[-1],
+            f"no valid checkpoint among steps {candidates} under "
+            f"{self.directory}; last error: {type(last_error).__name__}: {last_error}",
         )
-        return restored, int(step)
 
     def get_metadata(self) -> dict:
         meta = self._manager.metadata()

@@ -41,7 +41,7 @@ def aot_compile(fn: Any, *example_args: Any) -> Any:
             from stoix_tpu.observability import get_logger
 
             get_logger("stoix_tpu.aot").info("[aot] estimated FLOPs/call: %.3e", flops)
-    except Exception:
+    except Exception:  # noqa: STX003 — FLOPs estimate is best-effort telemetry
         pass
     return compiled
 

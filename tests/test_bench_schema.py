@@ -53,3 +53,11 @@ def test_bench_smoke_payload_schema():
     assert telemetry["spans"] > 0, telemetry
     assert telemetry["metric_series"] > 0, telemetry
     assert telemetry["trace_valid"] is True, telemetry
+
+    # Resilience self-check (docs/DESIGN.md §2.3): the bench records whether
+    # divergence guards were active for this number, how many updates were
+    # skipped, and whether the config could emergency-resume on preemption.
+    resilience = payload["resilience"]
+    assert resilience["update_guard"] == "off", resilience
+    assert resilience["skipped_updates"] == 0, resilience
+    assert isinstance(resilience["resume_capable"], bool), resilience

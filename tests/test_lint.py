@@ -67,6 +67,42 @@ def test_stx002_flags_bare_print_and_stats_dicts():
     assert all("stats dict" in f for f in findings)
 
 
+def _stx003(lint, source, rel="stoix_tpu/_stx003_probe.py"):
+    import ast
+
+    return lint.check_exception_swallowing(
+        os.path.join(REPO, rel), source, ast.parse(source)
+    )
+
+
+def test_stx003_flags_swallowed_broad_exceptions():
+    lint = _load_lint_module()
+    source = (
+        "try:\n    x()\nexcept Exception:\n    pass\n"
+        "try:\n    x()\nexcept:\n    pass\n"
+        "try:\n    x()\nexcept (ValueError, BaseException):\n    ...\n"
+        "try:\n    x()\nexcept Exception as e:\n    pass\n"
+    )
+    findings = _stx003(lint, source)
+    assert len(findings) == 4, findings
+    assert all("STX003" in f for f in findings)
+
+
+def test_stx003_allows_narrow_handled_and_allowlisted():
+    lint = _load_lint_module()
+    # Narrow types, handlers that DO something, noqa'd lines, and the fault
+    # injector (the chaos layer) are all clean; tests/ are out of scope.
+    clean = (
+        "try:\n    x()\nexcept queue.Empty:\n    pass\n"
+        "try:\n    x()\nexcept Exception:\n    log.error('boom')\n"
+        "try:\n    x()\nexcept Exception:  # noqa: STX003 — reason\n    pass\n"
+    )
+    assert _stx003(lint, clean) == []
+    swallowed = "try:\n    x()\nexcept Exception:\n    pass\n"
+    assert _stx003(lint, swallowed, rel="stoix_tpu/resilience/faultinject.py") == []
+    assert _stx003(lint, swallowed, rel="tests/test_whatever.py") == []
+
+
 def test_stx002_allows_legit_patterns():
     lint = _load_lint_module()
     # noqa opt-out, lowercase names, populated constant tables, class/function
