@@ -33,6 +33,15 @@ pyproject.toml, so installing them upgrades the gate with zero changes here):
      carry a `# noqa` with a reason on the except line. Allowlisted:
      resilience/faultinject.py (the chaos layer must never let its own
      bookkeeping mask the failure it is injecting).
+  7. no unbounded blocking calls (STX004): `stoix_tpu/` library code must
+     not call zero-argument `.get()` (queue.Queue.get — dict.get always
+     takes a key), `.result()` (concurrent futures), or `.join()` (threads
+     — string join always takes an iterable) with no timeout. Every
+     indefinite wait is a latent hang: a dead peer turns it into the wedged
+     process the launch-hardening layer (docs/DESIGN.md §2.4) exists to
+     kill. Pass a timeout (and handle expiry), or carry a reasoned `# noqa`
+     for a wait that is intentionally infinite. Allowlisted: none today —
+     the file allowlist exists for future provably-supervised waits.
 
 Exit code 0 = clean, 1 = findings. Run: python scripts/lint.py [paths...]
 """
@@ -323,6 +332,53 @@ def check_exception_swallowing(path: str, source: str, tree: ast.AST) -> List[st
     return findings
 
 
+# STX004: unbounded blocking calls. AST heuristic: a zero-argument call of
+# one of these attribute names cannot be the bounded/keyed variant
+# (dict.get(key), "sep".join(parts), t.join(timeout)) — it is a wait that
+# never returns if the other side is dead. Calls WITH arguments are only
+# flagged when they name block=... without a timeout (queue.get(block=True)).
+_STX004_BLOCKING_ATTRS = {"get", "result", "join"}
+_STX004_ALLOWLIST: set = set()  # files whose infinite waits are supervised
+
+
+def check_unbounded_blocking(path: str, source: str, tree: ast.AST) -> List[str]:
+    rel = os.path.relpath(path, REPO)
+    if not rel.startswith("stoix_tpu" + os.sep) or rel in _STX004_ALLOWLIST:
+        return []
+    lines = source.splitlines()
+    findings = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _STX004_BLOCKING_ATTRS
+        ):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords}
+        if node.args or kwargs:
+            # Positional args mean dict.get(key)/str.join(parts)/
+            # join(timeout)/get(block, timeout) — ambiguous or bounded. With
+            # keywords, only block=<not False> WITHOUT timeout= is provably
+            # an unbounded wait (block=False never blocks).
+            if "timeout" in kwargs or node.args:
+                continue
+            block = kwargs.get("block")
+            if block is None or (
+                isinstance(block, ast.Constant) and block.value is False
+            ):
+                continue
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        if "noqa" in line:
+            continue
+        findings.append(
+            f"{rel}:{node.lineno}: unbounded blocking call `.{node.func.attr}()` "
+            f"without a timeout — a dead peer turns this into a wedged process; "
+            f"pass a timeout and handle expiry, or noqa a provably-supervised "
+            f"infinite wait (STX004)"
+        )
+    return findings
+
+
 def run_external(tool: str, args: List[str]) -> List[str]:
     try:
         __import__(tool)
@@ -358,6 +414,7 @@ def main(argv: List[str]) -> int:
         errors.extend(check_host_sync_ownership(path, source, tree))
         errors.extend(check_observability_ownership(path, source, tree))
         errors.extend(check_exception_swallowing(path, source, tree))
+        errors.extend(check_unbounded_blocking(path, source, tree))
         errs, warns = check_hygiene(path, source)
         errors.extend(errs)
         warnings.extend(warns)

@@ -12,8 +12,15 @@ Usage:
     python -m stoix_tpu.launcher \
         --systems stoix_tpu.systems.ppo.anakin.ff_ppo stoix_tpu.systems.sac.ff_sac \
         --envs cartpole pendulum --seeds 0 1 2 \
-        [--local | --submit] [--nodes 1] [--time 04:00:00] [--partition tpu] \
-        [overrides...]
+        [--local | --submit | --preflight-only] [--nodes 1] [--time 04:00:00] \
+        [--partition tpu] [overrides...]
+
+`--preflight-only` (docs/DESIGN.md §2.4) runs the launch-hardening preflight —
+ONE subprocess-isolated backend probe for the host, then config
+cross-validation for every (system x env x seed) job against the probed
+topology — prints a one-page report, and exits 0 (all pass) or 1. Wire it
+into CI or a SLURM prolog so a wedged chip or a bad config fails the batch in
+seconds instead of after scheduling.
 """
 
 from __future__ import annotations
@@ -21,9 +28,10 @@ from __future__ import annotations
 import argparse
 import itertools
 import os
+import re
 import subprocess
 import sys
-from typing import List
+from typing import List, Optional
 
 from stoix_tpu.observability import get_logger
 
@@ -45,6 +53,65 @@ srun bash -c 'JAX_PROCESS_ID="$SLURM_PROCID" python -m {module} {overrides}'
 """
 
 
+def _default_yaml_for(module: str) -> Optional[str]:
+    """The root config a system module composes in its main() — every system
+    entry point carries exactly one `default/{anakin,sebulba}/*.yaml` literal.
+    None when the module cannot be located or breaks the convention."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.find_spec(module)
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return None
+    if spec is None or not spec.origin:
+        return None
+    try:
+        with open(spec.origin) as f:
+            source = f.read()
+    except OSError:
+        return None
+    match = re.search(r"default/(?:anakin|sebulba)/[\w.\-]+\.yaml", source)
+    return match.group(0) if match else None
+
+
+def run_preflight_only(jobs: List[dict]) -> int:
+    """ONE backend probe for the host + per-job config cross-validation
+    against the probed topology; prints the one-page report. Returns the
+    process exit code (0 = every stage passed)."""
+    from stoix_tpu.resilience import preflight
+    from stoix_tpu.utils import config as config_lib
+
+    configs = []
+    report_extra = []
+    for job in jobs:
+        yaml_file = _default_yaml_for(job["module"])
+        if yaml_file is None:
+            report_extra.append(
+                (f"config[{job['name']}]", "skip",
+                 f"could not derive a default yaml for {job['module']}")
+            )
+            continue
+        try:
+            config = config_lib.compose(
+                config_lib.default_config_dir(), yaml_file, job["overrides"]
+            )
+        except Exception as exc:  # noqa: BLE001 — a bad override IS a finding
+            report_extra.append(
+                (f"config[{job['name']}]", "fail",
+                 f"compose failed: {type(exc).__name__}: {exc}")
+            )
+            continue
+        configs.append((job["name"], config))
+
+    report = preflight.run_preflight(configs if configs else None)
+    for row in report_extra:
+        report.add(*row)
+    # The report IS this mode's output contract (CI / SLURM prolog logs
+    # capture stdout), like bench.py's JSON lines.
+    print(report.render())  # noqa: STX002 — --preflight-only's stdout contract
+    return 0 if report.ok else 1
+
+
 def build_jobs(args: argparse.Namespace) -> List[dict]:
     jobs = []
     for module, env, seed in itertools.product(args.systems, args.envs, args.seeds):
@@ -61,6 +128,13 @@ def main(argv: List[str] | None = None) -> None:
     parser.add_argument("--seeds", nargs="+", type=int, default=[0])
     parser.add_argument("--local", action="store_true", help="run sequentially here")
     parser.add_argument("--submit", action="store_true", help="sbatch immediately")
+    parser.add_argument(
+        "--preflight-only",
+        action="store_true",
+        help="run the launch-hardening preflight (subprocess backend probe + "
+        "per-job config cross-validation) and exit 0/1 with a one-page "
+        "report — no jobs are run or submitted (CI / SLURM prolog hook)",
+    )
     parser.add_argument("--nodes", type=int, default=1)
     parser.add_argument("--time", default="04:00:00")
     parser.add_argument("--partition", default=None)
@@ -88,6 +162,9 @@ def main(argv: List[str] | None = None) -> None:
         "[launcher] %d jobs: %d systems x %d envs x %d seeds",
         len(jobs), len(args.systems), len(args.envs), len(args.seeds),
     )
+
+    if args.preflight_only:
+        sys.exit(run_preflight_only(jobs))
 
     if args.local:
         # Make the repo importable from any working directory.

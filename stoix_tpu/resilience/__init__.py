@@ -18,8 +18,17 @@ Zero-dependency, off-by-default-transparent. Four pillars:
     burning the collect timeout.
   * **Fault injection** (faultinject.py): `STOIX_TPU_FAULT=actor_crash:3,...`
     deterministically injects crashes, wedges, NaN losses, checkpoint
-    corruption, and SIGTERM so tests/test_resilience.py proves every
-    recovery path end-to-end.
+    corruption, SIGTERM, probe wedges, and slow compiles so
+    tests/test_resilience.py proves every recovery path end-to-end.
+  * **Launch hardening** (preflight.py / watchdog.py, docs/DESIGN.md §2.4):
+    subprocess-isolated backend probe with bounded timeout + backoff retries
+    (`BackendUnavailableError` instead of a wedged parent), config
+    cross-validation before any device work (`ConfigValidationError` listing
+    every finding), AOT `memory_analysis()` vs device HBM
+    (`ResourcePreflightError` in seconds, not a runtime OOM), and deadline
+    watchdogs around first-compile/first-window that dump all thread stacks
+    + the registry snapshot and raise `CompileStallError` instead of
+    hanging. Opt-in via `arch.preflight`; off = bit-identical.
 
 With everything at defaults (`update_guard=off`, no faults armed, no crashes)
 training is bit-identical to a build without this package — guards add zero
@@ -27,16 +36,22 @@ ops, the signal handler only reacts to signals, and supervision only acts on
 failures (tests/test_resilience.py pins the trajectory equality).
 """
 
-from stoix_tpu.resilience import faultinject, guards  # noqa: F401 — public API
+from stoix_tpu.resilience import faultinject, guards, preflight  # noqa: F401 — public API
 from stoix_tpu.resilience.errors import (  # noqa: F401
+    BackendUnavailableError,
     CheckpointIntegrityError,
+    CompileStallError,
     ComponentFailure,
+    ConfigValidationError,
     DivergenceError,
     EvaluatorStallError,
     InjectedFault,
+    PreflightError,
+    ResourcePreflightError,
 )
 from stoix_tpu.resilience.preemption import PreemptionHandler  # noqa: F401
 from stoix_tpu.resilience.supervisor import (  # noqa: F401
     ActorSupervisor,
     supervisor_from_config,
 )
+from stoix_tpu.resilience.watchdog import Watchdog  # noqa: F401

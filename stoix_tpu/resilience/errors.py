@@ -79,6 +79,90 @@ class CheckpointIntegrityError(RuntimeError):
         super().__init__(f"checkpoint at step {step} failed integrity validation: {reason}")
 
 
+class PreflightError(RuntimeError):
+    """Base class for launch-hardening failures (resilience/preflight.py,
+    docs/DESIGN.md §2.4): the run was aborted BEFORE (or during) its first
+    window by a preflight check or watchdog, with a typed cause — never by an
+    indefinite hang or an anonymous 20-minutes-later OOM."""
+
+
+class BackendUnavailableError(PreflightError):
+    """The subprocess-isolated backend probe never got a healthy answer from
+    the device runtime: every attempt timed out (wedged PJRT init) or errored.
+    Names the attempt count and the per-attempt deadline so the operator can
+    tell 'chip wedged after N retries' from a config mistake."""
+
+    def __init__(self, attempts: int, timeout_s: float, last_error: str):
+        self.attempts = int(attempts)
+        self.timeout_s = float(timeout_s)
+        self.last_error = last_error
+        super().__init__(
+            f"device backend unavailable: {attempts} probe attempt(s) failed "
+            f"({timeout_s:.0f}s deadline each); last failure: {last_error}. "
+            f"The probe runs in a SUBPROCESS, so the wedged runtime never "
+            f"touched this process — safe to retry or fall back."
+        )
+
+
+class ConfigValidationError(PreflightError):
+    """Config cross-validation (arch × system × network × env) failed before
+    any device work. Carries ALL findings, not just the first, so one preflight
+    run fixes the whole config."""
+
+    def __init__(self, findings: list):
+        self.findings = list(findings)
+        lines = "\n".join(f"  - {f}" for f in self.findings)
+        super().__init__(
+            f"config validation failed with {len(self.findings)} finding(s):\n{lines}"
+        )
+
+
+class ResourcePreflightError(PreflightError):
+    """XLA's post-compile memory_analysis predicts this program cannot fit the
+    device: predicted bytes exceed the HBM budget (bytes_limit × headroom).
+    Aborting here costs seconds; discovering it as a runtime OOM costs the
+    whole compile plus a cryptic RESOURCE_EXHAUSTED mid-run."""
+
+    def __init__(self, predicted_bytes: int, limit_bytes: int, headroom: float,
+                 device_kind: str, detail: str = ""):
+        self.predicted_bytes = int(predicted_bytes)
+        self.limit_bytes = int(limit_bytes)
+        self.headroom = float(headroom)
+        self.device_kind = device_kind
+        gib = 1024.0 ** 3
+        super().__init__(
+            f"predicted device memory {predicted_bytes / gib:.2f} GiB exceeds "
+            f"{headroom:.0%} of the {limit_bytes / gib:.2f} GiB HBM on "
+            f"{device_kind}{(' (' + detail + ')') if detail else ''} — shrink "
+            f"arch.total_num_envs / system.rollout_length / the network, or "
+            f"raise arch.preflight.hbm_headroom if the estimate is known-loose"
+        )
+
+
+class CompileStallError(PreflightError):
+    """A watchdog deadline expired around first-compile or first-window
+    execution (resilience/watchdog.py). Carries the stage name, the deadline,
+    and the all-thread stack dump taken at expiry, so a wedged backend leaves
+    a diagnosis instead of an indefinite hang."""
+
+    def __init__(self, stage: str, deadline_s: float, dump: Optional[str] = None):
+        self.stage = stage
+        self.deadline_s = float(deadline_s)
+        self.dump = dump
+        knob = (
+            "compile_deadline_s"
+            if "compile" in stage
+            else "first_window_deadline_s"
+        )
+        super().__init__(
+            f"'{stage}' exceeded its {deadline_s:.0f}s watchdog deadline — "
+            f"backend likely wedged (thread stacks + registry snapshot were "
+            f"dumped to the stoix_tpu.resilience log). Raise "
+            f"arch.preflight.{knob} if this shape legitimately "
+            f"compiles/executes slower."
+        )
+
+
 class InjectedFault(RuntimeError):
     """Raised by the fault-injection harness (resilience/faultinject.py) at an
     armed injection point. Distinct from real failures so supervision tests

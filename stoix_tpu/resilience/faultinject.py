@@ -22,6 +22,15 @@ Spec grammar: comma-separated `name[:arg]` entries (a mapping
   sigterm:N       the host loop delivers SIGTERM to its own process after
                   dispatching eval window N (one-shot) — exercises the
                   preemption handler end-to-end, signal delivery included
+  backend_wedge   the preflight probe SUBPROCESS (resilience/preflight.py)
+                  sleeps forever before touching jax — a PJRT runtime that
+                  accepts the process and never answers. Honored in the child
+                  (it inherits STOIX_TPU_FAULT), so EVERY probe attempt
+                  wedges and the parent's timeout/retry/backoff path runs to
+                  BackendUnavailableError deterministically
+  slow_compile:S  the host loop sleeps S seconds inside the watchdog-guarded
+                  first-compile stage (one-shot) — drives the
+                  CompileStallError path without needing a wedged backend
 
 All injection points are no-ops (a single None check) when no plan is armed,
 and `configure()` is called once per experiment so one-shot state never leaks
@@ -43,7 +52,15 @@ from stoix_tpu.resilience.errors import InjectedFault
 
 ENV_VAR = "STOIX_TPU_FAULT"
 
-_KNOWN = ("actor_crash", "queue_stall", "nan_loss", "ckpt_corrupt", "sigterm")
+_KNOWN = (
+    "actor_crash",
+    "queue_stall",
+    "nan_loss",
+    "ckpt_corrupt",
+    "sigterm",
+    "backend_wedge",
+    "slow_compile",
+)
 
 
 class FaultPlan:
@@ -190,6 +207,32 @@ def maybe_sigterm(window_idx: int) -> None:
     if at is not None and window_idx == at and plan.consume("sigterm"):
         _injected_counter().inc(labels={"fault": "sigterm"})
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_slow_compile() -> None:
+    """Sleep `slow_compile:S` seconds inside the watchdog-guarded compile
+    stage (one-shot). The sleep is plain Python, so the watchdog's
+    interrupt_main() lands immediately — this drives the CompileStallError
+    path deterministically where a real wedge would need real hardware."""
+    plan = get_plan()
+    if plan is None:
+        return
+    secs = plan.arg("slow_compile")
+    if secs is None or not plan.consume("slow_compile"):
+        return
+    _injected_counter().inc(labels={"fault": "slow_compile"})
+    get_logger("stoix_tpu.resilience").warning(
+        "[faultinject] injecting %ds compile delay", secs
+    )
+    time.sleep(secs)
+
+
+def backend_wedge_armed() -> bool:
+    """Whether the probe-subprocess wedge is armed. The wedge itself fires in
+    the CHILD (resilience/preflight.py inlines the check — the child inherits
+    STOIX_TPU_FAULT); this parent-side view exists for logging/tests."""
+    plan = get_plan()
+    return plan is not None and plan.arg("backend_wedge") is not None
 
 
 def ckpt_corrupt_armed() -> bool:

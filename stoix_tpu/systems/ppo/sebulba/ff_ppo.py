@@ -49,6 +49,7 @@ from stoix_tpu.resilience import (
     PreemptionHandler,
     faultinject,
     guards,
+    preflight,
     supervisor_from_config,
 )
 from stoix_tpu.resilience.errors import EvaluatorStallError
@@ -393,6 +394,19 @@ def run_experiment(
     # divergence-guard mode for the learner loop's host-side checks.
     faultinject.configure(config.arch.get("fault_spec"))
     guard_mode = guards.resolve_mode(config)
+    # Launch hardening (docs/DESIGN.md §2.4, arch.preflight): subprocess
+    # backend probe + config cross-validation before any device work — the
+    # actor/learner device-id split below is exactly the class of config this
+    # catches (ids out of range, envs not divisible by actors).
+    pf = preflight.settings_from_config(config)
+    if pf.enabled:
+        probe = preflight.probe_backend(
+            timeout_s=pf.probe_timeout_s,
+            attempts=pf.probe_attempts,
+            backoff_base_s=pf.probe_backoff_base_s,
+            backoff_max_s=pf.probe_backoff_max_s,
+        )
+        preflight.validate_config(config, device_count=probe.device_count)
     devices = jax.devices()
     actor_devices = [devices[i] for i in config.arch.actor.device_ids]
     learner_devices = [devices[i] for i in config.arch.learner.device_ids]

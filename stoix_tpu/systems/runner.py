@@ -63,10 +63,29 @@ state, and returns cleanly so the run resumes instead of losing the window.
 `system.update_guard` wires the in-jit divergence guard's host half through
 process_window (skip counting / halt raising), and STOIX_TPU_FAULT /
 arch.fault_spec arms the deterministic chaos layer.
+
+Launch hardening (docs/DESIGN.md §2.4, `arch.preflight`): with
+`arch.preflight.enabled=true` the run starts with a subprocess-isolated
+backend probe (bounded timeout + backoff retries — a wedged PJRT runtime
+raises BackendUnavailableError instead of hanging this process) and config
+cross-validation BEFORE any device work; the AOT compile and the first
+window's execution run under deadline watchdogs that dump all thread stacks
++ the registry snapshot and raise CompileStallError on stall; and the
+compiled learner's memory_analysis() is checked against device HBM
+(ResourcePreflightError beats a 20-minutes-later runtime OOM). Off (the
+default) adds zero work and zero host syncs — bit-identical. On, the only
+semantic change is ONE block_until_ready on the first window's metrics (the
+watchdogged first-execution check); trajectory values are unchanged.
+
+Restore is topology-elastic (utils/checkpointing.py): a checkpoint saved on
+an 8-device mesh resumes on 1 device (and vice versa) with bit-identical
+params — the state materializes to host and re-places via the fresh
+template's shardings.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Any, Callable, NamedTuple, Optional
@@ -91,7 +110,7 @@ from stoix_tpu.parallel import (
     materialize,
     maybe_initialize_distributed,
 )
-from stoix_tpu.resilience import PreemptionHandler, faultinject, guards
+from stoix_tpu.resilience import PreemptionHandler, Watchdog, faultinject, guards, preflight
 from stoix_tpu.utils.checkpointing import checkpointer_from_config
 from stoix_tpu.utils.jax_utils import aot_warmup
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
@@ -155,6 +174,14 @@ class _Window(NamedTuple):
     metrics: Any  # ONE coalesced device tree: episode/train/eval metrics
 
 
+def _maybe_watchdog(pf: Any, stage: str, deadline_s: float):
+    """A deadline Watchdog when preflight is enabled; a free nullcontext
+    otherwise (the off path must add zero threads and zero work)."""
+    if not pf.enabled:
+        return contextlib.nullcontext()
+    return Watchdog(stage, deadline_s, hard_exit_grace_s=pf.hard_exit_grace_s)
+
+
 # ONE jit instance so per-window snapshot copies hit the compile cache
 # (jax.jit memoizes per input tree structure/avals).
 _TREE_COPY = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
@@ -174,13 +201,32 @@ def run_anakin_experiment(
     evaluator_setup_fn: Callable = None,
 ) -> float:
     """Generic Anakin experiment: returns final eval episode-return mean."""
-    maybe_initialize_distributed(config)
     # Resilience (docs/DESIGN.md §2.3): arm the chaos plan (no-op unless
     # STOIX_TPU_FAULT / arch.fault_spec is set) BEFORE the learner is built —
     # the in-jit nan_loss guard reads it at trace time — and resolve the
     # divergence-guard mode for the host-side checks below.
     faultinject.configure(config.arch.get("fault_spec"))
     guard_mode = guards.resolve_mode(config)
+    # Launch hardening (docs/DESIGN.md §2.4): probe the backend in a
+    # SUBPROCESS and cross-validate the config BEFORE this process commits to
+    # device work — a wedged PJRT runtime or a bad shape aborts here with a
+    # typed error, not twenty minutes in. Off by default (zero added work).
+    pf = preflight.settings_from_config(config)
+    if pf.enabled:
+        with span("preflight"):
+            probe = preflight.probe_backend(
+                timeout_s=pf.probe_timeout_s,
+                attempts=pf.probe_attempts,
+                backoff_base_s=pf.probe_backoff_base_s,
+                backoff_max_s=pf.probe_backoff_max_s,
+            )
+            preflight.validate_config(config, device_count=probe.device_count)
+            get_logger("stoix_tpu.resilience").info(
+                "[preflight] backend healthy (%s x%d, attempt %d) and config "
+                "cross-checks pass", probe.platform, probe.device_count,
+                probe.attempts,
+            )
+    maybe_initialize_distributed(config)
     mesh = create_mesh(dict(config.arch.get("mesh") or {"data": -1}))
     config = check_total_timesteps(config, int(mesh.shape["data"]))
     config.logger.system_name = config.system.system_name
@@ -264,18 +310,28 @@ def run_anakin_experiment(
         fused_step = jax.jit(_fused_step, **donate)
 
     # AOT warmup: pay the learner's XLA compile before the timed loop so the
-    # first window's steps_per_second is throughput, not compile time.
+    # first window's steps_per_second is throughput, not compile time. With
+    # preflight on, the compile runs under a deadline watchdog (a wedged
+    # backend raises CompileStallError with a full stack dump instead of
+    # hanging) and the compiled program's memory_analysis() is gated against
+    # device HBM before anything executes.
     t0 = time.perf_counter()
     with span("aot_warmup", fused=fused):
-        if fused:
-            # Aval-identical stand-in for the per-window eval keys below.
-            example_key = jax.random.split(jax.random.PRNGKey(0))[1]
-            fused_step = aot_warmup(fused_step, learner_state, example_key)
-        else:
-            learn = aot_warmup(learn, learner_state)
+        with _maybe_watchdog(pf, "first_compile", pf.compile_deadline_s):
+            faultinject.maybe_slow_compile()
+            if fused:
+                # Aval-identical stand-in for the per-window eval keys below.
+                example_key = jax.random.split(jax.random.PRNGKey(0))[1]
+                fused_step = aot_warmup(fused_step, learner_state, example_key)
+            else:
+                learn = aot_warmup(learn, learner_state)
     compile_s = time.perf_counter() - t0
     phases.add("compile_s", compile_s)
     compile_counter.inc(compile_s)
+    if pf.enabled:
+        preflight.check_device_memory(
+            fused_step if fused else learn, headroom=pf.hbm_headroom
+        )
 
     best_params = _tree_copy(setup.eval_params_fn(learner_state))
     best_return = -jnp.inf
@@ -433,7 +489,18 @@ def run_anakin_experiment(
                     jax.profiler.start_trace(profile_dir)
                 except Exception:  # noqa: BLE001
                     profile_window = -1
-            window = dispatch_window(eval_idx)
+            if eval_idx == 0 and pf.enabled:
+                # First-window execution watchdog (docs/DESIGN.md §2.4): force
+                # this window's metrics to the host under a deadline, so a
+                # backend that compiled fine but wedges on EXECUTION raises
+                # CompileStallError instead of hanging the run's first fetch.
+                # The extra sync exists only with preflight on; the dispatched
+                # program sequence (and hence the trajectory) is unchanged.
+                with _maybe_watchdog(pf, "first_window", pf.first_window_deadline_s):
+                    window = dispatch_window(eval_idx)
+                    jax.block_until_ready(window.metrics)
+            else:
+                window = dispatch_window(eval_idx)
             dispatched_t = window.t
             faultinject.maybe_sigterm(eval_idx)
             if pipelined:
@@ -516,6 +583,7 @@ def run_anakin_experiment(
                 "skipped_updates": guards.skipped_counter().value() - skipped_base,
                 "preempted": preempted,
                 "resume_capable": checkpointer is not None,
+                "preflight": pf.enabled,
             },
         }
     )

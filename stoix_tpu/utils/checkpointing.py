@@ -13,13 +13,28 @@ template itself carries inf/nan sentinels are exempt) — and, when the newest
 checkpoint is corrupt or truncated (a preempted save, a chaos-injected
 `ckpt_corrupt`), automatically falls back to the newest VALID step instead
 of dying on a bare orbax error.
+
+Topology-elastic restore (docs/DESIGN.md §2.4): every save records its device
+footprint (the number of distinct devices the state's shardings span) in a
+`_topology.json` sidecar next to the step directories, plus the saving
+process's device/process counts in the manager metadata. When `restore` sees
+a template whose footprint differs from the saved one — a run saved on an
+8-device mesh resuming on 1 device, or vice versa — it takes the RESHARD
+path: materialize the checkpoint to host WITHOUT a sharded template, match
+leaves to the template by tree-path (orbax serializes NamedTuples as dicts,
+so leaf ORDER differs), validate shape/dtype, and re-place each leaf via the
+template's own NamedShardings (the fresh setup built them from
+`parallel.mesh`). Values pass through the host unchanged: params restore
+bit-identical. Leaves whose GLOBAL shape is topology-dependent (the
+per-shard RNG key state, shaped [num_shards, ...]) cannot be ported; they
+keep the template's freshly-initialized value and are logged loudly.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +50,42 @@ from stoix_tpu.resilience.errors import CheckpointIntegrityError
 # — pre-3.0 PPO/DPO/penalty checkpoints lack it and cannot restore into the
 # new template.
 CHECKPOINTER_VERSION = 3.0
+
+# Sidecar recording each step's device footprint (docs/DESIGN.md §2.4):
+# {"steps": {"<step>": {"devices": N}}}. Lives at the store root next to the
+# step directories; orbax's step scan only considers directories, so the
+# file is invisible to it.
+TOPOLOGY_SIDECAR = "_topology.json"
+
+
+def _device_footprint(tree: Any) -> Optional[int]:
+    """Number of distinct devices the tree's jax.Array leaves span, or None
+    when the tree carries no addressable device arrays (host/numpy state)."""
+    ids = set()
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                ids.update(d.id for d in leaf.sharding.device_set)
+            except Exception:  # noqa: BLE001 — deleted/donated arrays have no sharding
+                continue
+    return len(ids) or None
+
+
+def _path_key(path: Any) -> Tuple[str, ...]:
+    """Normalize a jax key-path so the same LOGICAL leaf matches across
+    container types: orbax serializes NamedTuples as dicts (GetAttrKey on the
+    template side, DictKey on the restored side) and tuples as lists."""
+    parts = []
+    for entry in path:
+        if hasattr(entry, "name"):  # GetAttrKey (NamedTuple/dataclass field)
+            parts.append(str(entry.name))
+        elif hasattr(entry, "key"):  # DictKey / FlattenedIndexKey
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):  # SequenceKey
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return tuple(parts)
 
 
 class Checkpointer:
@@ -75,6 +126,14 @@ class Checkpointer:
         )
         metadata = dict(metadata or {})
         metadata["checkpointer_version"] = CHECKPOINTER_VERSION
+        # Saving process's topology, for operators reading the store; the
+        # per-step footprint that drives elastic restore lives in the
+        # _topology.json sidecar (written by save — only then is the actual
+        # device span of the state known).
+        metadata["topology"] = {
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+        }
         self._save_interval_steps = int(save_interval_steps)
         self._manager = ocp.CheckpointManager(
             self.directory,
@@ -118,12 +177,15 @@ class Checkpointer:
         hot path never calls wait(). `force=True` bypasses the save-interval
         policy (the preemption handler's emergency checkpoint must land
         regardless of cadence)."""
+        footprint = _device_footprint(state)
         saved = self._manager.save(
             timestep,
             args=ocp.args.StandardSave(jax.tree.map(jax.numpy.asarray, state)),
             metrics={"episode_return": float(episode_return)},
             force=force,
         )
+        if saved and jax.process_index() == 0:
+            self._record_topology(timestep, footprint)
         # Chaos hook (`STOIX_TPU_FAULT=ckpt_corrupt`, one-shot): mangle this
         # step's files AFTER serialization completes, so the restore-fallback
         # path is exercised against a real on-disk layout.
@@ -139,6 +201,41 @@ class Checkpointer:
     def all_steps(self) -> List[int]:
         """Ascending steps with a checkpoint on disk."""
         return sorted(int(s) for s in self._manager.all_steps())
+
+    # -- topology sidecar ----------------------------------------------------
+    def _sidecar_path(self) -> str:
+        return os.path.join(self.directory, TOPOLOGY_SIDECAR)
+
+    def _record_topology(self, timestep: int, footprint: Optional[int]) -> None:
+        """Read-modify-write the per-step footprint sidecar. Best-effort: a
+        missing sidecar only disables the PROACTIVE reshard decision (restore
+        still falls back to resharding when the template path fails)."""
+        if footprint is None:
+            return
+        try:
+            record = self.saved_topologies()
+            record[int(timestep)] = {"devices": int(footprint)}
+            with open(self._sidecar_path(), "w") as f:
+                json.dump(
+                    {"steps": {str(k): v for k, v in sorted(record.items())}}, f
+                )
+        except OSError as exc:
+            from stoix_tpu.observability import get_logger
+
+            get_logger("stoix_tpu.checkpoint").warning(
+                "[checkpoint] could not record topology sidecar for step %d "
+                "(%s) — elastic restore will rely on its fallback path",
+                timestep, exc,
+            )
+
+    def saved_topologies(self) -> Dict[int, dict]:
+        """Per-step device footprints from the sidecar ({} when absent)."""
+        try:
+            with open(self._sidecar_path()) as f:
+                data = json.load(f)
+            return {int(k): dict(v) for k, v in (data.get("steps") or {}).items()}
+        except (OSError, ValueError):
+            return {}
 
     @staticmethod
     def _validate(restored: Any, template: Any, step: int) -> None:
@@ -181,12 +278,100 @@ class Checkpointer:
                 f"(template expects finite values here)",
             )
 
+    def _restore_resharded(self, step: int, template: Any) -> Any:
+        """Topology-elastic restore path (docs/DESIGN.md §2.4): materialize
+        the checkpoint to host with NO sharded template, match leaves to the
+        template by normalized tree-path, and re-place each onto the
+        template's own sharding. Values round-trip through the host
+        untouched — params restore bit-identical across meshes.
+
+        Shape-mismatched leaves are topology-dependent state (the per-shard
+        RNG keys, [num_shards, ...]): they keep the TEMPLATE's value and are
+        logged. dtype mismatches and missing leaves are corruption, not
+        topology — they raise CheckpointIntegrityError."""
+        from stoix_tpu.observability import get_logger
+
+        # Read through a standalone PyTree handler with restore_type=ndarray:
+        # the MANAGER's restore (with or without a template) reconstructs
+        # jax.Arrays on the devices recorded AT SAVE TIME, which do not exist
+        # in a different topology — the whole point of this path is that the
+        # saving mesh is gone. Forcing numpy never touches device placement.
+        step_path = os.path.join(self.directory, str(step), "default")
+        if not os.path.isdir(step_path):  # older orbax layouts: no item subdir
+            step_path = os.path.join(self.directory, str(step))
+        reader = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+        try:
+            raw_meta = reader.metadata(step_path)
+            restore_args = jax.tree.map(
+                lambda _m: ocp.RestoreArgs(restore_type=np.ndarray), raw_meta
+            )
+            raw = reader.restore(
+                step_path, args=ocp.args.PyTreeRestore(restore_args=restore_args)
+            )
+        finally:
+            reader.close()
+        raw_by_path = {
+            _path_key(path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(raw)[0]
+        }
+        template_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+        placed: List[Any] = []
+        reinitialized: List[str] = []
+        matched = 0
+        for path, ref in template_leaves:
+            key = _path_key(path)
+            if key not in raw_by_path:
+                raise CheckpointIntegrityError(
+                    step,
+                    f"leaf {jax.tree_util.keystr(path)} missing from the "
+                    f"checkpoint (resharded restore matches by tree-path)",
+                )
+            arr = np.asarray(raw_by_path[key])
+            ref_dtype = getattr(ref, "dtype", None) or np.asarray(ref).dtype
+            ref_shape = tuple(np.shape(ref))
+            if arr.dtype != ref_dtype:
+                raise CheckpointIntegrityError(
+                    step,
+                    f"dtype mismatch at {jax.tree_util.keystr(path)}: saved "
+                    f"{arr.dtype} vs template {ref_dtype}",
+                )
+            if arr.shape != ref_shape:
+                # Topology-dependent global shape (e.g. the [num_shards, ...]
+                # per-shard key state): not portable across meshes by
+                # construction — keep the template's fresh value.
+                reinitialized.append(
+                    f"{jax.tree_util.keystr(path)} (saved {arr.shape} vs "
+                    f"template {ref_shape})"
+                )
+                placed.append(ref)
+                continue
+            matched += 1
+            if isinstance(ref, jax.Array):
+                placed.append(jax.device_put(arr, ref.sharding))
+            else:
+                placed.append(arr)
+        if matched == 0:
+            raise CheckpointIntegrityError(
+                step,
+                "resharded restore matched ZERO leaves by shape — this is a "
+                "different state entirely, not a topology change",
+            )
+        if reinitialized:
+            get_logger("stoix_tpu.checkpoint").warning(
+                "[checkpoint] elastic restore of step %d re-placed %d leaf(s) "
+                "onto the new mesh; %d topology-dependent leaf(s) kept their "
+                "template initialization: %s",
+                step, matched, len(reinitialized), "; ".join(reinitialized),
+            )
+        return treedef.unflatten(placed)
+
     def restore(
         self,
         template: Any,
         timestep: Optional[int] = None,
         validate: bool = True,
         fallback: bool = True,
+        reshard: str = "auto",
     ) -> Tuple[Any, int]:
         """Restore into the shape/sharding of `template`; returns (state, step).
 
@@ -195,9 +380,18 @@ class Checkpointer:
         a preempted or chaos-corrupted save costs one checkpoint interval,
         not the run. An EXPLICIT `timestep` never falls back: a missing step
         raises FileNotFoundError listing what IS available, and a corrupt one
-        raises its own error (the caller asked for that step by name)."""
+        raises its own error (the caller asked for that step by name).
+
+        `reshard` controls topology elasticity (docs/DESIGN.md §2.4):
+        'auto' (default) takes the resharding path when the sidecar-recorded
+        footprint of a step differs from the template's — and additionally
+        retries a failed template-path restore through it (old stores have no
+        sidecar); 'never' restores strictly into the template's topology;
+        'force' always reshards through the host."""
         from stoix_tpu.observability import get_logger
 
+        if reshard not in ("auto", "never", "force"):
+            raise ValueError(f"reshard must be auto|never|force, got {reshard!r}")
         steps = self.all_steps()
         if timestep is not None:
             if int(timestep) not in steps:
@@ -212,12 +406,48 @@ class Checkpointer:
                 raise FileNotFoundError(f"No checkpoints under {self.directory}")
             candidates = steps[::-1]
 
+        saved_topologies = self.saved_topologies() if reshard == "auto" else {}
+        template_footprint = _device_footprint(template)
+        log = get_logger("stoix_tpu.checkpoint")
         last_error: Optional[Exception] = None
         for step in candidates:
+            saved_fp = (saved_topologies.get(step) or {}).get("devices")
+            proactive_reshard = reshard == "force" or (
+                reshard == "auto"
+                and saved_fp is not None
+                and template_footprint is not None
+                and int(saved_fp) != int(template_footprint)
+            )
             try:
-                restored = self._manager.restore(
-                    step, args=ocp.args.StandardRestore(template)
-                )
+                if proactive_reshard:
+                    log.info(
+                        "[checkpoint] step %d saved on %s device(s), template "
+                        "spans %s — taking the elastic (resharding) restore "
+                        "path", step, saved_fp or "?", template_footprint,
+                    )
+                    restored = self._restore_resharded(step, template)
+                else:
+                    try:
+                        restored = self._manager.restore(
+                            step, args=ocp.args.StandardRestore(template)
+                        )
+                    except (CheckpointIntegrityError, FileNotFoundError):
+                        raise
+                    except Exception as exc:  # noqa: BLE001 — template-path
+                        # restore failures on an UNKNOWN-topology store (no
+                        # sidecar entry) are often sharding mismatches: give
+                        # the elastic path one shot before rejecting the step.
+                        # A KNOWN-matching topology that failed is corruption
+                        # — re-reading the whole state through the host path
+                        # would double the I/O for nothing.
+                        if reshard != "auto" or saved_fp is not None:
+                            raise
+                        log.warning(
+                            "[checkpoint] template-path restore of step %d "
+                            "failed (%s: %s) — retrying through the elastic "
+                            "resharding path", step, type(exc).__name__, exc,
+                        )
+                        restored = self._restore_resharded(step, template)
                 if validate:
                     self._validate(restored, template, step)
                 return restored, int(step)
@@ -227,7 +457,7 @@ class Checkpointer:
                 if not fallback:
                     raise
                 last_error = exc
-                get_logger("stoix_tpu.checkpoint").warning(
+                log.warning(
                     "[checkpoint] step %d unusable (%s: %s) — falling back to "
                     "the next-newest checkpoint",
                     step, type(exc).__name__, exc,

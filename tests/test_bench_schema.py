@@ -61,3 +61,46 @@ def test_bench_smoke_payload_schema():
     assert resilience["update_guard"] == "off", resilience
     assert resilience["skipped_updates"] == 0, resilience
     assert isinstance(resilience["resume_capable"], bool), resilience
+
+    # Launch-hardening fields (docs/DESIGN.md §2.4): CPU fallback is a
+    # FIRST-CLASS part of the schema, not a unit-string suffix. An explicit
+    # --cpu run is not a fallback and needed no probe.
+    assert payload["fallback"] is False, payload
+    assert payload["fallback_reason"] is None, payload
+    assert payload["probe_attempts"] == 0, payload
+
+
+def test_bench_backend_wedge_aborts_typed_within_deadline():
+    # Acceptance pin (docs/DESIGN.md §2.4): with the probe subprocess wedged
+    # (backend_wedge chaos fault — the child sleeps before touching jax),
+    # bench.py must abort with a structured BACKEND UNAVAILABLE line naming
+    # the attempt count, within the configured budget — never hang. Fallback
+    # is disabled so the typed failure line itself is under test.
+    import time
+
+    start = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "STOIX_BENCH_NO_FALLBACK": "1",
+            "STOIX_TPU_FAULT": "backend_wedge",
+            "STOIX_BENCH_PROBE_TIMEOUT": "2",
+            "STOIX_BENCH_PROBE_ATTEMPTS": "2",
+        },
+    )
+    elapsed = time.monotonic() - start
+    assert proc.returncode == 0, f"bench.py must exit 0 with a structured line:\n{proc.stderr}"
+    assert elapsed < 90.0, f"wedged-backend abort took {elapsed:.0f}s — must not hang"
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, proc.stdout
+    payload = json.loads(json_lines[0])
+    assert payload["value"] == 0.0
+    assert "BACKEND UNAVAILABLE" in payload["unit"], payload
+    assert payload["probe_attempts"] == 2, payload
+    assert payload["fallback"] is False, payload

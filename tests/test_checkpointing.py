@@ -1,4 +1,7 @@
-"""Checkpoint save -> restore round trip through the real training path."""
+"""Checkpoint save -> restore round trip through the real training path,
+plus topology-elastic restore (docs/DESIGN.md §2.4): a checkpoint saved on an
+8-device mesh restores onto a 1-device mesh — and the reverse — with
+bit-identical params, and training continues on the new mesh."""
 
 import os
 
@@ -59,6 +62,69 @@ def test_save_then_resume_round_trip(tmp_path, devices):
         assert np.isfinite(ret)
     finally:
         os.chdir(cwd)
+
+
+def _build_setup(tmp_path, n_devices):
+    """Real ff_ppo learner setup on a mesh spanning the first `n_devices` of
+    the process's 8 fake devices (the conftest XLA_FLAGS harness is the
+    'fake 8-device mesh'; a sub-mesh IS a different topology to restore
+    onto — the sharding footprint, not the process device count, is what
+    elastic restore keys on)."""
+    import copy
+
+    from stoix_tpu import envs
+    from stoix_tpu.parallel import create_mesh
+    from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
+    from stoix_tpu.utils.timestep_checker import check_total_timesteps
+
+    config = _cfg(tmp_path, [])
+    mesh = create_mesh({"data": -1}, devices=jax.devices()[:n_devices])
+    config = check_total_timesteps(copy.deepcopy(config), n_devices)
+    env, _ = envs.make(config)
+    return learner_setup(env, config, mesh, jax.random.PRNGKey(0))
+
+
+def _assert_params_equal(expected, restored_params):
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        expected, restored_params,
+    )
+
+
+def _elastic_roundtrip(tmp_path, devices, save_n, restore_n):
+    """Save the full learner state under a `save_n`-device mesh, restore into
+    a fresh `restore_n`-device template: params must be BIT-identical and a
+    learn step must run on the new mesh (training continues)."""
+    setup_src = _build_setup(tmp_path, save_n)
+    saver = Checkpointer(
+        model_name="elastic", rel_dir=str(tmp_path / "ck"), checkpoint_uid="u"
+    )
+    assert saver.save(1, setup_src.learner_state)
+    saver.close()
+    expected_params = jax.tree.map(np.asarray, setup_src.learner_state.params)
+
+    setup_dst = _build_setup(tmp_path, restore_n)
+    loader = Checkpointer(
+        model_name="elastic", rel_dir=str(tmp_path / "ck"), checkpoint_uid="u"
+    )
+    assert loader.saved_topologies()[1]["devices"] == save_n
+    restored, step = loader.restore(setup_dst.learner_state)
+    loader.close()
+    assert step == 1
+    _assert_params_equal(expected_params, restored.params)
+    # Training continues: one learn window on the NEW mesh from the restored
+    # state, finishing finite.
+    out = setup_dst.learn(restored)
+    leaf = np.asarray(jax.tree.leaves(out.learner_state.params)[0])
+    assert np.isfinite(leaf).all()
+
+
+def test_elastic_restore_8_device_save_to_1_device_mesh(tmp_path, devices):
+    _elastic_roundtrip(tmp_path, devices, save_n=8, restore_n=1)
+
+
+def test_elastic_restore_1_device_save_to_8_device_mesh(tmp_path, devices):
+    _elastic_roundtrip(tmp_path, devices, save_n=1, restore_n=8)
 
 
 def test_checkpointer_direct_round_trip(tmp_path):

@@ -103,6 +103,45 @@ def test_stx003_allows_narrow_handled_and_allowlisted():
     assert _stx003(lint, swallowed, rel="tests/test_whatever.py") == []
 
 
+def _stx004(lint, source, rel="stoix_tpu/_stx004_probe.py"):
+    import ast
+
+    return lint.check_unbounded_blocking(
+        os.path.join(REPO, rel), source, ast.parse(source)
+    )
+
+
+def test_stx004_flags_unbounded_blocking_calls():
+    lint = _load_lint_module()
+    source = (
+        "x = q.get()\n"            # queue.Queue.get, no timeout
+        "y = fut.result()\n"       # concurrent.futures, no timeout
+        "t.join()\n"               # thread join, no timeout
+        "z = q.get(block=True)\n"  # explicit block without a timeout
+    )
+    findings = _stx004(lint, source)
+    assert len(findings) == 4, findings
+    assert all("STX004" in f for f in findings)
+
+
+def test_stx004_allows_bounded_keyed_and_noqa():
+    lint = _load_lint_module()
+    clean = (
+        "x = q.get(timeout=1.0)\n"          # bounded
+        "y = fut.result(timeout=5)\n"       # bounded
+        "t.join(2.0)\n"                     # bounded (positional timeout)
+        "s = ', '.join(parts)\n"            # str.join: keyed, not blocking
+        "v = d.get('key')\n"                # dict.get: keyed
+        "w = q.get(True, 1.0)\n"            # positional block+timeout
+        "n = q.get(block=False)\n"          # non-blocking
+        "m = q.get()  # noqa: STX004 — supervised drain loop\n"
+    )
+    assert _stx004(lint, clean) == []
+    # Out of scope: tests/ and scripts/ are not library code.
+    assert _stx004(lint, "q.get()\n", rel="tests/test_whatever.py") == []
+    assert _stx004(lint, "q.get()\n", rel="scripts/tool.py") == []
+
+
 def test_stx002_allows_legit_patterns():
     lint = _load_lint_module()
     # noqa opt-out, lowercase names, populated constant tables, class/function

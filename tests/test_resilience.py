@@ -12,6 +12,11 @@ Every recovery path is proven under an INJECTED fault (resilience/faultinject):
   * actor_crash -> supervised restart completes the Sebulba run; with the
                   restart budget exhausted (or a wedge) a typed
                   ComponentFailure fails the learner fast
+  * backend_wedge -> the subprocess backend probe times out every attempt and
+                  raises BackendUnavailableError within the configured
+                  deadline — the parent process never hangs (DESIGN.md §2.4)
+  * slow_compile -> the first-compile watchdog dumps thread stacks and raises
+                  CompileStallError instead of stalling indefinitely
 
 Plus the bit-identity pin: with everything at defaults the resilience layer
 adds zero ops and zero metrics — training trajectories are unchanged.
@@ -484,6 +489,162 @@ def test_async_evaluator_stall_raises_named_error():
     release.set()
     evaluator.wait_until_idle(timeout=10.0)  # clean path still returns
     lifetime.stop()
+
+
+# ---------------------------------------------------------------------------
+# Pillar 5: launch hardening (preflight + watchdogs, DESIGN.md §2.4)
+# ---------------------------------------------------------------------------
+
+
+def test_probe_backend_healthy_cpu():
+    from stoix_tpu.resilience import preflight
+
+    probe = preflight.probe_backend(timeout_s=120.0, attempts=1)
+    assert probe.platform == "cpu"
+    assert probe.device_count >= 1
+    assert probe.attempts == 1
+    assert probe.process_count == 1
+
+
+def test_backend_wedge_aborts_within_deadline(monkeypatch):
+    # The acceptance pin: a wedged backend (every probe child sleeps forever
+    # before touching jax) must abort with the TYPED error within the
+    # configured budget — attempts * timeout + backoffs — never hang.
+    from stoix_tpu.resilience import BackendUnavailableError, preflight
+
+    monkeypatch.setenv("STOIX_TPU_FAULT", "backend_wedge")
+    start = time.monotonic()
+    with pytest.raises(BackendUnavailableError) as excinfo:
+        preflight.probe_backend(
+            timeout_s=2.0, attempts=2, backoff_base_s=0.1, backoff_max_s=0.2
+        )
+    elapsed = time.monotonic() - start
+    assert elapsed < 20.0, f"abort took {elapsed:.1f}s — the parent must not hang"
+    assert excinfo.value.attempts == 2
+    assert excinfo.value.timeout_s == 2.0
+    assert "timed out" in excinfo.value.last_error
+
+
+def test_validate_config_collects_all_findings():
+    from stoix_tpu.resilience import ConfigValidationError, preflight
+
+    bad = _anakin_config(
+        ["arch.total_num_envs=7", "arch.update_batch_size=3",
+         "system.update_guard=explode"]
+    )
+    with pytest.raises(ConfigValidationError) as excinfo:
+        preflight.validate_config(bad, device_count=1)
+    findings = excinfo.value.findings
+    assert len(findings) >= 2, findings  # divisibility AND guard mode, at once
+    assert any("total_num_envs" in f for f in findings), findings
+    assert any("update_guard" in f for f in findings), findings
+
+    good = _anakin_config([])
+    preflight.validate_config(good, device_count=8)  # must not raise
+
+
+def test_validate_config_sebulba_device_split():
+    from stoix_tpu.resilience import ConfigValidationError, preflight
+
+    bad = _sebulba_config(["arch.learner.device_ids=[99]"])
+    with pytest.raises(ConfigValidationError, match="out of range"):
+        preflight.validate_config(bad, device_count=2)
+    good = _sebulba_config([])
+    preflight.validate_config(good, device_count=2)
+
+
+def test_watchdog_stall_dumps_and_raises():
+    from stoix_tpu.resilience import CompileStallError, Watchdog
+
+    with pytest.raises(CompileStallError) as excinfo:
+        with Watchdog("unit_stage", deadline_s=0.2):
+            time.sleep(10.0)  # interrupt_main breaks this sleep
+    err = excinfo.value
+    assert err.stage == "unit_stage"
+    assert err.dump is not None and "thread" in err.dump
+    assert "registry snapshot" in err.dump
+
+
+def test_watchdog_clean_section_is_transparent():
+    from stoix_tpu.resilience import Watchdog
+
+    with Watchdog("unit_ok", deadline_s=30.0) as dog:
+        value = 1 + 1
+    assert value == 2 and not dog.stalled
+
+
+def test_slow_compile_trips_first_compile_watchdog(devices, monkeypatch):
+    # End-to-end through the Anakin runner: preflight on, a 1s compile
+    # deadline, and an injected 10s compile delay -> CompileStallError from
+    # the first_compile stage, not a 10s-later success or a hang.
+    from stoix_tpu.resilience import CompileStallError
+
+    monkeypatch.setenv("STOIX_TPU_FAULT", "slow_compile:10")
+    with pytest.raises(CompileStallError, match="first_compile"):
+        _run_recorded(
+            ["arch.preflight.enabled=True",
+             "arch.preflight.compile_deadline_s=1.0",
+             "arch.preflight.probe_timeout_s=120"]
+        )
+
+
+def test_preflight_on_trajectory_identical(devices):
+    # arch.preflight only ADDS checks (probe subprocess, validation, one
+    # block_until_ready on window 0): the dispatched program sequence — and
+    # hence the training trajectory — must be bit-identical to preflight off.
+    off_traj, _ = _run_recorded([])
+    on_traj, _ = _run_recorded(
+        ["arch.preflight.enabled=True", "arch.preflight.probe_timeout_s=120"]
+    )
+    _assert_identical(off_traj, on_traj)
+    from stoix_tpu.systems.runner import LAST_RUN_STATS
+
+    assert LAST_RUN_STATS["resilience"]["preflight"] is True
+
+
+def test_memory_gate_passes_and_estimates():
+    import jax.numpy as jnp
+
+    from stoix_tpu.resilience import preflight
+
+    compiled = jax.jit(lambda x: x @ x).lower(jnp.ones((64, 64))).compile()
+    estimate = preflight.estimate_compiled_memory(compiled)
+    assert estimate is not None and estimate["predicted_bytes"] >= 0
+    # CPU exposes no bytes_limit: the gate logs and passes (returns estimate).
+    assert preflight.check_device_memory(compiled, headroom=0.9) is not None
+    # Non-compiled callables (aot_warmup's graceful-degrade return) skip.
+    assert preflight.estimate_compiled_memory(lambda x: x) is None
+
+
+def test_memory_gate_rejects_predicted_oom():
+    import jax.numpy as jnp
+
+    from stoix_tpu.resilience import ResourcePreflightError, preflight
+
+    class FakeDevice:
+        device_kind = "FakeTPU v9"
+        platform = "tpu"
+
+        def memory_stats(self):
+            return {"bytes_limit": 1024}  # 1 KiB of "HBM"
+
+    compiled = jax.jit(lambda x: x @ x).lower(jnp.ones((64, 64))).compile()
+    with pytest.raises(ResourcePreflightError) as excinfo:
+        preflight.check_device_memory(compiled, headroom=0.9, device=FakeDevice())
+    assert excinfo.value.limit_bytes == 1024
+    assert excinfo.value.predicted_bytes > 1024
+
+
+def test_run_preflight_report_renders_and_gates():
+    from stoix_tpu.resilience import preflight
+
+    report = preflight.run_preflight(
+        [("good", _anakin_config([])), ("bad", _anakin_config(["arch.total_num_envs=7"]))]
+    )
+    text = report.render()
+    assert not report.ok
+    assert "backend_probe" in text and "config[bad]" in text
+    assert "overall: FAIL" in text
 
 
 # ---------------------------------------------------------------------------
