@@ -1,433 +1,37 @@
-"""First-party lint gate (reference .github/workflows/test_linters.yaml runs
-black/isort/flake8/mypy via pre-commit).
+"""First-party lint gate — thin shim over `python -m stoix_tpu.analysis`.
 
-External linters are not installed in the build sandbox, so this script
-implements the always-available core checks natively and delegates to
-ruff/mypy when they are importable (their configuration lives in
-pyproject.toml, so installing them upgrades the gate with zero changes here):
+The flat implementation that used to live here (syntax/F401/hygiene plus the
+STX001-STX004 ownership rules grown across PRs 1-4) was promoted into the
+rule-plugin subsystem `stoix_tpu/analysis/` (one module per rule, registry
+driven, `--select`/`--ignore`, text/JSON output, five additional JAX-aware
+rules STX005-STX009). This shim keeps every existing invocation — CI, docs,
+muscle memory — working byte-identically:
 
-  1. syntax: every file must compile (py_compile);
-  2. unused imports (AST-based, flake8 F401 equivalent; `# noqa` respected);
-  3. hygiene: no tabs in indentation, no trailing whitespace, max line
-     length 100 (warnings only);
-  4. host-sync ownership (STX001): Anakin system files must not call
-     `jax.block_until_ready` / `checkpointer.wait()` / `wait_until_finished`
-     — the pipelined runner (systems/runner.py) owns ALL host-sync points, so
-     future systems stay off the accelerator critical path by construction
-     (Sebulba files are exempt: their actor/learner threads own their syncs);
-  5. observability ownership (STX002): `stoix_tpu/` library code must not use
-     bare `print(` (status lines go through `observability.get_logger`,
-     metrics through the registry — stdout belongs to machine-readable
-     output contracts) nor declare ad-hoc module-level stats accumulators
-     (ALL_CAPS names bound to empty `{}`/`dict()` — the `LAST_RUN_STATS`
-     pattern; publish to the metrics registry and expose an
-     `observability.RunStats` view instead). Allowlisted: utils/logger.py
-     (the ConsoleSink IS the console) and sweep.py (JSON-lines stdout
-     contract); scripts/ and bench.py are not library code.
-  6. no swallowed exceptions (STX003): `stoix_tpu/` library code must not
-     catch a BROAD exception type (bare `except:`, `except Exception`,
-     `except BaseException`) and do nothing with it (`pass`/`...` body).
-     Silently eaten failures are how a wedged actor or a half-written
-     checkpoint turns into a 180s-timeout mystery — either narrow the type
-     (e.g. `except queue.Empty`), handle it (log/counter/re-raise), or
-     carry a `# noqa` with a reason on the except line. Allowlisted:
-     resilience/faultinject.py (the chaos layer must never let its own
-     bookkeeping mask the failure it is injecting).
-  7. no unbounded blocking calls (STX004): `stoix_tpu/` library code must
-     not call zero-argument `.get()` (queue.Queue.get — dict.get always
-     takes a key), `.result()` (concurrent futures), or `.join()` (threads
-     — string join always takes an iterable) with no timeout. Every
-     indefinite wait is a latent hang: a dead peer turns it into the wedged
-     process the launch-hardening layer (docs/DESIGN.md §2.4) exists to
-     kill. Pass a timeout (and handle expiry), or carry a reasoned `# noqa`
-     for a wait that is intentionally infinite. Allowlisted: none today —
-     the file allowlist exists for future provably-supervised waits.
+    python scripts/lint.py [paths...]
 
-Exit code 0 = clean, 1 = findings. Run: python scripts/lint.py [paths...]
+is exactly
+
+    python -m stoix_tpu.analysis [paths...]
+
+Exit code 0 = clean, 1 = findings. See `python -m stoix_tpu.analysis
+--list-rules` for the rule catalog and docs/DESIGN.md §2.5 for rationale,
+the jit-reachability resolution, and the noqa policy.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import py_compile
-import subprocess
 import sys
-from typing import Iterable, List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_PATHS = ["stoix_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py"]
-MAX_LINE = 100
-
-# Modules where a dangling import is part of the public re-export surface.
-REEXPORT_FILES = {"__init__.py"}
 
 
-def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
-    for p in paths:
-        full = os.path.join(REPO, p)
-        if os.path.isfile(full) and full.endswith(".py"):
-            yield full
-        elif os.path.isdir(full):
-            for root, _dirs, files in os.walk(full):
-                for f in sorted(files):
-                    if f.endswith(".py"):
-                        yield os.path.join(root, f)
+def main(argv) -> int:
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from stoix_tpu.analysis.__main__ import main as analysis_main
 
-
-def check_syntax(path: str) -> List[str]:
-    try:
-        py_compile.compile(path, doraise=True)
-        return []
-    except py_compile.PyCompileError as exc:
-        return [f"{path}: syntax error: {exc.msg}"]
-
-
-class _ImportCollector(ast.NodeVisitor):
-    def __init__(self) -> None:
-        self.imports: List[Tuple[str, int]] = []  # (bound name, lineno)
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            name = alias.asname or alias.name.split(".")[0]
-            self.imports.append((name, node.lineno))
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module == "__future__":
-            return
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            name = alias.asname or alias.name
-            self.imports.append((name, node.lineno))
-
-
-def check_unused_imports(path: str, source: str, tree: ast.AST) -> List[str]:
-    if os.path.basename(path) in REEXPORT_FILES:
-        return []
-    collector = _ImportCollector()
-    collector.visit(tree)
-    if not collector.imports:
-        return []
-
-    used: set = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # a.b.c — the root Name node is also visited, nothing extra needed.
-            pass
-    # Names referenced in __all__ strings and doc/annotation strings.
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            used.update(node.value.replace(".", " ").replace("[", " ").split())
-
-    lines = source.splitlines()
-    findings = []
-    for name, lineno in collector.imports:
-        if name in used or name.startswith("_"):
-            continue
-        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
-        if "noqa" in line:
-            continue
-        findings.append(f"{path}:{lineno}: unused import '{name}' (F401)")
-    return findings
-
-
-def check_hygiene(path: str, source: str) -> Tuple[List[str], List[str]]:
-    errors: List[str] = []
-    warnings: List[str] = []
-    for i, line in enumerate(source.splitlines(), 1):
-        stripped = line.rstrip("\n")
-        indent = stripped[: len(stripped) - len(stripped.lstrip())]
-        if "\t" in indent:
-            errors.append(f"{path}:{i}: tab in indentation (W191)")
-        if stripped != stripped.rstrip():
-            errors.append(f"{path}:{i}: trailing whitespace (W291)")
-        if len(stripped) > MAX_LINE and "http" not in stripped and "noqa" not in stripped:
-            warnings.append(f"{path}:{i}: line too long ({len(stripped)} > {MAX_LINE}) (E501)")
-    return errors, warnings
-
-
-# Host-sync calls that stall the accelerator; only the shared runner (which
-# schedules them off the critical path) may contain them. Sebulba system files
-# are exempt — their actor/learner threads own their own sync points.
-_HOST_SYNC_OWNER = os.path.join("stoix_tpu", "systems", "runner.py")
-
-
-def _receiver_names(node: ast.AST) -> List[str]:
-    """All identifier parts of a dotted receiver: self.checkpointer ->
-    ['self', 'checkpointer']."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-    return parts
-
-
-def _is_host_sync_call(node: ast.Call) -> bool:
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        if fn.attr in ("block_until_ready", "wait_until_finished"):
-            return True
-        # <anything named like a checkpointer>.wait(...) — including
-        # attribute-qualified receivers (self.checkpointer.wait(),
-        # setup.ckpt.wait()).
-        if fn.attr == "wait":
-            return any(
-                "checkpoint" in part.lower() or "ckpt" in part.lower()
-                for part in _receiver_names(fn.value)
-            )
-        return False
-    return isinstance(fn, ast.Name) and fn.id == "block_until_ready"
-
-
-def check_host_sync_ownership(path: str, source: str, tree: ast.AST) -> List[str]:
-    rel = os.path.relpath(path, REPO)
-    systems_prefix = os.path.join("stoix_tpu", "systems") + os.sep
-    if not rel.startswith(systems_prefix) or rel == _HOST_SYNC_OWNER:
-        return []
-    if "sebulba" in rel.split(os.sep):
-        return []
-    lines = source.splitlines()
-    findings = []
-    # AST-based (not substring): docstrings/comments DISCUSSING these calls
-    # must not trip the gate.
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not _is_host_sync_call(node):
-            continue
-        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
-        if "noqa" in line:
-            continue
-        findings.append(
-            f"{rel}:{node.lineno}: host-sync call in an Anakin system file — the "
-            f"pipelined runner (systems/runner.py) owns all host-sync points (STX001)"
-        )
-    return findings
-
-
-# STX002: library code must not print to stdout or grow ad-hoc module-level
-# stats dicts. Allowlist: the ConsoleSink's own file and the sweep driver
-# whose stdout IS its output contract (like bench.py, which is not scanned —
-# the rule covers stoix_tpu/ only).
-_STX002_ALLOWLIST = {
-    os.path.join("stoix_tpu", "utils", "logger.py"),
-    os.path.join("stoix_tpu", "sweep.py"),
-}
-
-
-def _is_empty_dict_value(node: ast.AST) -> bool:
-    if isinstance(node, ast.Dict) and not node.keys:
-        return True
-    return (
-        isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Name)
-        and node.func.id == "dict"
-        and not node.args
-        and not node.keywords
-    )
-
-
-def check_observability_ownership(path: str, source: str, tree: ast.AST) -> List[str]:
-    rel = os.path.relpath(path, REPO)
-    if not rel.startswith("stoix_tpu" + os.sep) or rel in _STX002_ALLOWLIST:
-        return []
-    lines = source.splitlines()
-    findings = []
-
-    def _line_ok(lineno: int) -> bool:
-        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
-        return "noqa" in line
-
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-            and not _line_ok(node.lineno)
-        ):
-            findings.append(
-                f"{rel}:{node.lineno}: bare print() in library code — use "
-                f"observability.get_logger (status) or the metrics registry "
-                f"(STX002)"
-            )
-    # Module-level ALL_CAPS empty-dict accumulators (body-level only: class
-    # attributes and function locals are fine).
-    for node in getattr(tree, "body", []):
-        targets, value = [], None
-        if isinstance(node, ast.Assign):
-            targets, value = node.targets, node.value
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets, value = [node.target], node.value
-        for target in targets:
-            if (
-                isinstance(target, ast.Name)
-                and target.id.isupper()
-                and value is not None
-                and _is_empty_dict_value(value)
-                and not _line_ok(node.lineno)
-            ):
-                findings.append(
-                    f"{rel}:{node.lineno}: ad-hoc module-level stats dict "
-                    f"'{target.id}' — publish to the metrics registry and "
-                    f"expose an observability.RunStats view (STX002)"
-                )
-    return findings
-
-
-# STX003: broad except + do-nothing body = a swallowed failure. Only the
-# fault injector may do this (its own bookkeeping must never mask the fault
-# it injects).
-_STX003_ALLOWLIST = {
-    os.path.join("stoix_tpu", "resilience", "faultinject.py"),
-}
-_BROAD_EXCEPTION_NAMES = {"Exception", "BaseException"}
-
-
-def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
-    if handler.type is None:  # bare `except:`
-        return True
-    types = handler.type.elts if isinstance(handler.type, ast.Tuple) else [handler.type]
-    for node in types:
-        if isinstance(node, ast.Name) and node.id in _BROAD_EXCEPTION_NAMES:
-            return True
-    return False
-
-
-def _body_swallows(handler: ast.ExceptHandler) -> bool:
-    for stmt in handler.body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if (
-            isinstance(stmt, ast.Expr)
-            and isinstance(stmt.value, ast.Constant)
-            and stmt.value.value is Ellipsis
-        ):
-            continue
-        return False
-    return True
-
-
-def check_exception_swallowing(path: str, source: str, tree: ast.AST) -> List[str]:
-    rel = os.path.relpath(path, REPO)
-    if not rel.startswith("stoix_tpu" + os.sep) or rel in _STX003_ALLOWLIST:
-        return []
-    lines = source.splitlines()
-    findings = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if not (_is_broad_handler(node) and _body_swallows(node)):
-            continue
-        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
-        if "noqa" in line:
-            continue
-        findings.append(
-            f"{rel}:{node.lineno}: broad exception swallowed (`except "
-            f"Exception: pass`) in library code — narrow the type, handle "
-            f"it, or add a reasoned noqa (STX003)"
-        )
-    return findings
-
-
-# STX004: unbounded blocking calls. AST heuristic: a zero-argument call of
-# one of these attribute names cannot be the bounded/keyed variant
-# (dict.get(key), "sep".join(parts), t.join(timeout)) — it is a wait that
-# never returns if the other side is dead. Calls WITH arguments are only
-# flagged when they name block=... without a timeout (queue.get(block=True)).
-_STX004_BLOCKING_ATTRS = {"get", "result", "join"}
-_STX004_ALLOWLIST: set = set()  # files whose infinite waits are supervised
-
-
-def check_unbounded_blocking(path: str, source: str, tree: ast.AST) -> List[str]:
-    rel = os.path.relpath(path, REPO)
-    if not rel.startswith("stoix_tpu" + os.sep) or rel in _STX004_ALLOWLIST:
-        return []
-    lines = source.splitlines()
-    findings = []
-    for node in ast.walk(tree):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in _STX004_BLOCKING_ATTRS
-        ):
-            continue
-        kwargs = {kw.arg: kw.value for kw in node.keywords}
-        if node.args or kwargs:
-            # Positional args mean dict.get(key)/str.join(parts)/
-            # join(timeout)/get(block, timeout) — ambiguous or bounded. With
-            # keywords, only block=<not False> WITHOUT timeout= is provably
-            # an unbounded wait (block=False never blocks).
-            if "timeout" in kwargs or node.args:
-                continue
-            block = kwargs.get("block")
-            if block is None or (
-                isinstance(block, ast.Constant) and block.value is False
-            ):
-                continue
-        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
-        if "noqa" in line:
-            continue
-        findings.append(
-            f"{rel}:{node.lineno}: unbounded blocking call `.{node.func.attr}()` "
-            f"without a timeout — a dead peer turns this into a wedged process; "
-            f"pass a timeout and handle expiry, or noqa a provably-supervised "
-            f"infinite wait (STX004)"
-        )
-    return findings
-
-
-def run_external(tool: str, args: List[str]) -> List[str]:
-    try:
-        __import__(tool)
-    except ImportError:
-        return []
-    proc = subprocess.run(
-        [sys.executable, "-m", tool, *args], capture_output=True, text=True, cwd=REPO
-    )
-    if proc.returncode != 0:
-        findings = [f"[{tool}] {line}" for line in proc.stdout.splitlines() if line.strip()]
-        findings += [f"[{tool}] {line}" for line in proc.stderr.splitlines() if line.strip()]
-        # A crash with no output must still fail the gate — a type check that
-        # never ran is not a passing type check.
-        return findings or [f"[{tool}] exited {proc.returncode} with no output"]
-    return []
-
-
-def main(argv: List[str]) -> int:
-    paths = argv or DEFAULT_PATHS
-    errors: List[str] = []
-    warnings: List[str] = []
-    n_files = 0
-    for path in iter_py_files(paths):
-        n_files += 1
-        with open(path) as f:
-            source = f.read()
-        syntax = check_syntax(path)
-        if syntax:
-            errors.extend(syntax)
-            continue
-        tree = ast.parse(source)
-        errors.extend(check_unused_imports(path, source, tree))
-        errors.extend(check_host_sync_ownership(path, source, tree))
-        errors.extend(check_observability_ownership(path, source, tree))
-        errors.extend(check_exception_swallowing(path, source, tree))
-        errors.extend(check_unbounded_blocking(path, source, tree))
-        errs, warns = check_hygiene(path, source)
-        errors.extend(errs)
-        warnings.extend(warns)
-
-    errors.extend(run_external("ruff", ["check", *paths]))
-    errors.extend(run_external("mypy", ["stoix_tpu"]))
-
-    for w in warnings:
-        print(f"warning: {w}")
-    for e in errors:
-        print(f"error: {e}")
-    print(f"[lint] {n_files} files, {len(errors)} errors, {len(warnings)} warnings")
-    return 1 if errors else 0
+    return analysis_main(list(argv))
 
 
 if __name__ == "__main__":

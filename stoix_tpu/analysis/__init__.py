@@ -1,0 +1,31 @@
+"""stoix_tpu.analysis — the first-party JAX-aware static-analysis gate.
+
+Promoted from the flat scripts/lint.py (PRs 1-4) into a rule-plugin
+subsystem: `core` holds the framework (Finding/Rule/registry/noqa/runner),
+`rules/` holds one module per rule (STX001-STX009 plus the F401/hygiene core
+checks), `jitreach` resolves which functions flow into jit/shard_map/scan/
+pmap, and `configmodel` models the Hydra-style YAML tree for STX009.
+
+Everything is stdlib `ast` + `yaml` — no jax import — so the gate runs in a
+SLURM prolog or CI box in milliseconds and `launcher.py --preflight-only`
+embeds it before any backend probe.
+
+CLI: `python -m stoix_tpu.analysis [paths...] [--select/--ignore IDS]
+[--format text|json] [--list-rules]`; `scripts/lint.py` is a byte-identical
+shim over the text format.
+"""
+
+from stoix_tpu.analysis.core import (  # noqa: F401 — public API
+    DEFAULT_PATHS,
+    ERROR,
+    WARNING,
+    FileContext,
+    Finding,
+    Rule,
+    TreeContext,
+    get_rule,
+    get_rules,
+    noqa_suppresses,
+    run_paths,
+    split_severity,
+)

@@ -1,0 +1,133 @@
+"""CLI for the static-analysis gate.
+
+    python -m stoix_tpu.analysis [paths...]
+        [--select STX005,STX007] [--ignore HYG]
+        [--format text|json] [--list-rules] [--skip-external]
+
+Text mode reproduces scripts/lint.py's historical output byte-for-byte
+(warnings, errors, `[lint] N files, E errors, W warnings` summary); the shim
+delegates here. JSON mode prints one object per finding
+(rule/path/line/message/severity) as a single JSON array for CI consumption
+(tests/test_analysis_clean.py). Exit code: 0 clean, 1 findings at error
+severity, 2 usage error.
+
+stdout is this tool's machine-readable contract (like sweep.py's JSON
+lines), hence the STX002 allowlist entry for this file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from typing import List, Optional
+
+from stoix_tpu.analysis import core
+
+
+def run_external(tool: str, args: List[str]) -> List[core.Finding]:
+    """Delegate to ruff/mypy when importable (their config lives in
+    pyproject.toml, so installing them upgrades the gate with zero changes)."""
+    try:
+        __import__(tool)
+    except ImportError:
+        return []
+    proc = subprocess.run(
+        [sys.executable, "-m", tool, *args], capture_output=True, text=True, cwd=core.REPO
+    )
+    if proc.returncode != 0:
+        lines = [line for line in proc.stdout.splitlines() if line.strip()]
+        lines += [line for line in proc.stderr.splitlines() if line.strip()]
+        # A crash with no output must still fail the gate — a type check that
+        # never ran is not a passing type check.
+        lines = lines or [f"exited {proc.returncode} with no output"]
+        return [Finding_external(tool, line) for line in lines]
+    return []
+
+
+def Finding_external(tool: str, line: str) -> core.Finding:
+    return core.Finding(rule=tool, path=f"[{tool}]", line=0, message=line)
+
+
+def _parse_ids(raw: Optional[List[str]]) -> Optional[List[str]]:
+    if not raw:
+        return None
+    out: List[str] = []
+    for chunk in raw:
+        out.extend(s for s in chunk.replace(",", " ").split() if s)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m stoix_tpu.analysis", description=__doc__
+    )
+    parser.add_argument("paths", nargs="*", help="files/dirs relative to the repo root")
+    parser.add_argument(
+        "--select",
+        action="append",
+        default=None,
+        metavar="IDS",
+        help="run ONLY these rule ids (comma separated; repeatable)",
+    )
+    parser.add_argument(
+        "--ignore",
+        action="append",
+        default=None,
+        metavar="IDS",
+        help="skip these rule ids (comma separated; repeatable)",
+    )
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    parser.add_argument(
+        "--skip-external",
+        action="store_true",
+        help="do not delegate to ruff/mypy even when importable",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in core.get_rules():
+            scope = "tree" if rule.check_tree else "file"
+            allow = ", ".join(sorted(rule.allowlist)) or "-"
+            print(f"{rule.id:<8} {scope:<5} {rule.title:<36} allowlist: {allow}")
+        return 0
+
+    select = _parse_ids(args.select)
+    ignore = _parse_ids(args.ignore)
+    try:
+        findings, n_files = core.run_paths(args.paths or None, select, ignore)
+    except KeyError as exc:
+        print(f"error: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    if select is None:
+        # The external delegations are part of the full gate only; a
+        # per-rule run (--select) is always the native rules alone.
+        if not args.skip_external:
+            findings = list(findings)
+            findings.extend(run_external("ruff", ["check", *(args.paths or core.DEFAULT_PATHS)]))
+            findings.extend(run_external("mypy", ["stoix_tpu"]))
+
+    errors, warnings = core.split_severity(findings)
+
+    if args.format == "json":
+        print(json.dumps([f.to_json() for f in findings], indent=None))
+        return 1 if errors else 0
+
+    for w in warnings:
+        print(f"warning: {w.render()}")
+    for e in errors:
+        if e.rule in ("ruff", "mypy"):
+            print(f"error: {e.path} {e.message}")
+        else:
+            print(f"error: {e.render()}")
+    print(f"[lint] {n_files} files, {len(errors)} errors, {len(warnings)} warnings")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,316 @@
+"""Static models of the two sides of STX009's config↔code cross-check.
+
+YAML side: every file under `stoix_tpu/configs/` is mounted the way
+`stoix_tpu.utils.config.compose` would mount it — group files
+(`env/cartpole.yaml`, `system/ppo/ff_ppo.yaml`, ...) land under their group
+key; root files under `configs/default/` merge at the top level. The model is
+the UNION over all files: a key "exists" if any composition could define it.
+
+Code side: attribute-chain reads rooted at a name `config`/`cfg` (plus
+one-level aliases like `net_cfg = config.network`), split into:
+
+  - strict reads  — `config.a.b.c` (AttributeError if missing),
+  - tolerant reads — `config.a.get("b", d)` / `getattr(config.a, "b", d)`
+    (consume a key for liveness but tolerate absence), and
+  - writes        — `config.a.b = ...` (systems inject computed fields; a
+    written path and everything under it is defined from then on).
+
+Everything here is pure stdlib (ast + yaml); no jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import yaml
+
+Path = Tuple[str, ...]
+Resolver = Callable[[str, Path], Optional[Path]]
+
+
+@dataclass
+class ConfigKeySet:
+    """The union YAML key space under stoix_tpu/configs/."""
+
+    nodes: Set[Path] = field(default_factory=set)  # every interior + leaf path
+    # leaf path -> every (rel file, line) defining it, so a dead key is
+    # reported against ALL the yamls that must drop it, in one run.
+    leaves: Dict[Path, List[Tuple[str, int]]] = field(default_factory=dict)
+    # Paths whose subtree is consumed dynamically by config.instantiate()
+    # (any dict carrying a `_target_` key): its sibling keys become
+    # constructor kwargs, which no attribute-chain read will ever name.
+    target_prefixes: Set[Path] = field(default_factory=set)
+
+    def defines(self, path: Path) -> bool:
+        return path in self.nodes
+
+    def under_target(self, path: Path) -> bool:
+        return any(path[: len(p)] == p for p in self.target_prefixes)
+
+
+def _yaml_key_line(lines: List[str], key: str, after: int) -> int:
+    """Best-effort line of `key:` at or after line index `after` (1-based)."""
+    pattern = re.compile(rf"^\s*{re.escape(key)}\s*:")
+    for i in range(max(after - 1, 0), len(lines)):
+        if pattern.match(lines[i]):
+            return i + 1
+    for i, line in enumerate(lines):
+        if pattern.match(line):
+            return i + 1
+    return 1
+
+
+def load_config_keys(repo: str) -> ConfigKeySet:
+    keys = ConfigKeySet()
+    config_dir = os.path.join(repo, "stoix_tpu", "configs")
+    for root, dirs, files in os.walk(config_dir):
+        dirs.sort()
+        for name in sorted(files):
+            if not name.endswith((".yaml", ".yml")):
+                continue
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, repo)
+            parts = os.path.relpath(full, config_dir).split(os.sep)
+            mount: Path = () if parts[0] == "default" else (parts[0],)
+            try:
+                with open(full) as f:
+                    text = f.read()
+                data = yaml.safe_load(text) or {}
+            except (OSError, yaml.YAMLError):
+                continue
+            if not isinstance(data, dict):
+                continue
+            lines = text.splitlines()
+            for i in range(len(mount)):
+                keys.nodes.add(mount[: i + 1])
+            _walk_yaml(keys, data, mount, rel, lines, hint=1)
+    return keys
+
+
+def _walk_yaml(
+    keys: ConfigKeySet,
+    node: dict,
+    prefix: Path,
+    rel: str,
+    lines: List[str],
+    hint: int,
+) -> None:
+    if "_target_" in node:
+        keys.target_prefixes.add(prefix)
+    for key, value in node.items():
+        if key == "defaults" and not prefix:
+            continue  # the compose() directive list, not config data
+        path = prefix + (str(key),)
+        keys.nodes.add(path)
+        line = _yaml_key_line(lines, str(key), hint)
+        if isinstance(value, dict):
+            _walk_yaml(keys, value, path, rel, lines, hint=line)
+        else:
+            keys.leaves.setdefault(path, []).append((rel, line))
+
+
+# ---------------------------------------------------------------------------
+# Code side
+
+
+_ROOT_NAMES = {"config", "cfg"}
+_DICT_METHODS = {
+    "get",
+    "items",
+    "keys",
+    "values",
+    "pop",
+    "setdefault",
+    "update",
+    "to_dict",
+    "copy",
+    "from_dict",
+}
+
+
+@dataclass
+class ConfigAccesses:
+    strict: List[Tuple[Path, int]] = field(default_factory=list)  # (path, lineno)
+    tolerant: List[Tuple[Path, int]] = field(default_factory=list)
+    writes: Set[Path] = field(default_factory=set)
+
+
+def _chain_of(node: ast.AST) -> Optional[Tuple[str, Path]]:
+    """(root name, attr path) for an attribute chain like config.a.b."""
+    attrs: List[str] = []
+    while isinstance(node, ast.Attribute):
+        attrs.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, tuple(reversed(attrs))
+    return None
+
+
+def _path_of_value(
+    node: ast.AST, resolve: "Resolver", depth: int = 0
+) -> Optional[Path]:
+    """Resolve a config-subtree EXPRESSION to its dotted path, covering the
+    repo's dict-style composition idioms beyond plain attribute chains:
+
+        (config.get("arch") or {}).get("preflight")   -> arch.preflight
+        config.arch.get("supervision") or {}          -> arch.supervision
+    """
+    if depth > 8:
+        return None
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        chain = _chain_of(node)
+        return resolve(chain[0], chain[1]) if chain else None
+    if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or) and node.values:
+        return _path_of_value(node.values[0], resolve, depth + 1)
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "get"
+        and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+    ):
+        base = _path_of_value(node.func.value, resolve, depth + 1)
+        if base is not None:
+            return base + (node.args[0].value,)
+    return None
+
+
+def _make_resolver(aliases: Dict[str, Path]):
+    def resolve(root: str, attrs: Path) -> Optional[Path]:
+        # An alias REBINDING wins over the root-name convention: a local
+        # `cfg = config.arch.get("preflight") or {}` is the subtree, not the
+        # root config.
+        if root in aliases:
+            return aliases[root] + attrs
+        if root in _ROOT_NAMES:
+            return attrs
+        if root == "self" and attrs and attrs[0] in _ROOT_NAMES:
+            return attrs[1:] or None  # self.config.a.b -> a.b
+        return None
+
+    return resolve
+
+
+def _collect_aliases(tree: ast.AST) -> Dict[str, Path]:
+    """Subtree aliases: `net_cfg = config.network`, and the dict-style
+    `pf_cfg = (config.get("arch") or {}).get("preflight") or {}` composition
+    idiom (file-wide; a name rebound to two different subtrees or to an
+    unresolvable value is dropped). The alias ASSIGNMENT itself still counts
+    as a read of the aliased path (it is one — and a typo'd
+    `x = config.system.gama` must stay reportable); reads THROUGH the alias
+    extend it.
+
+    Two passes so an alias defined in terms of another alias resolves."""
+    aliases: Dict[str, Path] = {}
+    for _ in range(2):
+        resolve = _make_resolver(aliases)
+        candidates: Dict[str, Set[Path]] = {}
+        poisoned: Set[str] = set()
+        for node in ast.walk(tree):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            for target in targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                path = _path_of_value(value, resolve) if value is not None else None
+                if path:
+                    candidates.setdefault(target.id, set()).add(path)
+                else:
+                    poisoned.add(target.id)
+        aliases = {
+            name: next(iter(paths))
+            for name, paths in candidates.items()
+            if name not in poisoned and len(paths) == 1
+        }
+    return aliases
+
+
+def collect_config_accesses(tree: ast.AST) -> ConfigAccesses:
+    aliases = _collect_aliases(tree)
+    accesses = ConfigAccesses()
+    resolve = _make_resolver(aliases)
+
+    consumed: Set[ast.AST] = set()  # attribute nodes already part of a longer chain
+    # Attribute nodes used as a call's function: their last component is a
+    # METHOD on the leaf value (`config.logger.path.rstrip(...)`), not a key.
+    call_funcs: Set[ast.AST] = {
+        node.func
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+    }
+
+    for node in ast.walk(tree):
+        # getattr(config.a, "b"[, default]) — tolerant read.
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("getattr", "hasattr")
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)
+        ):
+            chain = _chain_of(node.args[0])
+            if chain:
+                path = resolve(chain[0], chain[1] + (node.args[1].value,))
+                if path:
+                    accesses.tolerant.append((path, node.lineno))
+                    _mark_consumed(node.args[0], consumed)
+                    consumed.add(node.func)
+        # config.a.get("b"[, default]) — tolerant read of a.b; also resolves
+        # the chained dict-style idiom ((config.get("arch") or {}).get(...)).
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+        ):
+            path = _path_of_value(node, resolve)
+            if path is not None:
+                accesses.tolerant.append((path, node.lineno))
+                _mark_consumed(node.func, consumed)
+            else:
+                base = _path_of_value(node.func.value, resolve)
+                if base:  # .get(<non-literal key>) keeps the node itself live
+                    accesses.tolerant.append((base, node.lineno))
+                    _mark_consumed(node.func, consumed)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute) or node in consumed:
+            continue
+        chain = _chain_of(node)
+        if not chain:
+            continue
+        root, attrs = chain
+        if node in call_funcs and attrs:
+            attrs = attrs[:-1]  # drop the method component of a call
+        # Trim trailing dict-method / dunder components referenced unbound:
+        # config.system.get (the .get handled above), cfg.items, ...
+        while attrs and (attrs[-1] in _DICT_METHODS or attrs[-1].startswith("_")):
+            attrs = attrs[:-1]
+        if not attrs:
+            continue
+        path = resolve(root, attrs)
+        if path is None:
+            continue
+        _mark_consumed(node, consumed)
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            accesses.writes.add(path)
+        else:
+            accesses.strict.append((path, node.lineno))
+    return accesses
+
+
+def _mark_consumed(node: ast.AST, consumed: Set[ast.AST]) -> None:
+    """Mark an attribute chain's sub-chains so the maximal-chain pass does
+    not re-report `config.a` inside `config.a.b`."""
+    while isinstance(node, ast.Attribute):
+        consumed.add(node)
+        node = node.value

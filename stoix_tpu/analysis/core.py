@@ -1,0 +1,279 @@
+"""Rule framework for the first-party static-analysis gate.
+
+This module is deliberately dependency-free (stdlib `ast` + `os` only — no
+jax, no numpy): the gate must be runnable from a SLURM prolog or CI box that
+has never allocated an accelerator, and `launcher.py --preflight-only` calls
+it in-process before any backend probe.
+
+Concepts:
+
+  - `Finding`: one diagnostic (rule id, display path, line, message,
+    severity). `render()` reproduces the exact text the historical
+    `scripts/lint.py` printed, so the shim stays byte-identical.
+  - `Rule`: one registered check. A rule is *file-scoped* (`check_file` runs
+    per parsed file) and/or *tree-scoped* (`check_tree` runs once per
+    invocation — STX009's config cross-check). Each rule carries its
+    rationale, a file allowlist, and fixture snippets (`flag_snippets` must
+    produce >=1 finding; `clean_snippets` must produce none) that
+    tests/test_lint.py replays.
+  - noqa policy: a bare `# noqa` suppresses every rule on that line; a coded
+    `# noqa: STX005` suppresses only the listed rules and MUST carry a
+    one-line reason after an em-dash (`# noqa: STX005 — fixed fan-out`).
+    The legacy rules (F401/E501/STX001-004) keep their historical substring
+    semantics unchanged; new rules (STX005+) use `Noqa.suppresses`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import py_compile
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_PATHS = ["stoix_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py"]
+
+_NOQA_RE = re.compile(r"#\s*noqa\b:?\s*([^#]*)", re.IGNORECASE)
+_CODE_RE = re.compile(r"[A-Z]+[0-9]+")
+
+
+def noqa_suppresses(line: str, rule_id: str) -> bool:
+    """Code-aware noqa: bare `# noqa` suppresses everything; `# noqa: CODES`
+    suppresses only the listed codes. Used by STX005+ (legacy rules keep
+    their historical `"noqa" in line` substring check, migrated unchanged)."""
+    m = _NOQA_RE.search(line)
+    if not m:
+        return False
+    codes = _CODE_RE.findall(m.group(1).split("—")[0])
+    return not codes or rule_id in codes
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # display path (legacy convention: abs for core checks, repo-relative for STX rules)
+    line: int  # 0 = whole-file finding (no :line in the rendered text)
+    message: str  # includes the trailing "(STXnnn)" tag, as historically printed
+    severity: str = ERROR
+
+    def render(self) -> str:
+        if self.line:
+            return f"{self.path}:{self.line}: {self.message}"
+        return f"{self.path}: {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "severity": self.severity,
+        }
+
+
+@dataclass
+class FileContext:
+    """Everything a file-scoped rule needs; parsed once, shared by all rules."""
+
+    repo: str
+    path: str  # absolute
+    rel: str  # repo-relative (os.sep separators)
+    source: str
+    lines: List[str]
+    tree: ast.AST
+
+    def line(self, lineno: int) -> str:
+        return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
+
+    def noqa(self, lineno: int, rule_id: str) -> bool:
+        return noqa_suppresses(self.line(lineno), rule_id)
+
+
+@dataclass
+class TreeContext:
+    """Context for whole-tree rules (one run per invocation)."""
+
+    repo: str
+    files: List[FileContext]  # every file the invocation scanned
+
+    def scans_package(self) -> bool:
+        prefix = "stoix_tpu" + os.sep
+        return any(f.rel.startswith(prefix) for f in self.files)
+
+
+@dataclass
+class Rule:
+    id: str
+    title: str
+    rationale: str
+    allowlist: frozenset = frozenset()  # repo-relative paths exempt from the rule
+    severity: str = ERROR
+    # Execution/printing position; preserves the historical per-file finding
+    # order (F401, STX001..004, hygiene) the scripts/lint.py shim pins.
+    order: int = 100
+    # Finding ids this rule emits (hygiene keeps the legacy W191/W291/E501
+    # sub-ids); defaults to (id,). Fixture tests match against these.
+    finding_ids: Tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not self.finding_ids:
+            self.finding_ids = (self.id,)
+    check_file: Optional[Callable[["Rule", FileContext], List[Finding]]] = None
+    check_tree: Optional[Callable[["Rule", TreeContext], List[Finding]]] = None
+    # Fixture snippets replayed by tests: every flag snippet must yield >=1
+    # finding with this rule's id; every clean snippet must yield none. They
+    # are checked as if saved at `fixture_rel` (rules are path-scoped).
+    flag_snippets: Tuple[str, ...] = ()
+    clean_snippets: Tuple[str, ...] = ()
+    fixture_rel: str = "stoix_tpu/_analysis_probe.py"
+    # Extra warning-severity findings are allowed from an error-severity rule
+    # (hygiene emits both); `severity` is the default for its findings.
+
+    def run_on_source(
+        self, source: str, rel: Optional[str] = None, repo: str = REPO
+    ) -> List[Finding]:
+        """Run this rule alone against an in-memory snippet (fixture tests)."""
+        rel = rel or self.fixture_rel
+        ctx = FileContext(
+            repo=repo,
+            path=os.path.join(repo, rel),
+            rel=rel.replace("/", os.sep),
+            source=source,
+            lines=source.splitlines(),
+            tree=ast.parse(source),
+        )
+        return list(self.check_file(self, ctx)) if self.check_file else []
+
+
+# ---------------------------------------------------------------------------
+# Registry
+
+
+_registry: "Dict[str, Rule]" = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.id in _registry:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _registry[rule.id] = rule
+    return rule
+
+
+def get_rules() -> List[Rule]:
+    """All registered rules, ordered by their `order` field (legacy print order)."""
+    from stoix_tpu.analysis import rules as _rules  # noqa: F401 — registration side effect
+
+    return sorted(_registry.values(), key=lambda r: r.order)
+
+
+def get_rule(rule_id: str) -> Rule:
+    for rule in get_rules():
+        if rule.id == rule_id:
+            return rule
+    raise KeyError(rule_id)
+
+
+# ---------------------------------------------------------------------------
+# Runner
+
+
+def iter_py_files(paths: Iterable[str], repo: str = REPO) -> Iterable[str]:
+    for p in paths:
+        full = os.path.join(repo, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            yield full
+        elif os.path.isdir(full):
+            # Legacy walk order (dirs unsorted, files sorted) — keeps the
+            # scripts/lint.py shim output byte-identical.
+            for root, _dirs, files in os.walk(full):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def _select_rules(
+    select: Optional[Sequence[str]], ignore: Optional[Sequence[str]]
+) -> List[Rule]:
+    rules = get_rules()
+    known = {r.id for r in rules}
+    if select:
+        wanted = {s.upper() for s in select}
+        unknown = wanted - known
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.id in wanted]
+    if ignore:
+        dropped = {s.upper() for s in ignore}
+        unknown = dropped - known
+        if unknown:
+            # A typo'd --ignore must not silently waive nothing while the CI
+            # invocation looks configured.
+            raise KeyError(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.id not in dropped]
+    return rules
+
+
+def syntax_findings(path: str) -> List[Finding]:
+    """py_compile gate; a file that does not parse gets ONLY this finding."""
+    try:
+        py_compile.compile(path, doraise=True)
+        return []
+    except py_compile.PyCompileError as exc:
+        return [Finding("E999", path, 0, f"syntax error: {exc.msg}")]
+
+
+def run_paths(
+    paths: Optional[Sequence[str]] = None,
+    select: Optional[Sequence[str]] = None,
+    ignore: Optional[Sequence[str]] = None,
+    repo: str = REPO,
+) -> Tuple[List[Finding], int]:
+    """Run the selected rules over `paths`; returns (findings, files scanned).
+
+    Findings keep the historical order: per file, rules in registration
+    order; tree-scoped rules run once at the end."""
+    rules = _select_rules(select, ignore)
+    findings: List[Finding] = []
+    contexts: List[FileContext] = []
+    n_files = 0
+    for path in iter_py_files(paths or DEFAULT_PATHS, repo):
+        n_files += 1
+        with open(path) as f:
+            source = f.read()
+        syntax = syntax_findings(path)
+        if syntax:
+            findings.extend(syntax)
+            continue
+        ctx = FileContext(
+            repo=repo,
+            path=path,
+            rel=os.path.relpath(path, repo),
+            source=source,
+            lines=source.splitlines(),
+            tree=ast.parse(source),
+        )
+        contexts.append(ctx)
+        for rule in rules:
+            # Rule.allowlist and the scope checks INSIDE each checker read
+            # the same module-level constant (e.g. stx002._ALLOWLIST), so the
+            # two layers cannot drift; the central skip exists so a future
+            # rule that declares an allowlist without re-checking it inside
+            # its checker still honors it.
+            if rule.check_file is not None and ctx.rel not in rule.allowlist:
+                findings.extend(rule.check_file(rule, ctx))
+    tree_ctx = TreeContext(repo=repo, files=contexts)
+    for rule in rules:
+        if rule.check_tree is not None:
+            findings.extend(rule.check_tree(rule, tree_ctx))
+    return findings, n_files
+
+
+def split_severity(findings: Sequence[Finding]) -> Tuple[List[Finding], List[Finding]]:
+    errors = [f for f in findings if f.severity == ERROR]
+    warnings = [f for f in findings if f.severity == WARNING]
+    return errors, warnings

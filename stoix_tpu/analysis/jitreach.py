@@ -1,0 +1,205 @@
+"""Jit-boundary resolution: which functions in a module flow into a traced
+program (`jax.jit` / `jax.pmap` / `jax.vmap` / `lax.scan` / `shard_map` /
+`lax.while_loop` / `lax.cond` ...)?
+
+The stoix_tpu idiom makes this tractable with a per-module analysis:
+
+    learn_per_shard = get_learner_fn(env, apply_fns, update_fns, config)
+    learn = anakin.shardmap_learner(learn_per_shard, mesh, specs)   # traced
+    batched = jax.vmap(_update_step, axis_name="batch")             # wrapper
+    state, _ = jax.lax.scan(batched, state, None, n)                # traced
+
+Resolution steps (all AST, no imports executed):
+
+  1. Collect every `FunctionDef` (nested included) by simple name.
+  2. Build a wrapper-alias map: `x = jax.vmap(f, ...)` / `x = partial(f, ..)`
+     / `x = jit(f)` makes `x` an alias for `f`; `y = factory(...)` where
+     `factory` is a local function makes `y` an alias for every function
+     `factory` returns (the `get_learner_fn -> learner_fn` pattern).
+  3. Mark entry points: every function-valued argument of a traced call
+     (TRACED_CALLEES below), plus functions decorated with `@jax.jit` /
+     `@partial(jax.jit, ...)`.
+  4. Close over references: inside a reachable function's own scope (nested
+     `def` bodies excluded until *they* are reachable), any `Name` that
+     resolves to a known function or alias marks that function reachable.
+
+Known blind spots (documented in docs/DESIGN.md §2.5): cross-module flow
+(a function jitted by its *importer* is invisible to the exporting module's
+analysis — the scan/vmap-heavy stoix_tpu idiom keeps most trace surface
+module-local), method resolution (`self.f`), functions smuggled through
+containers, and conditional rebinding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Set
+
+# Callees whose function-valued arguments get traced. Bare-name forms are
+# accepted for the jax transforms (commonly imported directly); the lax
+# control-flow primitives must be attribute calls (`lax.cond`) so a local
+# helper named `cond` cannot confuse the analysis.
+_TRACED_ANY = {"jit", "pmap", "vmap", "scan", "shard_map", "shardmap_learner", "remat"}
+_TRACED_ATTR_ONLY = {"while_loop", "fori_loop", "cond", "switch", "associative_scan", "checkpoint"}
+_WRAPPERS = {"jit", "pmap", "vmap", "partial", "remat", "checkpoint", "annotate"}
+
+FunctionNode = ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+
+
+def callee_name(func: ast.AST) -> str:
+    """Terminal identifier of a callee: 'scan' for `jax.lax.scan` and `scan`.
+    Shared AST helper (also used by the STX007/STX008 rule modules)."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+_callee_name = callee_name  # internal alias
+
+
+def assigned_names(target: ast.AST) -> List[str]:
+    """Flat identifier list a binding target assigns: `a, (b, *c) = ...` ->
+    [a, b, c]. Shared AST helper (STX005/STX008 rebind tracking)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        names: List[str] = []
+        for elt in target.elts:
+            names.extend(assigned_names(elt))
+        return names
+    if isinstance(target, ast.Starred):
+        return assigned_names(target.value)
+    return []
+
+
+def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk limited to `node`'s own scope: nested function/lambda/class
+    nodes are yielded but their bodies are not entered (their decorators and
+    default-argument expressions — which evaluate in this scope — are)."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        current = stack.pop()
+        if current is not node:
+            yield current
+            if isinstance(
+                current, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+            ):
+                for deco in getattr(current, "decorator_list", []):
+                    stack.append(deco)
+                args = getattr(current, "args", None)
+                if args is not None:
+                    stack.extend(args.defaults)
+                    stack.extend(d for d in args.kw_defaults if d is not None)
+                continue
+        stack.extend(ast.iter_child_nodes(current))
+
+
+class _ModuleIndex:
+    """Name->function map + wrapper-alias map for one module."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.functions: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+        self.aliases: Dict[str, Set[str]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            wrapped = self._function_names_in(node.value)
+            if wrapped:
+                self.aliases.setdefault(target.id, set()).update(wrapped)
+
+    def _function_names_in(self, expr: ast.AST, depth: int = 0) -> Set[str]:
+        """Function names an expression evaluates to / wraps (bounded depth)."""
+        if depth > 6:
+            return set()
+        if isinstance(expr, ast.Name):
+            if expr.id in self.functions:
+                return {expr.id}
+            return set(self.aliases.get(expr.id, set()))
+        if isinstance(expr, ast.Call):
+            callee = _callee_name(expr.func)
+            if callee in _WRAPPERS:
+                out: Set[str] = set()
+                for arg in list(expr.args) + [kw.value for kw in expr.keywords]:
+                    out |= self._function_names_in(arg, depth + 1)
+                return out
+            if callee in self.functions:
+                return self._returned_function_names(callee)
+        return set()
+
+    def _returned_function_names(self, factory_name: str) -> Set[str]:
+        """Functions a local factory returns by name (`return learner_fn`)."""
+        out: Set[str] = set()
+        for factory in self.functions[factory_name]:
+            for node in ast.walk(factory):
+                if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+                    if node.value.id in self.functions:
+                        out.add(node.value.id)
+        return out
+
+    def resolve(self, name: str) -> Set[ast.AST]:
+        nodes: Set[ast.AST] = set()
+        for fn in self.functions.get(name, []):
+            nodes.add(fn)
+        for wrapped in self.aliases.get(name, set()):
+            for fn in self.functions.get(wrapped, []):
+                nodes.add(fn)
+        return nodes
+
+
+def _entry_function_nodes(tree: ast.AST, index: _ModuleIndex) -> Set[ast.AST]:
+    entries: Set[ast.AST] = set()
+
+    def mark(expr: ast.AST) -> None:
+        if isinstance(expr, ast.Lambda):
+            entries.add(expr)
+            return
+        for name in index._function_names_in(expr):
+            entries.update(index.functions.get(name, []))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            callee = _callee_name(node.func)
+            traced = callee in _TRACED_ANY or (
+                callee in _TRACED_ATTR_ONLY and isinstance(node.func, ast.Attribute)
+            )
+            if traced:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    mark(arg)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                callee = _callee_name(deco.func if isinstance(deco, ast.Call) else deco)
+                if callee == "jit":
+                    entries.add(node)
+                elif isinstance(deco, ast.Call) and callee == "partial":
+                    if any(_callee_name(a) == "jit" for a in deco.args):
+                        entries.add(node)
+    return entries
+
+
+def reachable_jit_functions(tree: ast.AST) -> Set[ast.AST]:
+    """AST nodes of every function that (per the module-local resolution
+    above) flows into a traced program."""
+    index = _ModuleIndex(tree)
+    reachable = set(_entry_function_nodes(tree, index))
+    frontier = list(reachable)
+    while frontier:
+        fn = frontier.pop()
+        for node in walk_scope(fn):
+            targets: Iterable[ast.AST] = ()
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                targets = index.resolve(node.id)
+            elif isinstance(node, ast.Lambda):
+                targets = (node,)
+            for target in targets:
+                if target not in reachable:
+                    reachable.add(target)
+                    frontier.append(target)
+    return reachable

@@ -1,0 +1,18 @@
+"""Rule registry: importing this package registers every rule module.
+
+Adding a rule = adding one module here that calls
+`stoix_tpu.analysis.core.register(Rule(...))` at import time with its id,
+rationale, checker, allowlist, and fixture snippets. Order fields pin the
+historical per-file finding order the scripts/lint.py shim output relies on.
+"""
+
+from stoix_tpu.analysis.rules import core_checks  # noqa: F401 — registers F401/HYG
+from stoix_tpu.analysis.rules import stx001_host_sync  # noqa: F401
+from stoix_tpu.analysis.rules import stx002_observability  # noqa: F401
+from stoix_tpu.analysis.rules import stx003_swallowed_exceptions  # noqa: F401
+from stoix_tpu.analysis.rules import stx004_unbounded_blocking  # noqa: F401
+from stoix_tpu.analysis.rules import stx005_prng_discipline  # noqa: F401
+from stoix_tpu.analysis.rules import stx006_host_transfer  # noqa: F401
+from stoix_tpu.analysis.rules import stx007_collective_axes  # noqa: F401
+from stoix_tpu.analysis.rules import stx008_donation  # noqa: F401
+from stoix_tpu.analysis.rules import stx009_config_crosscheck  # noqa: F401

@@ -1,0 +1,104 @@
+"""STX001 — host-sync ownership.
+
+Anakin system files must not call `jax.block_until_ready` /
+`checkpointer.wait()` / `wait_until_finished` — the pipelined runner
+(systems/runner.py) owns ALL host-sync points, so future systems stay off the
+accelerator critical path by construction. Sebulba files are exempt: their
+actor/learner threads own their syncs.
+
+Checker migrated unchanged from scripts/lint.py (PR 1).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+
+# Host-sync calls that stall the accelerator; only the shared runner (which
+# schedules them off the critical path) may contain them. Sebulba system files
+# are exempt — their actor/learner threads own their own sync points.
+_HOST_SYNC_OWNER = os.path.join("stoix_tpu", "systems", "runner.py")
+
+
+def _receiver_names(node: ast.AST) -> List[str]:
+    """All identifier parts of a dotted receiver: self.checkpointer ->
+    ['self', 'checkpointer']."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts
+
+
+def _is_host_sync_call(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("block_until_ready", "wait_until_finished"):
+            return True
+        # <anything named like a checkpointer>.wait(...) — including
+        # attribute-qualified receivers (self.checkpointer.wait(),
+        # setup.ckpt.wait()).
+        if fn.attr == "wait":
+            return any(
+                "checkpoint" in part.lower() or "ckpt" in part.lower()
+                for part in _receiver_names(fn.value)
+            )
+        return False
+    return isinstance(fn, ast.Name) and fn.id == "block_until_ready"
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    rel = ctx.rel
+    systems_prefix = os.path.join("stoix_tpu", "systems") + os.sep
+    if not rel.startswith(systems_prefix) or rel == _HOST_SYNC_OWNER:
+        return []
+    if "sebulba" in rel.split(os.sep):
+        return []
+    findings = []
+    # AST-based (not substring): docstrings/comments DISCUSSING these calls
+    # must not trip the gate.
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not _is_host_sync_call(node):
+            continue
+        if "noqa" in ctx.line(node.lineno):
+            continue
+        findings.append(
+            Finding(
+                "STX001",
+                rel,
+                node.lineno,
+                "host-sync call in an Anakin system file — the "
+                "pipelined runner (systems/runner.py) owns all host-sync points (STX001)",
+            )
+        )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX001",
+        order=20,
+        title="host-sync ownership",
+        rationale="A block_until_ready / checkpoint wait inside a system file "
+        "stalls the accelerator pipeline the runner carefully keeps one "
+        "window deep; the runner owns every host-sync point.",
+        allowlist=frozenset({_HOST_SYNC_OWNER}),
+        check_file=_check,
+        flag_snippets=(
+            "def run():\n"
+            "    self.checkpointer.wait()\n"
+            "    setup.ckpt.wait()\n"
+            "    jax.block_until_ready(state)\n",
+        ),
+        clean_snippets=(
+            # A non-checkpointer .wait() must NOT trip the gate.
+            "def run():\n    lock.wait()\n",
+        ),
+        fixture_rel="stoix_tpu/systems/_probe_system.py",
+    )
+)

@@ -1,0 +1,125 @@
+"""STX002 — observability ownership.
+
+`stoix_tpu/` library code must not use bare `print(` (status lines go through
+`observability.get_logger`, metrics through the registry — stdout belongs to
+machine-readable output contracts) nor declare ad-hoc module-level stats
+accumulators (ALL_CAPS names bound to empty `{}`/`dict()` — the
+`LAST_RUN_STATS` pattern; publish to the metrics registry and expose an
+`observability.RunStats` view instead).
+
+Allowlisted: utils/logger.py (the ConsoleSink IS the console), sweep.py
+(JSON-lines stdout contract), and analysis/__main__.py (the lint gate's own
+CLI — its stdout is the findings contract CI parses). scripts/ and bench.py
+are not library code.
+
+Checker migrated unchanged from scripts/lint.py (PR 2).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+
+_ALLOWLIST = frozenset(
+    {
+        os.path.join("stoix_tpu", "utils", "logger.py"),
+        os.path.join("stoix_tpu", "sweep.py"),
+        os.path.join("stoix_tpu", "analysis", "__main__.py"),
+    }
+)
+
+
+def _is_empty_dict_value(node: ast.AST) -> bool:
+    if isinstance(node, ast.Dict) and not node.keys:
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "dict"
+        and not node.args
+        and not node.keywords
+    )
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    rel = ctx.rel
+    if not rel.startswith("stoix_tpu" + os.sep) or rel in _ALLOWLIST:
+        return []
+    findings = []
+
+    def _line_ok(lineno: int) -> bool:
+        return "noqa" in ctx.line(lineno)
+
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+            and not _line_ok(node.lineno)
+        ):
+            findings.append(
+                Finding(
+                    "STX002",
+                    rel,
+                    node.lineno,
+                    "bare print() in library code — use "
+                    "observability.get_logger (status) or the metrics registry "
+                    "(STX002)",
+                )
+            )
+    # Module-level ALL_CAPS empty-dict accumulators (body-level only: class
+    # attributes and function locals are fine).
+    for node in getattr(ctx.tree, "body", []):
+        targets, value = [], None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for target in targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id.isupper()
+                and value is not None
+                and _is_empty_dict_value(value)
+                and not _line_ok(node.lineno)
+            ):
+                findings.append(
+                    Finding(
+                        "STX002",
+                        rel,
+                        node.lineno,
+                        f"ad-hoc module-level stats dict "
+                        f"'{target.id}' — publish to the metrics registry and "
+                        f"expose an observability.RunStats view (STX002)",
+                    )
+                )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX002",
+        order=30,
+        title="observability ownership",
+        rationale="stdout belongs to machine-readable contracts and ad-hoc "
+        "module-level stats dicts bypass the metrics registry every exporter "
+        "reads; route status through get_logger and stats through RunStats.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            'print("hello")\n',
+            "LAST_RUN_STATS: dict = {}\nOTHER = dict()\n",
+        ),
+        clean_snippets=(
+            'print("x")  # noqa: STX002\n'
+            "cache = {}\n"
+            "TABLE = {'a': 1}\n"
+            "STATS = RunStats()\n"
+            "class C:\n    BUF = {}\n"
+            "def f():\n    ACC = {}\n    print\n",
+        ),
+    )
+)

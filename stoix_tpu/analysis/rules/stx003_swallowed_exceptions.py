@@ -1,0 +1,99 @@
+"""STX003 — no swallowed exceptions.
+
+`stoix_tpu/` library code must not catch a BROAD exception type (bare
+`except:`, `except Exception`, `except BaseException`) and do nothing with it
+(`pass`/`...` body). Silently eaten failures are how a wedged actor or a
+half-written checkpoint turns into a 180s-timeout mystery — either narrow the
+type (e.g. `except queue.Empty`), handle it (log/counter/re-raise), or carry
+a `# noqa` with a reason on the except line.
+
+Allowlisted: resilience/faultinject.py (the chaos layer must never let its
+own bookkeeping mask the failure it is injecting).
+
+Checker migrated unchanged from scripts/lint.py (PR 3).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+
+_ALLOWLIST = frozenset({os.path.join("stoix_tpu", "resilience", "faultinject.py")})
+_BROAD_EXCEPTION_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:  # bare `except:`
+        return True
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) else [handler.type]
+    for node in types:
+        if isinstance(node, ast.Name) and node.id in _BROAD_EXCEPTION_NAMES:
+            return True
+    return False
+
+
+def _body_swallows(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    rel = ctx.rel
+    if not rel.startswith("stoix_tpu" + os.sep) or rel in _ALLOWLIST:
+        return []
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (_is_broad_handler(node) and _body_swallows(node)):
+            continue
+        if "noqa" in ctx.line(node.lineno):
+            continue
+        findings.append(
+            Finding(
+                "STX003",
+                rel,
+                node.lineno,
+                "broad exception swallowed (`except "
+                "Exception: pass`) in library code — narrow the type, handle "
+                "it, or add a reasoned noqa (STX003)",
+            )
+        )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX003",
+        order=40,
+        title="no swallowed exceptions",
+        rationale="A broad except with an empty body converts a real failure "
+        "into a silent hang or wrong result; narrow it, handle it, or carry "
+        "a reasoned noqa.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            "try:\n    x()\nexcept Exception:\n    pass\n"
+            "try:\n    x()\nexcept:\n    pass\n"
+            "try:\n    x()\nexcept (ValueError, BaseException):\n    ...\n"
+            "try:\n    x()\nexcept Exception as e:\n    pass\n",
+        ),
+        clean_snippets=(
+            "try:\n    x()\nexcept queue.Empty:\n    pass\n"
+            "try:\n    x()\nexcept Exception:\n    log.error('boom')\n"
+            "try:\n    x()\nexcept Exception:  # noqa: STX003 — reason\n    pass\n",
+        ),
+    )
+)

@@ -1,0 +1,102 @@
+"""STX004 — no unbounded blocking calls.
+
+`stoix_tpu/` library code must not call zero-argument `.get()`
+(queue.Queue.get — dict.get always takes a key), `.result()` (concurrent
+futures), or `.join()` (threads — string join always takes an iterable) with
+no timeout. Every indefinite wait is a latent hang: a dead peer turns it into
+the wedged process the launch-hardening layer (docs/DESIGN.md §2.4) exists to
+kill. Pass a timeout (and handle expiry), or carry a reasoned `# noqa` for a
+wait that is intentionally infinite.
+
+Allowlisted: none today — the file allowlist exists for future
+provably-supervised waits.
+
+Checker migrated unchanged from scripts/lint.py (PR 4).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+
+# AST heuristic: a zero-argument call of one of these attribute names cannot
+# be the bounded/keyed variant (dict.get(key), "sep".join(parts),
+# t.join(timeout)) — it is a wait that never returns if the other side is
+# dead. Calls WITH arguments are only flagged when they name block=... without
+# a timeout (queue.get(block=True)).
+_BLOCKING_ATTRS = {"get", "result", "join"}
+_ALLOWLIST: frozenset = frozenset()  # files whose infinite waits are supervised
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    rel = ctx.rel
+    if not rel.startswith("stoix_tpu" + os.sep) or rel in _ALLOWLIST:
+        return []
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _BLOCKING_ATTRS
+        ):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords}
+        if node.args or kwargs:
+            # Positional args mean dict.get(key)/str.join(parts)/
+            # join(timeout)/get(block, timeout) — ambiguous or bounded. With
+            # keywords, only block=<not False> WITHOUT timeout= is provably
+            # an unbounded wait (block=False never blocks).
+            if "timeout" in kwargs or node.args:
+                continue
+            block = kwargs.get("block")
+            if block is None or (
+                isinstance(block, ast.Constant) and block.value is False
+            ):
+                continue
+        if "noqa" in ctx.line(node.lineno):
+            continue
+        findings.append(
+            Finding(
+                "STX004",
+                rel,
+                node.lineno,
+                f"unbounded blocking call `.{node.func.attr}()` "
+                f"without a timeout — a dead peer turns this into a wedged process; "
+                f"pass a timeout and handle expiry, or noqa a provably-supervised "
+                f"infinite wait (STX004)",
+            )
+        )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX004",
+        order=50,
+        title="no unbounded blocking calls",
+        rationale="A .get()/.result()/.join() with no timeout never returns "
+        "once the producing peer dies; bounded waits with handled expiry are "
+        "what keep a degraded run diagnosable instead of wedged.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            "x = q.get()\n"
+            "y = fut.result()\n"
+            "t.join()\n"
+            "z = q.get(block=True)\n",
+        ),
+        clean_snippets=(
+            "x = q.get(timeout=1.0)\n"
+            "y = fut.result(timeout=5)\n"
+            "t.join(2.0)\n"
+            "s = ', '.join(parts)\n"
+            "v = d.get('key')\n"
+            "w = q.get(True, 1.0)\n"
+            "n = q.get(block=False)\n"
+            "m = q.get()  # noqa: STX004 — supervised drain loop\n",
+        ),
+    )
+)

@@ -1,0 +1,304 @@
+"""STX005 — PRNG key discipline.
+
+Two failure modes the single-jitted-program style makes silent:
+
+  1. **Key reuse**: the same key variable consumed by two or more
+     `jax.random.*` sampling calls (or `dist.sample(seed=key)`) without an
+     intervening `split`/`fold_in` rebinding. The program runs, the
+     distributions are correlated, and training quality quietly degrades —
+     nothing ever raises.
+  2. **Discarded split**: `jax.random.split(key)` as a bare expression
+     statement. The caller paid for a split and kept using the old key —
+     almost always a refactor leftover that reintroduces (1).
+
+Detection is a control-flow-aware linear scan per function scope: each
+branch of an `if` is analysed from a copy of the incoming state and merged
+conservatively (so one consume in each arm of an if/else does NOT flag);
+`for`/`while` bodies are analysed twice, which catches the loop-carried reuse
+of a key that is never re-split inside the loop. Consumption is recognised
+as (a) a `Name` in the first positional argument (or `key=`/`seed=`/`rng=`
+keyword) of a `jax.random.<sampler>` call, and (b) a `Name` passed as a
+`seed=`/`key=`/`rng=` keyword to ANY call (the `dist.sample(seed=k)` idiom).
+
+Known blind spots (docs/DESIGN.md §2.5): keys threaded through pytrees or
+attributes (`state.key`), cross-function flow, and aliasing (`k2 = k`).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis.jitreach import assigned_names as _assigned_names
+
+# jax.random functions that DERIVE or construct keys rather than consuming
+# randomness: not a "use" for the reuse check.
+_NON_CONSUMING = {
+    "split",
+    "fold_in",
+    "PRNGKey",
+    "key",
+    "key_data",
+    "wrap_key_data",
+    "key_impl",
+    "clone",
+}
+_KEY_KWARGS = {"seed", "key", "rng"}
+
+
+def _random_fn_name(func: ast.AST) -> Optional[str]:
+    """'normal' for jax.random.normal / random.normal / jrandom.normal.
+
+    np.random.* / numpy.random.* are NOT key-based (their first argument is a
+    distribution parameter, not a PRNG key) and must never match; a bare
+    `random.<fn>` receiver is treated as the `from jax import random` idiom —
+    stdlib-`random` module calls inside stoix_tpu/ would be a bug anyway
+    (host-side nondeterminism the whole design avoids)."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    receiver = func.value
+    if isinstance(receiver, ast.Attribute) and receiver.attr == "random":
+        root = receiver.value
+        if isinstance(root, ast.Name) and root.id in ("np", "numpy"):
+            return None
+        return func.attr
+    if isinstance(receiver, ast.Name) and "random" in receiver.id:
+        if receiver.id in ("np_random", "numpy_random"):
+            return None
+        return func.attr
+    return None
+
+
+class _KeyFlow:
+    """Per-scope linear scan with branch-aware state merging.
+
+    State maps a variable name to the line of its first un-reset consumption
+    (None = not consumed since the last rebind)."""
+
+    def __init__(self, ctx: FileContext, rule_id: str) -> None:
+        self.ctx = ctx
+        self.rule_id = rule_id
+        self.findings: List[Finding] = []
+
+    # -- expression-level event extraction ----------------------------------
+
+    def _consumed_names(self, expr: ast.AST) -> List[Tuple[str, int, str]]:
+        """(name, lineno, called_fn) for every key consumption in `expr`.
+        Nested lambda/def bodies are skipped (separate scopes)."""
+        out: List[Tuple[str, int, str]] = []
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                fn = _random_fn_name(node.func)
+                if fn is not None and fn not in _NON_CONSUMING:
+                    # A jax.random sampler: the key is the first positional
+                    # arg or a key-ish keyword.
+                    if node.args and isinstance(node.args[0], ast.Name):
+                        out.append((node.args[0].id, node.lineno, f"jax.random.{fn}"))
+                    for kw in node.keywords:
+                        if kw.arg in _KEY_KWARGS and isinstance(kw.value, ast.Name):
+                            out.append((kw.value.id, node.lineno, f"jax.random.{fn}"))
+                elif fn is None:
+                    # Any other call consuming a key through a key-ish keyword
+                    # (the `dist.sample(seed=key)` idiom).
+                    for kw in node.keywords:
+                        if kw.arg in _KEY_KWARGS and isinstance(kw.value, ast.Name):
+                            callee = (
+                                node.func.attr
+                                if isinstance(node.func, ast.Attribute)
+                                else node.func.id
+                                if isinstance(node.func, ast.Name)
+                                else "call"
+                            )
+                            out.append((kw.value.id, node.lineno, f"{callee}({kw.arg}=...)"))
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _discarded_splits(self, stmt: ast.stmt) -> List[int]:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            fn = _random_fn_name(stmt.value.func)
+            if fn == "split":
+                return [stmt.value.lineno]
+        return []
+
+    # -- statement walker ----------------------------------------------------
+
+    def _consume(self, state: Dict[str, Optional[int]], name: str, lineno: int, via: str) -> None:
+        first = state.get(name)
+        if first is not None:
+            if not self.ctx.noqa(lineno, self.rule_id):
+                self.findings.append(
+                    Finding(
+                        self.rule_id,
+                        self.ctx.rel,
+                        lineno,
+                        f"PRNG key '{name}' reused by {via} without an "
+                        f"intervening jax.random.split (first consumed at line "
+                        f"{first}) — correlated randomness (STX005)",
+                    )
+                )
+            return  # report each reused key once per scope, at first reuse
+        state[name] = lineno
+
+    def _reset(self, state: Dict[str, Optional[int]], names: List[str]) -> None:
+        for name in names:
+            state[name] = None
+
+    def _exprs_of(self, stmt: ast.stmt) -> List[ast.AST]:
+        """Value expressions of a simple statement (targets handled separately)."""
+        if isinstance(stmt, ast.Assign):
+            return [stmt.value]
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, (ast.Expr, ast.Return)):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, (ast.Assert, ast.Delete, ast.Raise, ast.Global, ast.Nonlocal)):
+            return [c for c in ast.iter_child_nodes(stmt)]
+        return []
+
+    def _apply_events(self, state: Dict[str, Optional[int]], expr: ast.AST) -> None:
+        for name, lineno, via in sorted(
+            self._consumed_names(expr), key=lambda t: t[1]
+        ):
+            self._consume(state, name, lineno, via)
+
+    def run_block(self, body: List[ast.stmt], state: Dict[str, Optional[int]]) -> None:
+        for stmt in body:
+            for lineno in self._discarded_splits(stmt):
+                if not self.ctx.noqa(lineno, self.rule_id):
+                    self.findings.append(
+                        Finding(
+                            self.rule_id,
+                            self.ctx.rel,
+                            lineno,
+                            "result of jax.random.split discarded — the caller "
+                            "keeps using the unsplit key (STX005)",
+                        )
+                    )
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes are analysed separately
+            if isinstance(stmt, ast.If):
+                self._apply_events(state, stmt.test)
+                branch_states = []
+                for branch in (stmt.body, stmt.orelse):
+                    sub = dict(state)
+                    self.run_block(branch, sub)
+                    branch_states.append(sub)
+                self._merge(state, branch_states)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._apply_events(state, stmt.iter)
+                self._reset(state, _assigned_names(stmt.target))
+                # Two passes catch loop-carried reuse of a never-re-split key.
+                self.run_block(stmt.body, state)
+                self.run_block(stmt.body, state)
+                self.run_block(stmt.orelse, state)
+            elif isinstance(stmt, ast.While):
+                self._apply_events(state, stmt.test)
+                self.run_block(stmt.body, state)
+                self.run_block(stmt.body, state)
+                self.run_block(stmt.orelse, state)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._apply_events(state, item.context_expr)
+                    if item.optional_vars is not None:
+                        self._reset(state, _assigned_names(item.optional_vars))
+                self.run_block(stmt.body, state)
+            elif isinstance(stmt, ast.Try):
+                sub = dict(state)
+                self.run_block(stmt.body, sub)
+                branch_states = [sub]
+                for handler in stmt.handlers:
+                    hstate = dict(state)
+                    self.run_block(handler.body, hstate)
+                    branch_states.append(hstate)
+                self._merge(state, branch_states)
+                self.run_block(stmt.orelse, state)
+                self.run_block(stmt.finalbody, state)
+            else:
+                for expr in self._exprs_of(stmt):
+                    self._apply_events(state, expr)
+                if isinstance(stmt, ast.Assign):
+                    for target in stmt.targets:
+                        self._reset(state, _assigned_names(target))
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    self._reset(state, _assigned_names(stmt.target))
+
+    def _merge(
+        self, state: Dict[str, Optional[int]], branches: List[Dict[str, Optional[int]]]
+    ) -> None:
+        """OR-merge complete post-branch states (each branch started from a
+        copy of the incoming state): consumed-after iff any branch left the
+        key consumed. When EVERY branch reset the key (the re-split-in-both-
+        arms idiom), the merged state must be reset too — falling back to the
+        pre-branch record here would flag correct code."""
+        names = set(state)
+        for b in branches:
+            names |= set(b)
+        for name in names:
+            linenos = [b.get(name) for b in branches if b.get(name) is not None]
+            state[name] = min(linenos) if linenos else None
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep):
+        return []
+    flow = _KeyFlow(ctx, rule.id)
+    # Module body is one scope; every function (nested included) is its own.
+    flow.run_block(getattr(ctx.tree, "body", []), {})
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            flow.run_block(node.body, {})
+    return flow.findings
+
+
+RULE = register(
+    Rule(
+        id="STX005",
+        order=70,
+        title="PRNG key discipline",
+        rationale="Reusing a consumed key correlates samples across calls and "
+        "never raises; a discarded split means the old key keeps being used. "
+        "Both train wrong silently on every device at once.",
+        check_file=_check,
+        flag_snippets=(
+            # Key reuse: same key sampled twice, no re-split.
+            "import jax\n\n\ndef sample(key):\n"
+            "    a = jax.random.normal(key, (2,))\n"
+            "    b = jax.random.uniform(key, (2,))\n"
+            "    return a + b\n",
+            # Discarded split result.
+            "import jax\n\n\ndef sample(key):\n"
+            "    jax.random.split(key)\n"
+            "    return jax.random.normal(key, (2,))\n",
+            # seed= reuse through a distribution sample call.
+            "import jax\n\n\ndef act(dist, key):\n"
+            "    a = dist.sample(seed=key)\n"
+            "    b = dist.sample(seed=key)\n"
+            "    return a, b\n",
+        ),
+        clean_snippets=(
+            # The canonical re-split idiom.
+            "import jax\n\n\ndef sample(key):\n"
+            "    key, sub = jax.random.split(key)\n"
+            "    a = jax.random.normal(sub, (2,))\n"
+            "    key, sub = jax.random.split(key)\n"
+            "    b = jax.random.uniform(sub, (2,))\n"
+            "    return a + b\n",
+            # One consume per if/else arm is NOT reuse.
+            "import jax\n\n\ndef sample(key, flag):\n"
+            "    if flag:\n"
+            "        return jax.random.normal(key, (2,))\n"
+            "    else:\n"
+            "        return jax.random.uniform(key, (2,))\n",
+            # Fan-out into distinct keys.
+            "import jax\n\n\ndef sample(key):\n"
+            "    k1, k2 = jax.random.split(key)\n"
+            "    return jax.random.normal(k1, (2,)) + jax.random.normal(k2, (2,))\n",
+        ),
+    )
+)

@@ -1,0 +1,223 @@
+"""STX007 — collective axis-name consistency.
+
+Every axis-name LITERAL passed to a collective (`lax.pmean/psum/pmax/...`) or
+to a stoix helper taking `axis_names=(...)` must be an axis that actually
+exists: either declared by an enclosing-file `jax.vmap`/`jax.pmap`
+(`axis_name="batch"`) or defined as a mesh axis by `stoix_tpu/parallel/`
+(`create_mesh({"data": -1})`, tensor-parallel "model", ...).
+
+This is the typo that only explodes on a multi-device run: on one device an
+unbound `axis_name="dataa"` can silently reduce over nothing or fail deep in
+compilation after minutes of tracing; on an 8-device TPU allocation it is a
+burned allocation. The Podracer/Anakin style (everything in one jitted
+program) makes the failure surface exactly at launch time — this rule moves
+it to lint time.
+
+Mesh-axis discovery is static: `stoix_tpu/parallel/*.py` is parsed for
+dict-literal mesh specs (str keys, int sizes), `PartitionSpec` string
+literals, and `axis*=`-parameter string defaults. Axis names passed as
+VARIABLES (library helpers like `ring_attention(..., axis_name)`) are out of
+scope — only literals are checked, so there are no false positives from
+axis-generic code.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set, Tuple
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis.jitreach import callee_name as _callee_name
+
+_COLLECTIVES = {
+    "pmean",
+    "psum",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "pshuffle",
+    "psum_scatter",
+    "pswapaxes",
+    "axis_index",
+}
+_DECLARING = {"vmap", "pmap"}
+_AXIS_KWARGS = {"axis_name", "axis_names"}
+
+_axes_cache: dict = {}
+
+
+def declared_axes(repo: str) -> Set[str]:
+    """Axis names that exist anywhere in the package: mesh axes parsed from
+    stoix_tpu/parallel/*.py plus every `vmap/pmap(axis_name="...")` literal
+    under stoix_tpu/ (the in-shard "batch" axis is declared by the shared
+    off_policy_core/system files and consumed by siblings — declarations are
+    a package-wide convention, uses are checked per literal). Cached per
+    repo path."""
+    cached = _axes_cache.get(repo)
+    if cached is not None:
+        return cached
+    axes: Set[str] = set()
+    package_dir = os.path.join(repo, "stoix_tpu")
+    for root, dirs, files in os.walk(package_dir):
+        dirs[:] = [d for d in dirs if d not in ("__pycache__", "configs")]
+        in_parallel = os.path.basename(root) == "parallel" or os.sep + "parallel" in root
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(root, name)) as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            axes |= _file_declared_axes(tree)
+            if not in_parallel:
+                continue
+            for node in ast.walk(tree):
+                # {"data": -1} style mesh specs.
+                if isinstance(node, ast.Dict):
+                    keys_ok = node.keys and all(
+                        isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        for k in node.keys
+                    )
+                    vals_ok = all(
+                        isinstance(v, ast.Constant) and isinstance(v.value, int)
+                        or isinstance(v, ast.UnaryOp)
+                        for v in node.values
+                    )
+                    if keys_ok and vals_ok:
+                        axes.update(k.value for k in node.keys)
+                # P("model") / PartitionSpec("data") literals.
+                elif isinstance(node, ast.Call) and _callee_name(node.func) in (
+                    "P",
+                    "PartitionSpec",
+                ):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                            axes.add(arg.value)
+                # def data_sharding(..., axis: str = "data") parameter defaults.
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    args = node.args
+                    pos_with_defaults = (
+                        zip(args.args[len(args.args) - len(args.defaults):], args.defaults)
+                        if args.defaults
+                        else []
+                    )
+                    for param, default in [
+                        *pos_with_defaults,
+                        *zip(args.kwonlyargs, args.kw_defaults),
+                    ]:
+                        if (
+                            default is not None
+                            and param.arg.startswith("axis")
+                            and isinstance(default, ast.Constant)
+                            and isinstance(default.value, str)
+                        ):
+                            axes.add(default.value)
+    _axes_cache[repo] = axes
+    return axes
+
+
+def _file_declared_axes(tree: ast.AST) -> Set[str]:
+    """Axis names declared by vmap/pmap axis_name= literals in this file."""
+    declared: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _callee_name(node.func) in _DECLARING:
+            for kw in node.keywords:
+                if kw.arg == "axis_name" and isinstance(kw.value, ast.Constant):
+                    if isinstance(kw.value.value, str):
+                        declared.add(kw.value.value)
+    return declared
+
+
+def _literal_axis_names(node: ast.AST) -> List[Tuple[str, int]]:
+    """(axis, lineno) for every string literal in an axis_name(s) value."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, node.lineno)]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append((elt.value, elt.lineno))
+        return out
+    return []
+
+
+def _axis_uses(call: ast.Call) -> List[Tuple[str, int]]:
+    callee = _callee_name(call.func)
+    uses: List[Tuple[str, int]] = []
+    if callee in _COLLECTIVES:
+        # axis_name may also be the second positional arg (pmean(x, "data")).
+        if len(call.args) >= 2:
+            uses.extend(_literal_axis_names(call.args[1]))
+        if callee == "axis_index" and len(call.args) == 1:
+            uses.extend(_literal_axis_names(call.args[0]))
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            uses.extend(_literal_axis_names(kw.value))
+    return uses
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep):
+        return []
+    known = declared_axes(ctx.repo) | _file_declared_axes(ctx.tree)
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _callee_name(node.func)
+        if callee in _DECLARING:
+            continue  # vmap/pmap axis_name= declares, never uses
+        for axis, lineno in _axis_uses(node):
+            if axis in known or ctx.noqa(lineno, rule.id):
+                continue
+            declared = ", ".join(sorted(known)) or "<none>"
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    lineno,
+                    f"collective axis name '{axis}' is not declared by any "
+                    f"vmap/pmap under stoix_tpu/ nor defined as a mesh "
+                    f"axis by stoix_tpu/parallel/ (known: {declared}) — this "
+                    f"typo only explodes on a multi-device run (STX007)",
+                )
+            )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX007",
+        order=90,
+        title="collective axis-name consistency",
+        rationale="An axis_name literal no mesh or vmap declares compiles on "
+        "one device and fails (or silently no-ops) on eight; catching it at "
+        "lint time saves the TPU allocation the launch would burn.",
+        check_file=_check,
+        flag_snippets=(
+            # The classic typo: pmean over a misspelled mesh axis.
+            "import jax\n\n\ndef learner(grads):\n"
+            '    return jax.lax.pmean(grads, axis_name="dataa")\n',
+            # axis_names tuple with one bad entry (guards/helper idiom).
+            "from stoix_tpu.resilience import guards\n\n\ndef step(new, old):\n"
+            '    return guards.guard_update("skip", new=new, old=old,\n'
+            '                               axis_names=("batch", "dat"))\n',
+        ),
+        clean_snippets=(
+            # Mesh axis from parallel/ + vmap-declared in-file axis.
+            "import jax\n\n\ndef make(step):\n"
+            '    batched = jax.vmap(step, axis_name="batch")\n'
+            "    def learner(grads):\n"
+            '        grads = jax.lax.pmean(grads, axis_name="batch")\n'
+            '        return jax.lax.pmean(grads, axis_name="data")\n'
+            "    return learner, batched\n",
+            # Axis passed as a VARIABLE is axis-generic library code: skipped.
+            "import jax\n\n\ndef reduce_over(x, axis_name):\n"
+            "    return jax.lax.psum(x, axis_name)\n",
+        ),
+    )
+)

@@ -1,0 +1,260 @@
+"""STX008 — donated-buffer misuse.
+
+When a function is jitted with `donate_argnums`, the caller hands the
+argument's buffers to XLA for reuse: reading the SAME variable after the call
+is a use-after-free that jax only sometimes catches (a deleted-buffer error
+on a good day, silently recycled memory inside a wedged runtime on a bad
+one). The pipelined runner's whole snapshot discipline exists because of this
+(docs/DESIGN.md §2.1, systems/anakin.py `shardmap_learner`).
+
+Detection: file-wide, find bindings `step = jax.jit(fn, donate_argnums=...)`
+(and `@partial(jax.jit, donate_argnums=...)` decorated defs) with a LITERAL
+argnums; then, per scope, a `Name` passed at a donated position whose value
+is loaded again after the call — without an intervening rebind — is flagged.
+Rebinding (`state = step(state)`) is the blessed idiom and resets tracking.
+
+Blind spots (docs/DESIGN.md §2.5): `donate_argnums` built dynamically
+(`**donate` — the runner's kill-switch pattern), donation through
+`donate_argnames`, aliasing, and cross-function escapes. The rule is a
+tripwire for the common refactor accident, not a proof of safety.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis.jitreach import assigned_names as _assigned_names
+from stoix_tpu.analysis.jitreach import callee_name as _callee_name
+
+
+def _literal_argnums(call: ast.Call) -> Optional[Set[int]]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        value = kw.value
+        if isinstance(value, ast.Constant) and isinstance(value.value, int):
+            return {value.value}
+        if isinstance(value, (ast.Tuple, ast.List)):
+            out = set()
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    out.add(elt.value)
+                else:
+                    return None
+            return out
+    return None
+
+
+def _donating_bindings(tree: ast.AST) -> Dict[str, Set[int]]:
+    """name -> donated positions, for jit-with-donation bindings and
+    @partial(jax.jit, donate_argnums=...) decorated functions."""
+    donors: Dict[str, Set[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+            if (
+                isinstance(target, ast.Name)
+                and isinstance(value, ast.Call)
+                and _callee_name(value.func) == "jit"
+            ):
+                argnums = _literal_argnums(value)
+                if argnums:
+                    donors[target.id] = argnums
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call) and _callee_name(deco.func) in (
+                    "jit",
+                    "partial",
+                ):
+                    argnums = _literal_argnums(deco)
+                    if argnums and (
+                        _callee_name(deco.func) == "jit"
+                        or any(_callee_name(a) == "jit" for a in deco.args)
+                    ):
+                        donors[node.name] = argnums
+    return donors
+
+
+class _DonationFlow:
+    """Per-scope statement-ordered scan: donated names -> first donation site;
+    a later load before a rebind is a use-after-donate."""
+
+    def __init__(self, rule: Rule, ctx: FileContext, donors: Dict[str, Set[int]]) -> None:
+        self.rule = rule
+        self.ctx = ctx
+        self.donors = donors
+        self.findings: List[Finding] = []
+
+    def _expr_events(self, expr: ast.AST) -> List[Tuple[int, int, str, str, str]]:
+        """(lineno, col, kind, name, extra) events inside one expression, in
+        source order. kind: 'load' | 'donate'."""
+        events: List[Tuple[int, int, str, str, str]] = []
+        stack = [expr]
+        donated_nodes: Set[ast.AST] = set()
+        calls: List[ast.Call] = []
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        for call in calls:
+            fname = _callee_name(call.func)
+            positions = self.donors.get(fname)
+            if not positions or not isinstance(call.func, ast.Name):
+                continue
+            for pos in positions:
+                if pos < len(call.args) and isinstance(call.args[pos], ast.Name):
+                    arg = call.args[pos]
+                    donated_nodes.add(arg)
+                    events.append(
+                        (
+                            call.end_lineno or call.lineno,
+                            getattr(call, "end_col_offset", 0),
+                            "donate",
+                            arg.id,
+                            fname,
+                        )
+                    )
+        for node in ast.walk(expr):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node not in donated_nodes
+            ):
+                events.append((node.lineno, node.col_offset, "load", node.id, ""))
+        events.sort(key=lambda e: (e[0], e[1]))
+        return events
+
+    def run(self, body: List[ast.stmt]) -> None:
+        self.state: Dict[str, Tuple[int, str]] = {}
+        self._block(body)
+
+    def _apply_expr(self, expr: ast.AST) -> None:
+        # Two passes: first discover donations (to know which loads matter),
+        # then replay events in order.
+        for lineno, _col, kind, name, via in self._expr_events(expr):
+            if kind == "donate":
+                self.state[name] = (lineno, via)
+            elif kind == "load" and name in self.state:
+                donated_line, via = self.state[name]
+                if lineno >= donated_line and not self.ctx.noqa(lineno, self.rule.id):
+                    self.findings.append(
+                        Finding(
+                            self.rule.id,
+                            self.ctx.rel,
+                            lineno,
+                            f"'{name}' is read after being donated to "
+                            f"'{via}' (donate_argnums) at line {donated_line} "
+                            f"— donated buffers may already be reused; "
+                            f"snapshot before the call or rebind the result "
+                            f"(STX008)",
+                        )
+                    )
+                    del self.state[name]
+
+    def _reset(self, target: ast.AST) -> None:
+        for name in _assigned_names(target):
+            self.state.pop(name, None)
+
+    def _block(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._apply_expr(stmt.value)
+                for target in stmt.targets:
+                    self._reset(target)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    self._apply_expr(stmt.value)
+                self._reset(stmt.target)
+            elif isinstance(stmt, ast.If):
+                self._apply_expr(stmt.test)
+                saved = dict(self.state)
+                self._block(stmt.body)
+                self.state = dict(saved)
+                self._block(stmt.orelse)
+                # Conservative merge: donation survives a branch only if it
+                # survived the else-branch state we are left with.
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._apply_expr(stmt.iter)
+                self._reset(stmt.target)
+                self._block(stmt.body)
+                self._block(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self._apply_expr(stmt.test)
+                self._block(stmt.body)
+                self._block(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._apply_expr(item.context_expr)
+                    if item.optional_vars is not None:
+                        self._reset(item.optional_vars)
+                self._block(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self._block(stmt.body)
+                for handler in stmt.handlers:
+                    self._block(handler.body)
+                self._block(stmt.orelse)
+                self._block(stmt.finalbody)
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, (ast.expr,)):
+                        self._apply_expr(child)
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep):
+        return []
+    donors = _donating_bindings(ctx.tree)
+    if not donors:
+        return []
+    findings: List[Finding] = []
+    scopes: List[List[ast.stmt]] = [getattr(ctx.tree, "body", [])]
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node.body)
+    for scope in scopes:
+        flow = _DonationFlow(rule, ctx, donors)
+        flow.run(scope)
+        findings.extend(flow.findings)
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX008",
+        order=95,
+        title="donated-buffer misuse",
+        rationale="Reading a variable after passing it as a donated argument "
+        "is a use-after-free on its HBM buffers; the runner's snapshot "
+        "discipline exists precisely to prevent this.",
+        check_file=_check,
+        flag_snippets=(
+            # Read-after-donate of the un-rebound variable.
+            "import jax\n\nstep = jax.jit(update, donate_argnums=(0,))\n\n\n"
+            "def run(state, batch):\n"
+            "    out = step(state, batch)\n"
+            "    loss = state.loss\n"
+            "    return out, loss\n",
+        ),
+        clean_snippets=(
+            # Rebinding the result is the blessed idiom.
+            "import jax\n\nstep = jax.jit(update, donate_argnums=(0,))\n\n\n"
+            "def run(state, batch):\n"
+            "    state = step(state, batch)\n"
+            "    return state.loss\n",
+            # Non-donated positions are free to be re-read.
+            "import jax\n\nstep = jax.jit(update, donate_argnums=(0,))\n\n\n"
+            "def run(state, batch):\n"
+            "    out = step(state, batch)\n"
+            "    return out, batch.shape\n",
+        ),
+    )
+)

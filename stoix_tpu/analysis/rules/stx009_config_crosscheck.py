@@ -1,0 +1,190 @@
+"""STX009 — config↔code cross-check.
+
+Two directions, one model (stoix_tpu.analysis.configmodel):
+
+  1. **Unknown-key reads** (the real bugs): a strict attribute-chain read
+     `config.a.b.c` in systems/runner/evaluator code whose dotted path no
+     YAML under `stoix_tpu/configs/` defines and no code ever assigns. The
+     Config class is permanently struct-off, so a typo'd read raises
+     AttributeError only on the code path that executes it — possibly twenty
+     minutes into a TPU run, or never in tests. Reported at the read site.
+  2. **Dead YAML keys**: a leaf key no code ever reads (strictly,
+     tolerantly via `.get`/`getattr`, or by reading an enclosing subtree).
+     Dead keys rot: they document behavior the code no longer has.
+     Reported at the YAML definition site.
+
+Liveness and definition are computed over the WHOLE repo (stoix_tpu/,
+bench.py, scaling_bench.py, tests/) regardless of which paths the invocation
+scanned, so partial-path runs cannot fabricate dead-key findings; the rule
+itself only runs when the scan covers stoix_tpu/ code.
+
+Subtree semantics keep the rule honest about its blind spots (documented in
+docs/DESIGN.md §2.5): reading an ancestor (`config.env` handed to a factory)
+marks the whole subtree live; any dict carrying `_target_` is consumed by
+`config.instantiate()` and its subtree is exempt from dead-key analysis;
+`.get(...)` with a non-literal key marks the enclosing node live.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+from stoix_tpu.analysis import configmodel
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, TreeContext, register
+from stoix_tpu.analysis.core import iter_py_files
+
+Path = Tuple[str, ...]
+
+# Code whose config reads are REPORTED when unknown (the per-system surface
+# the issue targets); everything scanned still contributes liveness/writes.
+_REPORT_PREFIXES = (
+    os.path.join("stoix_tpu", "systems") + os.sep,
+    os.path.join("stoix_tpu", "sebulba") + os.sep,
+    "stoix_tpu" + os.sep + "evaluator.py",
+    "stoix_tpu" + os.sep + "sweep.py",
+    "stoix_tpu" + os.sep + "launcher.py",
+)
+# The whole-repo scan STX009 always performs for definitions and liveness.
+_SCAN_ROOTS = ("stoix_tpu", "tests", "bench.py", "scaling_bench.py", "sweep.py")
+
+# Dead-key allowlist: dotted key (or dotted prefix ending in '.') -> reason.
+# Keys here are intentionally kept although no code reads them today; every
+# entry needs a reason a reviewer can audit.
+DEAD_KEY_ALLOWLIST: Dict[str, str] = {}  # noqa: STX002 — audited allowlist constant, not a stats accumulator
+
+
+def _allowlisted(dotted: str) -> bool:
+    for entry in DEAD_KEY_ALLOWLIST:
+        if dotted == entry or (entry.endswith(".") and dotted.startswith(entry)):
+            return True
+    return False
+
+
+def _collect_repo_accesses(
+    repo: str, cached: Dict[str, FileContext]
+) -> Tuple[configmodel.ConfigAccesses, List[Tuple[str, configmodel.ConfigAccesses]]]:
+    """(merged accesses for liveness, per-file accesses for reporting)."""
+    merged = configmodel.ConfigAccesses()
+    per_file: List[Tuple[str, configmodel.ConfigAccesses]] = []
+    for path in iter_py_files(_SCAN_ROOTS, repo):
+        rel = os.path.relpath(path, repo)
+        ctx = cached.get(rel)
+        if ctx is not None:
+            tree = ctx.tree
+        else:
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+        accesses = configmodel.collect_config_accesses(tree)
+        per_file.append((rel, accesses))
+        merged.strict.extend(accesses.strict)
+        merged.tolerant.extend(accesses.tolerant)
+        merged.writes.update(accesses.writes)
+    return merged, per_file
+
+
+def _is_defined(path: Path, keys: configmodel.ConfigKeySet, writes: Set[Path]) -> bool:
+    if keys.defines(path) or keys.under_target(path):
+        return True
+    for w in writes:
+        if path[: len(w)] == w or w[: len(path)] == path:
+            return True
+    return False
+
+
+def _is_live(leaf: Path, reads: Set[Path], writes: Set[Path]) -> bool:
+    for r in reads | writes:
+        if leaf[: len(r)] != r:
+            continue
+        # Exact read, or an ancestor-SUBTREE read (config.logger.kwargs
+        # handed to a consumer). A bare group read (`config.system` passed
+        # around) does not confer liveness: group mounts are the composition
+        # skeleton, and counting them would mark every key alive.
+        if len(r) == len(leaf) or len(r) >= 2:
+            return True
+    return False
+
+
+def _check_tree(rule: Rule, ctx: TreeContext) -> List[Finding]:
+    if not ctx.scans_package():
+        return []
+    keys = configmodel.load_config_keys(ctx.repo)
+    if not keys.nodes:
+        return []  # no configs tree (scratch invocation)
+    cached = {f.rel: f for f in ctx.files}
+    merged, per_file = _collect_repo_accesses(ctx.repo, cached)
+    read_paths: Set[Path] = {p for p, _ in merged.strict} | {
+        p for p, _ in merged.tolerant
+    }
+
+    findings: List[Finding] = []
+    # Direction 1: unknown-key reads in the per-system surface. Only SCANNED
+    # files are reported (their FileContext exists, so noqa is honored) —
+    # a partial-path run must not emit findings, nor ignore suppressions, in
+    # files the invocation never looked at. The whole-repo access collection
+    # above still feeds definitions/liveness regardless of scan scope.
+    for rel, accesses in per_file:
+        file_ctx = cached.get(rel)
+        if file_ctx is None or not rel.startswith(_REPORT_PREFIXES):
+            continue
+        reported: Set[Tuple[Path, int]] = set()
+        for path, lineno in accesses.strict:
+            if _is_defined(path, keys, merged.writes):
+                continue
+            if (path, lineno) in reported:
+                continue
+            reported.add((path, lineno))
+            if file_ctx.noqa(lineno, rule.id):
+                continue
+            dotted = ".".join(path)
+            findings.append(
+                Finding(
+                    rule.id,
+                    rel,
+                    lineno,
+                    f"config read '{dotted}' matches no key defined under "
+                    f"stoix_tpu/configs/ and is never assigned by code — "
+                    f"typo'd config access raises AttributeError only when "
+                    f"this path executes (STX009)",
+                )
+            )
+
+    # Direction 2: dead YAML keys (reported once per defining file).
+    for leaf, sites in sorted(keys.leaves.items()):
+        if keys.under_target(leaf):
+            continue
+        dotted = ".".join(leaf)
+        if _allowlisted(dotted):
+            continue
+        if _is_live(leaf, read_paths, merged.writes):
+            continue
+        for rel_yaml, line in sites:
+            findings.append(
+                Finding(
+                    rule.id,
+                    rel_yaml,
+                    line,
+                    f"config key '{dotted}' is never read by any stoix_tpu "
+                    f"code — dead config; delete it or allowlist it with a "
+                    f"reason in stx009_config_crosscheck.DEAD_KEY_ALLOWLIST "
+                    f"(STX009)",
+                )
+            )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX009",
+        order=110,
+        title="config↔code cross-check",
+        rationale="struct-off configs mean a typo'd read only fails on the "
+        "executing code path and a stale YAML key never fails at all; the "
+        "cross-check makes both a lint error.",
+        check_tree=_check_tree,
+    )
+)

@@ -75,11 +75,36 @@ def _default_yaml_for(module: str) -> Optional[str]:
 
 
 def run_preflight_only(jobs: List[dict]) -> int:
-    """ONE backend probe for the host + per-job config cross-validation
-    against the probed topology; prints the one-page report. Returns the
-    process exit code (0 = every stage passed)."""
+    """Static-analysis gate + ONE backend probe for the host + per-job config
+    cross-validation against the probed topology; prints the one-page report.
+    Returns the process exit code (0 = every stage passed)."""
     from stoix_tpu.resilience import preflight
     from stoix_tpu.utils import config as config_lib
+
+    # Static-analysis gate FIRST (docs/DESIGN.md §2.5): pure-AST, no jax
+    # import, milliseconds — a SLURM prolog catches an axis-name typo
+    # (STX007) or a typo'd config read (STX009) before the backend probe
+    # spends its timeout budget, let alone before burning a TPU allocation.
+    from stoix_tpu import analysis
+
+    findings, n_files = analysis.run_paths()
+    lint_errors, _lint_warnings = analysis.split_severity(findings)
+    if lint_errors:
+        # Short-circuit: the gate already failed the batch, so do not spend
+        # the probe's multi-attempt backoff budget (a wedged backend would
+        # hold the prolog for minutes before reporting a typo lint catches
+        # in milliseconds).
+        report = preflight.PreflightReport()
+        rules = ", ".join(sorted({f.rule for f in lint_errors}))
+        report.add(
+            "static-analysis", "fail",
+            f"{len(lint_errors)} finding(s) [{rules}]; first: "
+            f"{lint_errors[0].render()}",
+        )
+        report.add("backend_probe", "skip", "static-analysis failed — probe not attempted")
+        report.add("config_validation", "skip", "static-analysis failed")
+        print(report.render())  # noqa: STX002 — --preflight-only's stdout contract
+        return 1
 
     configs = []
     report_extra = []
@@ -106,6 +131,10 @@ def run_preflight_only(jobs: List[dict]) -> int:
     report = preflight.run_preflight(configs if configs else None)
     for row in report_extra:
         report.add(*row)
+    report.add(
+        "static-analysis", "pass",
+        f"{n_files} files clean ({len(analysis.get_rules())} rules)",
+    )
     # The report IS this mode's output contract (CI / SLURM prolog logs
     # capture stdout), like bench.py's JSON lines.
     print(report.render())  # noqa: STX002 — --preflight-only's stdout contract

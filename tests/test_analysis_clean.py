@@ -1,0 +1,52 @@
+"""The repo lints itself clean — the gate as a tier-1 test, not an
+honor-system script (ISSUE 5 satellite).
+
+Runs `python -m stoix_tpu.analysis --format json` over the default paths and
+asserts zero error-severity findings. Consuming the machine-readable JSON
+(one object per finding: rule/path/line/message/severity) is the point: the
+same contract CI uses, so a format regression fails here too.
+
+This subsumes the old test_lint.py::test_lint_gate_clean and adds the five
+JAX-aware rules (STX005-STX009) plus the config↔code cross-check to the
+always-green surface: an axis-name typo, a reused PRNG key, or a typo'd
+config read anywhere in stoix_tpu/ now fails the test suite directly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_lints_clean_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "stoix_tpu.analysis", "--format", "json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    findings = json.loads(proc.stdout)
+    errors = [f for f in findings if f["severity"] == "error"]
+    assert proc.returncode == 0 and not errors, (
+        "the repo no longer lints clean:\n"
+        + "\n".join(
+            f"  {f['rule']} {f['path']}:{f['line']}: {f['message']}" for f in errors
+        )
+    )
+    # Warnings (E501) are allowed but must stay structured.
+    for f in findings:
+        assert set(f) == {"rule", "path", "line", "message", "severity"}
+
+
+def test_shim_gate_clean_text():
+    # The historical invocation (CI, docs, muscle memory) — via the shim.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"lint gate failed:\n{proc.stdout}\n{proc.stderr}"
+    assert ", 0 errors," in proc.stdout.splitlines()[-1]
