@@ -2,17 +2,19 @@
 
 Promoted from the flat scripts/lint.py (PRs 1-4) into a rule-plugin
 subsystem: `core` holds the framework (Finding/Rule/registry/noqa/runner),
-`rules/` holds one module per rule (STX001-STX009 plus the F401/hygiene core
+`rules/` holds one module per rule (STX001-STX013 plus the F401/hygiene core
 checks), `jitreach` resolves which functions flow into jit/shard_map/scan/
-pmap, and `configmodel` models the Hydra-style YAML tree for STX009.
+pmap, `configmodel` models the Hydra-style YAML tree for STX009, and
+`meshmodel` models mesh construction + every sharding expression for the
+sharding-layer rules STX010-STX011 (docs/DESIGN.md §2.5).
 
 Everything is stdlib `ast` + `yaml` — no jax import — so the gate runs in a
 SLURM prolog or CI box in milliseconds and `launcher.py --preflight-only`
 embeds it before any backend probe.
 
 CLI: `python -m stoix_tpu.analysis [paths...] [--select/--ignore IDS]
-[--format text|json] [--list-rules]`; `scripts/lint.py` is a byte-identical
-shim over the text format.
+[--format text|json|github] [--changed-only] [--list-rules]`;
+`scripts/lint.py` is a byte-identical shim over the text format.
 """
 
 from stoix_tpu.analysis.core import (  # noqa: F401 — public API
@@ -23,6 +25,7 @@ from stoix_tpu.analysis.core import (  # noqa: F401 — public API
     Finding,
     Rule,
     TreeContext,
+    changed_paths,
     get_rule,
     get_rules,
     noqa_suppresses,

@@ -2,14 +2,29 @@
 
     python -m stoix_tpu.analysis [paths...]
         [--select STX005,STX007] [--ignore HYG]
-        [--format text|json] [--list-rules] [--skip-external]
+        [--format text|json|github] [--changed-only]
+        [--list-rules] [--skip-external]
 
 Text mode reproduces scripts/lint.py's historical output byte-for-byte
 (warnings, errors, `[lint] N files, E errors, W warnings` summary); the shim
 delegates here. JSON mode prints one object per finding
 (rule/path/line/message/severity) as a single JSON array for CI consumption
-(tests/test_analysis_clean.py). Exit code: 0 clean, 1 findings at error
-severity, 2 usage error.
+(tests/test_analysis_clean.py). GitHub mode prints one workflow-command
+annotation line per finding (`::error file=...,line=...,title=STX010::msg`)
+so findings surface inline on the PR diff. Exit code: 0 clean, 1 findings at
+error severity, 2 usage error.
+
+`--changed-only` scans only the .py files `git` reports changed vs HEAD
+(staged, unstaged, untracked) within the default scan surface — the
+selection that keeps the gate fast as the rule count grows. Tree-scoped
+rules (STX009) are skipped in this mode (a partial file set would make the
+never-read analysis see phantom dead keys) — explicitly --select'ing one
+together with --changed-only is a usage error (exit 2), never a silent
+no-op — as is the mypy delegation
+(whole-program inference has no meaningful per-file mode). When git is
+unavailable OR the work tree is clean (the CI case: the change under test is
+already committed, so a 0-file pass would be a fake gate) the full scan runs
+instead — degrading to MORE coverage, never silently less.
 
 stdout is this tool's machine-readable contract (like sweep.py's JSON
 lines), hence the STX002 allowlist entry for this file.
@@ -19,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 from typing import List, Optional
@@ -50,6 +66,35 @@ def Finding_external(tool: str, line: str) -> core.Finding:
     return core.Finding(rule=tool, path=f"[{tool}]", line=0, message=line)
 
 
+def _github_escape(text: str) -> str:
+    """Workflow-command data escaping per the GitHub Actions spec."""
+    return text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def _github_escape_property(text: str) -> str:
+    """Property VALUES (file=, title=) additionally escape ',' and ':', which
+    would otherwise terminate the property list / command prefix."""
+    return _github_escape(text).replace(":", "%3A").replace(",", "%2C")
+
+
+def render_github(finding: core.Finding) -> str:
+    """One `::error`/`::warning` annotation line per finding. Paths are
+    normalized repo-relative with forward slashes (annotations anchor to the
+    PR diff); line-less findings (whole-file, external tools) omit `line=`."""
+    level = "error" if finding.severity == core.ERROR else "warning"
+    path = finding.path
+    if os.path.isabs(path):
+        path = os.path.relpath(path, core.REPO)
+    path = path.replace(os.sep, "/")
+    fields = []
+    if not path.startswith("["):  # external pseudo-paths carry no file
+        fields.append(f"file={_github_escape_property(path)}")
+        if finding.line:
+            fields.append(f"line={finding.line}")
+    fields.append(f"title={_github_escape_property(finding.rule)}")
+    return f"::{level} {','.join(fields)}::{_github_escape(finding.message)}"
+
+
 def _parse_ids(raw: Optional[List[str]]) -> Optional[List[str]]:
     if not raw:
         return None
@@ -78,7 +123,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         metavar="IDS",
         help="skip these rule ids (comma separated; repeatable)",
     )
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "github"), default="text")
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="scan only .py files git reports changed vs HEAD (tree-scoped "
+        "rules are skipped; full scan when git is unavailable)",
+    )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog and exit"
     )
@@ -98,24 +149,71 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     select = _parse_ids(args.select)
     ignore = _parse_ids(args.ignore)
+
+    paths: Optional[List[str]] = args.paths or None
+    with_tree_rules = True
+    if args.changed_only:
+        if args.paths:
+            print("error: --changed-only and explicit paths are mutually "
+                  "exclusive", file=sys.stderr)
+            return 2
+        changed = core.changed_paths()
+        if not changed:
+            # git unavailable OR a clean checkout (the CI/prolog case, where
+            # the bad change is already committed): a vacuous 0-file pass
+            # would be a fake gate — run the full scan instead.
+            why = "git unavailable" if changed is None else "clean work tree"
+            print(f"[lint] --changed-only: {why}, running the full scan",
+                  file=sys.stderr)
+        else:
+            if select:
+                # An explicitly selected tree-scoped rule cannot run on a
+                # partial file set; silently dropping it would make the run a
+                # permanent green no-op — refuse, like the paths conflict.
+                tree_ids = {r.id for r in core.get_rules() if r.check_tree}
+                dropped = sorted(tree_ids.intersection(select))
+                if dropped:
+                    print(
+                        "error: --changed-only skips tree-scoped rules, but "
+                        f"{', '.join(dropped)} was explicitly selected — run "
+                        "without --changed-only",
+                        file=sys.stderr,
+                    )
+                    return 2
+            paths = changed
+            with_tree_rules = False
+
     try:
-        findings, n_files = core.run_paths(args.paths or None, select, ignore)
+        findings, n_files = core.run_paths(
+            paths, select, ignore, with_tree_rules=with_tree_rules
+        )
     except KeyError as exc:
         print(f"error: {exc.args[0]}", file=sys.stderr)
         return 2
 
     if select is None:
         # The external delegations are part of the full gate only; a
-        # per-rule run (--select) is always the native rules alone.
+        # per-rule run (--select) is always the native rules alone. mypy has
+        # no meaningful per-file mode (whole-program inference), so a
+        # genuinely narrowed run skips it rather than letting it silently
+        # dominate the "fast" path by type-checking the entire package.
+        narrowed = not with_tree_rules
         if not args.skip_external:
             findings = list(findings)
-            findings.extend(run_external("ruff", ["check", *(args.paths or core.DEFAULT_PATHS)]))
-            findings.extend(run_external("mypy", ["stoix_tpu"]))
+            findings.extend(run_external("ruff", ["check", *(paths or core.DEFAULT_PATHS)]))
+            if not narrowed:
+                findings.extend(run_external("mypy", ["stoix_tpu"]))
 
     errors, warnings = core.split_severity(findings)
 
     if args.format == "json":
         print(json.dumps([f.to_json() for f in findings], indent=None))
+        return 1 if errors else 0
+
+    if args.format == "github":
+        for f in warnings + errors:
+            print(render_github(f))
+        print(f"[lint] {n_files} files, {len(errors)} errors, {len(warnings)} warnings")
         return 1 if errors else 0
 
     for w in warnings:

@@ -86,12 +86,23 @@ class FileContext:
     source: str
     lines: List[str]
     tree: ast.AST
+    # Per-file memo shared across rules for derived models that several rules
+    # rebuild identically (ModuleMeshModel, jitreach._ModuleIndex) — the same
+    # reason the parsed tree itself is shared.
+    cache: Dict[str, object] = field(default_factory=dict)
 
     def line(self, lineno: int) -> str:
         return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
 
     def noqa(self, lineno: int, rule_id: str) -> bool:
         return noqa_suppresses(self.line(lineno), rule_id)
+
+    def memo(self, key: str, build: Callable[[], object]) -> object:
+        value = self.cache.get(key)
+        if value is None:
+            value = build()
+            self.cache[key] = value
+        return value
 
 
 @dataclass
@@ -196,6 +207,54 @@ def iter_py_files(paths: Iterable[str], repo: str = REPO) -> Iterable[str]:
                         yield os.path.join(root, f)
 
 
+def changed_paths(repo: str = REPO) -> Optional[List[str]]:
+    """Repo-relative .py files changed vs HEAD (staged, unstaged, untracked),
+    restricted to the DEFAULT_PATHS scan surface — the `--changed-only`
+    selection that keeps the gate fast as the rule count grows.
+
+    Returns None when git is unavailable or the tree is not a work tree
+    (an exported tarball on a CI box). Callers treat None AND an empty list
+    as "run the full scan" — a clean checkout means the change under test is
+    already committed, so a vacuous 0-file pass would be a fake gate;
+    degrade to MORE coverage, never silently to less.
+    """
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True,
+            text=True,
+            cwd=repo,
+            timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True,
+            text=True,
+            cwd=repo,
+            timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    names = set(diff.stdout.splitlines())
+    if untracked.returncode == 0:
+        names |= set(untracked.stdout.splitlines())
+    scan_files = {p for p in DEFAULT_PATHS if p.endswith(".py")}
+    scan_dirs = tuple(p + "/" for p in DEFAULT_PATHS if not p.endswith(".py"))
+    out = []
+    for name in sorted(names):
+        if not name.endswith(".py"):
+            continue
+        if name not in scan_files and not name.startswith(scan_dirs):
+            continue
+        if os.path.isfile(os.path.join(repo, name)):  # deletions drop out
+            out.append(name)
+    return out
+
+
 def _select_rules(
     select: Optional[Sequence[str]], ignore: Optional[Sequence[str]]
 ) -> List[Rule]:
@@ -232,16 +291,20 @@ def run_paths(
     select: Optional[Sequence[str]] = None,
     ignore: Optional[Sequence[str]] = None,
     repo: str = REPO,
+    with_tree_rules: bool = True,
 ) -> Tuple[List[Finding], int]:
     """Run the selected rules over `paths`; returns (findings, files scanned).
 
     Findings keep the historical order: per file, rules in registration
-    order; tree-scoped rules run once at the end."""
+    order; tree-scoped rules run once at the end. `with_tree_rules=False`
+    skips them — required under --changed-only, where the partial file set
+    would make STX009's never-read analysis see phantom dead keys."""
     rules = _select_rules(select, ignore)
     findings: List[Finding] = []
     contexts: List[FileContext] = []
     n_files = 0
-    for path in iter_py_files(paths or DEFAULT_PATHS, repo):
+    scan = paths if paths is not None else DEFAULT_PATHS
+    for path in iter_py_files(scan, repo):
         n_files += 1
         with open(path) as f:
             source = f.read()
@@ -266,10 +329,11 @@ def run_paths(
             # its checker still honors it.
             if rule.check_file is not None and ctx.rel not in rule.allowlist:
                 findings.extend(rule.check_file(rule, ctx))
-    tree_ctx = TreeContext(repo=repo, files=contexts)
-    for rule in rules:
-        if rule.check_tree is not None:
-            findings.extend(rule.check_tree(rule, tree_ctx))
+    if with_tree_rules:
+        tree_ctx = TreeContext(repo=repo, files=contexts)
+        for rule in rules:
+            if rule.check_tree is not None:
+                findings.extend(rule.check_tree(rule, tree_ctx))
     return findings, n_files
 
 

@@ -33,7 +33,7 @@ containers, and conditional rebinding.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, Iterator, List, Set
+from typing import Dict, Iterable, Iterator, List, Optional, Set
 
 # Callees whose function-valued arguments get traced. Bare-name forms are
 # accepted for the jax transforms (commonly imported directly); the lax
@@ -72,6 +72,72 @@ def assigned_names(target: ast.AST) -> List[str]:
     if isinstance(target, ast.Starred):
         return assigned_names(target.value)
     return []
+
+
+def literal_int_set(node: ast.AST) -> Optional[Set[int]]:
+    """{ints} of an int literal or all-int tuple/list literal, else None.
+    Shared AST helper (STX008 donate_argnums, STX012 static_argnums)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def literal_str_set(node: ast.AST) -> Optional[Set[str]]:
+    """{strs} of a str literal or all-str tuple/list literal, else None.
+    Shared AST helper (STX008 donate_argnames, STX012 static_argnames)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def annotate_parents(tree: ast.AST) -> Dict[int, ast.AST]:
+    """id(child) -> parent links for the whole tree. Shared AST helper
+    (ModuleMeshModel scope walks, STX012 enclosing-loop walks) — build once
+    per file via ctx.memo("parents", ...), it is an O(all-nodes) walk."""
+    parents: Dict[int, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+    return parents
+
+
+def positional_params(fn: ast.AST) -> List[str]:
+    """Positional parameter names of a def, posonly included. Shared AST
+    helper (STX008/STX012 name<->position cross-mapping)."""
+    args = fn.args
+    return [p.arg for p in list(getattr(args, "posonlyargs", [])) + list(args.args)]
+
+
+def all_param_names(args: ast.arguments) -> Set[str]:
+    """EVERY parameter name of a def/lambda — posonly, positional, kwonly,
+    *vararg, **kwarg. Shared AST helper (STX010/011/013 parameter-shadowing:
+    a parameter is a fresh caller value, never another scope's binding)."""
+    return {
+        p.arg
+        for p in (
+            list(getattr(args, "posonlyargs", []))
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        )
+    }
 
 
 def walk_scope(node: ast.AST) -> Iterator[ast.AST]:

@@ -1,0 +1,606 @@
+"""Repo-wide static model of mesh construction and sharding expressions.
+
+The scale arc (pod-scale Sebulba, gossip learner groups, the unified
+mesh-role abstraction) rewrites the device-placement layer of ~37 files that
+use `PartitionSpec`/`NamedSharding`/`shard_map`. A misspelled mesh axis or a
+spec/rank mismatch compiles fine on the CPU fallback and only explodes on a
+real multi-device run — or worse, silently replicates where it should reduce.
+This module gives the STX010-STX013 rules one shared model of BOTH sides:
+
+  Declaration side — which mesh axes exist, and per mesh binding, which axes
+  THAT mesh has:
+
+    * `Mesh(dev_array, ("data", "seq"))` / `jax.make_mesh(shape, axis_names)`
+      axis-name tuple literals, anywhere in the scanned tree;
+    * `create_mesh({"data": -1, "model": 2})` dict-literal specs (the
+      stoix_tpu/parallel factory), plus the `{str: int}` dict-literal mesh
+      specs inside `stoix_tpu/parallel/` itself (the factory's own default);
+    * `mesh:` mapping keys in `stoix_tpu/configs/**/*.yaml` (runner.py builds
+      the mesh from `config.arch.mesh`, so YAML is a declaration site);
+    * vmap/pmap `axis_name=` literals are deliberately NOT part of the
+      PartitionSpec universe — a vmap axis is not a mesh axis, which is
+      exactly the conflation STX007 tolerates and STX010 does not.
+
+  Use side — every sharding expression, resolved through the same
+  module-local name machinery as `jitreach.py`:
+
+    * `P(...)`/`PartitionSpec(...)` literals (entries: axis literal, `None`,
+      tuple-of-axes dims, or unresolvable expressions — tracked per slot);
+    * spec variables (`seq_spec = P(None, axis)`) resolved module-wide;
+    * `NamedSharding(mesh, spec)` — the spec is checked against the axes of
+      the mesh it statically flows with when the mesh binding resolves to a
+      constructor with literal axes, else against the repo-wide universe;
+    * `shard_map(fn, mesh=..., in_specs=..., out_specs=...)` sites with the
+      wrapped-callee expression kept for signature/body checks (STX011);
+    * `with_sharding_constraint(x, spec)` and
+      `make_array_from_single_device_arrays(shape, sharding, arrays)` (with
+      the literal-tuple shape rank when statically known, for arity checks).
+
+Known blind spots (docs/DESIGN.md §2.5): meshes built from config at runtime
+(`create_mesh(dict(config.arch.mesh))` falls back to the universe, which the
+YAML scan keeps honest), meshes threaded through containers or attributes
+(`self.mesh`), axis names passed as variables (axis-generic library code is
+skipped per slot, never guessed), and specs constructed by helpers in other
+modules. Pure stdlib `ast` + `yaml`; no jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from stoix_tpu.analysis.jitreach import all_param_names as _all_param_names
+from stoix_tpu.analysis.jitreach import annotate_parents as _annotate_parents
+from stoix_tpu.analysis.jitreach import assigned_names as _assigned_names
+from stoix_tpu.analysis.jitreach import callee_name as _callee_name
+from stoix_tpu.analysis.jitreach import literal_str_set as _literal_str_set
+
+_SPEC_CTORS = {"P", "PartitionSpec"}
+_MESH_CTORS = {"Mesh", "make_mesh", "create_mesh"}
+# Declaration scan covers every path the gate lints plus the top-level bench
+# entry points that build real meshes (scaling_bench is not in DEFAULT_PATHS).
+_DECL_SCAN_PATHS = (
+    "stoix_tpu",
+    "tests",
+    "scripts",
+    "bench.py",
+    "scaling_bench.py",
+    "__graft_entry__.py",
+)
+
+
+# ---------------------------------------------------------------------------
+# Spec parsing
+
+
+@dataclass(frozen=True)
+class SpecEntry:
+    """One positional slot of a `P(...)`: the axis literals it names (a slot
+    may shard over several axes via a tuple) and whether the slot resolved."""
+
+    axes: Tuple[Tuple[str, int], ...]  # (axis, lineno) literals in this slot
+    known: bool  # False: slot holds a variable/expression we cannot resolve
+
+
+@dataclass
+class SpecInfo:
+    """A parsed sharding spec (`P("data", None)` → two entries)."""
+
+    lineno: int
+    entries: List[SpecEntry] = field(default_factory=list)
+    opaque: bool = False  # the whole spec expression was unresolvable
+
+    @property
+    def arity(self) -> int:
+        return len(self.entries)
+
+    @property
+    def closed(self) -> bool:
+        """Every slot statically resolved — absence of an axis is meaningful
+        (the spec genuinely claims replication over axes it does not name)."""
+        return not self.opaque and all(e.known for e in self.entries)
+
+    def literal_axes(self) -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+        for entry in self.entries:
+            out.extend(entry.axes)
+        return out
+
+    def mentions(self, axis: str) -> bool:
+        return any(a == axis for a, _ in self.literal_axes())
+
+
+def is_spec_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _callee_name(node.func) in _SPEC_CTORS
+
+
+# Names a binding target rebinds. `mesh.x = ...`/`mesh[i] = ...` mutate, they
+# do not rebind the base name, so Attribute/Subscript yield nothing — which is
+# exactly jitreach.assigned_names' contract.
+_target_names = _assigned_names
+
+
+def parse_spec_call(call: ast.Call) -> SpecInfo:
+    """Parse one `P(...)` call into per-slot entries."""
+    info = SpecInfo(lineno=call.lineno)
+    if call.keywords or any(isinstance(a, ast.Starred) for a in call.args):
+        # P(*dims) / unexpected kwargs: arity and absence claims unreliable.
+        info.opaque = True
+        return info
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and arg.value is None:
+            info.entries.append(SpecEntry(axes=(), known=True))
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            info.entries.append(SpecEntry(axes=((arg.value, arg.lineno),), known=True))
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            axes: List[Tuple[str, int]] = []
+            known = True
+            for elt in arg.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    axes.append((elt.value, elt.lineno))
+                else:
+                    known = False
+            info.entries.append(SpecEntry(axes=tuple(axes), known=known))
+        else:
+            # A variable slot (`P(None, axis)`): arity still counts, the slot
+            # could name any axis — never guess, never claim absence.
+            info.entries.append(SpecEntry(axes=(), known=False))
+    return info
+
+
+# ---------------------------------------------------------------------------
+# Mesh-constructor parsing
+
+
+def _literal_str_tuple(node: ast.AST) -> Optional[FrozenSet[str]]:
+    strs = _literal_str_set(node)
+    return None if strs is None else frozenset(strs)
+
+
+def _literal_axis_dict(node: ast.AST) -> Optional[FrozenSet[str]]:
+    """`{"data": -1, "model": 2}` → {"data", "model"} (int/-N sizes only)."""
+    if not isinstance(node, ast.Dict) or not node.keys:
+        return None
+    axes = set()
+    for key, value in zip(node.keys, node.values):
+        if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+            return None
+        if not (
+            (isinstance(value, ast.Constant) and isinstance(value.value, int))
+            or isinstance(value, ast.UnaryOp)
+        ):
+            return None
+        axes.add(key.value)
+    return frozenset(axes)
+
+
+def mesh_ctor_axes(node: ast.AST) -> Optional[FrozenSet[str]]:
+    """Axis names a mesh-constructor expression declares, when literal.
+
+    `Mesh(arr, ("data",))`, `jax.make_mesh(shape, ("data", "model"))` (or
+    `axis_names=`), `create_mesh({"data": -1})` (or `axes=`). None when the
+    expression is not a mesh constructor or its axes are not literal.
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    callee = _callee_name(node.func)
+    if callee == "Mesh" or callee == "make_mesh":
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                return _literal_str_tuple(kw.value)
+        if len(node.args) >= 2:
+            return _literal_str_tuple(node.args[1])
+        return None
+    if callee == "create_mesh":
+        for kw in node.keywords:
+            if kw.arg == "axes":
+                return _literal_axis_dict(kw.value)
+        if node.args:
+            return _literal_axis_dict(node.args[0])
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Repo-wide axis universe (cached per repo, like STX007's declared_axes)
+
+
+_universe_cache: Dict[str, FrozenSet[str]] = {}
+
+
+def mesh_axis_universe(repo: str) -> FrozenSet[str]:
+    """Every mesh axis any scanned file (or config YAML) declares.
+
+    The fallback oracle for specs whose governing mesh is not statically
+    resolvable: an axis in NO mesh constructor, parallel/ dict spec, or YAML
+    `mesh:` block anywhere cannot be valid on any path.
+    """
+    cached = _universe_cache.get(repo)
+    if cached is not None:
+        return cached
+    axes: Set[str] = set()
+    for rel in _DECL_SCAN_PATHS:
+        full = os.path.join(repo, rel)
+        files: List[str] = []
+        if os.path.isfile(full) and full.endswith(".py"):
+            files = [full]
+        elif os.path.isdir(full):
+            for root, dirs, names in os.walk(full):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names) if n.endswith(".py")
+                )
+        for path in files:
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            in_parallel = os.sep + "parallel" in path
+            for node in ast.walk(tree):
+                declared = mesh_ctor_axes(node)
+                if declared:
+                    axes |= declared
+                elif in_parallel:
+                    # The factory's own default spec ({"data": -1} inside
+                    # create_mesh's body) is a bare dict literal.
+                    bare = _literal_axis_dict(node)
+                    if bare:
+                        axes |= bare
+    axes |= _yaml_mesh_axes(repo)
+    out = frozenset(axes)
+    _universe_cache[repo] = out
+    return out
+
+
+def _yaml_mesh_axes(repo: str) -> Set[str]:
+    """Keys of every `mesh:` mapping under stoix_tpu/configs/ — runner.py
+    builds the mesh from `config.arch.mesh`, so YAML declares axes too."""
+    try:
+        import yaml
+    except ImportError:  # the gate must degrade, not crash, without pyyaml
+        return set()
+    axes: Set[str] = set()
+    configs = os.path.join(repo, "stoix_tpu", "configs")
+    for root, _dirs, names in os.walk(configs):
+        for name in sorted(names):
+            if not name.endswith((".yaml", ".yml")):
+                continue
+            try:
+                with open(os.path.join(root, name)) as f:
+                    data = yaml.safe_load(f.read()) or {}
+            except (OSError, yaml.YAMLError):
+                continue
+            stack = [data]
+            while stack:
+                current = stack.pop()
+                if not isinstance(current, dict):
+                    continue
+                for key, value in current.items():
+                    if key == "mesh" and isinstance(value, dict):
+                        axes.update(k for k in value if isinstance(k, str))
+                    elif isinstance(value, dict):
+                        stack.append(value)
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Per-module model
+
+
+@dataclass
+class MeshRef:
+    """A statically-resolved mesh a spec flows with."""
+
+    axes: FrozenSet[str]
+    lineno: int  # binding/constructor line, for the finding message
+    name: str = ""  # the variable name when bound ("" for inline ctors)
+
+    def describe(self) -> str:
+        where = f"'{self.name}' (line {self.lineno})" if self.name else f"line {self.lineno}"
+        return f"mesh {where} with axes {{{', '.join(sorted(self.axes))}}}"
+
+
+@dataclass
+class SpecUse:
+    """One sharding expression at its use site.
+
+    mesh is None when the governing mesh is not statically resolvable (check
+    axis literals against the repo universe instead); rank is the statically
+    known rank of the array the spec applies to, when any (only
+    `make_array_from_single_device_arrays` with a literal shape today).
+    """
+
+    spec: SpecInfo
+    context: str  # "P", "NamedSharding", "in_specs", "out_specs", ...
+    mesh: Optional[MeshRef] = None
+    rank: Optional[int] = None
+
+
+@dataclass
+class ShardMapSite:
+    """One `shard_map(fn, mesh=..., in_specs=..., out_specs=...)` call."""
+
+    call: ast.Call
+    fn_expr: Optional[ast.AST]
+    mesh: Optional[MeshRef]
+    in_specs_expr: Optional[ast.AST]
+    out_specs_expr: Optional[ast.AST]
+    in_top_arity: Optional[int]  # len() of a literal in_specs tuple, else None
+    in_leaves: List[SpecInfo] = field(default_factory=list)
+    out_leaves: List[SpecInfo] = field(default_factory=list)
+
+
+def for_context(ctx) -> "ModuleMeshModel":
+    """The per-file model, memoized on the FileContext so every consuming rule
+    (STX010/STX011) shares one build — and one "parents" map with STX012."""
+    parents = ctx.memo("parents", lambda: _annotate_parents(ctx.tree))
+    return ctx.memo("meshmodel", lambda: ModuleMeshModel(ctx.tree, parents=parents))
+
+
+class ModuleMeshModel:
+    """Mesh bindings, spec bindings, and every sharding use site of one file."""
+
+    def __init__(
+        self, tree: ast.AST, parents: Optional[Dict[int, ast.AST]] = None
+    ) -> None:
+        self.tree = tree
+        # name -> (axes, lineno); a name rebound to meshes with different
+        # axes keeps the UNION (conservative: only axes in neither flag).
+        self.mesh_bindings: Dict[str, MeshRef] = {}
+        self._mesh_unresolved: Set[str] = set()
+        # Spec names get the same rebind-poisoning discipline as mesh names:
+        # a name resolves to a P(...) literal only when EVERY module-wide
+        # binding of it is that single spec literal — any other binding
+        # (helper call, rebind, loop/with/tuple target) makes it ambiguous
+        # and uses fall back to an opaque leaf instead of a stale or
+        # other-scope spec (which would raise error-severity false STX010s).
+        self.spec_bindings: Dict[str, SpecInfo] = {}
+        self._spec_unresolved: Set[str] = set()
+        # Parent links, so resolve_mesh can see that a mesh NAME at a use
+        # site is a parameter of its enclosing function — a fresh caller
+        # value that must NOT resolve to some other scope's local binding.
+        self._parents = parents if parents is not None else _annotate_parents(tree)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                target = node.targets[0]
+                axes = mesh_ctor_axes(node.value)
+                if axes is not None:
+                    prior = self.mesh_bindings.get(target.id)
+                    merged = axes | prior.axes if prior else axes
+                    self.mesh_bindings[target.id] = MeshRef(
+                        axes=frozenset(merged), lineno=node.lineno, name=target.id
+                    )
+                else:
+                    # Any other RHS — a mesh ctor with non-literal axes, a
+                    # helper call, a same-scope rebind (`mesh = widen(mesh)`),
+                    # or an unrelated same-named local in another scope —
+                    # makes the NAME ambiguous module-wide: uses fall back to
+                    # the universe rather than a stale/other-scope binding.
+                    self._mesh_unresolved.add(target.id)
+                if is_spec_call(node.value):
+                    if target.id in self.spec_bindings:
+                        # Two spec-literal bindings of one name: whichever the
+                        # walk met first is stale on the other's paths.
+                        self._spec_unresolved.add(target.id)
+                    else:
+                        self.spec_bindings[target.id] = parse_spec_call(node.value)
+                else:
+                    self._spec_unresolved.add(target.id)
+            elif isinstance(node, ast.Assign):
+                for target in node.targets:
+                    self._poison(_target_names(target))
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                self._poison(_target_names(node.target))
+            elif isinstance(node, (ast.For, ast.AsyncFor, ast.comprehension)):
+                self._poison(_target_names(node.target))
+            elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+                self._poison(_target_names(node.optional_vars))
+            elif isinstance(node, ast.NamedExpr):
+                self._poison(_target_names(node.target))
+        self._collect_sites()
+
+    def _poison(self, names) -> None:
+        """A non-constructor binding form makes a name ambiguous for BOTH
+        mesh and spec resolution module-wide."""
+        names = list(names)
+        self._mesh_unresolved.update(names)
+        self._spec_unresolved.update(names)
+
+    # -- resolution helpers -------------------------------------------------
+
+    def _is_param_of_enclosing_fn(self, name_node: ast.Name) -> bool:
+        current = self._parents.get(id(name_node))
+        while current is not None:
+            if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                if name_node.id in _all_param_names(current.args):
+                    return True
+            current = self._parents.get(id(current))
+        return False
+
+    def resolve_mesh(self, expr: Optional[ast.AST]) -> Optional[MeshRef]:
+        if expr is None:
+            return None
+        inline = mesh_ctor_axes(expr)
+        if inline is not None:
+            return MeshRef(axes=inline, lineno=expr.lineno)
+        if isinstance(expr, ast.Name):
+            bound = self.mesh_bindings.get(expr.id)
+            if (
+                bound is not None
+                and expr.id not in self._mesh_unresolved
+                # A parameter shadows a same-named binding in ANOTHER scope:
+                # the caller's mesh is unknown — fall back to the universe.
+                and not self._is_param_of_enclosing_fn(expr)
+            ):
+                return bound
+        return None
+
+    def flatten_spec_expr(self, expr: ast.AST, depth: int = 0) -> List[SpecInfo]:
+        """Leaf SpecInfos of a (possibly composite) spec expression.
+
+        Composites follow the repo idiom: tuples/lists of specs, NamedTuple
+        constructor calls whose arguments are specs
+        (`CoreLearnerState(P(), P("data"), ...)`), dict values, and names
+        bound to spec literals module-wide. Anything else is one opaque leaf.
+        """
+        if depth > 6:
+            return [SpecInfo(lineno=getattr(expr, "lineno", 0), opaque=True)]
+        if is_spec_call(expr):
+            return [parse_spec_call(expr)]
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: List[SpecInfo] = []
+            for elt in expr.elts:
+                out.extend(self.flatten_spec_expr(elt, depth + 1))
+            return out
+        if isinstance(expr, ast.Dict):
+            out = []
+            for value in expr.values:
+                out.extend(self.flatten_spec_expr(value, depth + 1))
+            return out
+        if isinstance(expr, ast.Call):
+            # NamedTuple/dataclass state-spec constructors: specs ride the args.
+            parts: List[SpecInfo] = []
+            for arg in list(expr.args) + [kw.value for kw in expr.keywords]:
+                parts.extend(self.flatten_spec_expr(arg, depth + 1))
+            if parts:
+                return parts
+            return [SpecInfo(lineno=expr.lineno, opaque=True)]
+        if isinstance(expr, ast.Name):
+            bound = self.spec_bindings.get(expr.id)
+            if (
+                bound is not None
+                and expr.id not in self._spec_unresolved
+                # A parameter shadows a same-named spec in ANOTHER scope: the
+                # caller's spec is unknown — treat the leaf as opaque.
+                and not self._is_param_of_enclosing_fn(expr)
+            ):
+                return [bound]
+        return [SpecInfo(lineno=getattr(expr, "lineno", 0), opaque=True)]
+
+    # -- site collection ----------------------------------------------------
+
+    def _collect_sites(self) -> None:
+        self.spec_uses: List[SpecUse] = []
+        self.shard_map_sites: List[ShardMapSite] = []
+        # ast node ids of P(...) calls consumed by a governed site, so the
+        # final free-spec pass checks each literal exactly once. A spec
+        # BINDING consumed by several sites is checked per consuming site
+        # with that site's mesh (rules dedupe findings by line+axis).
+        governed: Set[int] = set()
+
+        def claim(expr: ast.AST) -> None:
+            for node in ast.walk(expr):
+                if is_spec_call(node):
+                    governed.add(id(node))
+
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee_name(node.func)
+            if callee == "NamedSharding":
+                mesh_expr = node.args[0] if node.args else None
+                spec_expr = node.args[1] if len(node.args) >= 2 else None
+                for kw in node.keywords:
+                    if kw.arg == "mesh":
+                        mesh_expr = kw.value
+                    elif kw.arg == "spec":
+                        spec_expr = kw.value
+                if spec_expr is None:
+                    continue
+                mesh = self.resolve_mesh(mesh_expr)
+                claim(spec_expr)
+                for spec in self.flatten_spec_expr(spec_expr):
+                    self.spec_uses.append(SpecUse(spec, "NamedSharding", mesh=mesh))
+            elif callee == "shard_map":
+                self._collect_shard_map(node, claim)
+            elif callee == "with_sharding_constraint":
+                spec_expr = node.args[1] if len(node.args) >= 2 else None
+                for kw in node.keywords:
+                    if kw.arg in ("shardings", "spec"):
+                        spec_expr = kw.value
+                if spec_expr is None:
+                    continue
+                claim(spec_expr)
+                for spec in self.flatten_spec_expr(spec_expr):
+                    self.spec_uses.append(
+                        SpecUse(spec, "with_sharding_constraint", mesh=None)
+                    )
+            elif callee == "make_array_from_single_device_arrays":
+                shape_expr = node.args[0] if node.args else None
+                sharding_expr = node.args[1] if len(node.args) >= 2 else None
+                for kw in node.keywords:
+                    if kw.arg == "shape":
+                        shape_expr = kw.value
+                    elif kw.arg == "sharding":
+                        sharding_expr = kw.value
+                rank = None
+                if isinstance(shape_expr, (ast.Tuple, ast.List)):
+                    rank = len(shape_expr.elts)
+                if sharding_expr is None:
+                    continue
+                # The sharding is usually an inline NamedSharding(mesh, spec):
+                # attach the rank to its spec leaves; the NamedSharding branch
+                # above re-checks axis validity for the same leaves, so only
+                # rank rides this use (context keeps findings deduplicable).
+                mesh = None
+                spec_expr = sharding_expr
+                if (
+                    isinstance(sharding_expr, ast.Call)
+                    and _callee_name(sharding_expr.func) == "NamedSharding"
+                    and len(sharding_expr.args) >= 2
+                ):
+                    mesh = self.resolve_mesh(sharding_expr.args[0])
+                    spec_expr = sharding_expr.args[1]
+                else:
+                    claim(spec_expr)
+                for spec in self.flatten_spec_expr(spec_expr):
+                    self.spec_uses.append(
+                        SpecUse(spec, "make_array_shape", mesh=mesh, rank=rank)
+                    )
+
+        # Free P(...) literals: checked against the universe exactly once.
+        for node in ast.walk(self.tree):
+            if is_spec_call(node) and id(node) not in governed:
+                self.spec_uses.append(SpecUse(parse_spec_call(node), "P", mesh=None))
+
+    def _collect_shard_map(self, node: ast.Call, claim) -> None:
+        fn_expr = node.args[0] if node.args else None
+        mesh_expr = node.args[1] if len(node.args) >= 2 else None
+        in_expr = node.args[2] if len(node.args) >= 3 else None
+        out_expr = node.args[3] if len(node.args) >= 4 else None
+        for kw in node.keywords:
+            if kw.arg == "mesh":
+                mesh_expr = kw.value
+            elif kw.arg == "in_specs":
+                in_expr = kw.value
+            elif kw.arg == "out_specs":
+                out_expr = kw.value
+        mesh = self.resolve_mesh(mesh_expr)
+        site = ShardMapSite(
+            call=node,
+            fn_expr=fn_expr,
+            mesh=mesh,
+            in_specs_expr=in_expr,
+            out_specs_expr=out_expr,
+            in_top_arity=(
+                len(in_expr.elts) if isinstance(in_expr, (ast.Tuple, ast.List)) else None
+            ),
+        )
+        for expr, context, leaves in (
+            (in_expr, "in_specs", site.in_leaves),
+            (out_expr, "out_specs", site.out_leaves),
+        ):
+            if expr is None:
+                continue
+            claim(expr)
+            for spec in self.flatten_spec_expr(expr):
+                leaves.append(spec)
+                self.spec_uses.append(SpecUse(spec, context, mesh=mesh))
+        self.shard_map_sites.append(site)

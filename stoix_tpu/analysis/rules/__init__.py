@@ -16,3 +16,7 @@ from stoix_tpu.analysis.rules import stx006_host_transfer  # noqa: F401
 from stoix_tpu.analysis.rules import stx007_collective_axes  # noqa: F401
 from stoix_tpu.analysis.rules import stx008_donation  # noqa: F401
 from stoix_tpu.analysis.rules import stx009_config_crosscheck  # noqa: F401
+from stoix_tpu.analysis.rules import stx010_spec_validity  # noqa: F401
+from stoix_tpu.analysis.rules import stx011_shardmap_contract  # noqa: F401
+from stoix_tpu.analysis.rules import stx012_recompile_hazard  # noqa: F401
+from stoix_tpu.analysis.rules import stx013_host_divergence  # noqa: F401

@@ -133,7 +133,17 @@ RULE_HYGIENE = register(
 # Codes whose suppression must be auditable: the JAX-aware rules, where a
 # noqa waives a correctness tripwire (legacy F401/E501/STX001-004 keep their
 # historical reason-optional substring semantics — migrated unchanged).
-_REASON_REQUIRED = {"STX005", "STX006", "STX007", "STX008", "STX009"}
+_REASON_REQUIRED = {
+    "STX005",
+    "STX006",
+    "STX007",
+    "STX008",
+    "STX009",
+    "STX010",
+    "STX011",
+    "STX012",
+    "STX013",
+}
 _NOQA_DIRECTIVE = re.compile(r"#\s*noqa\b:?\s*([^#]*)", re.IGNORECASE)
 _NOQA_CODE = re.compile(r"[A-Z]+[0-9]+")
 

@@ -8,15 +8,27 @@ one). The pipelined runner's whole snapshot discipline exists because of this
 (docs/DESIGN.md §2.1, systems/anakin.py `shardmap_learner`).
 
 Detection: file-wide, find bindings `step = jax.jit(fn, donate_argnums=...)`
-(and `@partial(jax.jit, donate_argnums=...)` decorated defs) with a LITERAL
-argnums; then, per scope, a `Name` passed at a donated position whose value
-is loaded again after the call — without an intervening rebind — is flagged.
-Rebinding (`state = step(state)`) is the blessed idiom and resets tracking.
+(and `@partial(jax.jit, donate_argnums=...)` decorated defs); then, per
+scope, a `Name` passed at a donated position whose value is loaded again
+after the call — without an intervening rebind — is flagged. Rebinding
+(`state = step(state)`) is the blessed idiom and resets tracking. Three
+donation-declaration forms resolve (the first two closed PR 5's documented
+blind spot):
 
-Blind spots (docs/DESIGN.md §2.5): `donate_argnums` built dynamically
-(`**donate` — the runner's kill-switch pattern), donation through
-`donate_argnames`, aliasing, and cross-function escapes. The rule is a
-tripwire for the common refactor accident, not a proof of safety.
+  * `donate_argnames=("state",)` — mapped to positions through the wrapped
+    function's signature when it resolves module-locally, and matched against
+    KEYWORD arguments at call sites either way;
+  * `jax.jit(fn, **donate)` / `@partial(jax.jit, **donate)` where `donate`
+    is assigned a dict literal anywhere in the file — including the runner's
+    kill-switch idiom `{} if os.environ.get(...) else {"donate_argnums":
+    (0,)}`. The donating branch is taken (donation OFF is the degraded mode;
+    a read-after-donate is a bug whenever the switch is on);
+  * positional/keyword literal `donate_argnums=` as before.
+
+Blind spots (docs/DESIGN.md §2.5): donation kwargs built outside the file or
+via dict() calls/unpacking-of-unpacking, aliasing, and cross-function
+escapes. The rule is a tripwire for the common refactor accident, not a
+proof of safety.
 """
 
 from __future__ import annotations
@@ -26,56 +38,135 @@ import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis.jitreach import _ModuleIndex
 from stoix_tpu.analysis.jitreach import assigned_names as _assigned_names
 from stoix_tpu.analysis.jitreach import callee_name as _callee_name
+from stoix_tpu.analysis.jitreach import literal_int_set as _literal_ints
+from stoix_tpu.analysis.jitreach import literal_str_set as _literal_strs
+from stoix_tpu.analysis.jitreach import positional_params as _positional_params
+from stoix_tpu.analysis.jitreach import walk_scope as _walk_scope
 
 
-def _literal_argnums(call: ast.Call) -> Optional[Set[int]]:
-    for kw in call.keywords:
-        if kw.arg != "donate_argnums":
+class _Donor:
+    """Donated positions AND parameter names of one jitted binding, cross-
+    mapped through the wrapped signature when it resolves (so positional and
+    keyword call sites are both covered)."""
+
+    def __init__(
+        self, positions: Set[int], names: Set[str], params: Optional[List[str]]
+    ) -> None:
+        self.positions = set(positions)
+        self.names = set(names)
+        if params is not None:
+            self.positions |= {params.index(n) for n in names if n in params}
+            self.names |= {params[i] for i in positions if i < len(params)}
+
+
+def _dict_donation(node: ast.AST) -> Tuple[Set[int], Set[str]]:
+    """Donation markers in any dict LITERAL inside `node` — resolves the
+    kill-switch idiom `{} if os.environ.get(...) else {"donate_argnums":
+    (0,)}` by taking the donating branch (the mode the code must be safe in)."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for d in ast.walk(node):
+        if not isinstance(d, ast.Dict):
             continue
-        value = kw.value
-        if isinstance(value, ast.Constant) and isinstance(value.value, int):
-            return {value.value}
-        if isinstance(value, (ast.Tuple, ast.List)):
-            out = set()
-            for elt in value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
-                    out.add(elt.value)
-                else:
-                    return None
-            return out
-    return None
+        for key, value in zip(d.keys, d.values):
+            if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+                continue
+            if key.value == "donate_argnums":
+                nums |= _literal_ints(value) or set()
+            elif key.value == "donate_argnames":
+                names |= _literal_strs(value) or set()
+    return nums, names
 
 
-def _donating_bindings(tree: ast.AST) -> Dict[str, Set[int]]:
-    """name -> donated positions, for jit-with-donation bindings and
-    @partial(jax.jit, donate_argnums=...) decorated functions."""
-    donors: Dict[str, Set[int]] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1:
-            target = node.targets[0]
-            value = node.value
-            if (
-                isinstance(target, ast.Name)
-                and isinstance(value, ast.Call)
-                and _callee_name(value.func) == "jit"
-            ):
-                argnums = _literal_argnums(value)
-                if argnums:
-                    donors[target.id] = argnums
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for deco in node.decorator_list:
-                if isinstance(deco, ast.Call) and _callee_name(deco.func) in (
-                    "jit",
-                    "partial",
+def _scope_kws_map(
+    scope: ast.AST, base: Dict[str, Tuple[Set[int], Set[str]]]
+) -> Dict[str, Tuple[Set[int], Set[str]]]:
+    """name -> donation markers for variables assigned a donation-dict
+    expression IN THIS SCOPE (nested defs excluded), over `base` (the module
+    map) — an unrelated function's local `kws` must not contaminate a
+    same-named binding elsewhere."""
+    out = dict(base)
+    for node in _walk_scope(scope):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        nums, names = _dict_donation(node.value)
+        if nums or names:
+            prior = out.get(target.id, (set(), set()))
+            out[target.id] = (prior[0] | nums, prior[1] | names)
+    return out
+
+
+def _donation_markers(
+    call: ast.Call, kws_map: Dict[str, Tuple[Set[int], Set[str]]]
+) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums |= _literal_ints(kw.value) or set()
+        elif kw.arg == "donate_argnames":
+            names |= _literal_strs(kw.value) or set()
+        elif kw.arg is None and isinstance(kw.value, ast.Name):
+            extra_nums, extra_names = kws_map.get(kw.value.id, (set(), set()))
+            nums |= extra_nums
+            names |= extra_names
+    return nums, names
+
+
+def _donating_bindings(tree: ast.AST, index: _ModuleIndex) -> Dict[str, _Donor]:
+    """name -> donor info, for jit-with-donation bindings and
+    @partial(jax.jit, ...)/@jax.jit(...) decorated functions, covering
+    literal donate_argnums=, donate_argnames=, and resolvable `**kws`
+    (resolved scope-aware: the enclosing function's bindings over the
+    module's)."""
+    donors: Dict[str, _Donor] = {}
+
+    def handle_scope(scope: ast.AST, kws_map: Dict[str, Tuple[Set[int], Set[str]]]) -> None:
+        for node in _walk_scope(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                value = node.value
+                if (
+                    isinstance(target, ast.Name)
+                    and isinstance(value, ast.Call)
+                    and _callee_name(value.func) == "jit"
                 ):
-                    argnums = _literal_argnums(deco)
-                    if argnums and (
-                        _callee_name(deco.func) == "jit"
-                        or any(_callee_name(a) == "jit" for a in deco.args)
+                    nums, names = _donation_markers(value, kws_map)
+                    if not nums and not names:
+                        continue
+                    params: Optional[List[str]] = None
+                    if value.args and isinstance(value.args[0], ast.Name):
+                        defs = index.functions.get(value.args[0].id, [])
+                        if len(defs) == 1:
+                            params = _positional_params(defs[0])
+                    donors[target.id] = _Donor(nums, names, params)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if isinstance(deco, ast.Call) and _callee_name(deco.func) in (
+                        "jit",
+                        "partial",
                     ):
-                        donors[node.name] = argnums
+                        if _callee_name(deco.func) != "jit" and not any(
+                            _callee_name(a) == "jit" for a in deco.args
+                        ):
+                            continue
+                        nums, names = _donation_markers(deco, kws_map)
+                        if nums or names:
+                            donors[node.name] = _Donor(
+                                nums, names, _positional_params(node)
+                            )
+
+    module_map = _scope_kws_map(tree, {})
+    handle_scope(tree, module_map)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            handle_scope(node, _scope_kws_map(node, module_map))
     return donors
 
 
@@ -83,7 +174,7 @@ class _DonationFlow:
     """Per-scope statement-ordered scan: donated names -> first donation site;
     a later load before a rebind is a use-after-donate."""
 
-    def __init__(self, rule: Rule, ctx: FileContext, donors: Dict[str, Set[int]]) -> None:
+    def __init__(self, rule: Rule, ctx: FileContext, donors: Dict[str, _Donor]) -> None:
         self.rule = rule
         self.ctx = ctx
         self.donors = donors
@@ -105,22 +196,27 @@ class _DonationFlow:
             stack.extend(ast.iter_child_nodes(node))
         for call in calls:
             fname = _callee_name(call.func)
-            positions = self.donors.get(fname)
-            if not positions or not isinstance(call.func, ast.Name):
+            donor = self.donors.get(fname)
+            if donor is None or not isinstance(call.func, ast.Name):
                 continue
-            for pos in positions:
+            donated_args: List[ast.Name] = []
+            for pos in donor.positions:
                 if pos < len(call.args) and isinstance(call.args[pos], ast.Name):
-                    arg = call.args[pos]
-                    donated_nodes.add(arg)
-                    events.append(
-                        (
-                            call.end_lineno or call.lineno,
-                            getattr(call, "end_col_offset", 0),
-                            "donate",
-                            arg.id,
-                            fname,
-                        )
+                    donated_args.append(call.args[pos])
+            for kw in call.keywords:
+                if kw.arg in donor.names and isinstance(kw.value, ast.Name):
+                    donated_args.append(kw.value)
+            for arg in donated_args:
+                donated_nodes.add(arg)
+                events.append(
+                    (
+                        call.end_lineno or call.lineno,
+                        getattr(call, "end_col_offset", 0),
+                        "donate",
+                        arg.id,
+                        fname,
                     )
+                )
         for node in ast.walk(expr):
             if (
                 isinstance(node, ast.Name)
@@ -150,7 +246,7 @@ class _DonationFlow:
                             self.ctx.rel,
                             lineno,
                             f"'{name}' is read after being donated to "
-                            f"'{via}' (donate_argnums) at line {donated_line} "
+                            f"'{via}' at line {donated_line} "
                             f"— donated buffers may already be reused; "
                             f"snapshot before the call or rebind the result "
                             f"(STX008)",
@@ -212,7 +308,8 @@ class _DonationFlow:
 def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
     if not ctx.rel.startswith("stoix_tpu" + os.sep):
         return []
-    donors = _donating_bindings(ctx.tree)
+    index = ctx.memo("module_index", lambda: _ModuleIndex(ctx.tree))
+    donors = _donating_bindings(ctx.tree, index)
     if not donors:
         return []
     findings: List[Finding] = []
@@ -243,6 +340,21 @@ RULE = register(
             "    out = step(state, batch)\n"
             "    loss = state.loss\n"
             "    return out, loss\n",
+            # donate_argnames: resolved through the wrapped signature, so the
+            # POSITIONAL callsite is still covered.
+            "import jax\n\n\ndef update(state, batch):\n"
+            "    return state\n\n\n"
+            'step = jax.jit(update, donate_argnames=("state",))\n\n\n'
+            "def run(state, batch):\n"
+            "    out = step(state, batch)\n"
+            "    return out, state.loss\n",
+            # The **donate kill-switch idiom (runner.py/anakin.py): the
+            # donating branch is taken — donation-on must be safe.
+            "import jax, os\n\ndonate = {} if os.environ.get('NO_DONATE') "
+            "else {'donate_argnums': (0,)}\nstep = jax.jit(update, **donate)\n\n\n"
+            "def run(state):\n"
+            "    out = step(state)\n"
+            "    return out, state\n",
         ),
         clean_snippets=(
             # Rebinding the result is the blessed idiom.
@@ -255,6 +367,14 @@ RULE = register(
             "def run(state, batch):\n"
             "    out = step(state, batch)\n"
             "    return out, batch.shape\n",
+            # donate_argnames with the result rebound; the non-donated batch
+            # keyword stays readable.
+            "import jax\n\n\ndef update(state, batch):\n"
+            "    return state\n\n\n"
+            'step = jax.jit(update, donate_argnames=("state",))\n\n\n'
+            "def run(state, batch):\n"
+            "    state = step(state, batch=batch)\n"
+            "    return state, batch.shape\n",
         ),
     )
 )

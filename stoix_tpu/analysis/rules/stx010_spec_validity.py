@@ -1,0 +1,166 @@
+"""STX010 — sharding-spec validity against the mesh it flows with.
+
+Every axis literal in a `P(...)`/`PartitionSpec(...)` — whether it rides a
+`NamedSharding`, a `shard_map` `in_specs`/`out_specs`, a
+`with_sharding_constraint`, or a bare state-spec NamedTuple — must name an
+axis that can exist:
+
+  * when the governing mesh is statically resolvable in the same module
+    (`learner_mesh = Mesh(devs, ("data",))` then
+    `NamedSharding(learner_mesh, P("model"))`), the axis must be an axis of
+    THAT mesh — "model" existing on some other mesh elsewhere does not save
+    it;
+  * otherwise (mesh is a function parameter, built from config, ...) the
+    axis must exist in the repo-wide universe of declared mesh axes
+    (`meshmodel.mesh_axis_universe`): an axis no mesh constructor, parallel/
+    dict spec, or configs YAML `mesh:` block anywhere declares cannot be
+    valid on any path.
+
+Spec arity is additionally checked against statically-known array rank: a
+`make_array_from_single_device_arrays(shape, NamedSharding(mesh, spec), ...)`
+whose shape is a literal tuple must not carry a spec with more entries than
+the shape has dims (jax raises at runtime — on the multi-device run the CPU
+fallback never exercises).
+
+Unlike STX007 (collective axis names, which vmap/pmap declare), vmap axes are
+NOT valid PartitionSpec axes here: `P("batch")` over the in-shard vmap axis
+is exactly the confusion the mesh model exists to catch. Axis slots holding
+VARIABLES (`P(None, axis)` in axis-generic library code) are skipped per
+slot, never guessed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Set, Tuple
+
+from stoix_tpu.analysis import meshmodel
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+
+
+def _axis_findings(
+    rule: Rule, ctx: FileContext, use: meshmodel.SpecUse, universe
+) -> List[Tuple[str, Finding]]:
+    """(axis, finding) pairs — the axis rides alongside so the caller's
+    line+axis dedup never has to re-parse it out of the rendered message."""
+    findings: List[Tuple[str, Finding]] = []
+    if use.mesh is not None:
+        allowed = use.mesh.axes
+        where = use.mesh.describe()
+    else:
+        allowed = universe
+        where = (
+            "any mesh constructor, stoix_tpu/parallel/ spec, or configs "
+            f"YAML mesh block (known axes: {', '.join(sorted(universe)) or '<none>'})"
+        )
+    for axis, lineno in use.spec.literal_axes():
+        if axis in allowed or ctx.noqa(lineno, rule.id):
+            continue
+        findings.append(
+            (
+                axis,
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    lineno,
+                    f"sharding spec names axis '{axis}' which is not declared "
+                    f"by {where} — this spec only explodes (or silently "
+                    f"misplaces data) on a real multi-device run (STX010)",
+                ),
+            )
+        )
+    return findings
+
+
+def _rank_finding(
+    rule: Rule, ctx: FileContext, use: meshmodel.SpecUse
+) -> Optional[Finding]:
+    if use.rank is None or use.spec.opaque or use.spec.arity <= use.rank:
+        return None
+    if ctx.noqa(use.spec.lineno, rule.id):
+        return None
+    return Finding(
+        rule.id,
+        ctx.rel,
+        use.spec.lineno,
+        f"sharding spec has {use.spec.arity} entries but the array it is "
+        f"applied to has rank {use.rank} — jax rejects a PartitionSpec "
+        f"longer than the array rank at runtime (STX010)",
+    )
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep):
+        return []
+    model = meshmodel.for_context(ctx)
+    if not model.spec_uses:
+        return []
+    universe = meshmodel.mesh_axis_universe(ctx.repo)
+    findings: List[Finding] = []
+    seen: Set[Tuple[int, str]] = set()
+    # Mesh-governed uses first: their finding is strictly more specific than
+    # the universe fallback for the same literal (a spec binding consumed by
+    # several sites is checked once per site; dedupe by line+axis).
+    ordered = sorted(model.spec_uses, key=lambda u: u.mesh is None)
+    for use in ordered:
+        for axis, f in _axis_findings(rule, ctx, use, universe):
+            if (f.line, axis) not in seen:
+                seen.add((f.line, axis))
+                findings.append(f)
+        rank_f = _rank_finding(rule, ctx, use)
+        if rank_f is not None and (rank_f.line, "<rank>") not in seen:
+            seen.add((rank_f.line, "<rank>"))
+            findings.append(rank_f)
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX010",
+        order=96,
+        title="sharding-spec validity vs governing mesh",
+        rationale="A P() axis the governing mesh (or any mesh) never "
+        "declares, or a spec longer than the array's rank, compiles fine on "
+        "the single-device CPU fallback and fails — or silently misplaces "
+        "data — on the multi-device run the spec exists for.",
+        check_file=_check,
+        flag_snippets=(
+            # Axis valid SOMEWHERE but not on the mesh this spec flows with:
+            # the mesh-local resolution STX007 cannot do.
+            "import numpy as np\nfrom jax.sharding import Mesh, NamedSharding, "
+            "PartitionSpec as P\n\n\ndef place(devices, params):\n"
+            '    learner_mesh = Mesh(np.array(devices), ("data",))\n'
+            '    return NamedSharding(learner_mesh, P("model"))\n',
+            # The classic typo against the repo universe (mesh unresolvable).
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n\n\n"
+            "def sharding(mesh):\n"
+            '    return NamedSharding(mesh, P("dtaa"))\n',
+            # Spec arity exceeding the statically-known global shape rank.
+            "import jax\nfrom jax.sharding import NamedSharding, "
+            "PartitionSpec as P\n\n\ndef assemble(mesh, shards):\n"
+            "    return jax.make_array_from_single_device_arrays(\n"
+            '        (8,), NamedSharding(mesh, P("data", None)), shards\n'
+            "    )\n",
+        ),
+        clean_snippets=(
+            # Matching mesh-local axis + universe axis through a parameter.
+            "import numpy as np\nfrom jax.sharding import Mesh, NamedSharding, "
+            "PartitionSpec as P\n\n\ndef place(devices, mesh, params):\n"
+            '    learner_mesh = Mesh(np.array(devices), ("data",))\n'
+            '    a = NamedSharding(learner_mesh, P("data"))\n'
+            '    b = NamedSharding(mesh, P(None, "data"))\n'
+            "    return a, b\n",
+            # Axis passed as a VARIABLE slot is axis-generic library code.
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n\n\n"
+            "def seq_sharding(mesh, axis):\n"
+            "    return NamedSharding(mesh, P(None, axis))\n",
+            # Arity within the literal rank.
+            "import jax\nfrom jax.sharding import NamedSharding, "
+            "PartitionSpec as P\n\n\ndef assemble(mesh, shards):\n"
+            "    return jax.make_array_from_single_device_arrays(\n"
+            '        (8, 4), NamedSharding(mesh, P("data", None)), shards\n'
+            "    )\n",
+        ),
+    )
+)

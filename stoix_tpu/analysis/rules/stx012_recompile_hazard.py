@@ -1,0 +1,355 @@
+"""STX012 — recompile hazards that defeat the (persistent) compile cache.
+
+ROADMAP item 3 wants a persistent XLA compilation cache + AOT export so a
+64-host fleet launch pays one compile, not 64 — which only helps if the code
+does not churn trace-cache keys by construction. Four hazard classes, all
+statically checkable (the taxonomy in docs/DESIGN.md §2.5):
+
+  (a) **jit-in-loop** — `jax.jit(...)`/`jax.pmap(...)` constructed inside a
+      `for`/`while` body: every iteration builds a FRESH callable with an
+      empty trace cache, so every iteration retraces (and at best re-hashes
+      into the persistent cache). Hoist to setup scope or memoize (the
+      `parallel.fetch_global_async` LRU is the blessed pattern).
+  (b) **loop-varying static** — a call to a jit-with-`static_argnums/names`
+      binding passing the enclosing loop's variable at a static position:
+      one full recompile per iteration, silently.
+  (c) **non-hashable static** — a list/dict/set (literal or comprehension)
+      at a static position: `TypeError: unhashable` at call time, i.e. at
+      launch, after the batch was scheduled.
+  (d) **static index out of range** — `static_argnums` naming a position the
+      wrapped function does not have (the refactor that removed a parameter
+      but not the argnums): fails at call time, or worse, after a signature
+      reshuffle silently marks the WRONG argument static.
+
+Deliberately out of scope (weak-typed Python scalars as traced args do NOT
+churn the cache; config reads inside jit-reachable code are trace-time
+constants and belong to STX009's cross-check): see the DESIGN §2.5 taxonomy
+for what was evaluated and rejected.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis.jitreach import _ModuleIndex, callee_name as _callee_name
+from stoix_tpu.analysis.jitreach import annotate_parents as _annotate_parents
+from stoix_tpu.analysis.jitreach import literal_int_set as _literal_ints
+from stoix_tpu.analysis.jitreach import literal_str_set as _literal_strs
+from stoix_tpu.analysis.jitreach import positional_params as _positional_params
+
+_JIT_CTORS = {"jit", "pmap"}
+_NON_HASHABLE = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+)
+
+
+def _static_markers(call: ast.Call) -> Tuple[Optional[Set[int]], Optional[Set[str]]]:
+    nums: Optional[Set[int]] = None
+    names: Optional[Set[str]] = None
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _literal_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _literal_strs(kw.value)
+    return nums, names
+
+
+class _StaticBinding:
+    """One jitted callable with literal static markers, by local name."""
+
+    def __init__(
+        self,
+        name: str,
+        argnums: Set[int],
+        argnames: Set[str],
+        params: Optional[List[str]],
+    ) -> None:
+        self.name = name
+        self.params = params  # wrapped def's positional params, when resolved
+        self.positions = set(argnums)
+        self.names = set(argnames)
+        if params is not None:
+            # Cross-map so positional AND keyword callsites are both covered.
+            self.names |= {params[i] for i in argnums if i < len(params)}
+            self.positions |= {params.index(n) for n in argnames if n in params}
+
+
+def _collect_bindings(
+    rule: Rule, ctx: FileContext, index: _ModuleIndex
+) -> Tuple[Dict[str, _StaticBinding], List[Finding]]:
+    """Static-marked jit bindings plus (d) out-of-range findings."""
+    bindings: Dict[str, _StaticBinding] = {}
+    findings: List[Finding] = []
+
+    def handle(name: str, jit_call: ast.Call, fn_expr: Optional[ast.AST]) -> None:
+        nums, names = _static_markers(jit_call)
+        if not nums and not names:
+            return
+        params: Optional[List[str]] = None
+        defs: List[ast.AST] = []
+        if isinstance(fn_expr, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs = [fn_expr]
+        elif isinstance(fn_expr, ast.Name):
+            defs = list(index.functions.get(fn_expr.id, []))
+        if len(defs) == 1:
+            params = _positional_params(defs[0])
+            # *args absorbs any static position — no out-of-range claim.
+            has_vararg = defs[0].args.vararg is not None
+            for pos in sorted(nums or ()):
+                if has_vararg:
+                    break
+                if pos >= len(params) and not ctx.noqa(jit_call.lineno, rule.id):
+                    findings.append(
+                        Finding(
+                            rule.id,
+                            ctx.rel,
+                            jit_call.lineno,
+                            f"static_argnums position {pos} is out of range "
+                            f"for the wrapped function ({len(params)} "
+                            f"positional parameter(s)) — a refactor hazard "
+                            f"that fails (or marks the wrong argument "
+                            f"static) at call time (STX012)",
+                        )
+                    )
+        bindings[name] = _StaticBinding(name, nums or set(), names or set(), params)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+            if (
+                isinstance(target, ast.Name)
+                and isinstance(value, ast.Call)
+                and _callee_name(value.func) in _JIT_CTORS
+            ):
+                handle(target.id, value, value.args[0] if value.args else None)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if not isinstance(deco, ast.Call):
+                    continue
+                callee = _callee_name(deco.func)
+                is_jit = callee in _JIT_CTORS or (
+                    callee == "partial"
+                    and any(_callee_name(a) in _JIT_CTORS for a in deco.args)
+                )
+                if is_jit:
+                    handle(node.name, deco, node)
+    return bindings, findings
+
+
+def _enclosing_loops(
+    node: ast.AST, parents: Dict[int, ast.AST]
+) -> List[ast.AST]:
+    """ALL for/while statements between `node` and its enclosing function —
+    an OUTER loop's counter reaching a static position from inside a nested
+    minibatch/epoch loop is the same one-recompile-per-outer-iteration
+    hazard (a function boundary means the loops do not re-execute the node)."""
+    loops: List[ast.AST] = []
+    current = parents.get(id(node))
+    while current is not None:
+        if isinstance(current, (ast.For, ast.AsyncFor, ast.While)):
+            loops.append(current)
+        elif isinstance(
+            current, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+        ):
+            break
+        current = parents.get(id(current))
+    return loops
+
+
+def _loop_targets(loop: ast.AST) -> FrozenSet[str]:
+    """Names that vary per iteration: the for-target, loop-carried updates
+    (`i += 1` / `i = i + 1` — the while-counter idiom), and anything whose
+    assignment RHS transitively derives from those (`width = i * 2`). A name
+    assigned a loop-INVARIANT value inside the body (`width = 64`) is a
+    constant that compiles exactly once — flagging it at a static position
+    would fail correct code."""
+    from stoix_tpu.analysis.jitreach import assigned_names
+
+    varying: Set[str] = set()
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        varying |= set(assigned_names(loop.target))
+    assigns: List[Tuple[Set[str], Set[str]]] = []  # (targets, RHS load-names)
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            first = node.targets[0] if node.targets else None
+            if (
+                len(node.targets) == 1
+                and isinstance(first, (ast.Tuple, ast.List))
+                and isinstance(node.value, (ast.Tuple, ast.List))
+                and len(first.elts) == len(node.value.elts)
+                and not any(isinstance(e, ast.Starred) for e in first.elts)
+            ):
+                # `w, block = i, 64` pairs element-wise: only `w` derives
+                # from the iteration, `block` stays a loop-invariant constant.
+                for t_elt, v_elt in zip(first.elts, node.value.elts):
+                    assigns.append((set(assigned_names(t_elt)), _names_in(v_elt)))
+                continue
+            targets: Set[str] = set()
+            for target in node.targets:
+                targets |= set(assigned_names(target))
+            assigns.append((targets, _names_in(node.value)))
+        elif isinstance(node, ast.AugAssign):
+            # `i += 1` carries across iterations — inherently loop-varying.
+            varying |= set(assigned_names(node.target))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            assigns.append((set(assigned_names(node.target)), _names_in(node.value)))
+    # Self-referential plain assigns (`i = i + 1`) are loop-carried too.
+    for targets, rhs in assigns:
+        if targets & rhs:
+            varying |= targets
+    # Fixpoint: a target deriving from any varying name is itself varying.
+    changed = True
+    while changed:
+        changed = False
+        for targets, rhs in assigns:
+            if rhs & varying and not targets <= varying:
+                varying |= targets
+                changed = True
+    return frozenset(varying)
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {
+        n.id
+        for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _static_args_at_call(
+    call: ast.Call, binding: _StaticBinding
+) -> List[Tuple[ast.AST, str]]:
+    """(expr, how) for every argument landing at a static position."""
+    out: List[Tuple[ast.AST, str]] = []
+    for pos in binding.positions:
+        if pos < len(call.args) and not isinstance(call.args[pos], ast.Starred):
+            out.append((call.args[pos], f"position {pos}"))
+    for kw in call.keywords:
+        if kw.arg and kw.arg in binding.names:
+            out.append((kw.value, f"argument '{kw.arg}'"))
+    return out
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep):
+        return []
+    index = ctx.memo("module_index", lambda: _ModuleIndex(ctx.tree))
+    bindings, findings = _collect_bindings(rule, ctx, index)
+    parents = ctx.memo("parents", lambda: _annotate_parents(ctx.tree))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _callee_name(node.func)
+
+        # (a) jit/pmap constructed inside a loop body.
+        if callee in _JIT_CTORS:
+            if _enclosing_loops(node, parents) and not ctx.noqa(node.lineno, rule.id):
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        node.lineno,
+                        f"jax.{callee}() constructed inside a loop builds a "
+                        f"fresh callable with an empty trace cache every "
+                        f"iteration — hoist to setup scope or memoize like "
+                        f"parallel.fetch_global_async (STX012)",
+                    )
+                )
+            continue
+
+        # (b)/(c): callsites of static-marked bindings.
+        binding = bindings.get(callee) if isinstance(node.func, ast.Name) else None
+        if binding is None:
+            continue
+        loop_vars: FrozenSet[str] = frozenset().union(
+            *(_loop_targets(loop) for loop in _enclosing_loops(node, parents))
+        )
+        for expr, where in _static_args_at_call(node, binding):
+            if ctx.noqa(expr.lineno, rule.id):
+                continue
+            if isinstance(expr, _NON_HASHABLE):
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        expr.lineno,
+                        f"non-hashable value at static {where} of "
+                        f"'{binding.name}' — static arguments are dict keys "
+                        f"of the trace cache and TypeError at call time "
+                        f"(STX012)",
+                    )
+                )
+            elif loop_vars and (_names_in(expr) & loop_vars):
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        expr.lineno,
+                        f"loop variable flows into static {where} of "
+                        f"'{binding.name}' — one full XLA recompile per "
+                        f"iteration, defeating the (persistent) compile "
+                        f"cache (STX012)",
+                    )
+                )
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX012",
+        order=98,
+        title="recompile hazards (trace-cache churn)",
+        rationale="A jit built per loop iteration, a loop counter at a "
+        "static position, a non-hashable static, or an out-of-range "
+        "static_argnums each turn the compile cache into a per-step "
+        "compile — invisible on a CPU smoke test, ruinous on a 64-host "
+        "fleet launch.",
+        check_file=_check,
+        flag_snippets=(
+            # (a) jit constructed per iteration.
+            "import jax\n\n\ndef run(fns, x):\n"
+            "    outs = []\n"
+            "    for f in fns:\n"
+            "        outs.append(jax.jit(f)(x))\n"
+            "    return outs\n",
+            # (b) the loop counter lands at a static position.
+            "import jax\n\nstep = jax.jit(update, static_argnums=(1,))\n\n\n"
+            "def run(state, n):\n"
+            "    for i in range(n):\n"
+            "        state = step(state, i)\n"
+            "    return state\n",
+            # (c) non-hashable static.
+            "import jax\n\nstep = jax.jit(update, static_argnums=(1,))\n\n\n"
+            "def run(state):\n"
+            "    return step(state, [64, 64])\n",
+            # (d) static position a refactor removed.
+            "import jax\n\n\ndef update(state):\n"
+            "    return state\n\n\nstep = jax.jit(update, static_argnums=(2,))\n",
+        ),
+        clean_snippets=(
+            # jit at setup scope, called (not built) in the loop, with a
+            # hashable module-constant static.
+            "import jax\n\nBLOCK = (64, 64)\n"
+            "step = jax.jit(update, static_argnums=(1,))\n\n\n"
+            "def run(state, n):\n"
+            "    for _ in range(n):\n"
+            "        state = step(state, BLOCK)\n"
+            "    return state\n",
+            # In-range static on a resolvable def; tuple literal is hashable.
+            "import jax\n\n\ndef update(state, block):\n"
+            "    return state\n\n\nstep = jax.jit(update, static_argnums=(1,))\n"
+            "out = step(init, (8, 8))\n",
+        ),
+    )
+)

@@ -1,0 +1,403 @@
+"""STX013 — host-divergence hazards on the multi-host SPMD path.
+
+Multi-host JAX is single-program-multiple-data: every process must execute
+the SAME sequence of compiled programs with the SAME trace-time constants,
+or collectives deadlock / silently mix mismatched values. A value that
+differs per host — wall-clock time, unseeded RNG draws, environment
+variables, filesystem listings — is fine for logging, and poison the moment
+it reaches a traced program or a cross-host collective. Two detection modes:
+
+  1. **Trace-time divergence**: a divergent source CALLED inside
+     jit-reachable code (per `jitreach`). Each host traces a different
+     constant into the HLO → different programs → the all-reduce that
+     "should" line up deadlocks, usually minutes into a pod launch.
+
+  2. **Host-to-device taint**: a variable assigned from a divergent source
+     (module scope taints flow into function scopes) that is later passed as
+     an argument to a known-jitted binding or a cross-host collective helper
+     (`process_allgather`, `fetch_global`, raw `psum`/`pmean`...). Rebinding
+     from an untainted expression clears the taint.
+
+NOT flagged, deliberately: `jax.distributed.initialize(...)` consuming
+`os.environ` (the blessed SLURM coordination idiom — every host reads
+DIFFERENT process ids by design), divergent values that stay host-side
+(telemetry timestamps), and `jax.random.*` (keyed, deterministic). Cross-
+module flow is the usual jitreach blind spot; resilience/faultinject.py is
+allowlisted — injecting divergence is its job.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis.jitreach import (
+    _ModuleIndex,
+    all_param_names as _all_param_names,
+    assigned_names as _assigned_names,
+    callee_name as _callee_name,
+    reachable_jit_functions,
+    walk_scope,
+)
+from stoix_tpu.analysis.rules.stx007_collective_axes import _COLLECTIVES
+
+_ALLOWLIST = frozenset(
+    {
+        # Injecting per-host divergence (nan_loss at a step, wedges, crashes)
+        # is this module's entire purpose.
+        os.path.join("stoix_tpu", "resilience", "faultinject.py"),
+    }
+)
+
+_TIME_FNS = {
+    "time",
+    "time_ns",
+    "monotonic",
+    "monotonic_ns",
+    "perf_counter",
+    "perf_counter_ns",
+}
+_OS_FNS = {"getenv", "urandom", "getpid", "listdir", "uname"}
+_MISC = {
+    ("glob", "glob"),
+    ("socket", "gethostname"),
+    ("uuid", "uuid1"),
+    ("uuid", "uuid4"),
+}
+_JIT_CTORS = {"jit", "pmap"}
+# Callees whose RESULT is a jitted/collective callable when bound to a name.
+_JITTED_FACTORIES = {"shardmap_learner", "aot_warmup"}
+_COLLECTIVE_HELPERS = {
+    "process_allgather",
+    "fetch_global",
+    "fetch_global_async",
+    "broadcast_one_to_all",
+}
+
+
+def _dotted(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _jax_aliases(tree: ast.AST) -> FrozenSet[str]:
+    """Names this module binds to jax submodules: `from jax import random`
+    makes the bare name `random` KEYED jax.random, which the stdlib-random
+    heuristic must not flag (the rule's own exemption)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            if module == "jax" or module.startswith("jax."):
+                for alias in node.names:
+                    names.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith("jax.") and alias.asname:
+                    names.add(alias.asname)
+    return frozenset(names)
+
+
+def _divergent_call(call: ast.Call, jax_names: FrozenSet[str]) -> Optional[str]:
+    """A label when this call draws a per-host-divergent value."""
+    chain = _dotted(call.func)
+    if not chain:
+        return None
+    root, leaf = chain[0], chain[-1]
+    if root in jax_names:
+        # `from jax import random` / `import jax.random as random`: keyed,
+        # deterministic, shared-seed — deliberately NOT divergent.
+        return None
+    if root == "time" and leaf in _TIME_FNS and len(chain) == 2:
+        return f"time.{leaf}()"
+    if root == "os" and leaf in _OS_FNS:
+        return f"os.{leaf}()"
+    if chain[:2] == ["os", "environ"] and len(chain) == 3:  # os.environ.get
+        return "os.environ"
+    if root == "random" and len(chain) == 2:
+        return f"random.{leaf}()"
+    if root in ("np", "numpy") and len(chain) >= 3 and chain[1] == "random":
+        if leaf == "default_rng" and (call.args or call.keywords):
+            # A SEEDED generator is deterministic per seed; if the seed
+            # itself is divergent, the taint rides the seed expression.
+            return None
+        return f"{root}.random.{leaf}()"
+    if "datetime" in chain and leaf in ("now", "utcnow", "today"):
+        return f"datetime.{leaf}()"
+    if leaf == "open" and len(chain) == 1:
+        return "open()"
+    if (root, leaf) in _MISC:
+        return f"{root}.{leaf}()"
+    return None
+
+
+def _divergent_expr(
+    expr: ast.AST, jax_names: FrozenSet[str]
+) -> Optional[Tuple[str, int]]:
+    """(label, lineno) of the first divergent source inside an expression
+    (calls and `os.environ[...]` subscripts)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            label = _divergent_call(node, jax_names)
+            if label:
+                return label, node.lineno
+        elif isinstance(node, ast.Subscript):
+            if _dotted(node.value)[:2] == ["os", "environ"]:
+                return "os.environ", node.lineno
+    return None
+
+
+def _sink_names(tree: ast.AST) -> Set[str]:
+    """Local names whose CALL dispatches a traced program: jit/pmap bindings,
+    factory-wrapped learners, and @jax.jit-decorated defs."""
+    sinks: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+            if isinstance(target, ast.Name) and isinstance(value, ast.Call):
+                if _callee_name(value.func) in _JIT_CTORS | _JITTED_FACTORIES:
+                    sinks.add(target.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                callee = _callee_name(deco.func if isinstance(deco, ast.Call) else deco)
+                if callee in _JIT_CTORS:
+                    sinks.add(node.name)
+                elif isinstance(deco, ast.Call) and callee == "partial":
+                    if any(_callee_name(a) in _JIT_CTORS for a in deco.args):
+                        sinks.add(node.name)
+    return sinks
+
+
+class _TaintScan:
+    """Statement-ordered taint propagation through one scope."""
+
+    def __init__(
+        self,
+        rule: Rule,
+        ctx: FileContext,
+        sinks: Set[str],
+        jax_names: FrozenSet[str],
+        initial: Optional[Dict[str, Tuple[str, int]]] = None,
+    ) -> None:
+        self.rule = rule
+        self.ctx = ctx
+        self.sinks = sinks
+        self.jax_names = jax_names
+        self.state: Dict[str, Tuple[str, int]] = dict(initial or {})
+        self.findings: List[Finding] = []
+
+    def _expr_taint(self, expr: ast.AST) -> Optional[Tuple[str, int]]:
+        source = _divergent_expr(expr, self.jax_names)
+        if source:
+            return source
+        for node in ast.walk(expr):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in self.state
+            ):
+                return self.state[node.id]
+        return None
+
+    def _check_sink_calls(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee_name(node.func)
+            is_sink = (
+                (isinstance(node.func, ast.Name) and callee in self.sinks)
+                or callee in _COLLECTIVE_HELPERS
+                or callee in _COLLECTIVES
+            )
+            if not is_sink:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                taint = self._expr_taint(arg)
+                if taint and not self.ctx.noqa(node.lineno, self.rule.id):
+                    label, src_line = taint
+                    self.findings.append(
+                        Finding(
+                            self.rule.id,
+                            self.ctx.rel,
+                            node.lineno,
+                            f"per-host-divergent value from {label} (line "
+                            f"{src_line}) flows into '{callee}' — SPMD hosts "
+                            f"would trace/reduce different values and "
+                            f"deadlock or silently diverge (STX013)",
+                        )
+                    )
+                    break
+
+    def run(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._check_sink_calls(stmt.value)
+                taint = self._expr_taint(stmt.value)
+                for target in stmt.targets:
+                    for name in _assigned_names(target):
+                        if taint:
+                            self.state[name] = taint
+                        else:
+                            self.state.pop(name, None)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    self._check_sink_calls(stmt.value)
+                    taint = self._expr_taint(stmt.value)
+                    for name in _assigned_names(stmt.target):
+                        if taint:
+                            self.state[name] = taint
+                        elif not isinstance(stmt, ast.AugAssign):
+                            self.state.pop(name, None)
+            elif isinstance(stmt, ast.If):
+                self._check_sink_calls(stmt.test)
+                saved = dict(self.state)
+                self.run(stmt.body)
+                body_state = self.state
+                self.state = dict(saved)
+                self.run(stmt.orelse)
+                # Join: tainted on EITHER path stays tainted (an else-branch
+                # rebind must not launder the if-branch's divergent value).
+                for name, taint in body_state.items():
+                    self.state.setdefault(name, taint)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._check_sink_calls(stmt.iter)
+                self.run(stmt.body)
+                self.run(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self._check_sink_calls(stmt.test)
+                self.run(stmt.body)
+                self.run(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._check_sink_calls(item.context_expr)
+                    if item.optional_vars is not None:
+                        # `with open(p) as f:` — the withitem binding carries
+                        # the context expression's taint (reads of `f` are the
+                        # dominant filesystem-source idiom).
+                        taint = self._expr_taint(item.context_expr)
+                        for name in _assigned_names(item.optional_vars):
+                            if taint:
+                                self.state[name] = taint
+                            else:
+                                self.state.pop(name, None)
+                self.run(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self.run(stmt.body)
+                for handler in stmt.handlers:
+                    self.run(handler.body)
+                self.run(stmt.orelse)
+                self.run(stmt.finalbody)
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.expr):
+                        self._check_sink_calls(child)
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep) or ctx.rel in _ALLOWLIST:
+        return []
+    findings: List[Finding] = []
+    jax_names = _jax_aliases(ctx.tree)
+
+    # Mode 1: divergent sources inside jit-reachable code (trace-time bake).
+    for fn in reachable_jit_functions(ctx.tree):
+        for node in walk_scope(fn):
+            label = None
+            if isinstance(node, ast.Call):
+                label = _divergent_call(node, jax_names)
+            elif isinstance(node, ast.Subscript):
+                if _dotted(node.value)[:2] == ["os", "environ"]:
+                    label = "os.environ"
+            if label and not ctx.noqa(node.lineno, rule.id):
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        node.lineno,
+                        f"{label} inside jit-reachable code bakes a "
+                        f"DIFFERENT trace-time constant on every SPMD host "
+                        f"— the compiled programs (and their collectives) "
+                        f"no longer match across the pod (STX013)",
+                    )
+                )
+
+    # Mode 2: host-side taint reaching a jitted call or collective helper.
+    sinks = _sink_names(ctx.tree)
+    module_scan = _TaintScan(rule, ctx, sinks, jax_names)
+    module_scan.run(getattr(ctx.tree, "body", []))
+    findings.extend(module_scan.findings)
+    module_taint = dict(module_scan.state)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Parameters shadow module-scope taint: a same-named argument is a
+            # fresh caller-supplied value, not the tainted module global.
+            params = _all_param_names(node.args)
+            initial = {k: v for k, v in module_taint.items() if k not in params}
+            scan = _TaintScan(rule, ctx, sinks, jax_names, initial=initial)
+            scan.run(node.body)
+            findings.extend(scan.findings)
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX013",
+        order=99,
+        title="host-divergence hazards (SPMD)",
+        rationale="A wall-clock, env-var, RNG, or filesystem value reaching "
+        "a traced program or collective makes SPMD hosts execute different "
+        "programs — the multi-host failure that presents as a deadlocked "
+        "all-reduce minutes into a pod launch.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            # Trace-time bake inside jit-reachable code.
+            "import jax\nimport time\n\n\n@jax.jit\ndef step(x):\n"
+            "    return x * time.time()\n",
+            # Host-side env-var taint reaching a jitted call.
+            "import jax\nimport os\n\nstep = jax.jit(update)\n\n\n"
+            "def run(state):\n"
+            '    boost = float(os.environ.get("BOOST", "1.0"))\n'
+            "    return step(state, boost)\n",
+            # STDLIB random (unseeded, per-host) reaching a jitted call.
+            "import jax\nimport random\n\nstep = jax.jit(update)\n\n\n"
+            "def run(state):\n"
+            "    noise = random.random()\n"
+            "    return step(state, noise)\n",
+        ),
+        clean_snippets=(
+            # Wall-clock for host-side telemetry never reaches a program.
+            "import time\n\nfrom stoix_tpu.observability import get_logger\n\n\n"
+            "def log_window(metrics):\n"
+            "    t0 = time.perf_counter()\n"
+            '    get_logger("x").info("window at %.1f: %s", t0, metrics)\n'
+            "    return t0\n",
+            # Keyed jax.random is deterministic; config-fed seeds are shared.
+            "import jax\n\nstep = jax.jit(update)\n\n\n"
+            "def run(state, config):\n"
+            "    key = jax.random.PRNGKey(int(config.arch.seed))\n"
+            "    return step(state, key)\n",
+            # `from jax import random` is STILL jax.random, not the stdlib.
+            "import jax\nfrom jax import random\n\nstep = jax.jit(update)\n\n\n"
+            "def run(state, key):\n"
+            "    key, sub = random.split(key)\n"
+            "    return step(state, sub)\n",
+            # The blessed SLURM coordination idiom is NOT a sink.
+            "import jax\nimport os\n\n\ndef init_distributed():\n"
+            '    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")\n'
+            "    if coord:\n"
+            "        jax.distributed.initialize(coordinator_address=coord)\n",
+        ),
+    )
+)

@@ -12,15 +12,17 @@ Usage:
     python -m stoix_tpu.launcher \
         --systems stoix_tpu.systems.ppo.anakin.ff_ppo stoix_tpu.systems.sac.ff_sac \
         --envs cartpole pendulum --seeds 0 1 2 \
-        [--local | --submit | --preflight-only] [--nodes 1] [--time 04:00:00] \
-        [--partition tpu] [overrides...]
+        [--local | --submit | --preflight-only [--changed-only]] \
+        [--nodes 1] [--time 04:00:00] [--partition tpu] [overrides...]
 
 `--preflight-only` (docs/DESIGN.md §2.4) runs the launch-hardening preflight —
-ONE subprocess-isolated backend probe for the host, then config
-cross-validation for every (system x env x seed) job against the probed
-topology — prints a one-page report, and exits 0 (all pass) or 1. Wire it
-into CI or a SLURM prolog so a wedged chip or a bad config fails the batch in
-seconds instead of after scheduling.
+the static-analysis gate, then ONE subprocess-isolated backend probe for the
+host, then config cross-validation for every (system x env x seed) job
+against the probed topology — prints a one-page report, and exits 0 (all
+pass) or 1. Wire it into CI or a SLURM prolog so a wedged chip or a bad
+config fails the batch in seconds instead of after scheduling.
+`--changed-only` restricts the lint stage to git-changed files so the prolog
+stays fast as the rule count grows.
 """
 
 from __future__ import annotations
@@ -74,7 +76,7 @@ def _default_yaml_for(module: str) -> Optional[str]:
     return match.group(0) if match else None
 
 
-def run_preflight_only(jobs: List[dict]) -> int:
+def run_preflight_only(jobs: List[dict], changed_only: bool = False) -> int:
     """Static-analysis gate + ONE backend probe for the host + per-job config
     cross-validation against the probed topology; prints the one-page report.
     Returns the process exit code (0 = every stage passed)."""
@@ -83,11 +85,27 @@ def run_preflight_only(jobs: List[dict]) -> int:
 
     # Static-analysis gate FIRST (docs/DESIGN.md §2.5): pure-AST, no jax
     # import, milliseconds — a SLURM prolog catches an axis-name typo
-    # (STX007) or a typo'd config read (STX009) before the backend probe
-    # spends its timeout budget, let alone before burning a TPU allocation.
+    # (STX007), a misshard (STX010), or a typo'd config read (STX009) before
+    # the backend probe spends its timeout budget, let alone before burning a
+    # TPU allocation.
     from stoix_tpu import analysis
 
-    findings, n_files = analysis.run_paths()
+    lint_paths = None
+    lint_scope = "files clean"
+    with_tree_rules = True
+    if changed_only:
+        changed = analysis.changed_paths()
+        if changed:
+            # Tree-scoped rules need the full file set (see --changed-only in
+            # the analysis CLI). git-unavailable AND a clean checkout (the
+            # CI/prolog case — the bad change is already committed) both
+            # fall back to the full scan: a vacuous 0-file pass is no gate.
+            lint_paths = changed
+            lint_scope = "changed files clean"
+            with_tree_rules = False
+    findings, n_files = analysis.run_paths(
+        lint_paths, with_tree_rules=with_tree_rules
+    )
     lint_errors, _lint_warnings = analysis.split_severity(findings)
     if lint_errors:
         # Short-circuit: the gate already failed the batch, so do not spend
@@ -133,7 +151,7 @@ def run_preflight_only(jobs: List[dict]) -> int:
         report.add(*row)
     report.add(
         "static-analysis", "pass",
-        f"{n_files} files clean ({len(analysis.get_rules())} rules)",
+        f"{n_files} {lint_scope} ({len(analysis.get_rules())} rules)",
     )
     # The report IS this mode's output contract (CI / SLURM prolog logs
     # capture stdout), like bench.py's JSON lines.
@@ -164,6 +182,14 @@ def main(argv: List[str] | None = None) -> None:
         "per-job config cross-validation) and exit 0/1 with a one-page "
         "report — no jobs are run or submitted (CI / SLURM prolog hook)",
     )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="with --preflight-only: lint only the .py files git reports "
+        "changed vs HEAD (the analysis CLI's --changed-only selection), so "
+        "the prolog stays fast as the rule count grows; full scan when git "
+        "is unavailable",
+    )
     parser.add_argument("--nodes", type=int, default=1)
     parser.add_argument("--time", default="04:00:00")
     parser.add_argument("--partition", default=None)
@@ -184,6 +210,10 @@ def main(argv: List[str] | None = None) -> None:
     parser.add_argument("--log-dir", default="launcher_logs")
     parser.add_argument("overrides", nargs="*", help="shared key=value overrides")
     args = parser.parse_args(argv)
+    if args.changed_only and not args.preflight_only:
+        # Silently ignoring the flag would let a user believe their --submit
+        # was gated on a changed-file lint that never ran.
+        parser.error("--changed-only requires --preflight-only")
 
     jobs = build_jobs(args)
     log = get_logger("stoix_tpu.launcher")
@@ -193,7 +223,7 @@ def main(argv: List[str] | None = None) -> None:
     )
 
     if args.preflight_only:
-        sys.exit(run_preflight_only(jobs))
+        sys.exit(run_preflight_only(jobs, changed_only=args.changed_only))
 
     if args.local:
         # Make the repo importable from any working directory.

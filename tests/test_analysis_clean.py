@@ -1,15 +1,19 @@
 """The repo lints itself clean — the gate as a tier-1 test, not an
-honor-system script (ISSUE 5 satellite).
+honor-system script (ISSUE 5 satellite; extended to the mesh-aware rules by
+ISSUE 6).
 
 Runs `python -m stoix_tpu.analysis --format json` over the default paths and
 asserts zero error-severity findings. Consuming the machine-readable JSON
 (one object per finding: rule/path/line/message/severity) is the point: the
 same contract CI uses, so a format regression fails here too.
 
-This subsumes the old test_lint.py::test_lint_gate_clean and adds the five
-JAX-aware rules (STX005-STX009) plus the config↔code cross-check to the
-always-green surface: an axis-name typo, a reused PRNG key, or a typo'd
-config read anywhere in stoix_tpu/ now fails the test suite directly.
+This subsumes the old test_lint.py::test_lint_gate_clean and puts the
+JAX-aware rules (STX005-STX009) AND the sharding-layer rules (STX010-STX013,
+backed by the repo-wide mesh model in analysis/meshmodel.py) on the
+always-green surface: an axis-name typo, a reused PRNG key, a typo'd config
+read, a P() axis no mesh declares, a shard_map replication lie, a recompile
+hazard, or a host-divergent value feeding a collective anywhere in
+stoix_tpu/ now fails the test suite directly.
 """
 
 import json
@@ -38,6 +42,29 @@ def test_repo_lints_clean_json():
     # Warnings (E501) are allowed but must stay structured.
     for f in findings:
         assert set(f) == {"rule", "path", "line", "message", "severity"}
+
+
+def test_mesh_rules_clean_json():
+    # The ISSUE 6 acceptance criterion, verbatim: the four sharding-layer
+    # rules alone exit 0 on the shipped tree (a narrower, faster assertion
+    # than the full gate, so a future full-gate allowlist change cannot
+    # silently waive them).
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "stoix_tpu.analysis",
+            "--select",
+            "STX010,STX011,STX012,STX013",
+            "--format",
+            "json",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    findings = json.loads(proc.stdout)
+    assert proc.returncode == 0 and findings == [], findings
 
 
 def test_shim_gate_clean_text():

@@ -304,9 +304,10 @@ def test_stx008_decorated_partial_jit_donation():
     assert len(findings) == 1 and findings[0].line == 12
 
 
-def test_stx008_dynamic_donate_kwargs_out_of_scope():
-    # The runner's **donate kill-switch pattern is a documented blind spot:
-    # never flagged (no literal donate_argnums to resolve).
+def test_stx008_dynamic_donate_kwargs_kill_switch_resolves():
+    # PR 5's documented blind spot, closed this PR: the **donate kill-switch
+    # pattern resolves through the dict-literal assignment, taking the
+    # DONATING branch (donation-on must be safe; off is the degraded mode).
     rule = get_rule("STX008")
     source = (
         "import jax, os\n\n"
@@ -316,7 +317,484 @@ def test_stx008_dynamic_donate_kwargs_out_of_scope():
         "    out = step(state)\n"
         "    return out, state\n"
     )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and findings[0].line == 9, findings
+
+
+def test_stx008_donate_argnames_maps_to_positional_callsite():
+    # donate_argnames resolves through the wrapped signature, so a POSITIONAL
+    # read-after-donate is caught; the rebind idiom stays clean.
+    rule = get_rule("STX008")
+    source = (
+        "import jax\n\n\ndef update(state, batch):\n"
+        "    return state\n\n\n"
+        'step = jax.jit(update, donate_argnames=("state",))\n\n\n'
+        "def run(state, batch):\n"
+        "    out = step(state, batch)\n"
+        "    return out, state.loss\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and findings[0].line == 13, findings
+
+
+def test_stx008_keyword_callsite_of_donated_position_is_tracked():
+    # donate_argnums cross-maps to the parameter NAME, so passing the donated
+    # argument by keyword is tracked too.
+    rule = get_rule("STX008")
+    source = (
+        "import jax\n\n\ndef update(state, batch):\n"
+        "    return state\n\n\n"
+        "step = jax.jit(update, donate_argnums=(0,))\n\n\n"
+        "def run(state, batch):\n"
+        "    out = step(state=state, batch=batch)\n"
+        "    return out, state.loss\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and findings[0].line == 13, findings
+
+
+# ---------------------------------------------------------------------------
+# STX010 — the acceptance-criterion scenario: an axis renamed in ONE P(...)
+# of a copy of the real Anakin PPO file is caught at the exact line, and the
+# unmodified copy stays clean (mirrors the STX007 misspelled-axis test).
+
+
+def test_stx010_catches_seeded_misshard_in_ff_ppo_copy():
+    rule = get_rule("STX010")
+    with open(os.path.join(REPO, "stoix_tpu", "systems", "ppo", "anakin", "ff_ppo.py")) as f:
+        source = f.read()
+    rel = "stoix_tpu/systems/ppo/anakin/_misshard_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = 'key=P("data"),'
+    assert target in source
+    bad = source.replace(target, 'key=P("dtaa"),', 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert len(findings) == 1 and findings[0].rule == "STX010"
+    assert "'dtaa'" in findings[0].message
+    assert findings[0].line == source[: source.index(target)].count("\n") + 1
+    assert findings[0].path == rel.replace("/", os.sep)
+
+
+def test_stx010_mesh_local_resolution_beats_universe():
+    # "model" exists in the repo universe, but NOT on the mesh this spec
+    # statically flows with — the mesh-local check STX007 cannot do.
+    rule = get_rule("STX010")
+    source = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n\n\n"
+        "def place(devices, params):\n"
+        '    learner_mesh = Mesh(np.array(devices), ("data",))\n'
+        '    return NamedSharding(learner_mesh, P("model"))\n'
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and "learner_mesh" in findings[0].message
+
+
+def test_stx010_spec_arity_vs_literal_shape_rank():
+    rule = get_rule("STX010")
+    source = (
+        "import jax\nfrom jax.sharding import NamedSharding, PartitionSpec as P\n\n\n"
+        "def assemble(mesh, shards):\n"
+        "    return jax.make_array_from_single_device_arrays(\n"
+        '        (8,), NamedSharding(mesh, P("data", None)), shards\n'
+        "    )\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and "rank 1" in findings[0].message
+
+
+def test_stx010_parameter_mesh_does_not_resolve_to_other_scopes_binding():
+    # A `mesh` PARAMETER is the caller's mesh — it must not resolve to a
+    # same-named local binding in ANOTHER function (universe fallback, where
+    # "model" is valid), or the 37-file sharding refactor lints wrong code.
+    rule = get_rule("STX010")
+    source = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n\n\n"
+        "def build_learner(devices):\n"
+        '    mesh = Mesh(np.array(devices), ("data",))\n'
+        "    return mesh\n\n\n"
+        "def place(mesh, params):\n"
+        '    return NamedSharding(mesh, P("model"))\n'
+    )
     assert rule.run_on_source(source) == []
+
+
+def test_stx010_rebound_mesh_name_falls_back_to_universe():
+    # A same-scope rebind through a helper (`mesh = widen(mesh)`) makes the
+    # name's axes unknowable — the stale ctor binding must NOT win (universe
+    # fallback, where "model" is valid).
+    rule = get_rule("STX010")
+    source = (
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n\n\n"
+        "def place(devs, widen):\n"
+        '    mesh = Mesh(devs, ("data",))\n'
+        "    mesh = widen(mesh)\n"
+        '    return NamedSharding(mesh, P("model"))\n'
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx010_other_scope_nonctor_binding_poisons_mesh_name():
+    # `mesh` bound by a ctor in ONE function and by an opaque helper call in
+    # ANOTHER: the second function's use must not resolve to the first
+    # function's axes (universe fallback), or valid code fails the gate.
+    rule = get_rule("STX010")
+    source = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n\n\n"
+        "def build_data(devices):\n"
+        '    mesh = Mesh(np.array(devices), ("data",))\n'
+        '    return NamedSharding(mesh, P("data"))\n\n\n'
+        "def place(devices, make_model_mesh):\n"
+        "    mesh = make_model_mesh(devices)\n"
+        '    return NamedSharding(mesh, P("model"))\n'
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx010_parameter_spec_does_not_resolve_to_other_scopes_binding():
+    # A `spec` PARAMETER is the caller's spec — it must not resolve to a
+    # same-named local P(...) in ANOTHER function (opaque leaf), exactly the
+    # discipline mesh names already get.
+    rule = get_rule("STX010")
+    source = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n\n\n"
+        "def model_spec():\n"
+        '    spec = P("model")\n'
+        "    return spec\n\n\n"
+        "def place(devices, spec):\n"
+        '    m = Mesh(np.array(devices), ("data",))\n'
+        "    return NamedSharding(m, spec)\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx010_rebound_spec_name_is_ambiguous():
+    # A same-scope rebind through a helper (`spec = widen(spec)`) — and a
+    # second P(...) literal binding of the same name — make the name's value
+    # unknowable: the stale literal must NOT win (opaque leaf, no finding).
+    rule = get_rule("STX010")
+    source = (
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n\n\n"
+        "def place(devs, widen):\n"
+        '    spec = P("model")\n'
+        "    spec = widen(spec)\n"
+        '    return NamedSharding(Mesh(devs, ("data",)), spec)\n\n\n'
+        "def elsewhere(devs):\n"
+        '    spec = P("data")\n'
+        '    return NamedSharding(Mesh(devs, ("data",)), spec)\n'
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx010_single_spec_binding_still_resolves():
+    # The guard is rebind-poisoning, not a lobotomy: a name bound ONCE to a
+    # P(...) literal still resolves and still catches the misshard.
+    rule = get_rule("STX010")
+    source = (
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n\n\n"
+        "def place(devs):\n"
+        '    spec = P("model")\n'
+        '    return NamedSharding(Mesh(devs, ("data",)), spec)\n'
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and "'model'" in findings[0].message
+
+
+def test_stx010_variable_axis_slots_are_axis_generic():
+    # parallel/topology-style library code passes axes as variables: skipped
+    # per slot, never guessed.
+    rule = get_rule("STX010")
+    source = (
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n\n\n"
+        "def seq_sharding(mesh, axis):\n"
+        "    return NamedSharding(mesh, P(None, axis))\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+# ---------------------------------------------------------------------------
+# STX011 — shard_map contract specifics.
+
+
+def test_stx011_partial_bound_args_drop_out_of_arity():
+    # functools.partial binds positionals: 1 spec into partial(f, cfg) where
+    # f takes (cfg, batch) is satisfiable and must NOT flag.
+    rule = get_rule("STX011")
+    source = (
+        "from functools import partial\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from stoix_tpu.parallel.mesh import shard_map\n\n\n"
+        "def per_shard(cfg, batch):\n"
+        "    return batch\n\n\n"
+        "def build(mesh, cfg):\n"
+        "    return shard_map(partial(per_shard, cfg), mesh=mesh,\n"
+        '                     in_specs=(P("data"),), out_specs=P("data"))\n'
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx011_literal_axis_names_tuple_is_not_a_wildcard():
+    # An all-literal axis_names=("model",) tuple contributes its literals but
+    # must NOT wildcard-suppress the check for OTHER axes: "data" is sharded
+    # in, never reduced, and claimed replicated -> flags.
+    rule = get_rule("STX011")
+    source = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from stoix_tpu.parallel.mesh import shard_map\n"
+        "from stoix_tpu.resilience import guards\n\n\n"
+        "def per_shard(batch):\n"
+        '    out, _ = guards.guard_update("skip", new=batch, old=batch,\n'
+        '                                 axis_names=("model",))\n'
+        "    return out\n\n\n"
+        "def build(mesh):\n"
+        "    return shard_map(per_shard, mesh=mesh,\n"
+        '                     in_specs=(P("data"),), out_specs=P())\n'
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and "'data'" in findings[0].message
+
+
+def test_stx011_variable_axis_name_suppresses_replication_check():
+    # A collective whose axis rides a VARIABLE may reduce over any axis:
+    # axis-generic library code (ring_attention) must not false-positive.
+    rule = get_rule("STX011")
+    source = (
+        "import jax\nfrom jax.sharding import PartitionSpec as P\n"
+        "from stoix_tpu.parallel.mesh import shard_map\n\n\n"
+        "def make(axis):\n"
+        "    def per_shard(batch):\n"
+        "        return jax.lax.pmean(batch, axis_name=axis)\n\n"
+        "    def build(mesh):\n"
+        "        return shard_map(per_shard, mesh=mesh,\n"
+        '                         in_specs=(P("data"),), out_specs=P())\n'
+        "    return build\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+# ---------------------------------------------------------------------------
+# STX012 — recompile-hazard specifics.
+
+
+def test_stx012_static_argnames_cross_maps_to_positional_callsite():
+    # static_argnames resolves to positions through the wrapped signature, so
+    # a loop variable passed POSITIONALLY at that slot is still caught.
+    rule = get_rule("STX012")
+    source = (
+        "import jax\n\n\ndef update(state, width):\n"
+        "    return state\n\n\n"
+        'step = jax.jit(update, static_argnames=("width",))\n\n\n'
+        "def run(state, n):\n"
+        "    for i in range(n):\n"
+        "        state = step(state, i)\n"
+        "    return state\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and findings[0].line == 13
+    assert "loop variable" in findings[0].message
+
+
+def test_stx012_jit_in_setup_called_in_loop_is_clean():
+    rule = get_rule("STX012")
+    source = (
+        "import jax\n\n\ndef run(update, state, n):\n"
+        "    step = jax.jit(update)\n"
+        "    for _ in range(n):\n"
+        "        state = step(state)\n"
+        "    return state\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx012_out_of_range_static_argnums_names_the_bound():
+    rule = get_rule("STX012")
+    source = (
+        "import jax\n\n\ndef update(state):\n"
+        "    return state\n\n\nstep = jax.jit(update, static_argnums=(2,))\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and "out of range" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# STX013 — host-divergence specifics.
+
+
+def test_stx013_rebind_from_untainted_expression_clears_taint():
+    rule = get_rule("STX013")
+    source = (
+        "import jax\nimport time\n\nstep = jax.jit(update)\n\n\n"
+        "def run(state):\n"
+        "    t = time.time()\n"
+        "    t = 0.0\n"
+        "    return step(state, t)\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx013_module_scope_taint_reaches_function_scope_sink():
+    rule = get_rule("STX013")
+    source = (
+        "import jax\nimport os\n\nstep = jax.jit(update)\n"
+        'DEBUG_SCALE = float(os.environ.get("SCALE", "1.0"))\n\n\n'
+        "def run(state):\n"
+        "    return step(state, DEBUG_SCALE)\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and "os.environ" in findings[0].message
+    assert findings[0].line == 9
+
+
+def test_stx013_parameter_shadows_module_taint():
+    # A function parameter named like a tainted module global is a FRESH
+    # caller-supplied value — must not inherit the module-scope taint.
+    rule = get_rule("STX013")
+    source = (
+        "import jax\nimport time\n\nstep = jax.jit(update)\n"
+        "T0 = time.perf_counter()\n\n\n"
+        "def run(state, T0):\n"
+        "    return step(state, T0)\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx012_vararg_absorbs_static_positions():
+    # static_argnums may index into *args — no out-of-range claim.
+    rule = get_rule("STX012")
+    source = (
+        "import jax\n\n\ndef update(state, *scales):\n"
+        "    return state\n\n\nstep = jax.jit(update, static_argnums=(2,))\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx013_else_branch_rebind_does_not_launder_if_branch_taint():
+    # Branch states join as a union: the config-toggle pattern (env var
+    # reaching a jitted call on the debug path only) must still flag.
+    rule = get_rule("STX013")
+    source = (
+        "import jax\nimport os\n\nstep = jax.jit(update)\n\n\n"
+        "def run(state, debug):\n"
+        "    if debug:\n"
+        '        scale = float(os.environ.get("S", "1"))\n'
+        "    else:\n"
+        "        scale = 1.0\n"
+        "    return step(state, scale)\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and findings[0].line == 12, findings
+
+
+def test_stx013_with_open_binding_carries_taint():
+    # `with open(p) as f:` is the dominant filesystem-read idiom; reads of
+    # `f` must carry the taint to the sink.
+    rule = get_rule("STX013")
+    source = (
+        "import jax\n\nstep = jax.jit(update)\n\n\n"
+        "def run(state, path):\n"
+        "    with open(path) as f:\n"
+        "        cfg = f.read()\n"
+        "    return step(state, float(cfg))\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and "open()" in findings[0].message, findings
+
+
+def test_stx012_while_counter_and_body_derived_are_loop_varying():
+    rule = get_rule("STX012")
+    source = (
+        "import jax\n\nstep = jax.jit(update, static_argnums=(1,))\n\n\n"
+        "def run(state, n):\n"
+        "    i = 0\n"
+        "    while i < n:\n"
+        "        state = step(state, i)\n"
+        "        i += 1\n"
+        "    return state\n\n\n"
+        "def run2(state, n):\n"
+        "    for i in range(n):\n"
+        "        width = i * 2\n"
+        "        state = step(state, width)\n"
+        "    return state\n"
+    )
+    findings = rule.run_on_source(source)
+    assert [f.line for f in findings] == [9, 17], findings
+
+
+def test_stx012_loop_invariant_constant_at_static_position_is_clean():
+    # A name assigned a loop-INVARIANT value inside the body compiles exactly
+    # once — flagging it would fail correct code; a value derived from it AND
+    # the counter is still caught (transitive fixpoint).
+    rule = get_rule("STX012")
+    source = (
+        "import jax\n\nstep = jax.jit(update, static_argnums=(1,))\n\n\n"
+        "def run(state, n):\n"
+        "    for _ in range(n):\n"
+        "        width = 64\n"
+        "        state = step(state, width)\n"
+        "    return state\n\n\n"
+        "def run2(state, n):\n"
+        "    for i in range(n):\n"
+        "        base = 64\n"
+        "        width = base + i\n"
+        "        state = step(state, width)\n"
+        "    return state\n\n\n"
+        "def run3(state, n):\n"
+        "    for i in range(n):\n"
+        "        w, block = i, 64\n"
+        "        state = step(state, block)\n"
+        "    return state\n"
+    )
+    findings = rule.run_on_source(source)
+    # run3: tuple-unpack pairs element-wise — `block` is loop-invariant even
+    # though its unpack sibling `w` derives from the counter.
+    assert [f.line for f in findings] == [17], findings
+
+
+def test_stx013_jax_random_import_alias_is_not_stdlib_random():
+    # `from jax import random` binds KEYED jax.random to the bare name the
+    # stdlib heuristic matches — the rule's documented exemption must hold.
+    rule = get_rule("STX013")
+    source = (
+        "import jax\nfrom jax import random\n\nstep = jax.jit(update)\n\n\n"
+        "def run(state, key):\n"
+        "    key, sub = random.split(key)\n"
+        "    return step(state, sub)\n"
+    )
+    assert rule.run_on_source(source, rel="stoix_tpu/systems/x.py") == []
+    # Without the jax import, the SAME source is stdlib random: flagged.
+    bad = source.replace("from jax import random", "import random")
+    findings = rule.run_on_source(bad, rel="stoix_tpu/systems/x.py")
+    assert len(findings) == 1 and "random.split()" in findings[0].message
+
+
+def test_stx013_seeded_default_rng_is_deterministic():
+    rule = get_rule("STX013")
+    source = (
+        "import jax\nimport numpy as np\n\nstep = jax.jit(update)\n\n\n"
+        "def run(state, config):\n"
+        "    rng = np.random.default_rng(int(config.arch.seed))\n"
+        "    return step(state, rng.normal())\n"
+    )
+    assert rule.run_on_source(source, rel="stoix_tpu/systems/x.py") == []
+    # An UNSEEDED generator draws per-host entropy: still flagged.
+    bad = source.replace("default_rng(int(config.arch.seed))", "default_rng()")
+    findings = rule.run_on_source(bad, rel="stoix_tpu/systems/x.py")
+    assert len(findings) == 1 and "default_rng" in findings[0].message
+
+
+def test_stx013_collective_helper_is_a_sink():
+    rule = get_rule("STX013")
+    source = (
+        "import time\n\nfrom stoix_tpu.parallel import fetch_global\n\n\n"
+        "def snapshot(tree):\n"
+        "    stamp = time.time()\n"
+        "    return fetch_global(tree, stamp)\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and "time.time()" in findings[0].message
 
 
 # ---------------------------------------------------------------------------
@@ -443,6 +921,88 @@ def test_cli_json_format_shape():
     assert isinstance(findings[0]["line"], int)
 
 
+def test_cli_github_format_annotation_lines():
+    # One ::error workflow-command per finding, anchored to the PR diff.
+    rule = get_rule("STX005")
+    scratch = os.path.join(REPO, "stoix_tpu", "_stx_fixture_scratch_probe.py")
+    with open(scratch, "w") as f:
+        f.write(rule.flag_snippets[0])
+    try:
+        proc = _run_cli(
+            [
+                "--select",
+                "STX005",
+                "--format",
+                "github",
+                "stoix_tpu/_stx_fixture_scratch_probe.py",
+            ]
+        )
+    finally:
+        os.remove(scratch)
+    assert proc.returncode == 1
+    annotations = [l for l in proc.stdout.splitlines() if l.startswith("::")]
+    assert annotations, proc.stdout
+    assert annotations[0].startswith(
+        "::error file=stoix_tpu/_stx_fixture_scratch_probe.py,line="
+    )
+    assert "title=STX005" in annotations[0]
+    # The summary line rides along for the action log; not an annotation.
+    assert proc.stdout.splitlines()[-1].startswith("[lint] ")
+
+
+def test_cli_changed_only_scans_untracked_violation():
+    # An UNTRACKED scratch violation is part of the git-changed set, so
+    # --changed-only must find it; tree-scoped rules are skipped (a partial
+    # file set would fabricate dead config keys), which --select sidesteps.
+    rule = get_rule("STX005")
+    scratch = os.path.join(REPO, "stoix_tpu", "_stx_fixture_scratch_probe.py")
+    with open(scratch, "w") as f:
+        f.write(rule.flag_snippets[0])
+    try:
+        proc = _run_cli(["--select", "STX005", "--changed-only"])
+    finally:
+        os.remove(scratch)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "_stx_fixture_scratch_probe.py" in proc.stdout
+
+
+def test_cli_changed_only_rejects_explicit_paths():
+    proc = _run_cli(["--changed-only", "stoix_tpu/analysis"])
+    assert proc.returncode == 2
+    assert "mutually exclusive" in proc.stderr
+
+
+def test_cli_changed_only_with_selected_tree_rule_exits_2(monkeypatch, capsys):
+    # --select STX009 --changed-only would silently never run the one rule
+    # the user asked for (tree-scoped rules are skipped on a partial file
+    # set) — a permanent green no-op in CI. Must refuse, like the explicit
+    # paths conflict.
+    from stoix_tpu.analysis import __main__ as cli
+    from stoix_tpu.analysis import core
+
+    monkeypatch.setattr(
+        core, "changed_paths", lambda: [os.path.join("stoix_tpu", "launcher.py")]
+    )
+    rc = cli.main(["--changed-only", "--select", "STX009", "--format", "json"])
+    out = capsys.readouterr()
+    assert rc == 2
+    assert "STX009" in out.err and "tree-scoped" in out.err
+
+
+def test_cli_changed_only_clean_tree_falls_back_to_full_scan(monkeypatch, capsys):
+    # The CI/prolog case: the bad change is already COMMITTED, so the
+    # changed set is empty — a vacuous 0-file pass would be a fake gate.
+    from stoix_tpu.analysis import __main__ as cli
+    from stoix_tpu.analysis import core
+
+    monkeypatch.setattr(core, "changed_paths", lambda: [])
+    rc = cli.main(["--changed-only", "--select", "STX010", "--format", "json"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "clean work tree, running the full scan" in out.err
+    assert json.loads(out.out) == []
+
+
 def test_cli_select_unknown_rule_exits_2():
     proc = _run_cli(["--select", "STX999", "scripts"])
     assert proc.returncode == 2
@@ -506,7 +1066,7 @@ def test_launcher_preflight_fails_on_lint_finding(monkeypatch, capsys):
         report.add("backend_probe", "pass", "stubbed")
         return report
 
-    def fake_run_paths(paths=None, select=None, ignore=None, repo=None):
+    def fake_run_paths(paths=None, select=None, ignore=None, repo=None, with_tree_rules=True):
         finding = analysis.Finding(
             "STX007", "stoix_tpu/systems/x.py", 42, "collective axis name 'dataa' ... (STX007)"
         )
@@ -518,3 +1078,53 @@ def test_launcher_preflight_fails_on_lint_finding(monkeypatch, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "static-analysis" in out and "STX007" in out
+
+
+def test_launcher_preflight_changed_only_passes_git_selection(monkeypatch, capsys):
+    # --changed-only routes the git-diff selection into the lint stage (tree
+    # rules off) and the report names the narrowed scope.
+    from stoix_tpu import analysis, launcher
+    from stoix_tpu.resilience import preflight
+
+    def fake_run_preflight(configs=None, settings=None):
+        report = preflight.PreflightReport()
+        report.add("backend_probe", "pass", "stubbed")
+        return report
+
+    seen = {}
+
+    def fake_run_paths(paths=None, select=None, ignore=None, repo=None, with_tree_rules=True):
+        seen["paths"] = paths
+        seen["with_tree_rules"] = with_tree_rules
+        return [], len(paths or [])
+
+    monkeypatch.setattr(preflight, "run_preflight", fake_run_preflight)
+    monkeypatch.setattr(analysis, "run_paths", fake_run_paths)
+    monkeypatch.setattr(analysis, "changed_paths", lambda: ["stoix_tpu/launcher.py"])
+    rc = launcher.run_preflight_only([], changed_only=True)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert seen["paths"] == ["stoix_tpu/launcher.py"]
+    assert seen["with_tree_rules"] is False
+    assert "changed files clean" in out
+
+
+def test_launcher_changed_only_without_preflight_only_is_rejected():
+    # Silently ignoring --changed-only would fake a lint gate on --submit.
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "stoix_tpu.launcher",
+            "--systems",
+            "stoix_tpu.systems.ppo.anakin.ff_ppo",
+            "--envs",
+            "cartpole",
+            "--changed-only",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 2
+    assert "--changed-only requires --preflight-only" in proc.stderr
