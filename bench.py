@@ -13,6 +13,7 @@ and Sebulba hot paths are perf-tracked alongside the PPO path
     sebulba_ppo_cartpole      — actor/learner split over the native C++ pool
 
 Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba] [--cpu]
+                       [--reps N]
   --all       run all five tracked configs, one JSON line each
   --smoke     tiny budget for CI wiring checks
   --cartpole  the round-1 metric: tiny-MLP CartPole (VPU-bound; kept for
@@ -23,6 +24,14 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba] [--c
               window measured inside the host loop)
   --cpu       force the CPU backend (a site hook can force a remote platform
               even over JAX_PLATFORMS=cpu; this flag wins)
+  --reps N    how many times the steady-state window is re-measured
+              (default 3 for the Anakin timed loop; Sebulba re-runs its
+              whole experiment per rep, so it defaults to 1 unless --reps is
+              explicit). Every payload carries the per-rep dispersion as
+              FIRST-CLASS fields — reps/median/min/max/rel_spread — so a
+              number whose reps disagree (BENCH_r04->r05 moved 2.5x with no
+              hot-path change) can never masquerade as a trend again;
+              `value` stays the best rep (today's semantics).
 """
 
 from __future__ import annotations
@@ -32,8 +41,44 @@ import sys
 import time
 
 
+def _parse_reps(argv: list) -> int | None:
+    """The --reps N value, or None when absent (workloads apply their own
+    default: 3 timed reps for Anakin — the historical non-smoke count, now
+    also applied under --smoke so even CI payloads carry a real rel_spread
+    (a smoke rep is a single tiny learn call) — and 1 full experiment for
+    Sebulba, whose rep is a whole run)."""
+    if "--reps" not in argv:
+        return None
+    idx = argv.index("--reps")
+    try:
+        reps = int(argv[idx + 1])
+    except (IndexError, ValueError):
+        sys.exit("--reps requires an integer, e.g. --reps 5")
+    if reps < 1:
+        sys.exit("--reps must be >= 1")
+    return reps
+
+
+def _rep_stats(values: list) -> dict:
+    """Dispersion of the per-rep steady-state measurements, as first-class
+    payload fields (ROADMAP item 3: a bench number without its spread is not
+    evidence). rel_spread = (max - min) / median; 0.0 for a single rep."""
+    import statistics
+
+    med = float(statistics.median(values))
+    lo, hi = float(min(values)), float(max(values))
+    return {
+        "reps": len(values),
+        "median": round(med, 1),
+        "min": round(lo, 1),
+        "max": round(hi, 1),
+        "rel_spread": round((hi - lo) / med, 4) if med > 0 else 0.0,
+    }
+
+
 def main() -> None:
     smoke = "--smoke" in sys.argv
+    reps = _parse_reps(sys.argv)
     large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
     cartpole = "--cartpole" in sys.argv
     sebulba = "--sebulba" in sys.argv
@@ -223,28 +268,29 @@ def main() -> None:
     if run_all:
         workloads = [
             ("anakin_ppo_ant_env_steps_per_sec",
-             lambda: _run_anakin_ppo(smoke, False, False, n_devices)),
+             lambda: _run_anakin_ppo(smoke, False, False, n_devices, reps=reps)),
             ("anakin_c51_snake_env_steps_per_sec",
              lambda: _run_anakin_generic(
                  "anakin_c51_snake_env_steps_per_sec",
                  "default/anakin/default_ff_c51.yaml",
                  _c51_setup, ["env=snake"], smoke, n_devices,
-                 "snake, sharded replay")),
+                 "snake, sharded replay", reps=reps)),
             ("anakin_sac_ant_env_steps_per_sec",
              lambda: _run_anakin_generic(
                  "anakin_sac_ant_env_steps_per_sec",
                  "default/anakin/default_ff_sac.yaml",
                  "stoix_tpu.systems.sac.ff_sac", ["env=ant"], smoke, n_devices,
-                 "ant, off-policy replay")),
+                 "ant, off-policy replay", reps=reps)),
             ("anakin_mz_cartpole_env_steps_per_sec",
              lambda: _run_anakin_generic(
                  "anakin_mz_cartpole_env_steps_per_sec",
                  "default/anakin/default_ff_mz.yaml",
                  "stoix_tpu.systems.search.ff_mz", [], smoke, n_devices,
-                 "cartpole, on-device MCTS")),
+                 "cartpole, on-device MCTS", reps=reps)),
             ("sebulba_ppo_cartpole_env_steps_per_sec",
              lambda: _run_sebulba(
-                 "sebulba_ppo_cartpole_env_steps_per_sec", smoke, n_devices)),
+                 "sebulba_ppo_cartpole_env_steps_per_sec", smoke, n_devices,
+                 reps=reps)),
         ]
         payloads = []
         for name, workload in workloads:
@@ -279,15 +325,16 @@ def main() -> None:
                 rollout_length=8 if smoke else 32,
                 num_evaluation=2 if smoke else 4,
                 pool_desc="84x84x4 C++ pixel pool, Nature CNN",
+                reps=reps,
             )
         ])
         return
 
     if sebulba:
-        _finish([_run_sebulba(metric, smoke, n_devices)])
+        _finish([_run_sebulba(metric, smoke, n_devices, reps=reps)])
         return
 
-    _finish([_run_anakin_ppo(smoke, cartpole, large, n_devices, metric=metric)])
+    _finish([_run_anakin_ppo(smoke, cartpole, large, n_devices, metric=metric, reps=reps)])
 
 
 def _resilience_selfcheck(config, skipped_before: float) -> dict:
@@ -311,9 +358,11 @@ def _skipped_updates_base() -> float:
     return guards.skipped_counter().value()
 
 
-def _timed_anakin_run(config, learner_setup, smoke: bool):
-    """Shared timed-loop core: compose -> setup -> warmup -> best-of-N timing.
-    Returns (steps_per_sec, n_devices_used)."""
+def _timed_anakin_run(config, learner_setup, smoke: bool, reps: int | None = None):
+    """Shared timed-loop core: compose -> setup -> warmup -> N timed reps of
+    the steady-state window (`--reps`, default 3). Returns
+    (best_steps_per_sec, per_rep_steps_per_sec) — the headline stays the best
+    rep; the full list feeds the dispersion fields."""
     import jax
     import numpy as np
 
@@ -359,14 +408,14 @@ def _timed_anakin_run(config, learner_setup, smoke: bool):
     learner_state = out.learner_state
 
     times = []
-    for _ in range(3 if not smoke else 1):
+    for _ in range(reps if reps is not None else 3):
         start = time.perf_counter()
         out = learn(learner_state)
         force(out)
         learner_state = out.learner_state
         times.append(time.perf_counter() - start)
 
-    return steps_per_call / min(times)
+    return steps_per_call / min(times), [steps_per_call / t for t in times]
 
 
 def _phase_breakdown_probe(
@@ -434,7 +483,7 @@ def _phase_breakdown_probe(
         observability.shutdown()
 
 
-def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
+def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None, reps=None) -> dict:
     from stoix_tpu.utils import config as config_lib
 
     env_tag = "cartpole" if cartpole else "ant"
@@ -473,7 +522,7 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
         from stoix_tpu.systems.ppo.anakin.ff_ppo_continuous import learner_setup
 
     skipped_before = _skipped_updates_base()
-    steps_per_sec = _timed_anakin_run(config, learner_setup, smoke)
+    steps_per_sec, rep_values = _timed_anakin_run(config, learner_setup, smoke, reps)
     per_chip = steps_per_sec / n_devices
     baseline_per_chip = 1_000_000 / 64  # BASELINE.json north star on v5e-64
     # Host-loop phase attribution + telemetry self-check from a tiny
@@ -490,6 +539,7 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None) -> dict:
         "vs_baseline": (
             None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
         ),
+        **_rep_stats(rep_values),
         "phase_breakdown": phase_breakdown,
         "telemetry": telemetry,
         "resilience": _resilience_selfcheck(config, skipped_before),
@@ -511,6 +561,7 @@ def _run_anakin_generic(
     smoke: bool,
     n_devices: int,
     unit_tag: str,
+    reps: int | None = None,
 ) -> dict:
     """One tracked non-PPO Anakin config: same timed loop, config-default run
     shape (the round-3 validated shapes live in the config defaults).
@@ -533,13 +584,14 @@ def _run_anakin_generic(
     if isinstance(setup_fn, str):
         setup_fn = importlib.import_module(setup_fn).learner_setup
     skipped_before = _skipped_updates_base()
-    steps_per_sec = _timed_anakin_run(config, setup_fn, smoke)
+    steps_per_sec, rep_values = _timed_anakin_run(config, setup_fn, smoke, reps)
     return {
         "metric": metric,
         "value": round(steps_per_sec, 1),
         "unit": f"env_steps/sec ({n_devices} devices, {unit_tag})",
         # Only the PPO/ant north star has a numeric baseline.
         "vs_baseline": None,
+        **_rep_stats(rep_values),
         "resilience": _resilience_selfcheck(config, skipped_before),
     }
 
@@ -554,6 +606,7 @@ def _run_sebulba(
     rollout_length: int | None = None,
     num_evaluation: int | None = None,
     pool_desc: str = "C++ pool",
+    reps: int | None = None,
 ) -> dict:
     """Sebulba PPO on the native C++ pool; steady-state SPS. Default workload
     is the CartPole pool; `--pixel` swaps in the full-resolution 84x84x4
@@ -600,8 +653,16 @@ def _run_sebulba(
     wait_labels = {"queue": "rollout", "actor": "0"}
     before = wait_hist.summary(wait_labels)
     skipped_before = _skipped_updates_base()
-    sebulba_ppo.run_experiment(config)
-    steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
+    # A Sebulba "rep" is a whole experiment (the steady window lives inside
+    # the run), so re-measurement defaults to 1 and scales only on an
+    # explicit --reps; `value` stays the best rep, like the Anakin loop.
+    steadies = []
+    for _ in range(reps if reps is not None else 1):
+        sebulba_ppo.run_experiment(config)
+        rep_steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
+        if rep_steady:
+            steadies.append(float(rep_steady))
+    steady = max(steadies) if steadies else None
     after = wait_hist.summary(wait_labels)
     d_count = int(after.get("count", 0)) - int(before.get("count", 0))
     d_sum = float(after.get("sum", 0.0)) - float(before.get("sum", 0.0))
@@ -631,6 +692,7 @@ def _run_sebulba(
         # Sebulba has no tracked numeric baseline (reference publishes
         # none for its sebulba arch); report the raw number.
         "vs_baseline": None,
+        **_rep_stats(steadies if steadies else [0.0]),
         "telemetry": telemetry,
         "resilience": resilience,
     }

@@ -23,6 +23,15 @@ pass) or 1. Wire it into CI or a SLURM prolog so a wedged chip or a bad
 config fails the batch in seconds instead of after scheduling.
 `--changed-only` restricts the lint stage to git-changed files so the prolog
 stays fast as the rule count grows.
+
+`--supervise N` (docs/DESIGN.md §2.6) makes `--local` runs elastic: a job
+that exits with the fleet-partition code (87, resilience/fleet.py — a peer
+host died and the survivors secured a local-shard emergency checkpoint) is
+relaunched up to N times at the surviving topology with resume overrides
+appended (`logger.checkpointing.load_model=true` + the emergency-store
+load_path); topology-elastic restore brings the params back bit-identical on
+the shrunk mesh. Any other exit code is final — 87 is the ONLY code that
+means "the run is healthy, the fleet was not".
 """
 
 from __future__ import annotations
@@ -159,6 +168,48 @@ def run_preflight_only(jobs: List[dict], changed_only: bool = False) -> int:
     return 0 if report.ok else 1
 
 
+def run_supervised(
+    cmd: List[str],
+    env: Optional[dict],
+    max_relaunches: int,
+    resume_overrides: List[str],
+) -> int:
+    """Supervision loop for one job (docs/DESIGN.md §2.6): relaunch on the
+    fleet-partition exit code — the code resilience/fleet.py reserves for "a
+    peer died, a local-shard emergency checkpoint is on disk" — with the
+    resume overrides appended so the relaunch restores through the
+    topology-elastic path at whatever topology survived. Every OTHER exit
+    code (clean 0, watchdog 86, crash 1) is final: only a partition is a
+    relaunch-and-resume situation. Returns the final exit code."""
+    from stoix_tpu.resilience.fleet import EXIT_CODE_FLEET_PARTITION
+
+    log = get_logger("stoix_tpu.launcher")
+    relaunches = 0
+    extra: List[str] = []
+    while True:
+        rc = subprocess.run(cmd + extra, env=env).returncode
+        if rc != EXIT_CODE_FLEET_PARTITION:
+            if relaunches:
+                log.info(
+                    "[launcher] job finished (rc %d) after %d fleet "
+                    "relaunch(es)", rc, relaunches,
+                )
+            return rc
+        if relaunches >= max_relaunches:
+            log.error(
+                "[launcher] fleet-partition exit (rc %d) with the relaunch "
+                "budget (%d) exhausted — giving up", rc, max_relaunches,
+            )
+            return rc
+        relaunches += 1
+        extra = list(resume_overrides)
+        log.warning(
+            "[launcher] fleet partition (rc %d): relaunching (%d/%d) at the "
+            "surviving topology with %s",
+            rc, relaunches, max_relaunches, " ".join(extra),
+        )
+
+
 def build_jobs(args: argparse.Namespace) -> List[dict]:
     jobs = []
     for module, env, seed in itertools.product(args.systems, args.envs, args.seeds):
@@ -189,6 +240,23 @@ def main(argv: List[str] | None = None) -> None:
         "changed vs HEAD (the analysis CLI's --changed-only selection), so "
         "the prolog stays fast as the rule count grows; full scan when git "
         "is unavailable",
+    )
+    parser.add_argument(
+        "--supervise",
+        type=int,
+        default=0,
+        metavar="N",
+        help="with --local: relaunch a job up to N times when it exits with "
+        "the fleet-partition code (87 — a peer host died and a local-shard "
+        "emergency checkpoint was secured; stoix_tpu/resilience/fleet.py), "
+        "appending resume overrides so topology-elastic restore resumes at "
+        "the surviving topology. 0 (default) disables supervision.",
+    )
+    parser.add_argument(
+        "--fleet-resume-path",
+        default=os.path.join("checkpoints", "fleet_emergency"),
+        help="emergency-store path the supervised relaunch resumes from "
+        "(must match arch.fleet.emergency_dir)",
     )
     parser.add_argument("--nodes", type=int, default=1)
     parser.add_argument("--time", default="04:00:00")
@@ -230,13 +298,19 @@ def main(argv: List[str] | None = None) -> None:
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = dict(os.environ)
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        resume_overrides = [
+            "logger.checkpointing.load_model=true",
+            f"logger.checkpointing.load_args.load_path={args.fleet_resume_path}",
+        ]
         for job in jobs:
             log.info("[launcher] running %s", job["name"])
-            subprocess.run(
-                [sys.executable, "-m", job["module"], *job["overrides"]],
-                check=True,
-                env=env,
-            )
+            cmd = [sys.executable, "-m", job["module"], *job["overrides"]]
+            if args.supervise > 0:
+                rc = run_supervised(cmd, env, args.supervise, resume_overrides)
+                if rc != 0:
+                    sys.exit(rc)
+            else:
+                subprocess.run(cmd, check=True, env=env)
         return
 
     os.makedirs(args.script_dir, exist_ok=True)

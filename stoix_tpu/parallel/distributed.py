@@ -23,6 +23,13 @@ def maybe_initialize_distributed(config: Optional[Any] = None) -> None:
     standard env vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
     JAX_PROCESS_ID, or a cloud-TPU environment where jax.distributed can
     auto-detect). No-op for single-process runs.
+
+    A HALF-configured launch — num_processes > 1 declared (config or env)
+    but no coordinator address anywhere — raises ConfigValidationError
+    instead of silently falling back to single-process: the old behavior let
+    a "pod" run train 1/N of the batch with every collective a local no-op
+    and NO error anywhere, which is the worst possible failure mode (wrong
+    numbers, green dashboards).
     """
     dist_cfg = None
     if config is not None:
@@ -33,6 +40,25 @@ def maybe_initialize_distributed(config: Optional[Any] = None) -> None:
         coordinator = dist_cfg["coordinator_address"]
 
     if coordinator is None:
+        declared = None
+        source = None
+        if dist_cfg and dist_cfg.get("num_processes") not in (None, "~"):
+            declared, source = dist_cfg.get("num_processes"), "arch.distributed.num_processes"
+        elif os.environ.get("JAX_NUM_PROCESSES"):
+            declared, source = os.environ["JAX_NUM_PROCESSES"], "JAX_NUM_PROCESSES"
+        if declared is not None and int(declared) > 1:
+            from stoix_tpu.resilience.errors import ConfigValidationError
+
+            raise ConfigValidationError(
+                [
+                    f"{source}={declared} declares a multi-process launch but "
+                    f"no coordinator address is set (JAX_COORDINATOR_ADDRESS "
+                    f"or arch.distributed.coordinator_address): refusing to "
+                    f"silently run single-process — this 'pod' would train "
+                    f"1/{int(declared)} of the batch with every cross-host "
+                    f"collective a local no-op and no error anywhere"
+                ]
+            )
         return  # single process (or an environment where auto-detect is unsafe)
 
     jax.distributed.initialize(

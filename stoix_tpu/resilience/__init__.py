@@ -29,6 +29,15 @@ Zero-dependency, off-by-default-transparent. Four pillars:
     watchdogs around first-compile/first-window that dump all thread stacks
     + the registry snapshot and raise `CompileStallError` instead of
     hanging. Opt-in via `arch.preflight`; off = bit-identical.
+  * **Fleet coordination** (fleet.py, docs/DESIGN.md §2.6): cross-host
+    agreement for multi-host SPMD runs — per-host preemption/fault flags
+    combined at each window boundary so ALL hosts drain and checkpoint at
+    the SAME window; a KV-store heartbeat + monitor that converts a dead
+    peer into a typed `FleetPartitionError`, a local-shard emergency
+    checkpoint, and exit code 87 (`EXIT_CODE_FLEET_PARTITION`) for the
+    launcher's elastic-relaunch supervision; per-host window wall-time skew
+    telemetry (`stoix_tpu_fleet_*`); and deadline-guarded barriers. Opt-in
+    via `arch.fleet`; off = bit-identical.
 
 With everything at defaults (`update_guard=off`, no faults armed, no crashes)
 training is bit-identical to a build without this package — guards add zero
@@ -36,7 +45,7 @@ ops, the signal handler only reacts to signals, and supervision only acts on
 failures (tests/test_resilience.py pins the trajectory equality).
 """
 
-from stoix_tpu.resilience import faultinject, guards, preflight  # noqa: F401 — public API
+from stoix_tpu.resilience import faultinject, fleet, guards, preflight  # noqa: F401 — public API
 from stoix_tpu.resilience.errors import (  # noqa: F401
     BackendUnavailableError,
     CheckpointIntegrityError,
@@ -45,9 +54,19 @@ from stoix_tpu.resilience.errors import (  # noqa: F401
     ConfigValidationError,
     DivergenceError,
     EvaluatorStallError,
+    FleetBarrierTimeout,
+    FleetError,
+    FleetPartitionError,
     InjectedFault,
     PreflightError,
     ResourcePreflightError,
+)
+from stoix_tpu.resilience.fleet import (  # noqa: F401
+    EXIT_CODE_FLEET_PARTITION,
+    FakeFleetStore,
+    FleetCoordinator,
+    FleetStragglerWarning,
+    fleet_from_config,
 )
 from stoix_tpu.resilience.preemption import PreemptionHandler  # noqa: F401
 from stoix_tpu.resilience.supervisor import (  # noqa: F401

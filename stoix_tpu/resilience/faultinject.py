@@ -31,6 +31,28 @@ Spec grammar: comma-separated `name[:arg]` entries (a mapping
   slow_compile:S  the host loop sleeps S seconds inside the watchdog-guarded
                   first-compile stage (one-shot) — drives the
                   CompileStallError path without needing a wedged backend
+  host_loss:N     this PROCESS freezes (SIGSTOP to itself — every thread
+                  including the fleet heartbeat publisher halts, sockets
+                  stay OPEN) right after dispatching eval window N: a host
+                  lost to a hung VM, a network partition, or a preemption
+                  freeze. This is the silent case jax's own coordination
+                  service cannot see (a crashed host that CLOSES its sockets
+                  is already fatal-error-propagated and aborted by jax
+                  itself); only fleet heartbeats catch it. Armed on one
+                  process of a multi-host run it drives the surviving peers'
+                  monitor to FleetPartitionError + the local-shard emergency
+                  checkpoint (resilience/fleet.py, docs/DESIGN.md §2.6). If
+                  something SIGCONTs the frozen process it os._exit(1)s —
+                  the host stays lost.
+  host_stall:S    this process sleeps S seconds at the top of eval window 1
+                  (one-shot) — a straggler host, alive but slow. Exercises
+                  the fleet skew telemetry (stoix_tpu_fleet_* gauges +
+                  FleetStragglerWarning) and heartbeat near-staleness, which
+                  host_loss cannot
+  barrier_wedge   fleet.guarded_barrier sleeps forever INSTEAD of arriving at
+                  the barrier — a peer that never shows up — so the barrier
+                  deadline watchdog's FleetBarrierTimeout path runs
+                  deterministically without a real dead host
 
 All injection points are no-ops (a single None check) when no plan is armed,
 and `configure()` is called once per experiment so one-shot state never leaks
@@ -60,6 +82,9 @@ _KNOWN = (
     "sigterm",
     "backend_wedge",
     "slow_compile",
+    "host_loss",
+    "host_stall",
+    "barrier_wedge",
 )
 
 
@@ -225,6 +250,76 @@ def maybe_slow_compile() -> None:
         "[faultinject] injecting %ds compile delay", secs
     )
     time.sleep(secs)
+
+
+def maybe_host_loss(window_idx: int) -> None:
+    """Freeze THIS process (SIGSTOP to itself: all threads — heartbeat
+    publisher included — halt; sockets stay open) after dispatching eval
+    window N when `host_loss:N` is armed. A freeze, not an exit: a host that
+    CLOSES its sockets is detected and fatal-propagated by jax's own
+    coordination service within milliseconds (every peer aborts, no
+    checkpoint, no exit code) — the failure mode that NEEDS the fleet layer
+    is the silent one, where nothing closes and every collective just stops
+    answering. The fleet e2e harness arms this on ONE process; the
+    survivors' recovery path is what's under test (the harness SIGKILLs the
+    frozen victim at cleanup)."""
+    plan = get_plan()
+    if plan is None:
+        return
+    at = plan.arg("host_loss")
+    if at is not None and window_idx == at and plan.consume("host_loss"):
+        _injected_counter().inc(labels={"fault": "host_loss"})
+        get_logger("stoix_tpu.resilience").warning(
+            "[faultinject] host_loss at window %d — freezing (SIGSTOP) NOW",
+            window_idx,
+        )
+        import sys
+
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGSTOP)
+        # Only reachable if something SIGCONTs the frozen process: the host
+        # is still "lost" — finish the job.
+        os._exit(1)
+
+
+def maybe_host_stall(window_idx: int) -> None:
+    """Sleep `host_stall:S` seconds at the top of eval window 1 (one-shot):
+    a straggler host, alive and heartbeating but slow — the skew-telemetry
+    failure mode, which host_loss (dead) cannot exercise."""
+    plan = get_plan()
+    if plan is None:
+        return
+    secs = plan.arg("host_stall")
+    if secs is None or window_idx != 1 or not plan.consume("host_stall"):
+        return
+    _injected_counter().inc(labels={"fault": "host_stall"})
+    get_logger("stoix_tpu.resilience").warning(
+        "[faultinject] host stalling %ds at window %d", secs, window_idx
+    )
+    time.sleep(secs)
+
+
+def maybe_barrier_wedge(barrier: str, max_wedge_s: float = 3600.0) -> None:
+    """Wedge (sleep, never arrive) instead of entering a fleet barrier when
+    `barrier_wedge` is armed (one-shot) — drives the barrier deadline
+    watchdog's FleetBarrierTimeout deterministically. The sleep is plain
+    Python, so the watchdog's interrupt_main() lands immediately."""
+    plan = get_plan()
+    if plan is None:
+        return
+    if plan.arg("barrier_wedge") is None or not plan.consume("barrier_wedge"):
+        return
+    _injected_counter().inc(labels={"fault": "barrier_wedge"})
+    get_logger("stoix_tpu.resilience").warning(
+        "[faultinject] wedging instead of arriving at barrier %r", barrier
+    )
+    # Sliced sleep (like maybe_stall_queue): interrupt_main only raises
+    # BETWEEN bytecodes, so the barrier watchdog's interrupt must find a
+    # bytecode boundary — one monolithic sleep would absorb it for the
+    # full wedge duration.
+    deadline = time.monotonic() + max_wedge_s
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
 
 
 def backend_wedge_armed() -> bool:

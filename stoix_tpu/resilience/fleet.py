@@ -1,0 +1,996 @@
+"""Cross-host fleet coordination for multi-host SPMD runs (docs/DESIGN.md §2.6).
+
+Every resilience mechanism from PRs 3-4 (PreemptionHandler, divergence
+guards, watchdogs, emergency checkpoint) is strictly per-process, but the
+canonical MULTI-HOST failures are collective: one preempted host that drains
+and checkpoints alone leaves its peers hanging forever in the next
+all-reduce, and a host that dies outright turns the whole pod into a silent
+infinite collective until the scheduler kills it. This module is the
+cross-host net, built on the `jax.distributed` key-value store when a
+multi-process runtime is live — with an injectable in-process fake
+(`FakeFleetStore`) so every path is unit-testable without spawning
+processes. Four pillars:
+
+  * **Agreed stop decisions** — per-host preemption/fault flags are combined
+    at each eval-window boundary so ALL hosts drain, emergency-checkpoint,
+    and exit at the SAME window: never a torn checkpoint, never a
+    one-host-exits-while-peers-hang-in-pmean. Two transports share one
+    decision rule (`FleetDecision`): the Anakin runner piggybacks a tiny
+    per-device payload (`telemetry_for_fetch`: stop-flag byte + window
+    wall-time) on its existing coalesced metric fetch — zero extra
+    collectives — while Sebulba exchanges window-indexed votes through the
+    KV store (`agree_at_window`).
+  * **Fleet heartbeat + partition detection** — each host publishes a
+    heartbeat sequence number off the hot path; a monitor thread converts a
+    stale peer into a typed `FleetPartitionError` naming the missing
+    process, writes the local-shard emergency checkpoint, interrupts the
+    main thread (which may be wedged inside a dead collective), and — after
+    `exit_grace_s` — hard-exits with `EXIT_CODE_FLEET_PARTITION` so the
+    supervising launcher can relaunch at the surviving topology.
+  * **Straggler skew telemetry** — per-host window wall-times are exchanged
+    via `process_allgather` and exported as `stoix_tpu_fleet_*` gauges; a
+    host slower than `skew_warn_ratio` x the fastest raises a typed
+    `FleetStragglerWarning`.
+  * **Deadline-guarded barriers** — `guarded_barrier` wraps cross-host
+    barriers in the PR 4 `Watchdog` stage machinery with a
+    `FleetBarrierTimeout` error factory, so a peer that never arrives leaves
+    a stack dump and a typed error instead of an indefinite hang.
+
+The local-shard emergency checkpoint (`emergency_save`) is the partition
+path's answer to "orbax saves are collective, and my peer is dead": each
+window the runner stages an on-device snapshot COPY of the learner state and
+promotes it to "confirmed" once that window's metrics materialize (stream
+ordering proves the producing programs completed, so reading the copy can
+never block on a dead peer's collective). On partition, the monitor saves
+the confirmed snapshot's host-readable leaves — replicated leaves carry the
+FULL global value, so params/opt state survive intact — as a plain .npz
+store with a JSON manifest. `restore_emergency` feeds it back through the
+same tree-path-matching placement machinery as PR 4's topology-elastic
+restore, so a survivor relaunched on the shrunk topology resumes with
+bit-identical params.
+
+Everything is opt-in via the `arch.fleet` config block; disabled (the
+default) no thread starts, no KV key is written, and the host loops are
+bit-identical (tests/test_fleet.py pins this).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from stoix_tpu.observability import get_logger, get_registry
+from stoix_tpu.resilience import faultinject
+from stoix_tpu.resilience.errors import (
+    FleetBarrierTimeout,
+    FleetError,
+    FleetPartitionError,
+)
+
+# Exit code of the partition path: distinct from Python's 1, the watchdog's
+# 86 (EXIT_CODE_STALL), and SIGKILL's 137, so the launcher's supervision
+# loop (stoix_tpu/launcher.py --supervise) can tell "peer died, relaunch at
+# the surviving topology" apart from every other failure.
+EXIT_CODE_FLEET_PARTITION = 87
+
+# Per-host stop-flag bits, combined at window boundaries. Any nonzero flag
+# anywhere in the fleet means EVERY host stops at that window.
+FLAG_PREEMPT = 1  # SIGTERM/SIGINT observed on this host
+FLAG_FAULT = 2  # host-local unrecoverable fault (embedder-raised)
+FLAG_PARTITION = 4  # this host's monitor already declared a partition
+
+MANIFEST_NAME = "fleet_manifest.json"
+_STATE_FILE = "state.npz"
+# numpy-native dtype kinds that np.savez round-trips faithfully; anything
+# else (ml_dtypes bfloat16/float8 register as kind 'V') is cast to float32
+# for storage and cast back to the template dtype on restore — lossless for
+# the narrower float.
+_PORTABLE_KINDS = frozenset("biufc")
+
+
+class FleetStragglerWarning(UserWarning):
+    """Typed slow-host warning: one host's window wall-time exceeded
+    `skew_warn_ratio` x the fleet's fastest. A persistent straggler is the
+    lockstep-all-reduce tax ROADMAP item 2 (gossip groups) exists to remove;
+    this warning is how it becomes visible before it becomes a timeout."""
+
+
+class FleetSettings(NamedTuple):
+    """Resolved `arch.fleet` config block (defaults applied)."""
+
+    enabled: bool
+    heartbeat_interval_s: float
+    heartbeat_timeout_s: float
+    monitor_poll_s: float
+    barrier_deadline_s: float
+    skew_warn_ratio: float
+    exit_grace_s: float
+    emergency_dir: str
+
+
+def settings_from_config(config: Any) -> FleetSettings:
+    cfg = (config.get("arch") or {}).get("fleet") or {}
+    return FleetSettings(
+        enabled=bool(cfg.get("enabled", False)),
+        heartbeat_interval_s=float(cfg.get("heartbeat_interval_s", 2.0)),
+        heartbeat_timeout_s=float(cfg.get("heartbeat_timeout_s", 30.0)),
+        monitor_poll_s=float(cfg.get("monitor_poll_s", 1.0)),
+        barrier_deadline_s=float(cfg.get("barrier_deadline_s", 600.0)),
+        skew_warn_ratio=float(cfg.get("skew_warn_ratio", 2.0)),
+        exit_grace_s=float(cfg.get("exit_grace_s", 30.0)),
+        emergency_dir=str(
+            cfg.get("emergency_dir") or os.path.join("checkpoints", "fleet_emergency")
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backends: the jax.distributed KV store, and an in-process fake for tests.
+# ---------------------------------------------------------------------------
+
+
+class JaxKVBackend:
+    """The live `jax.distributed` coordination-service KV store. All keys are
+    namespaced under `stoix_tpu/fleet/` so they can never collide with jax's
+    own coordination keys."""
+
+    _PREFIX = "stoix_tpu/fleet/"
+
+    def __init__(self, client: Any, process_index: int, process_count: int):
+        self._client = client
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+
+    def _k(self, key: str) -> str:
+        return self._PREFIX + key
+
+    def put(self, key: str, value: str) -> None:
+        # allow_overwrite: the coordination service's set is write-once by
+        # default, and heartbeats REWRITE their key every interval — without
+        # it every beat after the first fails and the whole fleet reads as
+        # stale. Older clients without the kwarg get delete-then-set.
+        try:
+            self._client.key_value_set(self._k(key), str(value), allow_overwrite=True)
+        except TypeError:
+            try:
+                self._client.key_value_delete(self._k(key))
+            except Exception:  # noqa: STX003 — a missing key is the normal first-write case
+                pass
+            self._client.key_value_set(self._k(key), str(value))
+
+    def try_get(self, key: str) -> Optional[str]:
+        """Non-blocking-ish read: a missing key answers None within ~one
+        coordination-RPC round-trip (this jax exposes no try_get, so a 50ms
+        blocking get is the probe)."""
+        try:
+            return self._client.blocking_key_value_get(self._k(key), 50)
+        except Exception:  # noqa: STX003 — NotFound/timeout both mean "no value yet"; the monitor treats None as a stale beat
+            return None
+
+    def get_blocking(self, key: str, timeout_s: float) -> Optional[str]:
+        try:
+            return self._client.blocking_key_value_get(
+                self._k(key), max(1, int(timeout_s * 1000))
+            )
+        except Exception:  # noqa: STX003 — a deadline-exceeded RPC means the peer never wrote; the caller converts None into FleetPartitionError
+            return None
+
+    def barrier(self, name: str, timeout_s: float) -> bool:
+        try:
+            self._client.wait_at_barrier(self._k(name), max(1, int(timeout_s * 1000)))
+            return True
+        except Exception:  # noqa: STX003 — barrier timeout; the caller raises the typed FleetBarrierTimeout
+            return False
+
+
+class FakeFleetStore:
+    """Shared in-process stand-in for the distributed KV store: N `view()`s
+    of one store behave like N processes' backends. This is the test seam —
+    agreement votes, heartbeat staleness, and monitor thresholds all run in
+    tier-1 with zero subprocesses."""
+
+    def __init__(self, num_processes: int):
+        self.num_processes = int(num_processes)
+        self._cond = threading.Condition()
+        self._data: Dict[str, str] = {}
+        self._barriers: Dict[str, set] = {}
+
+    def view(self, process_index: int) -> "FakeFleetBackend":
+        return FakeFleetBackend(self, process_index)
+
+    # -- store side, called by views ----------------------------------------
+    def put(self, key: str, value: str) -> None:
+        with self._cond:
+            self._data[key] = str(value)
+            self._cond.notify_all()
+
+    def try_get(self, key: str) -> Optional[str]:
+        with self._cond:
+            return self._data.get(key)
+
+    def get_blocking(self, key: str, timeout_s: float) -> Optional[str]:
+        with self._cond:
+            self._cond.wait_for(lambda: key in self._data, timeout=timeout_s)
+            return self._data.get(key)
+
+    def barrier(self, name: str, timeout_s: float, process_index: int) -> bool:
+        with self._cond:
+            arrived = self._barriers.setdefault(name, set())
+            arrived.add(int(process_index))
+            self._cond.notify_all()
+            return self._cond.wait_for(
+                lambda: len(self._barriers.get(name, ())) >= self.num_processes,
+                timeout=timeout_s,
+            )
+
+
+class FakeFleetBackend:
+    """One process's view of a FakeFleetStore (same protocol as
+    JaxKVBackend)."""
+
+    def __init__(self, store: FakeFleetStore, process_index: int):
+        self._store = store
+        self.process_index = int(process_index)
+        self.process_count = store.num_processes
+
+    def put(self, key: str, value: str) -> None:
+        self._store.put(key, value)
+
+    def try_get(self, key: str) -> Optional[str]:
+        return self._store.try_get(key)
+
+    def get_blocking(self, key: str, timeout_s: float) -> Optional[str]:
+        return self._store.get_blocking(key, timeout_s)
+
+    def barrier(self, name: str, timeout_s: float) -> bool:
+        return self._store.barrier(name, timeout_s, self.process_index)
+
+
+def live_backend() -> Optional[JaxKVBackend]:
+    """The real KV backend when `jax.distributed.initialize` has run in this
+    process; None otherwise (single-process runs need no store)."""
+    try:
+        import jax
+        from jax._src import distributed
+
+        client = getattr(distributed.global_state, "client", None)
+    except Exception:  # noqa: STX003 — a jax build without the distributed service simply has no fleet store
+        return None
+    if client is None:
+        return None
+    return JaxKVBackend(client, jax.process_index(), jax.process_count())
+
+
+# ---------------------------------------------------------------------------
+# Decisions
+# ---------------------------------------------------------------------------
+
+
+def describe_flags(bits: int) -> str:
+    names = []
+    if bits & FLAG_PREEMPT:
+        names.append("preempt")
+    if bits & FLAG_FAULT:
+        names.append("fault")
+    if bits & FLAG_PARTITION:
+        names.append("partition")
+    return "+".join(names) if names else "healthy"
+
+
+class FleetDecision(NamedTuple):
+    """The combined window-boundary verdict: identical on every host because
+    it is a pure function of the same exchanged flag set."""
+
+    stop: bool
+    flags: Dict[int, int]  # process_index -> flag bits
+
+    @property
+    def stopping_processes(self) -> List[int]:
+        return sorted(p for p, f in self.flags.items() if f)
+
+    def describe(self) -> str:
+        if not self.stop:
+            return "fleet healthy"
+        parts = ", ".join(
+            f"process {p}: {describe_flags(f)}" for p, f in sorted(self.flags.items()) if f
+        )
+        return f"fleet stop agreed ({parts})"
+
+
+# ---------------------------------------------------------------------------
+# Coordinator
+# ---------------------------------------------------------------------------
+
+
+class FleetCoordinator:
+    """Owns this process's fleet membership: local stop flags, the heartbeat
+    publisher + peer monitor threads, agreement transport, skew telemetry,
+    and the local-shard emergency checkpoint. Construct via
+    `fleet_from_config`; `start()` before the host loop, `stop()` in its
+    finally."""
+
+    def __init__(
+        self,
+        settings: FleetSettings,
+        backend: Optional[Any] = None,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+        allgather_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        interrupt_on_partition: bool = True,
+    ):
+        self.settings = settings
+        self._backend = backend
+        if process_index is None or process_count is None:
+            if backend is not None:
+                process_index = backend.process_index
+                process_count = backend.process_count
+            else:
+                import jax
+
+                process_index = jax.process_index()
+                process_count = jax.process_count()
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self._allgather_fn = allgather_fn
+        self._interrupt_on_partition = bool(interrupt_on_partition)
+
+        self._flag_lock = threading.Lock()
+        self._local_flags = 0
+        self._last_wall: Optional[float] = None
+        self._stop_notes: List[str] = []
+
+        self._stop_event = threading.Event()
+        self._publisher: Optional[threading.Thread] = None
+        self._monitor: Optional[threading.Thread] = None
+
+        self.partition_event = threading.Event()
+        self._partition_error: Optional[FleetPartitionError] = None
+        self._exit_timer: Optional[threading.Timer] = None
+
+        self._rescue_lock = threading.Lock()
+        self._candidates: Dict[int, Any] = {}
+        self._confirmed: Optional[Tuple[int, Any]] = None
+        self._saved_path: Optional[str] = None
+
+        self._prev_excepthook = None
+        self._log = get_logger("stoix_tpu.resilience")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "FleetCoordinator":
+        self._install_excepthook()
+        if self._backend is not None and self.process_count > 1:
+            self._backend.put(f"hb/{self.process_index}", "0")
+            self._publisher = threading.Thread(
+                target=self._publisher_loop, name="fleet-heartbeat", daemon=True
+            )
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="fleet-monitor", daemon=True
+            )
+            self._publisher.start()
+            self._monitor.start()
+            self._log.info(
+                "[fleet] coordination live: process %d/%d, heartbeat every "
+                "%.1fs, peer deadline %.1fs",
+                self.process_index, self.process_count,
+                self.settings.heartbeat_interval_s,
+                self.settings.heartbeat_timeout_s,
+            )
+        return self
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        for thread in (self._publisher, self._monitor):
+            if thread is not None:
+                thread.join(timeout=5.0)
+        self._publisher = self._monitor = None
+        # Always disarm the hard-exit timer: its one job is shooting a main
+        # thread WEDGED inside a dead collective, and a main thread that
+        # reached this stop() (the host loop's finally) has provably escaped.
+        # From here the typed error propagates normally — callers may catch
+        # it, and the uncaught case still exits 87 via the excepthook below.
+        if self._exit_timer is not None:
+            self._exit_timer.cancel()
+        # Keep the excepthook installed across a partition: the
+        # FleetPartitionError propagating out of the host loop AFTER this
+        # stop() is exactly what the hook translates into the fleet exit
+        # code for the supervising launcher.
+        if not self.partition_event.is_set():
+            self._restore_excepthook()
+
+    def __enter__(self) -> "FleetCoordinator":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()
+
+    # -- local flags ----------------------------------------------------------
+    def request_stop(self, flag: int, note: str = "") -> None:
+        """Record a host-local stop reason (idempotent). The fleet acts on it
+        at the NEXT window-boundary agreement, so all hosts act together."""
+        with self._flag_lock:
+            already = bool(self._local_flags & flag)
+            self._local_flags |= int(flag)
+            if note:
+                self._stop_notes.append(note)
+        if not already:
+            get_registry().counter(
+                "stoix_tpu_fleet_stop_requests_total",
+                "Host-local fleet stop requests, by reason",
+            ).inc(labels={"reason": describe_flags(flag)})
+            self._log.warning(
+                "[fleet] process %d requesting fleet stop (%s)%s — peers will "
+                "agree at the next window boundary",
+                self.process_index, describe_flags(flag),
+                f": {note}" if note else "",
+            )
+
+    @property
+    def local_flags(self) -> int:
+        with self._flag_lock:
+            return self._local_flags
+
+    # -- agreement + telemetry: device piggyback (Anakin) ---------------------
+    def _per_device_vector(self, mesh: Any, value: np.ndarray) -> Any:
+        """A [num_devices] global array carrying `value` (a length-1 host
+        array) on each of THIS host's mesh devices, assembled shard-wise.
+        After the fetch's replicate collective materializes, every host holds
+        every host's value at its devices' positions."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        devices = list(mesh.devices.flatten())
+        sharding = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+        local = [
+            jax.device_put(value, d)
+            for d in devices
+            if d.process_index == self.process_index
+        ]
+        return jax.make_array_from_single_device_arrays(
+            (len(devices),), sharding, local
+        )
+
+    def note_window_wall(self, wall_s: float) -> None:
+        """Record this host's most recent window wall-time; the NEXT
+        `telemetry_for_fetch` ships it fleet-wide. Going through coordinator
+        state (rather than a separate process_allgather at the window
+        boundary) keeps the cross-host collective SEQUENCE identical to the
+        fetch stream — a second, host-side collective interleaving with the
+        still-executing async fetch collectives is exactly the mismatched-op
+        crash Gloo punishes."""
+        with self._flag_lock:
+            self._last_wall = float(wall_s)
+
+    def telemetry_for_fetch(self, mesh: Any) -> Dict[str, Any]:
+        """The per-device fleet payload to merge into the coalesced metric
+        fetch: the stop-flag byte (agreement) and the most recent window
+        wall-time (straggler skew), both riding the all-reduce that was
+        already being paid — zero extra collectives."""
+        with self._flag_lock:
+            last_wall = getattr(self, "_last_wall", None)
+        flag = np.asarray([self.local_flags], dtype=np.uint8)
+        wall = np.asarray(
+            [np.nan if last_wall is None else last_wall], dtype=np.float32
+        )
+        if self.process_count == 1:
+            return {"flags": flag, "wall": wall}
+        return {
+            "flags": self._per_device_vector(mesh, flag),
+            "wall": self._per_device_vector(mesh, wall),
+        }
+
+    def _per_process(self, values: Any, mesh: Any = None) -> Dict[int, float]:
+        """Fold a materialized per-device vector into {process: value}.
+        Element order follows `mesh.devices.flatten()` (the sharding places
+        shard i on flattened device i)."""
+        flat = np.asarray(values).reshape(-1)
+        if mesh is None or self.process_count == 1:
+            return {self.process_index: flat.max(initial=0)}
+        per_process: Dict[int, float] = {}
+        for device, value in zip(mesh.devices.flatten(), flat):
+            p = int(device.process_index)
+            per_process[p] = max(per_process.get(p, value), value)
+        return per_process
+
+    def decide_from_fetch(self, payload: Any, mesh: Any = None) -> FleetDecision:
+        """Combine a materialized `telemetry_for_fetch` payload (or a bare
+        flag vector) into the fleet decision — a pure function of the shared
+        replicated data, so every host computes the same verdict."""
+        flags = payload["flags"] if isinstance(payload, dict) else payload
+        values = np.asarray(flags).reshape(-1)
+        if mesh is None or self.process_count == 1:
+            per_process = {self.process_index: int(values.max(initial=0))}
+        else:
+            per_process: Dict[int, int] = {}
+            for device, value in zip(mesh.devices.flatten(), values):
+                p = int(device.process_index)
+                per_process[p] = per_process.get(p, 0) | int(value)
+        return FleetDecision(any(per_process.values()), per_process)
+
+    def skew_from_fetch(
+        self, payload: Any, mesh: Any, window_idx: int
+    ) -> Optional[float]:
+        """Export straggler-skew telemetry from a materialized fetch payload.
+        Returns the slowest/fastest ratio, or None while any host has not yet
+        reported a wall-time (the first windows ship NaN)."""
+        if not isinstance(payload, dict) or "wall" not in payload:
+            return None
+        walls_by_process = self._per_process(payload["wall"], mesh)
+        walls = {p: float(w) for p, w in walls_by_process.items()}
+        if any(np.isnan(w) for w in walls.values()):
+            return None
+        return self._export_skew(walls, window_idx)
+
+    # -- agreement: KV votes (Sebulba / host-path) ----------------------------
+    def agree_at_window(
+        self, window_idx: int, timeout_s: Optional[float] = None
+    ) -> FleetDecision:
+        """Window-indexed vote exchange through the KV store: every host
+        publishes its flags under `vote/<window>/<pid>` then reads every
+        peer's vote for the SAME window with a bounded blocking get. All
+        hosts compute the decision from the same vote set, so all stop at
+        the same window. A peer that never votes within the deadline is a
+        partition."""
+        flags = self.local_flags
+        if self._backend is None or self.process_count == 1:
+            return FleetDecision(flags != 0, {self.process_index: flags})
+        deadline = (
+            float(timeout_s) if timeout_s is not None
+            else self.settings.barrier_deadline_s
+        )
+        self._backend.put(f"vote/{int(window_idx)}/{self.process_index}", str(flags))
+        votes: Dict[int, int] = {}
+        missing: List[int] = []
+        for p in range(self.process_count):
+            raw = self._backend.get_blocking(f"vote/{int(window_idx)}/{p}", deadline)
+            if raw is None:
+                missing.append(p)
+            else:
+                votes[p] = int(raw)
+        if missing:
+            raise self._declare_partition(
+                missing, deadline, detail=f"no agreement vote for window {window_idx}"
+            )
+        return FleetDecision(any(votes.values()), votes)
+
+    # -- heartbeats + partition detection -------------------------------------
+    def _publisher_loop(self) -> None:
+        seq = 0
+        while not self._stop_event.wait(self.settings.heartbeat_interval_s):
+            seq += 1
+            try:
+                self._backend.put(f"hb/{self.process_index}", str(seq))
+            except Exception as exc:  # noqa: STX003 — a failed beat must not kill the publisher; peers will see us stale, which IS the signal
+                self._log.warning("[fleet] heartbeat publish failed: %s", exc)
+
+    def _monitor_loop(self) -> None:
+        peers = [p for p in range(self.process_count) if p != self.process_index]
+        last_value: Dict[int, Optional[str]] = {p: None for p in peers}
+        started = time.monotonic()
+        last_change: Dict[int, float] = {p: started for p in peers}
+        age_gauge = get_registry().gauge(
+            "stoix_tpu_fleet_heartbeat_age_seconds",
+            "Seconds since each peer process's fleet heartbeat last advanced",
+        )
+        while not self._stop_event.wait(self.settings.monitor_poll_s):
+            now = time.monotonic()
+            stale: List[int] = []
+            for p in peers:
+                value = self._backend.try_get(f"hb/{p}")
+                if value is not None and value != last_value[p]:
+                    last_value[p] = value
+                    last_change[p] = now
+                age = now - last_change[p]
+                age_gauge.set(age, {"process": str(p)})
+                if age > self.settings.heartbeat_timeout_s:
+                    stale.append(p)
+            if stale:
+                self._on_partition(stale)
+                return
+
+    def _declare_partition(
+        self, missing: List[int], deadline_s: float, detail: str
+    ) -> FleetPartitionError:
+        """Record a partition verdict (idempotent) and return the typed
+        error. Shared by the monitor thread and the vote path."""
+        with self._flag_lock:
+            self._local_flags |= FLAG_PARTITION
+        if self._partition_error is None:
+            self._partition_error = FleetPartitionError(missing, deadline_s, detail)
+            get_registry().counter(
+                "stoix_tpu_fleet_partitions_total",
+                "Fleet partitions declared by this process",
+            ).inc()
+            self.partition_event.set()
+            self._log.error(
+                "[fleet] %s: %s",
+                type(self._partition_error).__name__, self._partition_error,
+            )
+        return self._partition_error
+
+    def _on_partition(self, stale: List[int]) -> None:
+        """Monitor-thread partition handler: declare, rescue-save, interrupt
+        the (possibly natively-wedged) main thread, and arm the hard exit."""
+        self._declare_partition(
+            stale, self.settings.heartbeat_timeout_s, detail="heartbeat silent"
+        )
+        # The rescue save runs HERE, on the monitor thread: the main thread
+        # may be blocked inside a collective that will never complete, and
+        # the confirmed snapshot is readable without it (see emergency_save).
+        try:
+            self.emergency_save()
+        except Exception as exc:  # noqa: STX003 — the exit path must proceed to the interrupt/hard-exit even if the rescue save fails
+            self._log.error("[fleet] emergency save failed: %s", exc)
+        if self._interrupt_on_partition:
+            if self.settings.exit_grace_s > 0:
+                self._exit_timer = threading.Timer(
+                    self.settings.exit_grace_s, self._hard_exit
+                )
+                self._exit_timer.daemon = True
+                self._exit_timer.start()
+            import _thread
+
+            _thread.interrupt_main()
+
+    def _hard_exit(self) -> None:
+        self._log.error(
+            "[fleet] main thread still wedged %.0fs after the partition was "
+            "declared (dead collective is uninterruptible) — hard exit %d",
+            self.settings.exit_grace_s, EXIT_CODE_FLEET_PARTITION,
+        )
+        sys.stderr.flush()
+        os._exit(EXIT_CODE_FLEET_PARTITION)
+
+    def check_partition(self) -> None:
+        """Raise the monitor's verdict on the calling thread, if one exists.
+        Host loops call this at window/update boundaries so a partition
+        detected while the main thread was in Python surfaces as the typed
+        error instead of a bare KeyboardInterrupt."""
+        if self.partition_event.is_set() and self._partition_error is not None:
+            raise self._partition_error
+
+    @property
+    def partition_error(self) -> Optional[FleetPartitionError]:
+        return self._partition_error
+
+    # -- exit-code translation ------------------------------------------------
+    def _install_excepthook(self) -> None:
+        prev = sys.excepthook
+        self._prev_excepthook = prev
+
+        def hook(exc_type, exc, tb):
+            prev(exc_type, exc, tb)
+            if isinstance(exc, FleetError):
+                sys.stderr.flush()
+                os._exit(EXIT_CODE_FLEET_PARTITION)
+
+        sys.excepthook = hook
+
+    def _restore_excepthook(self) -> None:
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+
+    # -- straggler skew telemetry ---------------------------------------------
+    def observe_window_wall(self, window_idx: int, wall_s: float) -> Optional[float]:
+        """Exchange this window's host wall-time with every peer via
+        `process_allgather` and export the skew telemetry. Returns the ratio
+        (None single-process). This is the HOST-PATH transport (Sebulba's
+        update loop, which runs no concurrent cross-host device collectives);
+        the Anakin runner must use the fetch piggyback
+        (`telemetry_for_fetch`/`skew_from_fetch`) instead — a host-side
+        gather interleaving with its still-executing async fetch collectives
+        would misorder the collective stream."""
+        if self.process_count == 1:
+            get_registry().gauge(
+                "stoix_tpu_fleet_window_wall_seconds",
+                "Per-host wall time of the most recent eval window",
+            ).set(float(wall_s), {"process": str(self.process_index)})
+            return None
+        gather = self._allgather_fn
+        if gather is None:
+            from stoix_tpu.parallel import process_allgather
+
+            gather = process_allgather
+        walls = np.asarray(
+            gather(np.asarray([float(wall_s)], dtype=np.float64))
+        ).reshape(-1)
+        return self._export_skew(
+            {p: float(w) for p, w in enumerate(walls)}, window_idx
+        )
+
+    def _export_skew(
+        self, walls: Dict[int, float], window_idx: int
+    ) -> Optional[float]:
+        """Export per-host wall gauges + the max/min skew ratio; a host
+        slower than `skew_warn_ratio` x the fastest warns with the typed
+        FleetStragglerWarning."""
+        registry = get_registry()
+        wall_gauge = registry.gauge(
+            "stoix_tpu_fleet_window_wall_seconds",
+            "Per-host wall time of the most recent eval window",
+        )
+        for p, wall in walls.items():
+            wall_gauge.set(wall, {"process": str(p)})
+        if len(walls) < 2:
+            return None
+        fastest = min(walls.values())
+        slowest = max(walls.values())
+        ratio = slowest / fastest if fastest > 0 else 1.0
+        registry.gauge(
+            "stoix_tpu_fleet_window_skew_ratio",
+            "Slowest-host / fastest-host wall-time ratio for the most recent window",
+        ).set(ratio)
+        if ratio > self.settings.skew_warn_ratio:
+            straggler = max(walls, key=lambda p: walls[p])
+            registry.counter(
+                "stoix_tpu_fleet_straggler_warnings_total",
+                "Windows whose host wall-time skew exceeded skew_warn_ratio",
+            ).inc(labels={"process": str(straggler)})
+            message = (
+                f"window {window_idx}: process {straggler} is a straggler — "
+                f"{slowest:.2f}s vs fastest {fastest:.2f}s "
+                f"({ratio:.1f}x > skew_warn_ratio {self.settings.skew_warn_ratio:.1f}); "
+                f"the lockstep all-reduce runs at the slowest host's pace"
+            )
+            warnings.warn(FleetStragglerWarning(message), stacklevel=2)
+            self._log.warning("[fleet] %s", message)
+        return ratio
+
+    # -- deadline-guarded barriers --------------------------------------------
+    def barrier(self, name: str, deadline_s: Optional[float] = None) -> None:
+        deadline = (
+            float(deadline_s) if deadline_s is not None
+            else self.settings.barrier_deadline_s
+        )
+        guarded_barrier(name, self._backend, deadline, exit_grace_s=self.settings.exit_grace_s)
+
+    # -- local-shard emergency checkpoint -------------------------------------
+    def stage_candidate(self, step: int, state: Any) -> None:
+        """Stage an on-device snapshot COPY of the learner state for window
+        `step`. The copy was enqueued on the device stream right after the
+        window's learn program, so its completion is implied by the window's
+        metrics materializing — at which point `confirm_candidate` promotes
+        it to the rescue snapshot the partition path may save. A small dict
+        (not a single slot): the pipelined runner stages window k+1's
+        candidate BEFORE window k's confirmation arrives, so the in-flight
+        and the just-staged candidate must coexist."""
+        with self._rescue_lock:
+            self._candidates[int(step)] = state
+            while len(self._candidates) > 2:
+                del self._candidates[min(self._candidates)]
+
+    def confirm_candidate(self, step: int) -> None:
+        with self._rescue_lock:
+            state = self._candidates.get(int(step))
+            if state is None:
+                return
+            self._confirmed = (int(step), state)
+            # Confirmed supersedes everything at or below it.
+            for stale in [s for s in self._candidates if s <= int(step)]:
+                del self._candidates[stale]
+
+    def emergency_save(self) -> Optional[str]:
+        """Write the confirmed rescue snapshot's host-readable leaves to
+        `<emergency_dir>/p<process_index>/` as state.npz + manifest
+        (idempotent; returns the directory, or None with nothing staged).
+
+        Replicated leaves carry the FULL global value (each host's
+        addressable shard IS the array), so params and optimizer state
+        survive a partition intact. Leaves that are only partially
+        addressable from this host (data-sharded env state, per-shard RNG
+        keys) are topology-bound anyway — they are recorded in the manifest
+        and reinitialized from the template on restore, exactly like the
+        topology-dependent leaves of PR 4's elastic restore."""
+        with self._rescue_lock:
+            if self._saved_path is not None:
+                return self._saved_path
+            staged = self._confirmed
+        if staged is None:
+            self._log.warning(
+                "[fleet] no confirmed rescue snapshot to save (partition "
+                "before the first completed window?)"
+            )
+            return None
+        step, state = staged
+        import jax
+
+        from stoix_tpu.utils.checkpointing import _path_key
+
+        directory = os.path.join(
+            self.settings.emergency_dir, f"p{self.process_index}"
+        )
+        os.makedirs(directory, exist_ok=True)
+        arrays: Dict[str, np.ndarray] = {}
+        partial: List[str] = []
+        casts: Dict[str, str] = {}
+        digests: Dict[str, str] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+            key = "/".join(_path_key(path))
+            value = self._host_value(leaf)
+            if value is None:
+                partial.append(key)
+                continue
+            arr = np.asarray(value)
+            if arr.dtype.kind not in _PORTABLE_KINDS:
+                casts[key] = str(arr.dtype)
+                arr = arr.astype(np.float32)
+            arrays[key] = arr
+            digests[key] = hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+        np.savez(os.path.join(directory, _STATE_FILE), **arrays)
+        manifest = {
+            "format": 1,
+            "step": int(step),
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+            "partial": sorted(partial),
+            "casts": casts,
+            "digests": digests,
+        }
+        tmp = os.path.join(directory, MANIFEST_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(tmp, os.path.join(directory, MANIFEST_NAME))
+        with self._rescue_lock:
+            self._saved_path = directory
+        self._log.warning(
+            "[fleet] local-shard emergency checkpoint secured: step %d, %d "
+            "leaf(s) (%d topology-bound leaf(s) skipped) at %s — resume with "
+            "logger.checkpointing.load_model=true "
+            "logger.checkpointing.load_args.load_path=%s",
+            step, len(arrays), len(partial), directory, self.settings.emergency_dir,
+        )
+        return directory
+
+    @staticmethod
+    def _host_value(leaf: Any) -> Optional[np.ndarray]:
+        """The full host value of a leaf, or None when this host cannot see
+        all of it (partially-addressable shard of a dead-peer global)."""
+        import jax
+
+        if not isinstance(leaf, jax.Array):
+            return np.asarray(leaf)
+        try:
+            if leaf.sharding.is_fully_replicated:
+                return np.asarray(leaf.addressable_data(0))
+            if leaf.is_fully_addressable:
+                return np.asarray(leaf)
+        except Exception:  # noqa: STX003 — a deleted/donated buffer cannot be rescued; record it as partial rather than lose the save
+            return None
+        return None
+
+
+def guarded_barrier(
+    name: str,
+    backend: Any,
+    deadline_s: float,
+    exit_grace_s: float = 0.0,
+) -> None:
+    """Cross-host barrier under a deadline watchdog (PR 4's stage machinery
+    with a fleet error factory): a peer that never arrives raises
+    FleetBarrierTimeout — with an all-thread stack dump — instead of hanging.
+    The watchdog deadline trails the backend's own timeout slightly, so the
+    backend's bounded wait answers first when it CAN; the watchdog is the
+    backstop for a backend whose native wait outlives its nominal timeout."""
+    from stoix_tpu.resilience.watchdog import Watchdog
+
+    if backend is None:
+        return
+    with Watchdog(
+        f"fleet_barrier:{name}",
+        deadline_s + min(5.0, 0.25 * deadline_s + 0.5),
+        hard_exit_grace_s=exit_grace_s,
+        error_factory=lambda _stage, _deadline, dump: FleetBarrierTimeout(
+            name, deadline_s, dump=dump
+        ),
+        exit_code=EXIT_CODE_FLEET_PARTITION,
+    ):
+        faultinject.maybe_barrier_wedge(name)
+        if not backend.barrier(name, deadline_s):
+            raise FleetBarrierTimeout(name, deadline_s)
+
+
+# ---------------------------------------------------------------------------
+# Emergency-store restore (feeds PR 4's tree-path placement machinery)
+# ---------------------------------------------------------------------------
+
+
+def _find_manifests(path: str) -> List[str]:
+    direct = os.path.join(path, MANIFEST_NAME)
+    if os.path.isfile(direct):
+        return [direct]
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return []
+
+    def _index(entry: str) -> Tuple[int, str]:
+        # Numeric survivor order: 'p10' must sort AFTER 'p2', or the
+        # documented lowest-process-index-wins tie-break silently picks the
+        # wrong store on pods with >= 10 survivors.
+        if entry.startswith("p") and entry[1:].isdigit():
+            return (int(entry[1:]), entry)
+        return (1 << 30, entry)
+
+    found = []
+    for entry in sorted(entries, key=_index):
+        candidate = os.path.join(path, entry, MANIFEST_NAME)
+        if os.path.isfile(candidate):
+            found.append(candidate)
+    return found
+
+
+def is_emergency_store(path: Any) -> bool:
+    """Whether `path` holds a fleet local-shard emergency checkpoint (its own
+    manifest, or per-survivor `p<N>/` subdirectories)."""
+    return bool(path) and bool(_find_manifests(str(path)))
+
+
+def restore_emergency(template: Any, path: str) -> Tuple[Any, int]:
+    """Restore a local-shard emergency store into `template`'s shardings via
+    the same tree-path matching + placement as topology-elastic restore
+    (utils/checkpointing.place_host_leaves): matched leaves round-trip
+    through the host bit-identical; manifest-recorded partial leaves (and
+    shape-mismatched topology-bound leaves) keep the template's fresh value.
+    With several survivors' stores present, the lowest process index wins —
+    replicated leaves are identical across survivors by construction."""
+    import jax
+
+    from stoix_tpu.utils.checkpointing import place_host_leaves
+
+    manifests = _find_manifests(str(path))
+    if not manifests:
+        raise FileNotFoundError(f"no fleet emergency manifest under {path}")
+    manifest_path = manifests[0]
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    step = int(manifest["step"])
+    directory = os.path.dirname(manifest_path)
+    with np.load(os.path.join(directory, _STATE_FILE)) as data:
+        raw = {key: data[key] for key in data.files}
+    # Cast storage-widened leaves back to the template's dtype (bfloat16 was
+    # stored as float32 — lossless to round-trip through the wider float).
+    template_dtypes = {
+        "/".join(_leaf_path_key(p)): getattr(leaf, "dtype", np.asarray(leaf).dtype)
+        for p, leaf in jax.tree_util.tree_flatten_with_path(template)[0]
+    }
+    for key in manifest.get("casts", {}):
+        if key in raw and key in template_dtypes:
+            raw[key] = raw[key].astype(template_dtypes[key])
+    raw_by_path = {tuple(key.split("/")): value for key, value in raw.items()}
+    restored, matched, reinitialized = place_host_leaves(
+        raw_by_path, template, step, allow_missing=True
+    )
+    get_logger("stoix_tpu.checkpoint").warning(
+        "[fleet] emergency restore of step %d from %s: %d leaf(s) restored "
+        "bit-identical, %d kept template initialization%s",
+        step, directory, matched, len(reinitialized),
+        f" ({'; '.join(reinitialized)})" if reinitialized else "",
+    )
+    return restored, step
+
+
+def _leaf_path_key(path: Any) -> Tuple[str, ...]:
+    from stoix_tpu.utils.checkpointing import _path_key
+
+    return _path_key(path)
+
+
+def fleet_from_config(
+    config: Any, backend: Optional[Any] = None
+) -> Optional[FleetCoordinator]:
+    """A started-able FleetCoordinator when `arch.fleet.enabled`, else None.
+    `backend` injects a FakeFleetBackend for tests; by default the live
+    jax.distributed KV store is used when one exists (single-process runs
+    coordinate trivially with no store)."""
+    settings = settings_from_config(config)
+    if not settings.enabled:
+        return None
+    return FleetCoordinator(settings, backend=backend or live_backend())

@@ -35,7 +35,7 @@ import sys
 import threading
 import time
 import traceback
-from typing import Optional
+from typing import Callable, Optional
 
 from stoix_tpu.observability import HeartbeatBoard, get_logger, get_registry
 from stoix_tpu.resilience.errors import CompileStallError
@@ -104,10 +104,21 @@ class Watchdog:
         deadline_s: float,
         hard_exit_grace_s: float = 0.0,
         board: Optional[HeartbeatBoard] = None,
+        error_factory: Optional[Callable[[str, float, Optional[str]], BaseException]] = None,
+        exit_code: int = EXIT_CODE_STALL,
     ):
         self.stage = stage
         self.deadline_s = float(deadline_s)
         self.hard_exit_grace_s = float(hard_exit_grace_s)
+        # The stall error to raise on expiry: (stage, deadline_s, dump) ->
+        # exception. Defaults to CompileStallError (the launch-hardening
+        # stages); fleet barriers (resilience/fleet.py) substitute
+        # FleetBarrierTimeout and the fleet exit code so the SAME deadline
+        # machinery serves both failure vocabularies.
+        self._error_factory = error_factory or (
+            lambda stage, deadline, dump: CompileStallError(stage, deadline, dump=dump)
+        )
+        self._exit_code = int(exit_code)
         self._board = board
         self._component = f"host-{stage}"
         self._timer: Optional[threading.Timer] = None
@@ -157,11 +168,11 @@ class Watchdog:
         get_logger("stoix_tpu.resilience").error(
             "[watchdog] main thread still wedged %.0fs after the '%s' stall "
             "dump (native call uninterruptible) — hard exit %d",
-            self.hard_exit_grace_s, self.stage, EXIT_CODE_STALL,
+            self.hard_exit_grace_s, self.stage, self._exit_code,
         )
         # Flush what we can: logging handlers buffer, and this process is done.
         sys.stderr.flush()
-        os._exit(EXIT_CODE_STALL)
+        os._exit(self._exit_code)
 
     # -- protected-section side ----------------------------------------------
     def __enter__(self) -> "Watchdog":
@@ -184,5 +195,5 @@ class Watchdog:
             # The KeyboardInterrupt interrupt_main() raised (when it landed —
             # the section may also have completed in the race window) is the
             # watchdog's own mechanism, not an operator ^C: convert it.
-            raise CompileStallError(self.stage, self.deadline_s, dump=self.dump) from exc
+            raise self._error_factory(self.stage, self.deadline_s, self.dump) from exc
         return False

@@ -95,14 +95,21 @@ class ThreadLifetime:
 
 
 class OnPolicyPipeline:
-    """Bounded rollout queues, one per actor thread."""
+    """Bounded rollout queues, one per actor thread.
 
-    def __init__(self, num_actors: int, max_size: int = 1):
+    `fleet` (optional, a resilience.fleet.FleetCoordinator) makes the
+    learner-side collect fleet-aware: a cross-host partition declared by the
+    fleet monitor fails the collect IMMEDIATELY with the typed
+    FleetPartitionError instead of burning the collect timeout against
+    actors that are healthy while the POD is dead (docs/DESIGN.md §2.6)."""
+
+    def __init__(self, num_actors: int, max_size: int = 1, fleet: Optional[Any] = None):
         self._queues: List[queue.Queue] = [queue.Queue(maxsize=max_size) for _ in range(num_actors)]
         self.heartbeats = HeartbeatBoard()
         self._depth, self._put_wait, self._get_wait = _queue_instruments()
         self._failures: Dict[int, ComponentFailure] = {}
         self._failure_lock = threading.Lock()
+        self._fleet = fleet
 
     def fail(self, actor_id: int, failure: ComponentFailure) -> None:
         """Poison-pill injection (supervisor path): record the failure and
@@ -135,6 +142,8 @@ class OnPolicyPipeline:
         detector = StallDetector(self.heartbeats, stale_after_s=max(1.0, timeout / 4))
         payloads = []
         for actor_id, q in enumerate(self._queues):
+            if self._fleet is not None:
+                self._fleet.check_partition()
             with self._failure_lock:
                 failure = self._failures.get(actor_id)
             if failure is not None:

@@ -48,6 +48,7 @@ from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.resilience import (
     PreemptionHandler,
     faultinject,
+    fleet,
     guards,
     preflight,
     supervisor_from_config,
@@ -522,7 +523,15 @@ def run_experiment(
 
     logger = StoixLogger(config)
     lifetime = ThreadLifetime()
-    pipeline = OnPolicyPipeline(num_actors)
+    # Fleet coordination (docs/DESIGN.md §2.6, arch.fleet): in a multi-host
+    # Sebulba deployment the learner loop exchanges window-indexed stop votes
+    # through the jax.distributed KV store (there is no coalesced device
+    # fetch to piggyback on here), publishes heartbeats, and fails collects
+    # fast on a declared partition. Off (default) = None = unchanged loop.
+    fleet_coord = fleet.fleet_from_config(config)
+    if fleet_coord is not None:
+        fleet_coord.start()
+    pipeline = OnPolicyPipeline(num_actors, fleet=fleet_coord)
     # One heartbeat board for the whole run: actor beats come from the
     # pipeline, param-server and evaluator beats land on the same board so
     # the stall detector sees every component's age.
@@ -591,6 +600,7 @@ def run_experiment(
     skipped_base = guards.skipped_counter().value()
     steady_start_time = None  # set after the first eval block (post-compile)
     steady_start_steps = 0
+    fleet_window_started = time.perf_counter()
     try:
         for update_idx in range(int(config.arch.num_updates)):
             with timer.time("rollout_get"):
@@ -628,9 +638,22 @@ def run_experiment(
             # raises DivergenceError here (metrics are already materialized
             # by the block_until_ready above — no extra sync).
             guards.publish_guard_metrics(guard_mode, train_metrics, t_steps)
-            if preempt.stop_requested():
-                preempt.acknowledge(t_steps)
-                break
+            if fleet_coord is None:
+                if preempt.stop_requested():
+                    preempt.acknowledge(t_steps)
+                    break
+            else:
+                # Fleet mode: never stop alone. The local preemption flag
+                # becomes this host's vote at the next eval-window boundary
+                # (below), so every host drains at the SAME window; a peer
+                # partition declared by the monitor raises the typed error
+                # here instead of wedging a future collective.
+                fleet_coord.check_partition()
+                if preempt.stop_requested():
+                    fleet_coord.request_stop(
+                        fleet.FLAG_PREEMPT,
+                        note=f"{preempt.signal_name} at update {update_idx}",
+                    )
 
             if (update_idx + 1) % int(config.arch.num_updates_per_eval) == 0:
                 # Drain actor metrics and log.
@@ -671,12 +694,45 @@ def run_experiment(
                     # been paid (end of the first eval block).
                     steady_start_time = time.perf_counter()
                     steady_start_steps = t_steps
+                if fleet_coord is not None:
+                    # Window-boundary agreement: exchange stop votes for THIS
+                    # window through the KV store — identical decision on
+                    # every host, so all drain together — and swap straggler
+                    # wall-times for the skew gauges.
+                    window_idx = (update_idx + 1) // int(config.arch.num_updates_per_eval)
+                    now = time.perf_counter()
+                    fleet_coord.observe_window_wall(
+                        window_idx, now - fleet_window_started
+                    )
+                    fleet_window_started = now
+                    decision = fleet_coord.agree_at_window(window_idx)
+                    if decision.stop:
+                        if preempt.stop_requested():
+                            preempt.acknowledge(t_steps)
+                        else:
+                            get_logger("stoix_tpu.sebulba").warning(
+                                "[fleet] %s — stopping at window %d in "
+                                "lockstep with the fleet",
+                                decision.describe(), window_idx,
+                            )
+                        break
         # Close the window BEFORE shutdown: thread joins / evaluator drain in
         # the finally block below can take tens of seconds and must not
         # deflate the steady-state number.
         steady_end_time = time.perf_counter()
+    except KeyboardInterrupt:
+        # The fleet monitor interrupts the main thread when a peer dies (it
+        # may be blocked in collect_rollouts' bounded get). Convert its
+        # interrupt into the typed error — the excepthook then translates it
+        # to EXIT_CODE_FLEET_PARTITION for the supervising launcher, exactly
+        # as in the Anakin runner. A genuine operator ^C re-raises untouched.
+        if fleet_coord is not None and fleet_coord.partition_event.is_set():
+            raise fleet_coord.partition_error from None
+        raise
     finally:
         preempt.uninstall()
+        if fleet_coord is not None:
+            fleet_coord.stop()
         lifetime.stop()
         param_server.shutdown()
         # Unblock actors waiting to enqueue (uninstrumented: drain gets are
@@ -723,6 +779,7 @@ def run_experiment(
         # Sebulba has no checkpoint path yet: a preemption stops cleanly but
         # cannot resume mid-run.
         "resume_capable": False,
+        "fleet": fleet_coord is not None,
     }
 
     logger.close()

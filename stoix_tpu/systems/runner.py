@@ -110,7 +110,14 @@ from stoix_tpu.parallel import (
     materialize,
     maybe_initialize_distributed,
 )
-from stoix_tpu.resilience import PreemptionHandler, Watchdog, faultinject, guards, preflight
+from stoix_tpu.resilience import (
+    PreemptionHandler,
+    Watchdog,
+    faultinject,
+    fleet,
+    guards,
+    preflight,
+)
 from stoix_tpu.utils.checkpointing import checkpointer_from_config
 from stoix_tpu.utils.jax_utils import aot_warmup
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
@@ -228,6 +235,14 @@ def run_anakin_experiment(
             )
     maybe_initialize_distributed(config)
     mesh = create_mesh(dict(config.arch.get("mesh") or {"data": -1}))
+    # Fleet coordination (docs/DESIGN.md §2.6, arch.fleet): cross-host agreed
+    # stop decisions (flags piggybacked on the coalesced metric fetch),
+    # heartbeat-based partition detection, straggler skew telemetry, and the
+    # local-shard emergency checkpoint. Off (the default) = None = zero extra
+    # work, bit-identical host loop.
+    fleet_coord = fleet.fleet_from_config(config)
+    if fleet_coord is not None:
+        fleet_coord.start()
     config = check_total_timesteps(config, int(mesh.shape["data"]))
     config.logger.system_name = config.system.system_name
 
@@ -247,18 +262,28 @@ def run_anakin_experiment(
     ckpt_cfg = config.logger.checkpointing
     start_step = 0
     if ckpt_cfg.get("load_model", False):
-        from stoix_tpu.utils.checkpointing import Checkpointer
-
         load_args = ckpt_cfg.get("load_args") or {}
-        loader = Checkpointer(
-            model_name=config.system.system_name,
-            rel_dir=load_args.get("load_path") or "checkpoints",
-            checkpoint_uid=load_args.get("checkpoint_uid"),
-        )
-        loader.check_version()
-        learner_state, start_step = loader.restore(
-            learner_state, load_args.get("timestep")
-        )
+        load_path = load_args.get("load_path")
+        if load_path and fleet.is_emergency_store(load_path):
+            # A fleet local-shard emergency store (a partition survivor's
+            # rescue save, docs/DESIGN.md §2.6): restore through the same
+            # tree-path placement as the topology-elastic path — params
+            # round-trip bit-identical onto the (possibly shrunk) new mesh.
+            learner_state, start_step = fleet.restore_emergency(
+                learner_state, load_path
+            )
+        else:
+            from stoix_tpu.utils.checkpointing import Checkpointer
+
+            loader = Checkpointer(
+                model_name=config.system.system_name,
+                rel_dir=load_path or "checkpoints",
+                checkpoint_uid=load_args.get("checkpoint_uid"),
+            )
+            loader.check_version()
+            learner_state, start_step = loader.restore(
+                learner_state, load_args.get("timestep")
+            )
         if is_coordinator():
             get_logger("stoix_tpu.checkpoint").info(
                 "[checkpoint] restored state from step %d", start_step
@@ -382,6 +407,14 @@ def run_anakin_experiment(
             if take_ckpt:
                 last_save_t = t
             ckpt_state = _tree_copy(learner_state) if take_ckpt else None
+            if fleet_coord is not None:
+                # Rescue candidate for the partition path: an on-device copy
+                # enqueued right after this window's learn, so once the
+                # window's metrics materialize the copy is provably complete
+                # and readable without any (possibly dead) peer.
+                fleet_coord.stage_candidate(
+                    t, ckpt_state if take_ckpt else _tree_copy(learner_state)
+                )
 
         if not fused:
             ts = time.perf_counter()
@@ -393,14 +426,20 @@ def run_anakin_experiment(
         # and eval metrics ride a single pytree -> a single host-sync point).
         ts = time.perf_counter()
         with span("fetch_dispatch", window=eval_idx):
-            metrics = fetch_global_async(
-                {
-                    "episode": dict(output.episode_metrics),
-                    "train": dict(output.train_metrics),
-                    "eval": dict(eval_metrics),
-                },
-                mesh,
-            )
+            tree = {
+                "episode": dict(output.episode_metrics),
+                "train": dict(output.train_metrics),
+                "eval": dict(eval_metrics),
+            }
+            if fleet_coord is not None:
+                # Agreed-stop + skew transport: a tiny per-device payload
+                # (stop-flag byte + last window wall-time) rides the SAME
+                # coalesced fetch collective — every host decodes every
+                # host's values when this window materializes, at zero extra
+                # collectives, and the cross-host collective SEQUENCE stays
+                # exactly the fetch stream (docs/DESIGN.md §2.6).
+                tree["fleet"] = fleet_coord.telemetry_for_fetch(mesh)
+            metrics = fetch_global_async(tree, mesh)
         phases.add("fetch_s", time.perf_counter() - ts)
         return _Window(eval_idx, t, snapshot, ckpt_state, metrics)
 
@@ -408,6 +447,7 @@ def run_anakin_experiment(
         """Host half: materialize the window's metrics, log, track best
         params, and hand the checkpoint snapshot to orbax (async, no wait)."""
         nonlocal best_params, best_return, final_return, window_done_at, last_save_t
+        nonlocal agreed_stop
         ts = time.perf_counter()
         with span("fetch_materialize", window=window.eval_idx):
             fetched = materialize(window.metrics)
@@ -417,6 +457,19 @@ def run_anakin_experiment(
         wall = now - window_done_at
         window_done_at = now
         window_walls.append(wall)
+
+        if fleet_coord is not None:
+            # This window's metrics are on the host, so (stream ordering) its
+            # learn completed: promote the rescue candidate, decode the
+            # fleet-wide flags + straggler wall-times, and record this
+            # window's wall for the next dispatch's payload.
+            fleet_coord.confirm_candidate(window.t)
+            payload = fetched.pop("fleet")
+            decision = fleet_coord.decide_from_fetch(payload, mesh)
+            if decision.stop and agreed_stop is None:
+                agreed_stop = decision
+            fleet_coord.skew_from_fetch(payload, mesh, window.eval_idx)
+            fleet_coord.note_window_wall(wall)
 
         episode_metrics = envs.get_final_step_metrics(fetched["episode"])
         train_metrics = fetched["train"]
@@ -479,11 +532,13 @@ def run_anakin_experiment(
     # resumes from the saved state instead of losing the window.
     preempt = PreemptionHandler().install()
     preempted = False
+    agreed_stop: Optional[fleet.FleetDecision] = None
     skipped_base = guards.skipped_counter().value()
     dispatched_t = start_step
     pending: Optional[_Window] = None
     try:
         for eval_idx in range(num_evaluation):
+            faultinject.maybe_host_stall(eval_idx)
             if eval_idx == profile_window:
                 try:
                     jax.profiler.start_trace(profile_dir)
@@ -503,6 +558,7 @@ def run_anakin_experiment(
                 window = dispatch_window(eval_idx)
             dispatched_t = window.t
             faultinject.maybe_sigterm(eval_idx)
+            faultinject.maybe_host_loss(eval_idx)
             if pipelined:
                 # Process LAST window's host work while the device runs this one.
                 if pending is not None:
@@ -510,17 +566,64 @@ def run_anakin_experiment(
                 pending = window
             else:
                 process_window(window)
-            if preempt.stop_requested():
-                preempted = True
-                break
+            if fleet_coord is None:
+                if preempt.stop_requested():
+                    preempted = True
+                    break
+            else:
+                # Fleet mode: a host-local stop request is never acted on
+                # alone — it becomes this host's flag on the NEXT window's
+                # fetch, and every host breaks together once the combined
+                # decision (identical everywhere, it is a pure function of
+                # the same replicated flag vector) comes back. A partition
+                # verdict from the monitor thread surfaces here as the typed
+                # error instead of a hung collective.
+                fleet_coord.check_partition()
+                if preempt.stop_requested():
+                    fleet_coord.request_stop(
+                        fleet.FLAG_PREEMPT,
+                        note=f"{preempt.signal_name} at window {eval_idx}",
+                    )
+                if agreed_stop is not None:
+                    preempted = True
+                    break
         # Drain the dispatcher: the final (or preemption-interrupted) window's
         # host half — metrics, logging, and its pending checkpoint snapshot.
         if pending is not None:
             process_window(pending)
             pending = None
 
+        if fleet_coord is not None and not preempted:
+            # Final-boundary agreement: a SIGTERM that landed during the last
+            # window(s) has no later fetch to carry its flag, so without this
+            # vote it would be silently dropped (no acknowledge, no forced
+            # emergency save, and a march into the absolute-metric eval under
+            # a scheduler kill deadline). One bounded KV vote — not a device
+            # collective — at a point every host reaches; every host computes
+            # the same verdict, so the skip-absolute decision stays
+            # collective-safe.
+            if preempt.stop_requested():
+                fleet_coord.request_stop(
+                    fleet.FLAG_PREEMPT,
+                    note=f"{preempt.signal_name} during the final window",
+                )
+            final_decision = fleet_coord.agree_at_window(num_evaluation)
+            if final_decision.stop:
+                if agreed_stop is None:
+                    agreed_stop = final_decision
+                preempted = True
+
         if preempted:
-            preempt.acknowledge(dispatched_t)
+            if preempt.stop_requested():
+                preempt.acknowledge(dispatched_t)
+            elif agreed_stop is not None:
+                # This host is stopping on a PEER's flag: same drain, same
+                # emergency checkpoint, same window — the coordinated half
+                # of graceful preemption (docs/DESIGN.md §2.6).
+                get_logger("stoix_tpu.resilience").warning(
+                    "[fleet] %s — draining and checkpointing at step %d in "
+                    "lockstep with the fleet", agreed_stop.describe(), dispatched_t,
+                )
             if checkpointer is not None:
                 if last_save_t != dispatched_t:
                     # The regular cadence did not cover the last completed
@@ -554,8 +657,19 @@ def run_anakin_experiment(
                     LogEvent.ABSOLUTE,
                 )
             final_return = float(abs_metrics["episode_return"].mean())
+    except KeyboardInterrupt:
+        # The fleet monitor interrupts the main thread when a peer dies (the
+        # main thread may even have been wedged inside the dead collective).
+        # Convert its interrupt into the typed error; a genuine operator ^C
+        # (no partition declared) re-raises untouched.
+        if fleet_coord is not None and fleet_coord.partition_event.is_set():
+            fleet_coord.emergency_save()  # idempotent; monitor usually saved
+            raise fleet_coord.partition_error from None
+        raise
     finally:
         preempt.uninstall()
+        if fleet_coord is not None:
+            fleet_coord.stop()
         if checkpointer is not None:
             # Drain in-flight async saves; otherwise interpreter shutdown races
             # orbax's executor ("cannot schedule new futures after shutdown").
@@ -584,6 +698,10 @@ def run_anakin_experiment(
                 "preempted": preempted,
                 "resume_capable": checkpointer is not None,
                 "preflight": pf.enabled,
+                "fleet": fleet_coord is not None,
+                "fleet_agreed_stop": (
+                    agreed_stop.describe() if agreed_stop is not None else None
+                ),
             },
         }
     )

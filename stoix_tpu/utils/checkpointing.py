@@ -88,6 +88,74 @@ def _path_key(path: Any) -> Tuple[str, ...]:
     return tuple(parts)
 
 
+def place_host_leaves(
+    raw_by_path: Dict[Tuple[str, ...], Any],
+    template: Any,
+    step: int,
+    allow_missing: bool = False,
+) -> Tuple[Any, int, List[str]]:
+    """Place host-materialized leaves into `template`'s structure and
+    shardings, matching by normalized tree-path — the placement half of the
+    topology-elastic restore (docs/DESIGN.md §2.4), shared with the fleet
+    local-shard emergency restore (resilience/fleet.py, §2.6).
+
+    Returns (tree, matched_count, reinitialized_descriptions). Shape
+    mismatches are topology-dependent state and keep the template's value;
+    dtype mismatches raise CheckpointIntegrityError (corruption, not
+    topology). A missing leaf raises unless `allow_missing` (the fleet store
+    legitimately omits partially-addressable leaves); zero matched leaves is
+    always an error — that is a different state, not a topology change."""
+    template_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    placed: List[Any] = []
+    reinitialized: List[str] = []
+    matched = 0
+    for path, ref in template_leaves:
+        key = _path_key(path)
+        if key not in raw_by_path:
+            if allow_missing:
+                reinitialized.append(
+                    f"{jax.tree_util.keystr(path)} (absent from the store)"
+                )
+                placed.append(ref)
+                continue
+            raise CheckpointIntegrityError(
+                step,
+                f"leaf {jax.tree_util.keystr(path)} missing from the "
+                f"checkpoint (resharded restore matches by tree-path)",
+            )
+        arr = np.asarray(raw_by_path[key])
+        ref_dtype = getattr(ref, "dtype", None) or np.asarray(ref).dtype
+        ref_shape = tuple(np.shape(ref))
+        if arr.dtype != ref_dtype:
+            raise CheckpointIntegrityError(
+                step,
+                f"dtype mismatch at {jax.tree_util.keystr(path)}: saved "
+                f"{arr.dtype} vs template {ref_dtype}",
+            )
+        if arr.shape != ref_shape:
+            # Topology-dependent global shape (e.g. the [num_shards, ...]
+            # per-shard key state): not portable across meshes by
+            # construction — keep the template's fresh value.
+            reinitialized.append(
+                f"{jax.tree_util.keystr(path)} (saved {arr.shape} vs "
+                f"template {ref_shape})"
+            )
+            placed.append(ref)
+            continue
+        matched += 1
+        if isinstance(ref, jax.Array):
+            placed.append(jax.device_put(arr, ref.sharding))
+        else:
+            placed.append(arr)
+    if matched == 0:
+        raise CheckpointIntegrityError(
+            step,
+            "resharded restore matched ZERO leaves by shape — this is a "
+            "different state entirely, not a topology change",
+        )
+    return treedef.unflatten(placed), matched, reinitialized
+
+
 class Checkpointer:
     def __init__(
         self,
@@ -314,48 +382,9 @@ class Checkpointer:
             _path_key(path): leaf
             for path, leaf in jax.tree_util.tree_flatten_with_path(raw)[0]
         }
-        template_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
-        placed: List[Any] = []
-        reinitialized: List[str] = []
-        matched = 0
-        for path, ref in template_leaves:
-            key = _path_key(path)
-            if key not in raw_by_path:
-                raise CheckpointIntegrityError(
-                    step,
-                    f"leaf {jax.tree_util.keystr(path)} missing from the "
-                    f"checkpoint (resharded restore matches by tree-path)",
-                )
-            arr = np.asarray(raw_by_path[key])
-            ref_dtype = getattr(ref, "dtype", None) or np.asarray(ref).dtype
-            ref_shape = tuple(np.shape(ref))
-            if arr.dtype != ref_dtype:
-                raise CheckpointIntegrityError(
-                    step,
-                    f"dtype mismatch at {jax.tree_util.keystr(path)}: saved "
-                    f"{arr.dtype} vs template {ref_dtype}",
-                )
-            if arr.shape != ref_shape:
-                # Topology-dependent global shape (e.g. the [num_shards, ...]
-                # per-shard key state): not portable across meshes by
-                # construction — keep the template's fresh value.
-                reinitialized.append(
-                    f"{jax.tree_util.keystr(path)} (saved {arr.shape} vs "
-                    f"template {ref_shape})"
-                )
-                placed.append(ref)
-                continue
-            matched += 1
-            if isinstance(ref, jax.Array):
-                placed.append(jax.device_put(arr, ref.sharding))
-            else:
-                placed.append(arr)
-        if matched == 0:
-            raise CheckpointIntegrityError(
-                step,
-                "resharded restore matched ZERO leaves by shape — this is a "
-                "different state entirely, not a topology change",
-            )
+        restored, matched, reinitialized = place_host_leaves(
+            raw_by_path, template, step
+        )
         if reinitialized:
             get_logger("stoix_tpu.checkpoint").warning(
                 "[checkpoint] elastic restore of step %d re-placed %d leaf(s) "
@@ -363,7 +392,7 @@ class Checkpointer:
                 "template initialization: %s",
                 step, matched, len(reinitialized), "; ".join(reinitialized),
             )
-        return treedef.unflatten(placed)
+        return restored
 
     def restore(
         self,

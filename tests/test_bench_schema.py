@@ -37,6 +37,15 @@ def test_bench_smoke_payload_schema():
     assert isinstance(payload["unit"], str) and "env_steps/sec" in payload["unit"]
     assert "vs_baseline" in payload
 
+    # Bench trustworthiness (ROADMAP item 3): the steady-state window is
+    # re-measured (--reps, default 3) and the dispersion rides the payload as
+    # first-class fields, so a noisy number can never masquerade as a trend.
+    assert payload["reps"] == 3, payload
+    assert payload["min"] <= payload["median"] <= payload["max"], payload
+    # `value` keeps its best-rep semantics: it IS the max-rate rep.
+    assert abs(payload["value"] - payload["max"]) <= 0.11, payload
+    assert payload["rel_spread"] >= 0.0, payload
+
     # Pipelined-runner phase attribution: all phases present, numeric, >= 0,
     # and the probe actually ran (no probe_error, nonzero compile).
     phases = payload["phase_breakdown"]
@@ -68,6 +77,33 @@ def test_bench_smoke_payload_schema():
     assert payload["fallback"] is False, payload
     assert payload["fallback_reason"] is None, payload
     assert payload["probe_attempts"] == 0, payload
+
+
+def _load_bench_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_rep_stats_and_reps_parsing():
+    bench = _load_bench_module()
+    # Single rep: today's shape plus the new fields, degenerate dispersion.
+    stats = bench._rep_stats([100.0])
+    assert stats == {
+        "reps": 1, "median": 100.0, "min": 100.0, "max": 100.0, "rel_spread": 0.0
+    }
+    stats = bench._rep_stats([100.0, 50.0, 80.0])
+    assert stats["reps"] == 3
+    assert (stats["min"], stats["median"], stats["max"]) == (50.0, 80.0, 100.0)
+    assert stats["rel_spread"] == round(50.0 / 80.0, 4)
+    # --reps parsing: absent -> None (workload defaults apply), explicit wins.
+    assert bench._parse_reps(["--smoke"]) is None
+    assert bench._parse_reps(["--smoke", "--reps", "5"]) == 5
 
 
 def test_bench_backend_wedge_aborts_typed_within_deadline():

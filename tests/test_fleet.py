@@ -1,0 +1,668 @@
+"""Fleet resilience layer (stoix_tpu/resilience/fleet.py, DESIGN.md §2.6).
+
+Every fleet mechanism is unit-tested here against the injectable
+FakeFleetStore — agreement votes, heartbeat staleness, monitor thresholds,
+skew telemetry, barrier deadlines, the local-shard emergency save/restore —
+plus the single-process runner integration pins (fleet on = bit-identical
+trajectory; SIGTERM under fleet = agreed stop + emergency checkpoint) and
+the launcher's elastic-relaunch supervision loop. The REAL 2-process
+`jax.distributed` paths live in tests/test_fleet_e2e.py (marked slow)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.resilience import faultinject, fleet
+from stoix_tpu.resilience.errors import (
+    ConfigValidationError,
+    FleetBarrierTimeout,
+    FleetPartitionError,
+)
+from stoix_tpu.utils import config as config_lib
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leakage():
+    yield
+    faultinject.reset()
+
+
+def _settings(**overrides):
+    base = dict(
+        enabled=True,
+        heartbeat_interval_s=0.05,
+        heartbeat_timeout_s=0.5,
+        monitor_poll_s=0.05,
+        barrier_deadline_s=1.0,
+        skew_warn_ratio=2.0,
+        exit_grace_s=0.0,  # unit tests must never arm the hard-exit timer
+        emergency_dir="checkpoints/fleet_emergency",
+    )
+    base.update(overrides)
+    return fleet.FleetSettings(**base)
+
+
+def _coordinator(store, pid, **settings_overrides):
+    """A coordinator over a fake-store view, safe for in-process tests:
+    no interrupt_main, no hard exit."""
+    return fleet.FleetCoordinator(
+        _settings(**settings_overrides),
+        backend=store.view(pid),
+        interrupt_on_partition=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Settings / construction
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_from_config_default_off_and_settings_resolve():
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml", []
+    )
+    assert fleet.fleet_from_config(cfg) is None  # off by default
+    settings = fleet.settings_from_config(cfg)
+    assert settings.enabled is False
+    assert settings.heartbeat_timeout_s == 30.0
+    assert settings.emergency_dir == os.path.join("checkpoints", "fleet_emergency")
+    on = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        ["arch.fleet.enabled=True"],
+    )
+    coord = fleet.fleet_from_config(on)
+    assert coord is not None and coord.process_count == 1
+
+
+# ---------------------------------------------------------------------------
+# Agreement: decisions, device-flag decode, KV votes
+# ---------------------------------------------------------------------------
+
+
+def test_decision_and_flag_describe():
+    d = fleet.FleetDecision(True, {0: fleet.FLAG_PREEMPT, 1: 0})
+    assert d.stopping_processes == [0]
+    assert "process 0: preempt" in d.describe()
+    assert fleet.describe_flags(0) == "healthy"
+    assert fleet.describe_flags(fleet.FLAG_PREEMPT | fleet.FLAG_PARTITION) == (
+        "preempt+partition"
+    )
+
+
+def test_decide_from_fetch_maps_devices_to_processes():
+    store = fleet.FakeFleetStore(2)
+    coord = _coordinator(store, 0)
+    # Fake 4-device mesh: devices 0-1 on process 0, devices 2-3 on process 1.
+    devices = np.array(
+        [types.SimpleNamespace(process_index=p) for p in (0, 0, 1, 1)]
+    )
+    mesh = types.SimpleNamespace(devices=devices)
+    decision = coord.decide_from_fetch(np.asarray([0, 0, 1, 1], np.uint8), mesh)
+    assert decision.stop and decision.flags == {0: 0, 1: fleet.FLAG_PREEMPT}
+    healthy = coord.decide_from_fetch(np.zeros(4, np.uint8), mesh)
+    assert not healthy.stop
+
+
+def test_telemetry_for_fetch_single_process_is_plain_numpy():
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        ["arch.fleet.enabled=True"],
+    )
+    coord = fleet.fleet_from_config(cfg)
+    payload = coord.telemetry_for_fetch(mesh=None)
+    assert isinstance(payload["flags"], np.ndarray)
+    assert payload["flags"].tolist() == [0]
+    assert np.isnan(payload["wall"]).all()  # no window measured yet
+    coord.request_stop(fleet.FLAG_PREEMPT, note="unit")
+    coord.note_window_wall(1.5)
+    payload = coord.telemetry_for_fetch(mesh=None)
+    assert payload["wall"].tolist() == [1.5]
+    decision = coord.decide_from_fetch(payload)
+    assert decision.stop and decision.flags == {0: fleet.FLAG_PREEMPT}
+    # NaN walls (first windows) suppress the skew export entirely.
+    assert coord.skew_from_fetch({"wall": np.asarray([np.nan])}, None, 0) is None
+
+
+def test_skew_from_fetch_decodes_per_process_and_warns():
+    store = fleet.FakeFleetStore(2)
+    coord = _coordinator(store, 0, skew_warn_ratio=2.0)
+    devices = np.array(
+        [types.SimpleNamespace(process_index=p) for p in (0, 0, 1, 1)]
+    )
+    mesh = types.SimpleNamespace(devices=devices)
+    payload = {"wall": np.asarray([1.0, 1.0, 5.0, 5.0], np.float32)}
+    with pytest.warns(fleet.FleetStragglerWarning, match="process 1 is a straggler"):
+        ratio = coord.skew_from_fetch(payload, mesh, 2)
+    assert ratio == pytest.approx(5.0)
+
+
+def test_agreement_votes_stop_together_at_same_window():
+    store = fleet.FakeFleetStore(2)
+    a, b = _coordinator(store, 0), _coordinator(store, 1)
+    results = {}
+
+    import threading
+
+    def vote(coord, name, window):
+        results[name] = coord.agree_at_window(window, timeout_s=5.0)
+
+    t = threading.Thread(target=vote, args=(b, "b0", 0))
+    t.start()
+    vote(a, "a0", 0)
+    t.join(timeout=10.0)
+    assert not results["a0"].stop and not results["b0"].stop
+    # Window 1: host 0 was preempted — BOTH must decide stop, naming host 0.
+    a.request_stop(fleet.FLAG_PREEMPT, note="SIGTERM")
+    t = threading.Thread(target=vote, args=(b, "b1", 1))
+    t.start()
+    vote(a, "a1", 1)
+    t.join(timeout=10.0)
+    for name in ("a1", "b1"):
+        assert results[name].stop, results
+        assert results[name].stopping_processes == [0]
+    assert results["a1"].flags == results["b1"].flags  # identical verdicts
+
+
+def test_agreement_missing_vote_is_a_partition():
+    store = fleet.FakeFleetStore(2)
+    a = _coordinator(store, 0)
+    # Peer 1 never votes: the bounded get expires and the typed error names it.
+    with pytest.raises(FleetPartitionError) as excinfo:
+        a.agree_at_window(0, timeout_s=0.2)
+    assert excinfo.value.missing_processes == [1]
+    assert "process 1" in str(excinfo.value)
+    # The verdict is sticky: check_partition now raises too.
+    with pytest.raises(FleetPartitionError):
+        a.check_partition()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats / partition monitor
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_monitor_names_dead_peer():
+    store = fleet.FakeFleetStore(2)
+    a = _coordinator(store, 0)
+    b = _coordinator(store, 1)
+    a.start()
+    b.start()
+    try:
+        # Healthy while both publish: no partition within several timeouts.
+        time.sleep(0.3)
+        assert not a.partition_event.is_set()
+        assert not b.partition_event.is_set()
+        # Kill A's publisher (A "dies"); B must declare within the deadline.
+        a.stop()
+        deadline = time.monotonic() + 5.0
+        while not b.partition_event.is_set() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert b.partition_event.is_set(), "monitor never declared the partition"
+        with pytest.raises(FleetPartitionError) as excinfo:
+            b.check_partition()
+        assert excinfo.value.missing_processes == [0]
+        assert "process 0" in str(excinfo.value)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_heartbeat_monitor_no_false_positive_while_beating():
+    store = fleet.FakeFleetStore(2)
+    a = _coordinator(store, 0, heartbeat_timeout_s=0.4)
+    b = _coordinator(store, 1, heartbeat_timeout_s=0.4)
+    a.start()
+    b.start()
+    try:
+        time.sleep(1.0)  # several timeout periods of healthy publishing
+        assert not a.partition_event.is_set()
+        assert not b.partition_event.is_set()
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Straggler skew telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_skew_warns_and_exports_gauges():
+    from stoix_tpu.observability import get_registry
+
+    store = fleet.FakeFleetStore(2)
+    coord = fleet.FleetCoordinator(
+        _settings(skew_warn_ratio=2.0),
+        backend=store.view(0),
+        allgather_fn=lambda x: np.asarray([[1.0], [5.0]]),
+        interrupt_on_partition=False,
+    )
+    with pytest.warns(fleet.FleetStragglerWarning, match="process 1 is a straggler"):
+        ratio = coord.observe_window_wall(3, 1.0)
+    assert ratio == pytest.approx(5.0)
+    gauge = get_registry().gauge("stoix_tpu_fleet_window_wall_seconds")
+    assert gauge.value({"process": "1"}) == pytest.approx(5.0)
+    assert get_registry().gauge(
+        "stoix_tpu_fleet_window_skew_ratio"
+    ).value() == pytest.approx(5.0)
+    # Balanced fleet: no warning.
+    coord._allgather_fn = lambda x: np.asarray([[1.0], [1.2]])
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error", fleet.FleetStragglerWarning)
+        assert coord.observe_window_wall(4, 1.0) == pytest.approx(1.2)
+
+
+def test_skew_single_process_skips_allgather():
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        ["arch.fleet.enabled=True"],
+    )
+    coord = fleet.fleet_from_config(cfg)
+    assert coord.observe_window_wall(0, 0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# Deadline-guarded barriers
+# ---------------------------------------------------------------------------
+
+
+def test_guarded_barrier_passes_when_all_arrive():
+    import threading
+
+    store = fleet.FakeFleetStore(2)
+    errors = []
+
+    def arrive(pid):
+        try:
+            fleet.guarded_barrier("sync", store.view(pid), deadline_s=5.0)
+        except Exception as exc:  # pragma: no cover - failure detail for assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=arrive, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errors
+
+
+def test_guarded_barrier_times_out_typed_when_peer_never_arrives():
+    store = fleet.FakeFleetStore(2)
+    start = time.monotonic()
+    with pytest.raises(FleetBarrierTimeout) as excinfo:
+        fleet.guarded_barrier("lonely", store.view(0), deadline_s=0.3)
+    assert time.monotonic() - start < 10.0
+    assert excinfo.value.barrier == "lonely"
+
+
+def test_barrier_wedge_fault_trips_the_watchdog(monkeypatch):
+    # barrier_wedge: this host never ARRIVES (sleeps in Python), so the fake
+    # store's own bounded wait never runs — the watchdog's interrupt is the
+    # only net, and it must convert to the typed FleetBarrierTimeout.
+    monkeypatch.setenv("STOIX_TPU_FAULT", "barrier_wedge")
+    faultinject.configure()
+    store = fleet.FakeFleetStore(1)  # alone: the barrier itself would pass
+    with pytest.raises(FleetBarrierTimeout) as excinfo:
+        fleet.guarded_barrier("wedged", store.view(0), deadline_s=0.3)
+    assert excinfo.value.dump is not None and "thread" in excinfo.value.dump
+
+
+# ---------------------------------------------------------------------------
+# Local-shard emergency save / restore
+# ---------------------------------------------------------------------------
+
+
+def _rescue_coord(tmp_path):
+    return fleet.FleetCoordinator(
+        _settings(emergency_dir=str(tmp_path / "fleet_emergency")),
+        backend=None,
+        process_index=0,
+        process_count=1,
+        interrupt_on_partition=False,
+    )
+
+
+def test_emergency_save_restore_roundtrip_bit_identical(tmp_path):
+    coord = _rescue_coord(tmp_path)
+    state = {
+        "params": {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.ones(4)},
+        "count": jnp.asarray(7, jnp.int32),
+        "bf": jnp.arange(6.0, dtype=jnp.bfloat16),
+    }
+    assert coord.emergency_save() is None  # nothing staged yet
+    coord.stage_candidate(500, state)
+    assert coord.emergency_save() is None  # staged but not CONFIRMED complete
+    coord.confirm_candidate(500)
+    path = coord.emergency_save()
+    assert path is not None and os.path.isfile(os.path.join(path, "state.npz"))
+    assert coord.emergency_save() == path  # idempotent
+
+    root = str(tmp_path / "fleet_emergency")
+    assert fleet.is_emergency_store(root)
+    assert not fleet.is_emergency_store(str(tmp_path / "nope"))
+
+    template = jax.tree.map(jnp.zeros_like, state)
+    restored, step = fleet.restore_emergency(template, root)
+    assert step == 500
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored, state,
+    )
+    # The bfloat16 leaf (npz-unportable dtype) restored to its exact dtype.
+    assert restored["bf"].dtype == jnp.bfloat16
+
+
+def test_emergency_restore_reinitializes_topology_bound_leaves(tmp_path):
+    coord = _rescue_coord(tmp_path)
+    state = {
+        "params": {"w": jnp.arange(4.0)},
+        "per_shard_keys": jnp.zeros((8, 2), jnp.uint32) + 3,
+    }
+    coord.stage_candidate(10, state)
+    coord.confirm_candidate(10)
+    coord.emergency_save()
+    # New topology: fewer shards -> different global shape for the key state.
+    template = {
+        "params": {"w": jnp.zeros(4)},
+        "per_shard_keys": jnp.ones((2, 2), jnp.uint32),
+    }
+    restored, step = fleet.restore_emergency(template, str(tmp_path / "fleet_emergency"))
+    assert step == 10
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]), np.arange(4.0))
+    # Shape-mismatched leaf kept the TEMPLATE value (fresh init), not garbage.
+    np.testing.assert_array_equal(
+        np.asarray(restored["per_shard_keys"]), np.ones((2, 2), np.uint32)
+    )
+
+
+def test_find_manifests_orders_survivors_numerically(tmp_path):
+    # 'p10' must sort AFTER 'p2' (lowest process index wins the restore).
+    for pid in (10, 2):
+        d = tmp_path / f"p{pid}"
+        d.mkdir()
+        (d / fleet.MANIFEST_NAME).write_text("{}")
+    manifests = fleet._find_manifests(str(tmp_path))
+    assert [os.path.basename(os.path.dirname(m)) for m in manifests] == ["p2", "p10"]
+
+
+def test_manifest_digests_match_saved_arrays(tmp_path):
+    import hashlib
+
+    coord = _rescue_coord(tmp_path)
+    state = {"w": jnp.arange(8.0)}
+    coord.stage_candidate(1, state)
+    coord.confirm_candidate(1)
+    path = coord.emergency_save()
+    with open(os.path.join(path, fleet.MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    assert manifest["step"] == 1 and manifest["partial"] == []
+    expected = hashlib.sha256(
+        np.ascontiguousarray(np.arange(8.0, dtype=np.float32)).tobytes()
+    ).hexdigest()
+    assert manifest["digests"]["w"] == expected
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection spec additions
+# ---------------------------------------------------------------------------
+
+
+def test_new_fault_specs_parse_and_are_noops_unarmed():
+    plan = faultinject.parse_spec("host_loss:2,host_stall:1,barrier_wedge")
+    assert plan.arg("host_loss") == 2
+    assert plan.arg("host_stall") == 1
+    assert plan.arg("barrier_wedge") == 0
+    faultinject.reset()
+    # Unarmed: every injection point is a no-op single None-check.
+    faultinject.maybe_host_loss(0)
+    faultinject.maybe_host_stall(1)
+    faultinject.maybe_barrier_wedge("x")
+
+
+def test_host_stall_fires_once_at_window_one(monkeypatch):
+    monkeypatch.setenv("STOIX_TPU_FAULT", "host_stall:0")  # 0s stall: instant
+    faultinject.configure()
+    faultinject.maybe_host_stall(0)  # not window 1: must not consume
+    assert faultinject.get_plan().consume("host_stall") is True
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# Half-configured distributed launch (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_half_configured_distributed_launch_raises(monkeypatch):
+    from stoix_tpu.parallel import maybe_initialize_distributed
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    # Plain single-process: still a no-op.
+    maybe_initialize_distributed(None)
+    # Config variant: num_processes declared, no coordinator anywhere.
+    cfg = config_lib.Config.from_dict(
+        {"arch": {"distributed": {"num_processes": 4}}}
+    )
+    with pytest.raises(ConfigValidationError, match="num_processes=4"):
+        maybe_initialize_distributed(cfg)
+    # Env-var-only variant.
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    with pytest.raises(ConfigValidationError, match="JAX_NUM_PROCESSES"):
+        maybe_initialize_distributed(None)
+    # Declared but single process: fine.
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    maybe_initialize_distributed(None)
+
+
+# ---------------------------------------------------------------------------
+# Launcher supervision loop (elastic relaunch, satellite of the tentpole)
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import os, sys
+marker = sys.argv[1]
+argv_log = sys.argv[2]
+with open(argv_log, "a") as f:
+    f.write("ARGS:" + " ".join(sys.argv[3:]) + "\n")
+if os.path.exists(marker):
+    sys.exit(0)          # relaunch: healthy at the surviving topology
+open(marker, "w").close()
+sys.exit(87)             # first run: fleet partition
+"""
+
+
+def test_run_supervised_relaunches_on_fleet_exit_code(tmp_path):
+    from stoix_tpu.launcher import run_supervised
+
+    marker = str(tmp_path / "died_once")
+    argv_log = str(tmp_path / "argv.log")
+    cmd = [sys.executable, "-c", _CHILD, marker, argv_log]
+    resume = [
+        "logger.checkpointing.load_model=true",
+        "logger.checkpointing.load_args.load_path=checkpoints/fleet_emergency",
+    ]
+    rc = run_supervised(cmd, env=dict(os.environ), max_relaunches=2, resume_overrides=resume)
+    assert rc == 0
+    lines = open(argv_log).read().splitlines()
+    assert len(lines) == 2, lines
+    assert lines[0] == "ARGS:"  # first launch: no resume overrides
+    assert "load_model=true" in lines[1] and "fleet_emergency" in lines[1]
+
+
+def test_run_supervised_budget_exhausted_returns_fleet_code(tmp_path):
+    from stoix_tpu.launcher import run_supervised
+
+    always_die = [sys.executable, "-c", "import sys; sys.exit(87)"]
+    rc = run_supervised(
+        always_die, env=dict(os.environ), max_relaunches=1, resume_overrides=[]
+    )
+    assert rc == 87
+
+
+def test_run_supervised_other_codes_are_final(tmp_path):
+    from stoix_tpu.launcher import run_supervised
+
+    crash = [sys.executable, "-c", "import sys; sys.exit(3)"]
+    rc = run_supervised(crash, env=dict(os.environ), max_relaunches=5, resume_overrides=[])
+    assert rc == 3
+
+
+def test_uncaught_fleet_error_exits_with_fleet_code(tmp_path):
+    # The excepthook FleetCoordinator.start() installs must translate an
+    # uncaught FleetPartitionError into exit code 87 — that code is the
+    # launcher supervision contract.
+    script = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from stoix_tpu.resilience import fleet\n"
+        "s = fleet.settings_from_config({'arch': {'fleet': {'enabled': True}}})\n"
+        "coord = fleet.FleetCoordinator(s, process_index=0, process_count=1)\n"
+        "coord.start()\n"
+        "from stoix_tpu.resilience.errors import FleetPartitionError\n"
+        "raise FleetPartitionError([1], 30.0, 'unit test')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == fleet.EXIT_CODE_FLEET_PARTITION, proc.stderr[-2000:]
+    assert "FleetPartitionError" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Runner integration pins (single-process)
+# ---------------------------------------------------------------------------
+
+BASE_OVERRIDES = [
+    "env=identity_game",
+    "arch.total_num_envs=16",
+    "arch.num_updates=4",
+    "arch.total_timesteps=~",
+    "arch.num_evaluation=2",
+    "arch.num_eval_episodes=8",
+    "arch.absolute_metric=False",
+    "system.rollout_length=4",
+    "system.epochs=1",
+    "system.num_minibatches=2",
+    "logger.use_console=False",
+]
+
+
+def _run_recorded(extra):
+    from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
+    from stoix_tpu.systems.runner import run_anakin_experiment
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        BASE_OVERRIDES + list(extra),
+    )
+    trajectory = []
+
+    def recording_setup(env, cfg, mesh, key):
+        setup = learner_setup(env, cfg, mesh, key)
+        inner = setup.learn
+
+        def recording_learn(state):
+            out = inner(state)
+            trajectory.append(jax.tree.map(np.asarray, out.learner_state.params))
+            return out
+
+        return setup._replace(learn=recording_learn)
+
+    final_return = run_anakin_experiment(config, recording_setup)
+    return trajectory, final_return
+
+
+def test_fleet_on_trajectory_bit_identical(devices):
+    # The off-path pin, mirroring the PR 2-4 pattern: arch.fleet only ADDS a
+    # flag vector to the fetch tree — the dispatched learn sequence, and
+    # hence the trajectory, must be bit-identical to fleet off.
+    off_traj, _ = _run_recorded([])
+    on_traj, _ = _run_recorded(["arch.fleet.enabled=True"])
+    assert len(off_traj) == len(on_traj) and off_traj
+    for step, (ta, tb) in enumerate(zip(off_traj, on_traj)):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                a, b, err_msg=f"trajectory diverged at window {step}"
+            ),
+            ta, tb,
+        )
+    from stoix_tpu.systems.runner import LAST_RUN_STATS
+
+    assert LAST_RUN_STATS["resilience"]["fleet"] is True
+
+
+def test_sigterm_under_fleet_stops_via_agreement_and_checkpoints(
+    devices, tmp_path, monkeypatch
+):
+    # Single-process fleet: the SIGTERM flag must travel through the
+    # window-boundary agreement (request_stop -> flags on the next fetch ->
+    # decision) rather than the immediate per-host break, and the emergency
+    # checkpoint must land exactly as in the non-fleet path.
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("STOIX_TPU_FAULT", "sigterm:1")
+    traj, _ = _run_recorded(
+        [
+            "arch.fleet.enabled=True",
+            "arch.num_updates=6",
+            "arch.num_evaluation=6",
+            "logger.checkpointing.save_model=True",
+            "logger.checkpointing.save_args.checkpoint_uid=fleet-sigterm",
+            "logger.checkpointing.save_args.save_interval_steps=1000000",
+        ]
+    )
+    from stoix_tpu.systems.runner import LAST_RUN_STATS
+
+    resilience = LAST_RUN_STATS["resilience"]
+    assert resilience["preempted"] is True
+    assert resilience["fleet_agreed_stop"] is not None
+    assert "preempt" in resilience["fleet_agreed_stop"]
+    assert 0 < len(traj) < 6, "the agreed stop must land mid-run"
+    assert (tmp_path / "checkpoints" / "fleet-sigterm" / "ff_ppo").is_dir()
+
+
+def test_sigterm_during_final_window_still_preempts_under_fleet(
+    devices, tmp_path, monkeypatch
+):
+    # A SIGTERM landing at the LAST window has no later fetch to carry its
+    # flag — the final-boundary KV/local vote must catch it, or the stop is
+    # silently dropped (no acknowledge, no forced emergency save) while the
+    # non-fleet path would have saved.
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("STOIX_TPU_FAULT", "sigterm:1")  # fires at window 1 of 2
+    traj, _ = _run_recorded(
+        [
+            "arch.fleet.enabled=True",
+            "arch.num_updates=2",
+            "arch.num_evaluation=2",
+            "logger.checkpointing.save_model=True",
+            "logger.checkpointing.save_args.checkpoint_uid=fleet-final",
+            "logger.checkpointing.save_args.save_interval_steps=1000000",
+        ]
+    )
+    from stoix_tpu.systems.runner import LAST_RUN_STATS
+
+    resilience = LAST_RUN_STATS["resilience"]
+    assert resilience["preempted"] is True, resilience
+    assert resilience["fleet_agreed_stop"] is not None, resilience
+    # The forced emergency save landed as a real numbered step directory.
+    import glob
+
+    steps = glob.glob(str(tmp_path / "checkpoints" / "fleet-final" / "ff_ppo" / "*"))
+    assert any(os.path.basename(s).isdigit() for s in steps), steps

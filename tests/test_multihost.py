@@ -23,6 +23,10 @@ WORKER = textwrap.dedent(
 
     import jax
     jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jax: gloo is the implicit default
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{{port}}", num_processes=2, process_id=proc_id
     )
