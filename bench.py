@@ -14,6 +14,8 @@ and Sebulba hot paths are perf-tracked alongside the PPO path
 
 Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba] [--cpu]
                        [--reps N]
+       python bench.py --check BASELINE.json --candidate CAND.json
+                       [--check-threshold 0.05] [--check-require-all]
   --all       run all five tracked configs, one JSON line each
   --smoke     tiny budget for CI wiring checks
   --cartpole  the round-1 metric: tiny-MLP CartPole (VPU-bound; kept for
@@ -24,6 +26,21 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba] [--c
               window measured inside the host loop)
   --cpu       force the CPU backend (a site hook can force a remote platform
               even over JAX_PLATFORMS=cpu; this flag wins)
+  --check     variance-aware regression gate (no benchmark is run, no jax is
+              imported): compare the --candidate payload lines against the
+              baseline file metric-by-metric, failing a metric only when its
+              candidate median drops below baseline median by more than
+              max(baseline rel_spread, candidate rel_spread,
+              --check-threshold). A CPU-fallback payload is NEVER numerically
+              compared against a device baseline (or vice versa) — posture
+              mismatch is its own failure, because the BENCH_r04->r05 2.5x
+              "regression" was exactly such an apples-to-oranges read.
+              Baseline metrics the candidate never measured get a visible
+              skip verdict (--check-require-all promotes them to failures,
+              for CI gates benching every tracked config). Exit 0 = every
+              compared metric within band; 1 = regression / posture mismatch
+              / failed workload line; 2 = usage or file errors. One JSON
+              verdict line per metric.
   --reps N    how many times the steady-state window is re-measured
               (default 3 for the Anakin timed loop; Sebulba re-runs its
               whole experiment per rep, so it defaults to 1 unless --reps is
@@ -59,6 +76,214 @@ def _parse_reps(argv: list) -> int | None:
     return reps
 
 
+# ---------------------------------------------------------------------------
+# --check: the variance-aware regression gate (no jax import on this path)
+# ---------------------------------------------------------------------------
+
+
+def _parse_payload_lines(text: str) -> list:
+    """Every JSON object line carrying a `metric` field, in file order."""
+    payloads = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and obj.get("metric"):
+            payloads.append(obj)
+    return payloads
+
+
+def _load_baseline_payloads(path: str) -> list:
+    """Baseline payloads from either format: a BENCH_r*.json file (one JSON
+    payload line per tracked metric) or a BASELINE.json whose `published`
+    mapping carries payload dicts keyed by metric name."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict) and isinstance(obj.get("published"), dict):
+        out = []
+        for metric, payload in obj["published"].items():
+            if isinstance(payload, dict):
+                out.append({"metric": metric, **payload})
+        return out
+    if isinstance(obj, dict) and obj.get("metric"):
+        return [obj]
+    return _parse_payload_lines(text)
+
+
+def _median_of(payload: dict) -> float:
+    """The dispersion-aware center: `median` when the payload carries the
+    PR 7 rep fields, else the headline `value` (pre-reps payloads)."""
+    if payload.get("median") is not None:
+        return float(payload["median"])
+    return float(payload.get("value") or 0.0)
+
+
+def check_payloads(
+    baselines: list, candidates: list, threshold: float = 0.05,
+    require_all: bool = False,
+) -> tuple:
+    """Gate the candidate payloads against the baselines. Returns
+    (exit_code, verdict_lines): one verdict dict per candidate metric with a
+    baseline counterpart, plus a VISIBLE skip verdict for every baseline
+    metric the candidate never measured (a truncated candidate run must not
+    clear the gate silently; `require_all` promotes those skips to failures
+    for CI gates that bench every tracked config). Exit 1 when any verdict
+    failed.
+
+    Comparison rule per metric:
+      * a failed workload line (value/median 0) always fails;
+      * fallback-posture mismatch (CPU-fallback vs device) fails WITHOUT a
+        numeric comparison — the numbers are not measurements of the same
+        hardware, so neither verdict direction would mean anything;
+      * otherwise fail iff candidate median < baseline median scaled by
+        (1 - band), band = max(baseline rel_spread, candidate rel_spread,
+        threshold) — a drop indistinguishable from the recorded run-to-run
+        jitter is jitter, not a regression. Improvements never fail.
+    """
+    by_metric = {p["metric"]: p for p in baselines}
+    verdicts = []
+    failed = False
+    for cand in candidates:
+        base = by_metric.get(cand["metric"])
+        if base is None:
+            verdicts.append(
+                {
+                    "metric": cand["metric"],
+                    "status": "skip",
+                    "reason": "no baseline for this metric",
+                }
+            )
+            continue
+        base_median, cand_median = _median_of(base), _median_of(cand)
+        verdict = {
+            "metric": cand["metric"],
+            "baseline_median": base_median,
+            "candidate_median": cand_median,
+        }
+        cand_fb, base_fb = bool(cand.get("fallback")), bool(base.get("fallback"))
+        if cand_median <= 0.0 or base_median <= 0.0:
+            which = "candidate" if cand_median <= 0.0 else "baseline"
+            verdict.update(
+                status="fail",
+                reason=f"{which} is a failed workload line (zero median)",
+            )
+        elif cand_fb != base_fb:
+            side = "candidate" if cand_fb else "baseline"
+            verdict.update(
+                status="fail",
+                reason=(
+                    f"posture mismatch: {side} is a CPU-fallback measurement, "
+                    "the other ran on the device — refusing the numeric "
+                    "comparison"
+                ),
+            )
+        else:
+            band = max(
+                float(base.get("rel_spread") or 0.0),
+                float(cand.get("rel_spread") or 0.0),
+                float(threshold),
+            )
+            floor = base_median * (1.0 - band)
+            verdict["band"] = round(band, 4)
+            if cand_median < floor:
+                verdict.update(
+                    status="fail",
+                    reason=(
+                        f"regression: median {cand_median:.1f} < "
+                        f"{floor:.1f} (baseline {base_median:.1f} - "
+                        f"{band:.1%} variance band)"
+                    ),
+                )
+            else:
+                verdict.update(status="pass", reason="within variance band")
+        failed = failed or verdict["status"] == "fail"
+        verdicts.append(verdict)
+    candidate_metrics = {c["metric"] for c in candidates}
+    for metric in by_metric:
+        if metric not in candidate_metrics:
+            # Never silent: a candidate that crashed after measuring a subset
+            # of the tracked workloads would otherwise clear the gate.
+            status = "fail" if require_all else "skip"
+            verdicts.append(
+                {
+                    "metric": metric,
+                    "status": status,
+                    "reason": "baseline metric absent from the candidate run",
+                }
+            )
+            failed = failed or status == "fail"
+    if not any(v["status"] != "skip" for v in verdicts):
+        # A gate that compared nothing passed nothing: make the empty
+        # intersection loud instead of a vacuous green.
+        verdicts.append(
+            {
+                "metric": None,
+                "status": "fail",
+                "reason": "no candidate metric had a baseline counterpart",
+            }
+        )
+        failed = True
+    return (1 if failed else 0), verdicts
+
+
+def run_check(argv: list) -> int:
+    """CLI half of the gate; never imports jax (CI/fleet prologs call this
+    on machines with no accelerator runtime at all)."""
+
+    def _flag_value(flag: str) -> str | None:
+        if flag not in argv:
+            return None
+        idx = argv.index(flag)
+        if idx + 1 >= len(argv):
+            print(json.dumps({"error": f"{flag} requires a value"}))
+            raise SystemExit(2)
+        return argv[idx + 1]
+
+    baseline_path = _flag_value("--check")
+    candidate_path = _flag_value("--candidate")
+    threshold_raw = _flag_value("--check-threshold")
+    try:
+        threshold = float(threshold_raw) if threshold_raw is not None else 0.05
+    except ValueError:
+        print(json.dumps({"error": f"bad --check-threshold {threshold_raw!r}"}))
+        return 2
+    try:
+        baselines = _load_baseline_payloads(baseline_path)
+        if candidate_path in (None, "-"):
+            if sys.stdin.isatty():
+                print(
+                    json.dumps(
+                        {"error": "--check needs --candidate FILE (or piped stdin)"}
+                    )
+                )
+                return 2
+            candidates = _parse_payload_lines(sys.stdin.read())
+        else:
+            with open(candidate_path) as f:
+                candidates = _parse_payload_lines(f.read())
+    except OSError as exc:
+        print(json.dumps({"error": f"{type(exc).__name__}: {exc}"}))
+        return 2
+    if not baselines:
+        print(json.dumps({"error": f"no baseline payloads in {baseline_path}"}))
+        return 2
+    code, verdicts = check_payloads(
+        baselines, candidates, threshold,
+        require_all="--check-require-all" in argv,
+    )
+    for verdict in verdicts:
+        print(json.dumps(verdict), flush=True)
+    return code
+
+
 def _rep_stats(values: list) -> dict:
     """Dispersion of the per-rep steady-state measurements, as first-class
     payload fields (ROADMAP item 3: a bench number without its spread is not
@@ -77,6 +302,10 @@ def _rep_stats(values: list) -> dict:
 
 
 def main() -> None:
+    if "--check" in sys.argv:
+        # The regression gate is pure JSON arithmetic: no probe, no watchdog,
+        # no jax import — exit before any of that machinery arms.
+        sys.exit(run_check(sys.argv))
     smoke = "--smoke" in sys.argv
     reps = _parse_reps(sys.argv)
     large = "--large" in sys.argv  # MXU-bound variant: 1024x1024 bf16 torsos
@@ -361,15 +590,27 @@ def _skipped_updates_base() -> float:
 def _timed_anakin_run(config, learner_setup, smoke: bool, reps: int | None = None):
     """Shared timed-loop core: compose -> setup -> warmup -> N timed reps of
     the steady-state window (`--reps`, default 3). Returns
-    (best_steps_per_sec, per_rep_steps_per_sec) — the headline stays the best
-    rep; the full list feeds the dispersion fields."""
+    (best_steps_per_sec, per_rep_steps_per_sec, compile_info) — the headline
+    stays the best rep; the full list feeds the dispersion fields, and
+    compile_info carries the first-class compile economy fields (compile_s =
+    the warmup call's wall time, cache_hits = persistent-cache hits during
+    this workload; docs/DESIGN.md §2.7)."""
     import jax
     import numpy as np
 
     from stoix_tpu import envs
     from stoix_tpu.parallel import create_mesh
+    from stoix_tpu.utils import compilecache
     from stoix_tpu.utils.timestep_checker import check_total_timesteps
 
+    # Honor arch.compile_cache + system.multistep_impl overrides (the bench
+    # drives learner_setup directly, not run_anakin_experiment, so it wires
+    # both itself — otherwise a BENCH_r* line claiming to measure the assoc
+    # kernel would silently measure scan).
+    from stoix_tpu.ops import scan_kernels
+
+    compilecache.configure(config)
+    scan_kernels.configure_from_config(config)
     mesh = create_mesh({"data": -1})
     updates_per_call = 2 if smoke else 8
     config.arch.num_updates = updates_per_call * (3 if not smoke else 1)
@@ -402,9 +643,18 @@ def _timed_anakin_run(config, learner_setup, smoke: bool, reps: int | None = Non
         leaf = jax.tree.leaves(out.learner_state.params)[0]
         return float(np.asarray(jax.numpy.sum(leaf)))
 
-    # Warmup / compile.
+    # Warmup / compile. The wall time of this first call is the payload's
+    # `compile_s` (XLA compile + one un-timed window); with
+    # arch.compile_cache enabled, `cache_hits` records how much of the
+    # compile the persistent cache absorbed.
+    cache_before = compilecache.cache_stats()
+    compile_start = time.perf_counter()
     out = learn(learner_state)
     force(out)
+    compile_info = {
+        "compile_s": round(time.perf_counter() - compile_start, 3),
+        "cache_hits": compilecache.cache_stats()["hits"] - cache_before["hits"],
+    }
     learner_state = out.learner_state
 
     times = []
@@ -415,7 +665,11 @@ def _timed_anakin_run(config, learner_setup, smoke: bool, reps: int | None = Non
         learner_state = out.learner_state
         times.append(time.perf_counter() - start)
 
-    return steps_per_call / min(times), [steps_per_call / t for t in times]
+    return (
+        steps_per_call / min(times),
+        [steps_per_call / t for t in times],
+        compile_info,
+    )
 
 
 def _phase_breakdown_probe(
@@ -522,7 +776,9 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None, reps=None) -
         from stoix_tpu.systems.ppo.anakin.ff_ppo_continuous import learner_setup
 
     skipped_before = _skipped_updates_base()
-    steps_per_sec, rep_values = _timed_anakin_run(config, learner_setup, smoke, reps)
+    steps_per_sec, rep_values, compile_info = _timed_anakin_run(
+        config, learner_setup, smoke, reps
+    )
     per_chip = steps_per_sec / n_devices
     baseline_per_chip = 1_000_000 / 64  # BASELINE.json north star on v5e-64
     # Host-loop phase attribution + telemetry self-check from a tiny
@@ -540,6 +796,7 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None, reps=None) -
             None if (large or cartpole) else round(per_chip / baseline_per_chip, 3)
         ),
         **_rep_stats(rep_values),
+        **compile_info,
         "phase_breakdown": phase_breakdown,
         "telemetry": telemetry,
         "resilience": _resilience_selfcheck(config, skipped_before),
@@ -584,7 +841,9 @@ def _run_anakin_generic(
     if isinstance(setup_fn, str):
         setup_fn = importlib.import_module(setup_fn).learner_setup
     skipped_before = _skipped_updates_base()
-    steps_per_sec, rep_values = _timed_anakin_run(config, setup_fn, smoke, reps)
+    steps_per_sec, rep_values, compile_info = _timed_anakin_run(
+        config, setup_fn, smoke, reps
+    )
     return {
         "metric": metric,
         "value": round(steps_per_sec, 1),
@@ -592,6 +851,7 @@ def _run_anakin_generic(
         # Only the PPO/ant north star has a numeric baseline.
         "vs_baseline": None,
         **_rep_stats(rep_values),
+        **compile_info,
         "resilience": _resilience_selfcheck(config, skipped_before),
     }
 
@@ -648,10 +908,12 @@ def _run_sebulba(
     # monotonic); shutdown-drain gets are uninstrumented by construction
     # (OnPolicyPipeline.drain), so they cannot deflate the mean.
     from stoix_tpu.observability import get_registry
+    from stoix_tpu.utils import compilecache
 
     wait_hist = get_registry().histogram("stoix_tpu_sebulba_queue_get_wait_seconds")
     wait_labels = {"queue": "rollout", "actor": "0"}
     before = wait_hist.summary(wait_labels)
+    cache_before = compilecache.cache_stats()
     skipped_before = _skipped_updates_base()
     # A Sebulba "rep" is a whole experiment (the steady window lives inside
     # the run), so re-measurement defaults to 1 and scales only on an
@@ -693,6 +955,11 @@ def _run_sebulba(
         # none for its sebulba arch); report the raw number.
         "vs_baseline": None,
         **_rep_stats(steadies if steadies else [0.0]),
+        # Sebulba pays its compiles inside the run (no separate AOT warmup
+        # call to time), so compile_s is not separable here; cache_hits still
+        # shows whether arch.compile_cache absorbed them.
+        "compile_s": None,
+        "cache_hits": compilecache.cache_stats()["hits"] - cache_before["hits"],
         "telemetry": telemetry,
         "resilience": resilience,
     }

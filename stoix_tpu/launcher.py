@@ -258,6 +258,25 @@ def main(argv: List[str] | None = None) -> None:
         help="emergency-store path the supervised relaunch resumes from "
         "(must match arch.fleet.emergency_dir)",
     )
+    parser.add_argument(
+        "--compile-cache",
+        default=None,
+        metavar="DIR",
+        help="share ONE persistent XLA compilation cache directory across "
+        "every launched job (appends arch.compile_cache.enabled/dir "
+        "overrides; utils/compilecache.py, docs/DESIGN.md §2.7): the first "
+        "job/host pays each compile, the rest hit the cache — and a "
+        "--supervise relaunch recompiles nothing",
+    )
+    parser.add_argument(
+        "--aot-export",
+        default=None,
+        metavar="DIR",
+        help="with --compile-cache semantics for the top-level learn "
+        "function: jax.export artifacts are serialized into DIR by the "
+        "first job and loaded (skipping trace+lower) by every later one "
+        "(appends arch.compile_cache.export_dir; requires --compile-cache)",
+    )
     parser.add_argument("--nodes", type=int, default=1)
     parser.add_argument("--time", default="04:00:00")
     parser.add_argument("--partition", default=None)
@@ -282,6 +301,24 @@ def main(argv: List[str] | None = None) -> None:
         # Silently ignoring the flag would let a user believe their --submit
         # was gated on a changed-file lint that never ran.
         parser.error("--changed-only requires --preflight-only")
+    if args.aot_export and not args.compile_cache:
+        # The export store exists to be shared alongside the cache dir; an
+        # export-only launch silently paying full per-job XLA compiles is
+        # exactly the surprise this flag pairing prevents.
+        parser.error("--aot-export requires --compile-cache")
+    if args.compile_cache:
+        # Ride the ordinary override mechanism so the same knobs reach SLURM
+        # scripts, --local runs, and --supervise relaunches identically.
+        args.overrides = [
+            "arch.compile_cache.enabled=true",
+            f"arch.compile_cache.dir={args.compile_cache}",
+            *(
+                [f"arch.compile_cache.export_dir={args.aot_export}"]
+                if args.aot_export
+                else []
+            ),
+            *args.overrides,
+        ]
 
     jobs = build_jobs(args)
     log = get_logger("stoix_tpu.launcher")

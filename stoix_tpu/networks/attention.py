@@ -22,7 +22,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from stoix_tpu.ops.pallas_attention import best_attention
+from stoix_tpu.ops import best_attention
 
 AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
 

@@ -10,7 +10,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from stoix_tpu.ops.distributions import Distribution
+from stoix_tpu.ops import Distribution
 
 
 class PostProcessedDistribution(Distribution):

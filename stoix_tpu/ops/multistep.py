@@ -8,9 +8,14 @@ Behavioral parity targets (checked by tests/test_multistep.py):
   stoix/systems/impala/sebulba/ff_impala.py:426-439).
 
 TPU-first design notes:
-  - Everything here is ONE `lax.scan` over the time axis with elementwise math
-    in the body — XLA fuses each step into a few vector ops, and the scan sits
-    inside the learner's jit so no host sync ever happens.
+  - Every estimator reduces to ONE reverse linear recurrence over the time
+    axis (acc_t = delta_t + w_t * acc_{t+1}) with elementwise math around it,
+    and that recurrence is evaluated by ops/scan_kernels.py under the
+    `system.multistep_impl` knob: `scan` (sequential lax.scan — the reference
+    semantics and the bit-identical default), `assoc` (log-depth
+    `jax.lax.associative_scan`), or `pallas` (time-blocked TPU kernel).
+    Each estimator also takes an explicit `impl=` override; None defers to
+    the process default installed by `scan_kernels.configure_from_config`.
   - Arrays are time-major [T, ...] natively (trajectories come out of rollout
     scans time-major); `batch_major=True` transposes at the boundary only.
   - All estimators share one reverse accumulator primitive, so truncation
@@ -31,6 +36,8 @@ import chex
 import jax
 import jax.numpy as jnp
 
+from stoix_tpu.ops import scan_kernels
+
 Array = jax.Array
 Numeric = Union[Array, float]
 
@@ -50,16 +57,13 @@ def _broadcast_param(param: Numeric, like: Array, batch_major: bool) -> Array:
     return jnp.broadcast_to(param, like.shape)
 
 
-def _reverse_scan(weight_t: Array, delta_t: Array, init: Array) -> Array:
-    """acc_t = delta_t + weight_t * acc_{t+1}, scanned from T-1 down to 0."""
-
-    def body(acc: Array, inputs: Tuple[Array, Array]) -> Tuple[Array, Array]:
-        delta, weight = inputs
-        acc = delta + weight * acc
-        return acc, acc
-
-    _, out = jax.lax.scan(body, init, (delta_t, weight_t), reverse=True)
-    return out
+def _reverse_scan(
+    weight_t: Array, delta_t: Array, init: Array, impl: Optional[str] = None
+) -> Array:
+    """acc_t = delta_t + weight_t * acc_{t+1}, evaluated from T-1 down to 0 by
+    the selected scan kernel (ops/scan_kernels.py; `scan` is the sequential
+    reference and the default)."""
+    return scan_kernels.linear_recurrence_reverse(weight_t, delta_t, init, impl=impl)
 
 
 def _maybe_stop_gradient(x: Array, stop: bool) -> Array:
@@ -77,6 +81,7 @@ def truncated_generalized_advantage_estimation(
     stop_target_gradients: bool = False,
     batch_major: bool = False,
     standardize_advantages: bool = False,
+    impl: Optional[str] = None,
 ) -> Tuple[Array, Array]:
     """GAE with truncation-aware accumulator resets.
 
@@ -103,7 +108,9 @@ def truncated_generalized_advantage_estimation(
         continue_t = 1.0 - truncation_t.astype(r_t.dtype)
 
     delta_t = r_t + discount_t * v_t - v_tm1
-    advantages = _reverse_scan(discount_t * lam * continue_t, delta_t, jnp.zeros_like(delta_t[-1]))
+    advantages = _reverse_scan(
+        discount_t * lam * continue_t, delta_t, jnp.zeros_like(delta_t[-1]), impl
+    )
     targets = v_tm1 + advantages
 
     if batch_major:
@@ -122,12 +129,13 @@ def lambda_returns(
     lambda_: Numeric = 1.0,
     stop_target_gradients: bool = False,
     batch_major: bool = False,
+    impl: Optional[str] = None,
 ) -> Array:
     """TD(lambda) returns: G_t = r_t + γ_t [(1-λ) v_t + λ G_{t+1}]."""
     r_t, discount_t, v_t = _time_major(batch_major, r_t, discount_t, v_t)
     lam = _broadcast_param(lambda_, r_t, batch_major)
     delta = r_t + discount_t * (1.0 - lam) * v_t
-    returns = _reverse_scan(discount_t * lam, delta, v_t[-1])
+    returns = _reverse_scan(discount_t * lam, delta, v_t[-1], impl)
     if batch_major:
         returns = jnp.swapaxes(returns, 0, 1)
     return _maybe_stop_gradient(returns, stop_target_gradients)
@@ -139,10 +147,13 @@ def discounted_returns(
     v_t: Numeric,
     stop_target_gradients: bool = False,
     batch_major: bool = False,
+    impl: Optional[str] = None,
 ) -> Array:
     """Monte-Carlo discounted returns bootstrapped with v at the sequence end."""
     bootstrapped = jnp.broadcast_to(jnp.asarray(v_t, r_t.dtype), r_t.shape)
-    return lambda_returns(r_t, discount_t, bootstrapped, 1.0, stop_target_gradients, batch_major)
+    return lambda_returns(
+        r_t, discount_t, bootstrapped, 1.0, stop_target_gradients, batch_major, impl
+    )
 
 
 def n_step_bootstrapped_returns(
@@ -153,6 +164,7 @@ def n_step_bootstrapped_returns(
     lambda_t: Numeric = 1.0,
     stop_target_gradients: bool = True,
     batch_major: bool = True,
+    impl: Optional[str] = None,
 ) -> Array:
     """Strided n-step bootstrapped returns.
 
@@ -160,6 +172,11 @@ def n_step_bootstrapped_returns(
     Sequences shorter than n at the tail bootstrap from the final value.
     Defaults to batch-major [B, T] to match how off-policy systems sample
     buffers (reference multistep.py:148-207).
+
+    This fold is a WINDOW of exactly n affine maps per output, not a suffix
+    scan: `scan` keeps the reference's n unrolled vector passes; `assoc` (and
+    `pallas`, which has no windowed kernel) evaluates it in O(log n) shifted
+    compositions (scan_kernels.affine_window_fold).
     """
     r_t, discount_t, v_t = _time_major(batch_major, r_t, discount_t, v_t)
     seq_len = r_t.shape[0]
@@ -177,10 +194,19 @@ def n_step_bootstrapped_returns(
     l_pad = jnp.concatenate([lam, ones_pad], axis=0)
     v_pad = jnp.concatenate([v_t, jnp.repeat(v_t[-1:], pad, axis=0)], axis=0)
 
-    for i in reversed(range(n)):
-        targets = r_pad[i : i + seq_len] + g_pad[i : i + seq_len] * (
-            (1.0 - l_pad[i : i + seq_len]) * v_pad[i : i + seq_len] + l_pad[i : i + seq_len] * targets
-        )
+    if scan_kernels.resolve_impl(impl) == "scan":
+        for i in reversed(range(n)):
+            targets = r_pad[i : i + seq_len] + g_pad[i : i + seq_len] * (
+                (1.0 - l_pad[i : i + seq_len]) * v_pad[i : i + seq_len]
+                + l_pad[i : i + seq_len] * targets
+            )
+    else:
+        # Per-step affine maps f_j(x) = d_j + w_j·x over the padded range; the
+        # identity padding (w=1, d=0) past seq_len matches the reference's
+        # r=0/γ=1/λ=1 padding exactly.
+        weight = g_pad * l_pad
+        delta = r_pad + g_pad * (1.0 - l_pad) * v_pad
+        targets = scan_kernels.affine_window_fold(weight, delta, targets, n)
     if batch_major:
         targets = jnp.swapaxes(targets, 0, 1)
     return _maybe_stop_gradient(targets, stop_target_gradients)
@@ -194,6 +220,7 @@ def general_off_policy_returns_from_q_and_v(
     c_t: Array,
     stop_target_gradients: bool = False,
     batch_major: bool = True,
+    impl: Optional[str] = None,
 ) -> Array:
     """Generalized off-policy return: G_t = r_t + γ_t (v_t - c_t q_t + c_t G_{t+1}).
 
@@ -204,7 +231,7 @@ def general_off_policy_returns_from_q_and_v(
     q_t, v_t, r_t, discount_t, c_t = _time_major(batch_major, q_t, v_t, r_t, discount_t, c_t)
     g_last = r_t[-1] + discount_t[-1] * v_t[-1]
     delta = r_t[:-1] + discount_t[:-1] * (v_t[:-1] - c_t * q_t)
-    returns = _reverse_scan(discount_t[:-1] * c_t, delta, g_last)
+    returns = _reverse_scan(discount_t[:-1] * c_t, delta, g_last, impl)
     returns = jnp.concatenate([returns, g_last[None]], axis=0)
     if batch_major:
         returns = jnp.swapaxes(returns, 0, 1)
@@ -221,11 +248,13 @@ def retrace_continuous(
     lambda_: Numeric,
     stop_target_gradients: bool = True,
     batch_major: bool = True,
+    impl: Optional[str] = None,
 ) -> Array:
     """Retrace error for continuous control: c_t = λ min(1, ρ_t)."""
     c_t = jnp.minimum(1.0, jnp.exp(log_rhos)) * lambda_
     target = general_off_policy_returns_from_q_and_v(
-        q_t, v_t, r_t, discount_t, c_t, stop_target_gradients=False, batch_major=batch_major
+        q_t, v_t, r_t, discount_t, c_t, stop_target_gradients=False,
+        batch_major=batch_major, impl=impl,
     )
     return _maybe_stop_gradient(target, stop_target_gradients) - q_tm1
 
@@ -238,6 +267,7 @@ def importance_corrected_td_errors(
     values: Array,
     truncation_t: Optional[Array] = None,
     stop_target_gradients: bool = False,
+    impl: Optional[str] = None,
 ) -> Array:
     """Per-decision importance-sampled multistep TD errors (Sutton et al. 2014).
 
@@ -251,7 +281,9 @@ def importance_corrected_td_errors(
         jnp.ones_like(r_t) if truncation_t is None else 1.0 - truncation_t.astype(r_t.dtype)
     )
     delta = r_t + discount_t * v_t - v_tm1
-    errors = _reverse_scan(discount_t * rho_t * lam * continue_t, delta, jnp.zeros_like(delta[-1]))
+    errors = _reverse_scan(
+        discount_t * rho_t * lam * continue_t, delta, jnp.zeros_like(delta[-1]), impl
+    )
     errors = rho_tm1 * errors
     if stop_target_gradients:
         errors = jax.lax.stop_gradient(errors + v_tm1) - v_tm1
@@ -265,11 +297,13 @@ def q_lambda(
     lambda_: Numeric,
     stop_target_gradients: bool = True,
     batch_major: bool = True,
+    impl: Optional[str] = None,
 ) -> Array:
     """Peng's/Watkins' Q(lambda) targets: lambda returns over max_a Q."""
     v_t = jnp.max(q_t, axis=-1)
     return lambda_returns(
-        r_t, discount_t, v_t, lambda_, stop_target_gradients, batch_major=batch_major
+        r_t, discount_t, v_t, lambda_, stop_target_gradients, batch_major=batch_major,
+        impl=impl,
     )
 
 
@@ -283,6 +317,7 @@ def vtrace_td_error_and_advantage(
     clip_rho_threshold: float = 1.0,
     clip_pg_rho_threshold: float = 1.0,
     stop_target_gradients: bool = True,
+    impl: Optional[str] = None,
 ) -> Tuple[Array, Array, Array]:
     """V-trace (IMPALA, Espeholt et al. 2018) — the off-policy corrected value
     targets and policy-gradient advantages the reference takes from rlax.
@@ -297,7 +332,7 @@ def vtrace_td_error_and_advantage(
     c_t = lam * jnp.minimum(1.0, rho_tm1)
 
     delta = rho_clipped * (r_t + discount_t * v_t - v_tm1)
-    corrections = _reverse_scan(discount_t * c_t, delta, jnp.zeros_like(delta[-1]))
+    corrections = _reverse_scan(discount_t * c_t, delta, jnp.zeros_like(delta[-1]), impl)
     vs = corrections + v_tm1
 
     vs_t = jnp.concatenate([vs[1:], v_t[-1:]], axis=0)

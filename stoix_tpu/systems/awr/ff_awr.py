@@ -25,7 +25,7 @@ from stoix_tpu.base_types import (
 )
 from stoix_tpu.buffers import make_trajectory_buffer
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.multistep import lambda_returns
+from stoix_tpu.ops import lambda_returns
 from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils import config as config_lib

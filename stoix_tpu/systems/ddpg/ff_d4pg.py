@@ -18,7 +18,7 @@ from jax.sharding import Mesh
 from stoix_tpu import envs
 from stoix_tpu.base_types import OnlineAndTarget, Transition
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.losses import categorical_l2_project
+from stoix_tpu.ops import categorical_l2_project
 from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.ddpg.ff_ddpg import DDPGOptStates, DDPGParams
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment

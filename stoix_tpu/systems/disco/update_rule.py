@@ -40,7 +40,7 @@ import numpy as np
 
 from stoix_tpu.networks.disco import DiscoAgentOutput
 from stoix_tpu.observability import get_logger
-from stoix_tpu.ops.losses import categorical_l2_project
+from stoix_tpu.ops import categorical_l2_project
 
 DISCO103_URL = (
     "https://raw.githubusercontent.com/google-deepmind/disco_rl/main/"

@@ -19,8 +19,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
 from stoix_tpu.observability import annotate
-from stoix_tpu.ops import running_statistics
-from stoix_tpu.ops.multistep import vtrace_td_error_and_advantage
+from stoix_tpu.ops import running_statistics, vtrace_td_error_and_advantage
 from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.resilience import guards
 from stoix_tpu.systems.ppo.sebulba.ff_ppo import CoreLearnerState, run_experiment as _run

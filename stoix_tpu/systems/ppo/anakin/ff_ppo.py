@@ -44,8 +44,11 @@ from stoix_tpu.base_types import (
 )
 from stoix_tpu.evaluator import get_distribution_act_fn
 from stoix_tpu.observability import annotate, get_logger
-from stoix_tpu.ops import losses, running_statistics
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops import (
+    losses,
+    running_statistics,
+    truncated_generalized_advantage_estimation,
+)
 from stoix_tpu.parallel import is_coordinator
 from stoix_tpu.resilience import guards
 from stoix_tpu.utils import config as config_lib
@@ -100,6 +103,12 @@ def get_learner_fn(
     reward_scale = float(config.system.get("reward_scale", 1.0))
     normalize_obs = bool(config.system.get("normalize_observations", False))
     guard_mode = guards.resolve_mode(config)
+    # Hot-path compute knobs (docs/DESIGN.md §2.7): which scan kernel
+    # evaluates the GAE recurrence, and whether actor+critic loss/grad/pmean
+    # ride ONE fused gradient pass (2 collectives instead of 4) or the
+    # reference's two independent passes (the bit-identical default).
+    multistep_impl = str(config.system.get("multistep_impl", "scan"))
+    fused_update = bool(config.system.get("fused_update", False))
 
     def _maybe_normalize(observation, obs_stats):
         if not normalize_obs:
@@ -173,32 +182,71 @@ def get_learner_fn(
             value_loss = jnp.mean((value - targets) ** 2)
         return float(config.system.vf_coef) * value_loss, value_loss
 
+    def _fused_loss_fn(
+        joint_params, behavior_actor_params, obs, action, old_log_prob, gae,
+        kl_beta, targets, old_value,
+    ):
+        """Joint actor+critic objective for the fused update: the two losses
+        share no parameters, so d(total)/d(actor) == the actor grad and
+        d(total)/d(critic) == the critic grad — the SAME gradients as the
+        two-pass path, computed in one backward pass over one params tree."""
+        actor_total, (loss_actor, entropy) = _actor_loss_fn(
+            joint_params.actor_params, behavior_actor_params, obs, action,
+            old_log_prob, gae, kl_beta,
+        )
+        critic_total, value_loss = _critic_loss_fn(
+            joint_params.critic_params, obs, targets, old_value
+        )
+        return actor_total + critic_total, (loss_actor, entropy, value_loss)
+
     @annotate("ppo_minibatch")
     def _update_minibatch(train_state: Tuple, batch_info: Tuple):
         params, opt_states, behavior_actor_params, kl_beta = train_state
         traj_batch, advantages, targets = batch_info
 
-        actor_grad_fn = jax.grad(_actor_loss_fn, has_aux=True)
-        actor_grads, (loss_actor, entropy) = actor_grad_fn(
-            params.actor_params,
-            behavior_actor_params,
-            traj_batch.obs,
-            traj_batch.action,
-            traj_batch.log_prob,
-            advantages,
-            kl_beta,
-        )
-        critic_grad_fn = jax.grad(_critic_loss_fn, has_aux=True)
-        critic_grads, value_loss = critic_grad_fn(
-            params.critic_params, traj_batch.obs, targets, traj_batch.value
-        )
+        if fused_update:
+            # ONE backward pass + ONE pmean pair over the joint grads tree:
+            # XLA sees a single all-reduce per axis for actor+critic together
+            # instead of two, and the actor/critic backward graphs fuse.
+            joint_grads, (loss_actor, entropy, value_loss) = jax.grad(
+                _fused_loss_fn, has_aux=True
+            )(
+                params,
+                behavior_actor_params,
+                traj_batch.obs,
+                traj_batch.action,
+                traj_batch.log_prob,
+                advantages,
+                kl_beta,
+                targets,
+                traj_batch.value,
+            )
+            joint_grads = jax.lax.pmean(joint_grads, axis_name="batch")
+            joint_grads = jax.lax.pmean(joint_grads, axis_name="data")
+            actor_grads = joint_grads.actor_params
+            critic_grads = joint_grads.critic_params
+        else:
+            actor_grad_fn = jax.grad(_actor_loss_fn, has_aux=True)
+            actor_grads, (loss_actor, entropy) = actor_grad_fn(
+                params.actor_params,
+                behavior_actor_params,
+                traj_batch.obs,
+                traj_batch.action,
+                traj_batch.log_prob,
+                advantages,
+                kl_beta,
+            )
+            critic_grad_fn = jax.grad(_critic_loss_fn, has_aux=True)
+            critic_grads, value_loss = critic_grad_fn(
+                params.critic_params, traj_batch.obs, targets, traj_batch.value
+            )
 
-        # Gradient sync: mean over the in-shard update-batch vmap axis, then
-        # the mesh data axis (the latter rides ICI/DCN).
-        actor_grads = jax.lax.pmean(actor_grads, axis_name="batch")
-        actor_grads = jax.lax.pmean(actor_grads, axis_name="data")
-        critic_grads = jax.lax.pmean(critic_grads, axis_name="batch")
-        critic_grads = jax.lax.pmean(critic_grads, axis_name="data")
+            # Gradient sync: mean over the in-shard update-batch vmap axis,
+            # then the mesh data axis (the latter rides ICI/DCN).
+            actor_grads = jax.lax.pmean(actor_grads, axis_name="batch")
+            actor_grads = jax.lax.pmean(actor_grads, axis_name="data")
+            critic_grads = jax.lax.pmean(critic_grads, axis_name="batch")
+            critic_grads = jax.lax.pmean(critic_grads, axis_name="data")
 
         actor_updates, actor_opt_state = actor_update(
             actor_grads, opt_states.actor_opt_state
@@ -313,6 +361,7 @@ def get_learner_fn(
             v_t=v_t,
             truncation_t=traj_batch.truncated.astype(jnp.float32),
             standardize_advantages=bool(config.system.get("standardize_advantages", True)),
+            impl=multistep_impl,
         )
 
         # Behavior params (the rollout's) stay FIXED across all epochs: KL

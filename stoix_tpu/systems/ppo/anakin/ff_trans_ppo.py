@@ -29,8 +29,7 @@ from stoix_tpu.base_types import (
     ActorCriticParams,
     ExperimentOutput,
 )
-from stoix_tpu.ops import losses
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops import losses, truncated_generalized_advantage_estimation
 from stoix_tpu.systems import anakin
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils import config as config_lib

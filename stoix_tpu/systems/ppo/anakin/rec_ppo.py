@@ -23,8 +23,11 @@ from stoix_tpu.base_types import (
     ExperimentOutput,
     RNNLearnerState,
 )
-from stoix_tpu.ops import losses, running_statistics
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops import (
+    losses,
+    running_statistics,
+    truncated_generalized_advantage_estimation,
+)
 from stoix_tpu.systems import anakin
 from stoix_tpu.systems.runner import AnakinSetup
 from stoix_tpu.utils import config as config_lib

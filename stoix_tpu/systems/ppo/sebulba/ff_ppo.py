@@ -41,8 +41,12 @@ from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTra
 from stoix_tpu.envs.factory import make_factory
 from stoix_tpu.evaluator import get_distribution_act_fn, get_ff_evaluator_fn
 from stoix_tpu.observability import RunStats, annotate, get_logger, get_registry, span
-from stoix_tpu.ops import losses, running_statistics
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops import (
+    losses,
+    running_statistics,
+    scan_kernels,
+    truncated_generalized_advantage_estimation,
+)
 from stoix_tpu.parallel import assemble_global_array
 from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.resilience import (
@@ -60,6 +64,7 @@ from stoix_tpu.sebulba.core import (
     ParameterServer,
     ThreadLifetime,
 )
+from stoix_tpu.utils import compilecache
 from stoix_tpu.utils import config as config_lib
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
 from stoix_tpu.utils.timing import TimingTracker
@@ -142,6 +147,7 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
             v_tm1=traj.value, v_t=v_t,
             truncation_t=traj.truncated.astype(jnp.float32),
             standardize_advantages=bool(config.system.get("standardize_advantages", True)),
+            impl=str(config.system.get("multistep_impl", "scan")),
         )
 
         @annotate("ppo_minibatch")
@@ -395,6 +401,11 @@ def run_experiment(
     # divergence-guard mode for the learner loop's host-side checks.
     faultinject.configure(config.arch.get("fault_spec"))
     guard_mode = guards.resolve_mode(config)
+    # Compile economy (docs/DESIGN.md §2.7): persistent XLA cache knobs must
+    # land before the first compile, and the multistep scan-kernel default
+    # before the learner is traced.
+    compilecache.configure(config)
+    scan_kernels.configure_from_config(config)
     # Launch hardening (docs/DESIGN.md §2.4, arch.preflight): subprocess
     # backend probe + config cross-validation before any device work — the
     # actor/learner device-id split below is exactly the class of config this

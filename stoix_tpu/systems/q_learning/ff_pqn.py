@@ -16,7 +16,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput, OnPolicyLearnerState
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.multistep import q_lambda
+from stoix_tpu.ops import q_lambda
 from stoix_tpu.systems import anakin
 from stoix_tpu.systems.q_learning.q_family import build_q_network
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment

@@ -19,7 +19,7 @@ from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput, OffPolicyLearnerState, OnlineAndTarget
 from stoix_tpu.buffers import make_prioritised_trajectory_buffer
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.losses import categorical_l2_project
+from stoix_tpu.ops import categorical_l2_project
 from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment

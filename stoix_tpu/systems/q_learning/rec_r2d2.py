@@ -21,8 +21,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput, OnlineAndTarget, RNNOffPolicyLearnerState
 from stoix_tpu.buffers import make_prioritised_trajectory_buffer
-from stoix_tpu.ops.value_transforms import SIGNED_HYPERBOLIC_PAIR
-from stoix_tpu.ops.multistep import n_step_bootstrapped_returns
+from stoix_tpu.ops import SIGNED_HYPERBOLIC_PAIR, n_step_bootstrapped_returns
 from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.off_policy_core import pmean_grads
 from stoix_tpu.systems.runner import AnakinSetup
@@ -198,7 +197,7 @@ class RecurrentQNetwork:
         # RecurrentActor passes head kwargs through observation mask path only;
         # epsilon is applied by rebuilding the distribution over preferences.
         hstate, dist = self.module.apply(params, hstate, inputs)
-        from stoix_tpu.ops.distributions import EpsilonGreedy
+        from stoix_tpu.ops import EpsilonGreedy
 
         return hstate, EpsilonGreedy(dist.preferences, epsilon)
 

@@ -118,6 +118,8 @@ from stoix_tpu.resilience import (
     guards,
     preflight,
 )
+from stoix_tpu.ops import scan_kernels
+from stoix_tpu.utils import compilecache
 from stoix_tpu.utils.checkpointing import checkpointer_from_config
 from stoix_tpu.utils.jax_utils import aot_warmup
 from stoix_tpu.utils.logger import LogEvent, StoixLogger
@@ -214,6 +216,12 @@ def run_anakin_experiment(
     # divergence-guard mode for the host-side checks below.
     faultinject.configure(config.arch.get("fault_spec"))
     guard_mode = guards.resolve_mode(config)
+    # Compile economy (docs/DESIGN.md §2.7): the persistent-cache knobs must
+    # land before the FIRST compile this process does (network init included),
+    # and the multistep scan-kernel default before the learner is traced —
+    # both are trace/compile-time statics, so the off defaults add zero work.
+    compilecache.configure(config)
+    scan_kernels.configure_from_config(config)
     # Launch hardening (docs/DESIGN.md §2.4): probe the backend in a
     # SUBPROCESS and cross-validate the config BEFORE this process commits to
     # device work — a wedged PJRT runtime or a bad shape aborts here with a
@@ -339,20 +347,45 @@ def run_anakin_experiment(
     # preflight on, the compile runs under a deadline watchdog (a wedged
     # backend raises CompileStallError with a full stack dump instead of
     # hanging) and the compiled program's memory_analysis() is gated against
-    # device HBM before anything executes.
+    # device HBM before anything executes. With `arch.compile_cache.export_dir`
+    # set, the non-fused learner additionally round-trips the jax.export AOT
+    # store (docs/DESIGN.md §2.7): a matching serialized artifact skips
+    # trace+lower here, and a miss serializes this compile for peer hosts.
+    cc_settings = compilecache.settings_from_config(config)
+    export_dir = cc_settings["export_dir"] if cc_settings["enabled"] else None
+    cache_before = compilecache.cache_stats()
+    aot_info = {"source": "compile", "export_path": None}
     t0 = time.perf_counter()
     with span("aot_warmup", fused=fused):
         with _maybe_watchdog(pf, "first_compile", pf.compile_deadline_s):
             faultinject.maybe_slow_compile()
             if fused:
                 # Aval-identical stand-in for the per-window eval keys below.
+                # (The fused program embeds the evaluator, so it is not served
+                # by the learn-function export store.)
                 example_key = jax.random.split(jax.random.PRNGKey(0))[1]
                 fused_step = aot_warmup(fused_step, learner_state, example_key)
             else:
-                learn = aot_warmup(learn, learner_state)
+                learn, aot_info = compilecache.warmup_with_export(
+                    learn, (learner_state,), export_dir,
+                    name=config.system.system_name,
+                )
     compile_s = time.perf_counter() - t0
     phases.add("compile_s", compile_s)
     compile_counter.inc(compile_s)
+    # Per-entry compile observability (docs/DESIGN.md §2.7): which program
+    # paid how much compile, and whether the persistent cache absorbed it.
+    cache_after = compilecache.cache_stats()
+    compile_stats = {
+        "compile_s": round(compile_s, 6),
+        "cache_hits": cache_after["hits"] - cache_before["hits"],
+        "cache_misses": cache_after["misses"] - cache_before["misses"],
+        "aot_source": aot_info["source"],
+    }
+    get_registry().gauge(
+        "stoix_tpu_compile_entry_seconds",
+        "AOT warmup wall seconds of the most recent compile, per entry point",
+    ).set(compile_s, {"entry": "fused_step" if fused else "learn"})
     if pf.enabled:
         preflight.check_device_memory(
             fused_step if fused else learn, headroom=pf.hbm_headroom
@@ -692,6 +725,7 @@ def run_anakin_experiment(
             "steady_state_sps": steady,
             "pipelined": pipelined,
             "fused_eval": fused,
+            "compile": compile_stats,
             "resilience": {
                 "update_guard": guard_mode,
                 "skipped_updates": guards.skipped_counter().value() - skipped_base,

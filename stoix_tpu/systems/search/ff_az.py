@@ -29,7 +29,7 @@ from stoix_tpu.base_types import (
     OnPolicyLearnerState,
 )
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops import truncated_generalized_advantage_estimation
 from stoix_tpu.search import mcts
 from stoix_tpu.systems import anakin
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment

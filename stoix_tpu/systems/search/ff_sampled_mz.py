@@ -26,8 +26,7 @@ from stoix_tpu import envs
 from stoix_tpu.base_types import ExperimentOutput, OffPolicyLearnerState
 from stoix_tpu.buffers import make_trajectory_buffer
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.multistep import n_step_bootstrapped_returns
-from stoix_tpu.ops.value_transforms import muzero_pair
+from stoix_tpu.ops import muzero_pair, n_step_bootstrapped_returns
 from stoix_tpu.search import mcts
 from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment

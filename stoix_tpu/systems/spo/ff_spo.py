@@ -38,7 +38,7 @@ from stoix_tpu.base_types import ExperimentOutput, OffPolicyLearnerState, Online
 from stoix_tpu.buffers import make_trajectory_buffer
 from stoix_tpu.evaluator import get_distribution_act_fn
 from stoix_tpu.ops import distributions as dists
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops import truncated_generalized_advantage_estimation
 from stoix_tpu.systems import anakin, off_policy_core as core
 from stoix_tpu.systems.mpo.ff_vmpo import (
     decoupled_alpha_losses,

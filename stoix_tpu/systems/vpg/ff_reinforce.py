@@ -24,7 +24,7 @@ from stoix_tpu.base_types import (
     OnPolicyLearnerState,
 )
 from stoix_tpu.evaluator import get_distribution_act_fn
-from stoix_tpu.ops.multistep import truncated_generalized_advantage_estimation
+from stoix_tpu.ops import truncated_generalized_advantage_estimation
 from stoix_tpu.systems import anakin
 from stoix_tpu.systems.runner import AnakinSetup, run_anakin_experiment
 from stoix_tpu.utils import config as config_lib

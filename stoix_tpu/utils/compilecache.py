@@ -1,0 +1,326 @@
+"""Compile economy: persistent XLA compilation cache + `jax.export` AOT store.
+
+Two mechanisms, both wired through the `arch.compile_cache` config block
+(docs/DESIGN.md §2.7) and both off by default (zero work, bit-identical):
+
+1. **Persistent compilation cache.** `configure()` points
+   `jax_compilation_cache_dir` at a shared directory (with the
+   min-entry-size / min-compile-time admission knobs) BEFORE the first
+   compile, so every re-run — and every peer host of a multi-host fleet
+   launch sharing the directory — pays XLA's multi-minute learner compile
+   once instead of N times. Cache hits/misses are observable: jax's
+   `/jax/compilation_cache/*` monitoring events are folded into the PR 2
+   metrics registry as `stoix_tpu_compile_persistent_cache_events_total
+   {event=hit|miss}` and surfaced as first-class `cache_hits` bench payload
+   fields. A corrupted cache entry degrades to a recompile, never a crash
+   (`jax_raise_persistent_cache_errors` stays False;
+   tests/test_compilecache.py pins it).
+
+2. **AOT export of the top-level learn function.** `warmup_with_export`
+   extends `utils/jax_utils.aot_warmup`: when `arch.compile_cache.export_dir`
+   is set, the serialized `jax.export` artifact (StableHLO + shardings) of
+   the jitted+shard_mapped learner is loaded when one exists for the same
+   input avals / topology / jax version, else compiled once and serialized
+   for peers. The deserialized path trades buffer donation for tracing
+   economy (an `Exported.call` cannot donate its operands — documented in
+   §2.7), so it is opt-in and separate from the cache dir knob.
+
+Everything here is host-side setup code: nothing in this module is
+jit-reachable, and failures downgrade with a logged warning instead of
+killing a launch (an AOT store is an optimization, never a correctness
+dependency).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.export as jax_export
+
+from stoix_tpu.observability import get_logger, get_registry
+
+# jax's monitoring event names for the persistent compilation cache
+# (stable across the 0.4.x line; unknown names simply never fire).
+_EVENT_HITS = "/jax/compilation_cache/cache_hits"
+_EVENT_MISSES = "/jax/compilation_cache/cache_misses"
+
+_CACHE_EVENTS_METRIC = "stoix_tpu_compile_persistent_cache_events_total"
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+EXPORT_SUFFIX = ".jaxexport"
+
+
+def _cache_counter():
+    return get_registry().counter(
+        _CACHE_EVENTS_METRIC,
+        "Persistent XLA compilation cache events, labelled event=hit|miss",
+    )
+
+
+def install_cache_metrics_listener() -> None:
+    """Idempotently fold jax's compilation-cache monitoring events into the
+    metrics registry. Installed by `configure()`; safe to call repeatedly
+    (and from tests) — only the first call registers."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+
+        def _on_event(event: str, **_kwargs: Any) -> None:
+            if event == _EVENT_HITS:
+                _cache_counter().inc(1.0, {"event": "hit"})
+            elif event == _EVENT_MISSES:
+                _cache_counter().inc(1.0, {"event": "miss"})
+
+        jax.monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+
+
+def cache_stats() -> Dict[str, int]:
+    """Persistent-cache hit/miss totals for this process (registry-backed)."""
+    counter = _cache_counter()
+    return {
+        "hits": int(counter.value({"event": "hit"})),
+        "misses": int(counter.value({"event": "miss"})),
+    }
+
+
+def configure_cache(
+    cache_dir: str,
+    min_entry_size_bytes: int = 0,
+    min_compile_time_secs: float = 0.0,
+) -> None:
+    """Point jax's persistent compilation cache at `cache_dir` with the given
+    admission knobs, and start recording hit/miss metrics. Must run before
+    the first compile of interest; later compiles in this process all flow
+    through the cache."""
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes", int(min_entry_size_bytes)
+    )
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_time_secs)
+    )
+    # jax latches is-the-cache-used ONCE per process, at its first compile: a
+    # single jit executed before this point (an import-time helper, an env
+    # probe) would silently disable the cache for the whole run. Reset the
+    # latch so it re-evaluates under the directory we just configured.
+    from jax.experimental.compilation_cache import compilation_cache
+
+    compilation_cache.reset_cache()
+    install_cache_metrics_listener()
+
+
+def settings_from_config(config: Any) -> Dict[str, Any]:
+    """The `arch.compile_cache` block as a plain dict with defaults applied
+    (the dict-style read keeps STX009 happy on configs that omit the block)."""
+    block = (config.arch.get("compile_cache") or {})
+    return {
+        "enabled": bool(block.get("enabled", False)),
+        "dir": block.get("dir") or os.path.join("checkpoints", "xla_cache"),
+        "min_entry_size_bytes": int(block.get("min_entry_size_bytes", 0) or 0),
+        "min_compile_time_secs": float(block.get("min_compile_time_secs", 0.0) or 0.0),
+        "export_dir": block.get("export_dir"),
+    }
+
+
+def configure(config: Any) -> bool:
+    """Wire the persistent cache from `arch.compile_cache`; returns whether it
+    was enabled. Runs before any compile in both run entry points
+    (systems/runner.py and the Sebulba learner)."""
+    settings = settings_from_config(config)
+    if not settings["enabled"]:
+        return False
+    configure_cache(
+        settings["dir"],
+        min_entry_size_bytes=settings["min_entry_size_bytes"],
+        min_compile_time_secs=settings["min_compile_time_secs"],
+    )
+    get_logger("stoix_tpu.compilecache").info(
+        "[compilecache] persistent XLA cache at %s (min entry %d B, min "
+        "compile %.1f s)",
+        settings["dir"], settings["min_entry_size_bytes"],
+        settings["min_compile_time_secs"],
+    )
+    return True
+
+
+# ---------------------------------------------------------------------------
+# jax.export AOT serialize/load of the top-level learn function
+# ---------------------------------------------------------------------------
+
+
+def _aval_digest(example_args: Tuple[Any, ...]) -> str:
+    """Stable digest of the call signature the export is valid for: input
+    avals + jax version + backend + device count. Anything that changes the
+    compiled program's meaning changes the file name, so a stale artifact is
+    simply never loaded (invalidation by construction, docs/DESIGN.md §2.7)."""
+    avals = jax.tree.map(
+        lambda leaf: str(jax.api_util.shaped_abstractify(leaf)), example_args
+    )
+    payload = "|".join(
+        [
+            str(avals),
+            jax.__version__,
+            jax.default_backend(),
+            str(jax.device_count()),
+        ]
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def export_artifact_path(export_dir: str, name: str, example_args: Tuple[Any, ...]) -> str:
+    digest = _aval_digest(example_args)
+    safe_name = "".join(c if (c.isalnum() or c in "-_") else "_" for c in name)
+    return os.path.join(export_dir, f"{safe_name}-{digest}{EXPORT_SUFFIX}")
+
+
+_registered_serializations: set = set()
+
+
+def register_tree_serialization(tree: Any) -> None:
+    """Make every NamedTuple node in `tree` serializable by jax.export.
+
+    Learner states are NamedTuples of NamedTuples (PPOLearnerState,
+    ActorCriticParams, optax's ScaleByAdamState, ...) and jax.export refuses
+    to serialize unregistered custom pytree types. Registration needs a
+    STABLE name — module.qualname is stable across processes of the same
+    codebase, which is exactly the export store's compatibility domain (the
+    aval digest already pins jax version/backend/topology). Idempotent;
+    symmetric for serialize and deserialize, so both paths call it. Custom
+    non-NamedTuple pytree nodes (if a system ever carries one) still fail
+    registration-free and degrade to compile-from-source with the logged
+    warning."""
+
+    def _walk(node: Any) -> None:
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            cls = type(node)
+            if cls not in _registered_serializations:
+                _registered_serializations.add(cls)
+                try:
+                    jax_export.register_namedtuple_serialization(
+                        cls,
+                        serialized_name=f"{cls.__module__}.{cls.__qualname__}",
+                    )
+                except ValueError:
+                    pass  # already registered by an earlier caller/test
+            for field in node:
+                _walk(field)
+        elif isinstance(node, (tuple, list)):
+            for item in node:
+                _walk(item)
+        elif isinstance(node, dict):
+            for item in node.values():
+                _walk(item)
+
+    _walk(tree)
+
+
+def _register_signature(jit_fn: Callable, example_args: Tuple[Any, ...]) -> None:
+    """Register NamedTuple serialization for the call's INPUT and OUTPUT
+    trees (the output — e.g. ExperimentOutput — only exists abstractly, so
+    it comes from eval_shape: a trace without the lowering the export store
+    exists to skip). Needed symmetrically: serialize records the names,
+    deserialize resolves them back to classes."""
+    register_tree_serialization(example_args)
+    try:
+        register_tree_serialization(jax.eval_shape(jit_fn, *example_args))
+    except Exception as exc:  # noqa: BLE001 — registration is best-effort; export will report
+        get_logger("stoix_tpu.compilecache").warning(
+            "[compilecache] could not abstract-trace outputs for serialization "
+            "registration (%s: %s)", type(exc).__name__, exc,
+        )
+
+
+def save_exported(jit_fn: Callable, example_args: Tuple[Any, ...], path: str) -> bool:
+    """Serialize the jitted callable for `example_args` to `path`; False (with
+    a logged warning) when the function or backend is not exportable."""
+    log = get_logger("stoix_tpu.compilecache")
+    try:
+        _register_signature(jit_fn, example_args)
+        exported = jax_export.export(jit_fn)(*example_args)
+        blob = exported.serialize()
+    except Exception as exc:  # noqa: BLE001 — export is an optimization, not a dependency
+        log.warning(
+            "[compilecache] jax.export serialize failed (%s: %s) — peers will "
+            "compile from source", type(exc).__name__, exc,
+        )
+        return False
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)  # atomic: a concurrent peer never reads a torn file
+    log.info("[compilecache] exported learn function -> %s (%d bytes)", path, len(blob))
+    return True
+
+
+def load_exported(path: str) -> Optional[Callable]:
+    """Deserialize an exported learn function; None (with a logged warning)
+    when missing or unloadable — the caller then compiles from source."""
+    log = get_logger("stoix_tpu.compilecache")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+        exported = jax_export.deserialize(blob)
+        return exported.call
+    except Exception as exc:  # noqa: BLE001 — a stale/corrupt artifact degrades to recompile
+        log.warning(
+            "[compilecache] could not load AOT export %s (%s: %s) — compiling "
+            "from source", path, type(exc).__name__, exc,
+        )
+        return None
+
+
+def warmup_with_export(
+    jit_fn: Callable,
+    example_args: Tuple[Any, ...],
+    export_dir: Optional[str],
+    name: str,
+) -> Tuple[Callable, Dict[str, Any]]:
+    """AOT-warm the jitted callable, optionally through the `jax.export`
+    store: with `export_dir` set, a matching serialized artifact is loaded
+    (skipping trace+lower; the StableHLO→executable compile that remains can
+    additionally hit the persistent cache), else the function is compiled and
+    serialized for peers. Returns `(callable, info)` with info carrying
+    `source` (export|compile), `export_path`, and `compile_s`.
+
+    The exported path does NOT preserve donation (an Exported.call cannot
+    donate operands), so it changes memory behavior, never values.
+    """
+    from stoix_tpu.utils.jax_utils import aot_warmup
+
+    info: Dict[str, Any] = {"source": "compile", "export_path": None}
+    start = time.perf_counter()
+    if export_dir:
+        path = export_artifact_path(export_dir, name, example_args)
+        info["export_path"] = path
+        if os.path.exists(path):
+            # Deserialization resolves the serialized NamedTuple names back
+            # to classes, so this process must register them first too.
+            _register_signature(jit_fn, example_args)
+        loaded = load_exported(path)
+        if loaded is not None:
+            compiled = aot_warmup(jax.jit(loaded), *example_args)
+            info["source"] = "export"
+            info["compile_s"] = time.perf_counter() - start
+            get_logger("stoix_tpu.compilecache").info(
+                "[compilecache] learn function restored from AOT export %s "
+                "(%.2fs to executable)", path, info["compile_s"],
+            )
+            return compiled, info
+    compiled = aot_warmup(jit_fn, *example_args)
+    info["compile_s"] = time.perf_counter() - start
+    if export_dir:
+        save_exported(jit_fn, example_args, info["export_path"])
+    return compiled, info

@@ -1,0 +1,236 @@
+"""`bench.py --check` — the variance-aware regression gate's contract.
+
+Exit semantics for CI / fleet prologs: 0 = every compared metric within its
+variance band, 1 = regression / posture mismatch / failed workload, 2 = usage
+or file errors. The gate never imports jax (subprocess tests assert it stays
+fast enough for a prolog) and NEVER numerically compares a CPU-fallback
+payload against a device baseline.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_check_under_test", os.path.join(REPO, "bench.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _payload(metric="anakin_ppo_ant_env_steps_per_sec", median=10000.0, **over):
+    return {
+        "metric": metric, "value": median * 1.02, "median": median,
+        "rel_spread": 0.05, "fallback": False, **over,
+    }
+
+
+# ---- check_payloads unit semantics ------------------------------------------
+
+
+def test_within_band_jitter_passes():
+    bench = _bench()
+    code, verdicts = bench.check_payloads(
+        [_payload(rel_spread=0.08)], [_payload(median=9300.0, rel_spread=0.02)]
+    )
+    assert code == 0 and verdicts[0]["status"] == "pass", verdicts
+
+
+def test_regression_beyond_band_fails():
+    bench = _bench()
+    code, verdicts = bench.check_payloads(
+        [_payload(rel_spread=0.08)], [_payload(median=4296.0, rel_spread=0.01)]
+    )
+    assert code == 1 and verdicts[0]["status"] == "fail"
+    assert "regression" in verdicts[0]["reason"]
+
+
+def test_band_is_max_of_spreads_and_threshold():
+    bench = _bench()
+    # candidate spread wider than baseline's: a drop inside ITS spread passes.
+    code, verdicts = bench.check_payloads(
+        [_payload(rel_spread=0.0)], [_payload(median=8000.0, rel_spread=0.25)]
+    )
+    assert code == 0, verdicts
+    # both spreads tiny: the floor threshold governs.
+    code, verdicts = bench.check_payloads(
+        [_payload(rel_spread=0.0)],
+        [_payload(median=9800.0, rel_spread=0.0)],
+        threshold=0.05,
+    )
+    assert code == 0 and verdicts[0]["band"] == 0.05
+    code, _ = bench.check_payloads(
+        [_payload(rel_spread=0.0)],
+        [_payload(median=9300.0, rel_spread=0.0)],
+        threshold=0.05,
+    )
+    assert code == 1
+
+
+def test_improvement_never_fails():
+    bench = _bench()
+    code, verdicts = bench.check_payloads(
+        [_payload()], [_payload(median=50000.0)]
+    )
+    assert code == 0, verdicts
+
+
+def test_fallback_vs_device_refused_both_directions():
+    bench = _bench()
+    for base_fb, cand_fb in [(False, True), (True, False)]:
+        code, verdicts = bench.check_payloads(
+            [_payload(fallback=base_fb)],
+            # Even a BETTER fallback number must be refused: it is not a
+            # measurement of the tracked hardware.
+            [_payload(median=99999.0, fallback=cand_fb)],
+        )
+        assert code == 1 and "posture mismatch" in verdicts[0]["reason"], verdicts
+    # Matching fallback posture (both CPU) compares normally.
+    code, verdicts = bench.check_payloads(
+        [_payload(fallback=True)], [_payload(median=9900.0, fallback=True)]
+    )
+    assert code == 0, verdicts
+
+
+def test_failed_workload_line_fails():
+    bench = _bench()
+    code, verdicts = bench.check_payloads(
+        [_payload()], [_payload(median=0.0, value=0.0)]
+    )
+    assert code == 1 and "failed workload" in verdicts[0]["reason"]
+
+
+def test_baseline_only_metrics_get_visible_skip_and_require_all_fails():
+    bench = _bench()
+    baselines = [_payload(), _payload(metric="anakin_sac_ant_env_steps_per_sec")]
+    # A candidate that measured only a subset (e.g. the run was killed after
+    # the first workload) must not clear the gate SILENTLY: the uncovered
+    # metric carries a visible skip verdict, and --check-require-all turns
+    # it into a failure.
+    code, verdicts = bench.check_payloads(baselines, [_payload(median=9800.0)])
+    assert code == 0
+    skips = [v for v in verdicts if v["status"] == "skip"]
+    assert len(skips) == 1 and "absent from the candidate" in skips[0]["reason"]
+    code, verdicts = bench.check_payloads(
+        baselines, [_payload(median=9800.0)], require_all=True
+    )
+    assert code == 1
+    assert any(
+        v["status"] == "fail" and "absent from the candidate" in v["reason"]
+        for v in verdicts
+    )
+
+
+def test_empty_intersection_is_loud_failure():
+    bench = _bench()
+    code, verdicts = bench.check_payloads(
+        [_payload()], [_payload(metric="some_other_metric")]
+    )
+    assert code == 1
+    assert any("no candidate metric" in v["reason"] for v in verdicts), verdicts
+
+
+def test_pre_reps_payload_falls_back_to_value():
+    bench = _bench()
+    old_style = {"metric": "anakin_ppo_ant_env_steps_per_sec", "value": 10000.0}
+    code, verdicts = bench.check_payloads([old_style], [_payload(median=9700.0)])
+    assert code == 0 and verdicts[0]["baseline_median"] == 10000.0
+
+
+def test_baseline_json_published_mapping_format(tmp_path):
+    bench = _bench()
+    path = tmp_path / "BASELINE.json"
+    path.write_text(
+        json.dumps(
+            {
+                "metric": "env steps/sec/chip",
+                "published": {
+                    "anakin_ppo_ant_env_steps_per_sec": {
+                        "value": 10000.0, "median": 10000.0, "rel_spread": 0.05
+                    }
+                },
+            }
+        )
+    )
+    payloads = bench._load_baseline_payloads(str(path))
+    assert payloads == [
+        {
+            "metric": "anakin_ppo_ant_env_steps_per_sec",
+            "value": 10000.0, "median": 10000.0, "rel_spread": 0.05,
+        }
+    ]
+
+
+# ---- CLI contract (subprocess; no jax import on this path) -------------------
+
+
+def _run_check(tmp_path, baseline_lines, candidate_lines, extra=()):
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    base.write_text("\n".join(json.dumps(p) for p in baseline_lines))
+    cand.write_text("\n".join(json.dumps(p) for p in candidate_lines))
+    return subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--check", str(base), "--candidate", str(cand), *extra,
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+
+
+def test_cli_regression_exits_one_jitter_exits_zero(tmp_path):
+    proc = _run_check(tmp_path, [_payload()], [_payload(median=9700.0)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[0])
+    assert verdict["status"] == "pass"
+
+    proc = _run_check(tmp_path, [_payload()], [_payload(median=4296.0)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[0])
+    assert verdict["status"] == "fail" and "regression" in verdict["reason"]
+
+
+def test_cli_never_imports_jax(tmp_path):
+    # A prolog gate must not drag a multi-second accelerator runtime import;
+    # poisoning jax proves --check never touches it.
+    poison = tmp_path / "jax"
+    poison.mkdir()
+    (poison / "__init__.py").write_text("raise ImportError('gate imported jax')")
+    base = tmp_path / "b.json"
+    cand = tmp_path / "c.json"
+    base.write_text(json.dumps(_payload()))
+    cand.write_text(json.dumps(_payload(median=9700.0)))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--check", str(base), "--candidate", str(cand),
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+        env={**os.environ, "PYTHONPATH": str(tmp_path)},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_usage_errors_exit_two(tmp_path):
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--check", str(tmp_path / "missing.json"),
+            "--candidate", str(tmp_path / "also_missing.json"),
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 2, proc.stdout
+    assert "error" in json.loads(proc.stdout.strip().splitlines()[0])
+
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    proc = _run_check(tmp_path, [], [_payload()])
+    assert proc.returncode == 2, proc.stdout

@@ -63,6 +63,12 @@ def test_bench_smoke_payload_schema():
     assert telemetry["metric_series"] > 0, telemetry
     assert telemetry["trace_valid"] is True, telemetry
 
+    # Compile economy (docs/DESIGN.md §2.7): the warmup call's wall time and
+    # the persistent-cache hits absorbed during this workload are first-class
+    # payload fields (no cache configured here, so hits stay 0).
+    assert isinstance(payload["compile_s"], (int, float)) and payload["compile_s"] > 0.0
+    assert payload["cache_hits"] == 0, payload
+
     # Resilience self-check (docs/DESIGN.md §2.3): the bench records whether
     # divergence guards were active for this number, how many updates were
     # skipped, and whether the config could emergency-resume on preemption.

@@ -1,0 +1,199 @@
+"""Compile-economy gate (utils/compilecache.py, docs/DESIGN.md §2.7).
+
+The core acceptance is cross-PROCESS: two cold subprocesses run the same tiny
+jitted program against one tmp cache dir on CPU — the second must record
+persistent-cache hits and spend less wall time compiling, and a corrupted
+cache entry must degrade to a recompile, never a crash.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The child enables the cache through the REAL config surface
+# (arch.compile_cache overrides -> compilecache.configure) and reports the
+# recorded metrics: registry-backed hit/miss counts + its compile wall time.
+_CHILD_SCRIPT = """
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax, jax.numpy as jnp
+from stoix_tpu.utils import compilecache
+from stoix_tpu.utils import config as config_lib
+
+config = config_lib.compose(
+    config_lib.default_config_dir(),
+    "default/anakin/default_ff_ppo.yaml",
+    [
+        "arch.compile_cache.enabled=true",
+        "arch.compile_cache.dir=" + sys.argv[1],
+        "arch.compile_cache.min_entry_size_bytes=-1",
+    ],
+)
+assert compilecache.configure(config) is True
+
+@jax.jit
+def program(x):
+    return jnp.tanh(x) @ jnp.sin(x).T + jnp.cos(x).sum()
+
+start = time.perf_counter()
+program(jnp.ones((64, 64))).block_until_ready()
+compile_s = time.perf_counter() - start
+print(json.dumps({**compilecache.cache_stats(), "compile_s": compile_s}))
+"""
+
+
+def _run_child(cache_dir):
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD_SCRIPT, str(cache_dir)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"cache child failed:\n{proc.stdout}\n{proc.stderr}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_persistent_cache_roundtrip_across_cold_processes(tmp_path):
+    cache_dir = tmp_path / "xla_cache"
+    first = _run_child(cache_dir)
+    assert first["hits"] == 0 and first["misses"] >= 1, first
+    entries = [p for p in os.listdir(cache_dir) if p.endswith("-cache")]
+    assert entries, "first run wrote no cache entries"
+
+    second = _run_child(cache_dir)
+    assert second["hits"] >= 1, second
+    assert second["compile_s"] < first["compile_s"], (
+        f"cache hit did not reduce compile seconds: "
+        f"{first['compile_s']:.3f}s -> {second['compile_s']:.3f}s"
+    )
+
+    # Corruption degrades to a recompile (jax_raise_persistent_cache_errors
+    # stays False), not a crash: garbage every entry and run again.
+    for entry in entries:
+        with open(cache_dir / entry, "wb") as f:
+            f.write(b"not a compiled executable")
+    third = _run_child(cache_dir)
+    assert third["compile_s"] > 0.0, third
+
+
+def test_settings_from_composed_config():
+    from stoix_tpu.utils import compilecache
+    from stoix_tpu.utils import config as config_lib
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        [
+            "arch.compile_cache.enabled=true",
+            "arch.compile_cache.dir=/tmp/somewhere",
+            "arch.compile_cache.min_compile_time_secs=2.5",
+        ],
+    )
+    settings = compilecache.settings_from_config(config)
+    assert settings["enabled"] is True
+    assert settings["dir"] == "/tmp/somewhere"
+    assert settings["min_compile_time_secs"] == 2.5
+    assert settings["export_dir"] is None
+
+    # The shipped default block: disabled, configure() is a no-op.
+    config2 = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml", []
+    )
+    assert compilecache.settings_from_config(config2)["enabled"] is False
+    assert compilecache.configure(config2) is False
+
+
+def test_aot_export_roundtrip_plain_and_shard_map(tmp_path, devices):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from stoix_tpu.parallel import create_mesh
+    from stoix_tpu.parallel.mesh import shard_map
+    from stoix_tpu.utils import compilecache
+
+    mesh = create_mesh({"data": -1})
+    fn = jax.jit(
+        shard_map(
+            lambda x: jax.lax.pmean(x * 3.0, axis_name="data"),
+            mesh=mesh,
+            in_specs=(P("data"),),
+            out_specs=P(),
+        )
+    )
+    x = jax.device_put(
+        jnp.arange(16, dtype=jnp.float32), NamedSharding(mesh, P("data"))
+    )
+
+    compiled, info = compilecache.warmup_with_export(fn, (x,), str(tmp_path), "learn")
+    assert info["source"] == "compile"
+    assert os.path.exists(info["export_path"]), info
+    want = np.asarray(compiled(x))
+
+    # Second launch (same avals/topology): served from the export store, with
+    # identical values — including the shard_map collective.
+    restored, info2 = compilecache.warmup_with_export(fn, (x,), str(tmp_path), "learn")
+    assert info2["source"] == "export"
+    np.testing.assert_allclose(np.asarray(restored(x)), want)
+
+    # Different avals: a DIFFERENT artifact name — stale exports are never
+    # loaded (invalidation by construction).
+    y = jax.device_put(
+        jnp.arange(32, dtype=jnp.float32), NamedSharding(mesh, P("data"))
+    )
+    _, info3 = compilecache.warmup_with_export(fn, (y,), str(tmp_path), "learn")
+    assert info3["source"] == "compile"
+    assert info3["export_path"] != info2["export_path"]
+
+    # A corrupt artifact degrades to compile-from-source, never a crash.
+    with open(info2["export_path"], "wb") as f:
+        f.write(b"garbage")
+    recompiled, info4 = compilecache.warmup_with_export(fn, (x,), str(tmp_path), "learn")
+    assert info4["source"] == "compile"
+    np.testing.assert_allclose(np.asarray(recompiled(x)), want)
+
+
+def test_launcher_compile_cache_overrides_reach_jobs(tmp_path):
+    from stoix_tpu import launcher
+
+    script_dir = tmp_path / "scripts"
+    launcher.main(
+        [
+            "--systems", "stoix_tpu.systems.ppo.anakin.ff_ppo",
+            "--envs", "cartpole",
+            "--compile-cache", "/shared/xla",
+            "--aot-export", "/shared/aot",
+            "--script-dir", str(script_dir),
+            "--log-dir", str(tmp_path / "logs"),
+        ]
+    )
+    scripts = list(script_dir.glob("*.sbatch"))
+    assert len(scripts) == 1
+    text = scripts[0].read_text()
+    assert "arch.compile_cache.enabled=true" in text
+    assert "arch.compile_cache.dir=/shared/xla" in text
+    assert "arch.compile_cache.export_dir=/shared/aot" in text
+
+
+def test_launcher_aot_export_requires_compile_cache(tmp_path):
+    from stoix_tpu import launcher
+
+    with pytest.raises(SystemExit) as excinfo:
+        launcher.main(
+            [
+                "--systems", "stoix_tpu.systems.ppo.anakin.ff_ppo",
+                "--envs", "cartpole",
+                "--aot-export", "/shared/aot",
+                "--script-dir", str(tmp_path / "s"),
+                "--log-dir", str(tmp_path / "l"),
+            ]
+        )
+    assert excinfo.value.code == 2
