@@ -12,8 +12,8 @@ and Sebulba hot paths are perf-tracked alongside the PPO path
     anakin_mz_cartpole        — ff_mz on CartPole (on-device MCTS in the loop)
     sebulba_ppo_cartpole      — actor/learner split over the native C++ pool
 
-Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba] [--cpu]
-                       [--reps N]
+Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
+                       [--serve] [--cpu] [--reps N]
        python bench.py --check BASELINE.json --candidate CAND.json
                        [--check-threshold 0.05] [--check-require-all]
   --all       run all five tracked configs, one JSON line each
@@ -24,6 +24,13 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba] [--c
   --sebulba   actor/learner-disaggregated PPO on the native C++ env pool
               (CartPole); reports steady-state env-steps/sec (post-compile
               window measured inside the host loop)
+  --serve     the latency frontier (docs/DESIGN.md §2.8): train a tiny
+              ff_ppo checkpoint, serve it through the dynamic-batching
+              PolicyServer (stoix_tpu/serve), drive the open-loop load
+              generator, and report p99 request latency in ms. The payload
+              carries direction=lower_is_better (the --check gate inverts
+              its comparison), the full latency percentile set, offered vs
+              achieved QPS, batch-fill ratio, shed count, and hot-swap count
   --cpu       force the CPU backend (a site hook can force a remote platform
               even over JAX_PLATFORMS=cpu; this flag wins)
   --check     variance-aware regression gate (no benchmark is run, no jax is
@@ -191,19 +198,42 @@ def check_payloads(
                 float(cand.get("rel_spread") or 0.0),
                 float(threshold),
             )
-            floor = base_median * (1.0 - band)
             verdict["band"] = round(band, 4)
-            if cand_median < floor:
-                verdict.update(
-                    status="fail",
-                    reason=(
-                        f"regression: median {cand_median:.1f} < "
-                        f"{floor:.1f} (baseline {base_median:.1f} - "
-                        f"{band:.1%} variance band)"
-                    ),
-                )
+            # Latency metrics (the serve payloads) carry
+            # direction=lower_is_better: a regression is a median RISE above
+            # the baseline + band, the mirror of the throughput rule. The
+            # baseline's direction wins on disagreement — the tracked
+            # definition of the metric is the baseline's.
+            direction = str(
+                base.get("direction") or cand.get("direction") or "higher_is_better"
+            )
+            if direction == "lower_is_better":
+                verdict["direction"] = direction
+                ceiling = base_median * (1.0 + band)
+                if cand_median > ceiling:
+                    verdict.update(
+                        status="fail",
+                        reason=(
+                            f"regression: median {cand_median:.1f} > "
+                            f"{ceiling:.1f} (baseline {base_median:.1f} + "
+                            f"{band:.1%} variance band; lower is better)"
+                        ),
+                    )
+                else:
+                    verdict.update(status="pass", reason="within variance band")
             else:
-                verdict.update(status="pass", reason="within variance band")
+                floor = base_median * (1.0 - band)
+                if cand_median < floor:
+                    verdict.update(
+                        status="fail",
+                        reason=(
+                            f"regression: median {cand_median:.1f} < "
+                            f"{floor:.1f} (baseline {base_median:.1f} - "
+                            f"{band:.1%} variance band)"
+                        ),
+                    )
+                else:
+                    verdict.update(status="pass", reason="within variance band")
         failed = failed or verdict["status"] == "fail"
         verdicts.append(verdict)
     candidate_metrics = {c["metric"] for c in candidates}
@@ -312,17 +342,22 @@ def main() -> None:
     cartpole = "--cartpole" in sys.argv
     sebulba = "--sebulba" in sys.argv
     pixel = "--pixel" in sys.argv  # Sebulba on 84x84x4 frames + Nature CNN
+    serve = "--serve" in sys.argv  # latency frontier: dynamic-batching policy serving
     run_all = "--all" in sys.argv
     if large and cartpole:
         sys.exit("--large is the MXU-bound Ant variant; it does not compose with --cartpole")
     if (sebulba or pixel) and (large or cartpole) or (sebulba and pixel):
         sys.exit("--sebulba/--pixel are their own workloads; they do not compose")
-    if run_all and (large or cartpole or sebulba or pixel):
+    if serve and (large or cartpole or sebulba or pixel):
+        sys.exit("--serve is its own (latency-shaped) workload; it does not compose")
+    if run_all and (large or cartpole or sebulba or pixel or serve):
         sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
     env_tag = "cartpole" if cartpole else "ant"
     if run_all:
         metric = "bench_all"
+    elif serve:
+        metric = "serve_ppo_identity_game_p99_latency_ms"
     elif pixel:
         metric = "sebulba_ppo_breakout_pixel_env_steps_per_sec"
     elif sebulba:
@@ -557,6 +592,10 @@ def main() -> None:
                 reps=reps,
             )
         ])
+        return
+
+    if serve:
+        _finish([_run_serve(metric, smoke, n_devices, reps=reps)])
         return
 
     if sebulba:
@@ -801,6 +840,108 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None, reps=None) -
         "telemetry": telemetry,
         "resilience": _resilience_selfcheck(config, skipped_before),
     }
+
+
+def _run_serve(metric, smoke, n_devices, reps=None) -> dict:
+    """Latency-shaped serving workload (docs/DESIGN.md §2.8): train a tiny
+    ff_ppo checkpoint, serve it through the dynamic-batching PolicyServer,
+    drive the open-loop load generator for N windows, and report p99 request
+    latency. Latency payloads carry direction=lower_is_better so the --check
+    gate compares them the right way up, and `value` is the BEST (minimum)
+    p99 rep — the mirror of the throughput payloads' best-rep maximum."""
+    import os
+    import shutil
+    import tempfile
+
+    from stoix_tpu.utils import config as config_lib
+
+    tmp = tempfile.mkdtemp(prefix="stoix_serve_bench_")
+    cwd = os.getcwd()
+    os.chdir(tmp)
+    try:
+        from stoix_tpu.serve import PolicyServer, run_loadgen
+        from stoix_tpu.systems.ppo.anakin import ff_ppo
+
+        train_cfg = config_lib.compose(
+            config_lib.default_config_dir(),
+            "default/anakin/default_ff_ppo.yaml",
+            [
+                "env=identity_game",
+                "arch.total_num_envs=16",
+                "arch.total_timesteps=1024",
+                "arch.num_evaluation=1",
+                "arch.num_eval_episodes=8",
+                "arch.absolute_metric=False",
+                "system.rollout_length=8",
+                "system.num_minibatches=2",
+                "logger.use_console=False",
+                f"logger.base_exp_path={tmp}/results",
+                "logger.checkpointing.save_model=True",
+                "logger.checkpointing.save_args.checkpoint_uid=serve-bench",
+            ],
+        )
+        ff_ppo.run_experiment(train_cfg)
+        store = os.path.join(tmp, "checkpoints", "serve-bench", "ff_ppo")
+
+        offered_qps = 200.0 if smoke else 500.0
+        duration_s = 2.0 if smoke else 10.0
+        serve_cfg = config_lib.compose(
+            config_lib.default_config_dir(),
+            "default/serve.yaml",
+            [
+                f"arch.serve.checkpoint.path={store}",
+                "arch.serve.batching.max_wait_ms=2.0",
+                f"arch.serve.loadgen.offered_qps={offered_qps}",
+                f"arch.serve.loadgen.duration_s={duration_s}",
+            ],
+        )
+        server = PolicyServer.from_config(serve_cfg)
+        reports = []
+        with server:
+            for _ in range(reps if reps is not None else 3):
+                reports.append(
+                    run_loadgen(
+                        server, offered_qps=offered_qps, duration_s=duration_s
+                    )
+                )
+        warmed = server.compile_count
+        # A rep that completed zero requests has NO latency measurement —
+        # exclude it rather than letting an empty-dict .get() default of 0
+        # crown the broken rep as the best latency of the run. Every rep
+        # empty means the workload failed: raise (the workload contract, like
+        # any other failed bench config) instead of publishing value=0.
+        p99s = [r["latency_ms"].get("p99") for r in reports]
+        valid = [i for i, p in enumerate(p99s) if p]
+        if not valid:
+            raise RuntimeError(
+                "load generator completed zero requests in every rep — no "
+                "latency to report"
+            )
+        best_idx = min(valid, key=lambda i: p99s[i])
+        best = reports[best_idx]
+        return {
+            "metric": metric,
+            "value": round(p99s[best_idx], 3),
+            "unit": (
+                f"ms p99 request latency ({n_devices}-device host, "
+                f"identity_game MLP policy, open-loop {offered_qps:g} qps)"
+            ),
+            "vs_baseline": None,
+            "direction": "lower_is_better",
+            **_rep_stats([p99s[i] for i in valid]),
+            "offered_qps": best["offered_qps"],
+            "achieved_qps": best["achieved_qps"],
+            "requests": best["requests"],
+            "shed": best["shed"],
+            "errors": best["errors"],
+            "latency_ms": best["latency_ms"],
+            "batch_fill_ratio": best["batch_fill_ratio"],
+            "hot_swaps": best["hot_swaps"],
+            "compile_count": warmed,
+        }
+    finally:
+        os.chdir(cwd)
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _c51_setup(env, config, mesh, key):

@@ -209,6 +209,13 @@ class _Locomotion(Environment):
         if healthy is None:
             terminated = ~finite
         else:
+            # Check the INCOMING state too: a state already outside the
+            # healthy band terminates even when one control step of contact
+            # dynamics would bounce the body back inside it (a teleported or
+            # corrupted state). Along a normal trajectory the incoming state
+            # is the previous step's healthy outgoing state, so this is a
+            # no-op for training rollouts.
+            healthy = jnp.logical_and(healthy, self._healthy(state.body))
             terminated = jnp.logical_or(~healthy, ~finite)
 
         reward = (
@@ -366,13 +373,25 @@ def _leg(b: _PlanarBuilder, torso: int, hip_world, gear: float = 30.0) -> None:
     b.sphere(foot, toe, 0.08)
 
 
+# Passive hinge-axis hold PD for the legged planar morphologies (the engine's
+# hold_kp/hold_kd, rigid_body.py): free hinges make the whole chain a
+# multi-link inverted pendulum that quasi-statically collapses under ANY
+# perturbation. 35 N·m/rad sits between the two tipping-mode gravity
+# stiffnesses — the whole-robot-about-ankle mode needs ~MgH/n_legs per leg:
+# walker2d (2 legs, MgH≈55) is held statically stable and stands under zero
+# action, hopper (1 leg, MgH≈46 > 35) still collapses like MuJoCo's.
+_LEG_HOLD_KP = 35.0
+_LEG_HOLD_KD = 1.0
+
+
 def _build_hopper() -> Tuple[RigidBodySystem, np.ndarray]:
     """4-body monoped: torso rod (z 1.05-1.45) on one (thigh, leg, foot)."""
     b = _PlanarBuilder()
     torso = b.body(com=(0.0, 0.0, 1.25), mass=3.0, inertia=0.08)
     b.sphere(torso, (0.0, 0.0, 1.45), 0.08)  # crown contact for falls
     _leg(b, torso, hip_world=(0.0, 0.0, 1.05))
-    return b.build()
+    sys, pos = b.build()
+    return sys._replace(hold_kp=_LEG_HOLD_KP, hold_kd=_LEG_HOLD_KD), pos
 
 
 def _build_walker2d() -> Tuple[RigidBodySystem, np.ndarray]:
@@ -382,7 +401,8 @@ def _build_walker2d() -> Tuple[RigidBodySystem, np.ndarray]:
     b.sphere(torso, (0.0, 0.0, 1.45), 0.08)
     _leg(b, torso, hip_world=(0.0, 0.0, 1.05))
     _leg(b, torso, hip_world=(0.0, 0.0, 1.05))
-    return b.build()
+    sys, pos = b.build()
+    return sys._replace(hold_kp=_LEG_HOLD_KP, hold_kd=_LEG_HOLD_KD), pos
 
 
 def _build_halfcheetah() -> Tuple[RigidBodySystem, np.ndarray]:

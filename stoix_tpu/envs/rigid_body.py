@@ -121,6 +121,13 @@ class RigidBodySystem(NamedTuple):
     swing_kp: float = 500.0  # off-axis rotational spring
     swing_kd: float = 2.0  # off-axis rotational damper
     limit_kp: float = 1_000.0  # angle-limit spring
+    # Passive hold PD about the hinge axis itself (tendon/servo stiffness,
+    # MuJoCo's per-joint stiffness/damping). 0 = free hinge. Morphologies
+    # whose zero-action pose must be statically stable (walker2d standing)
+    # set this; the gain separates a biped (2 legs share the load -> stable)
+    # from a monoped (1 leg -> still collapses), see envs/locomotion.py.
+    hold_kp: float = 0.0
+    hold_kd: float = 0.0
     contact_kp: float = 10_000.0  # ground penetration spring
     contact_kd: float = 50.0  # normal damping
     friction: float = 1.0  # Coulomb cap on viscous tangential force
@@ -212,11 +219,21 @@ def _accumulate_joint_forces(
     omega_swing = omega_rel - jnp.sum(omega_rel * axis_w, axis=-1, keepdims=True) * axis_w
     tau_swing = -sys.swing_kp * swing_err_w - sys.swing_kd * omega_swing  # on child
 
-    # Angle limits + actuation, both about the world hinge axis.
+    # Angle limits + actuation + passive hold PD, all about the world hinge
+    # axis. The hold term resists rotation of the hinge DOF itself (the
+    # swing spring only acts OFF-axis), giving chain robots a statically
+    # stable zero-action pose when hold_kp exceeds the gravity stiffness of
+    # the corresponding tipping mode.
     angle = quat_twist_angle(q_rel, sys.axis_p)
+    omega_axis = jnp.sum(omega_rel * axis_w, axis=-1)
     lo, hi = sys.limit[:, 0], sys.limit[:, 1]
     limit_err = jnp.where(angle < lo, lo - angle, jnp.where(angle > hi, hi - angle, 0.0))
-    tau_axis = (sys.limit_kp * limit_err + sys.gear * action)[:, None] * axis_w
+    tau_axis = (
+        sys.limit_kp * limit_err
+        + sys.gear * action
+        - sys.hold_kp * angle
+        - sys.hold_kd * omega_axis
+    )[:, None] * axis_w
 
     tau_c = tau_swing + tau_axis
     force = jnp.zeros((sys.num_bodies, 3), jnp.float32)

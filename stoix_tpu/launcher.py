@@ -15,6 +15,18 @@ Usage:
         [--local | --submit | --preflight-only [--changed-only]] \
         [--nodes 1] [--time 04:00:00] [--partition tpu] [overrides...]
 
+    python -m stoix_tpu.launcher serve \
+        arch.serve.checkpoint.path=checkpoints/<uid>/<model> \
+        [--config default/serve.yaml] [--duration S] [--loadgen] [overrides...]
+
+`serve` (docs/DESIGN.md §2.8) starts the in-process policy server
+(stoix_tpu/serve): composes the serve root config, restores the checkpoint's
+actor through the topology-elastic path, warms every batch bucket under the
+compile watchdog, and serves until SIGINT/SIGTERM (or `--duration S`).
+`--loadgen` instead drives the server with the configured open-loop load
+generator and prints ONE JSON latency report line (the bench payload body),
+then exits — the CI smoke mode.
+
 `--preflight-only` (docs/DESIGN.md §2.4) runs the launch-hardening preflight —
 the static-analysis gate, then ONE subprocess-isolated backend probe for the
 host, then config cross-validation for every (system x env x seed) job
@@ -42,7 +54,7 @@ import os
 import re
 import subprocess
 import sys
-from typing import List, Optional
+from typing import Any, List, Optional
 
 from stoix_tpu.observability import get_logger
 
@@ -210,6 +222,97 @@ def run_supervised(
         )
 
 
+def serve_main(argv: List[str]) -> int:
+    """`launcher.py serve` (docs/DESIGN.md §2.8): run the policy server from
+    a composed serve config. Returns the process exit code."""
+    import json
+    import signal
+    import time
+
+    from stoix_tpu.utils import config as config_lib
+
+    parser = argparse.ArgumentParser(
+        prog="stoix_tpu.launcher serve",
+        description="serve a trained policy (stoix_tpu/serve)",
+    )
+    parser.add_argument(
+        "--config",
+        default="default/serve.yaml",
+        help="serve root yaml under stoix_tpu/configs (default: default/serve.yaml)",
+    )
+    parser.add_argument(
+        "--duration",
+        type=float,
+        default=None,
+        metavar="S",
+        help="serve for S seconds then exit cleanly (default: until SIGINT/SIGTERM)",
+    )
+    parser.add_argument(
+        "--loadgen",
+        action="store_true",
+        help="drive the server with the arch.serve.loadgen open-loop load "
+        "generator, print ONE JSON latency report line, and exit (CI smoke)",
+    )
+    parser.add_argument("overrides", nargs="*", help="key=value overrides")
+    args = parser.parse_args(argv)
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(), args.config, args.overrides
+    )
+    from stoix_tpu.serve import PolicyServer, run_loadgen
+
+    log = get_logger("stoix_tpu.launcher")
+    server = PolicyServer.from_config(config)
+    serve_cfg = config.arch.serve
+    stop_requested = {"flag": False}
+
+    def _request_stop(_signum: int, _frame: Any) -> None:
+        stop_requested["flag"] = True
+
+    previous_handlers = {}
+    for signum in (signal.SIGINT, signal.SIGTERM):
+        try:
+            previous_handlers[signum] = signal.signal(signum, _request_stop)
+        except (ValueError, OSError):  # non-main thread / unsupported platform
+            pass
+    try:
+        with server:
+            if args.loadgen:
+                loadgen_cfg = serve_cfg.loadgen
+                report = run_loadgen(
+                    server,
+                    offered_qps=float(loadgen_cfg.offered_qps),
+                    duration_s=float(loadgen_cfg.duration_s),
+                )
+                # The JSON line IS this mode's output contract (CI smoke),
+                # like bench.py's payload lines.
+                print(json.dumps(report), flush=True)  # noqa: STX002 — serve --loadgen stdout contract
+            else:
+                log.info(
+                    "[serve] serving (step %d%s) — Ctrl-C to stop",
+                    server.watcher.current_step if server.watcher else -1,
+                    f", for {args.duration:.0f}s" if args.duration else "",
+                )
+                deadline = (
+                    time.perf_counter() + args.duration if args.duration else None
+                )
+                while not stop_requested["flag"]:
+                    if deadline is not None and time.perf_counter() >= deadline:
+                        break
+                    time.sleep(0.2)
+                log.info(
+                    "[serve] stopping: %s", server.telemetry.slo_snapshot()
+                )
+            telemetry_dir = serve_cfg.get("telemetry_dir")
+            if telemetry_dir:
+                path = server.telemetry.export(str(telemetry_dir))
+                log.info("[serve] SLO metrics exported to %s", path)
+    finally:
+        for signum, handler in previous_handlers.items():
+            signal.signal(signum, handler)
+    return 0
+
+
 def build_jobs(args: argparse.Namespace) -> List[dict]:
     jobs = []
     for module, env, seed in itertools.product(args.systems, args.envs, args.seeds):
@@ -220,6 +323,11 @@ def build_jobs(args: argparse.Namespace) -> List[dict]:
 
 
 def main(argv: List[str] | None = None) -> None:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # Subcommand dispatch: `launcher.py serve [...]` is the serving entry
+        # point (docs/DESIGN.md §2.8); the batch-launch surface is unchanged.
+        sys.exit(serve_main(argv[1:]))
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--systems", nargs="+", required=True, help="module paths")
     parser.add_argument("--envs", nargs="+", required=True, help="env group names")

@@ -933,18 +933,28 @@ def is_emergency_store(path: Any) -> bool:
     return bool(path) and bool(_find_manifests(str(path)))
 
 
-def restore_emergency(template: Any, path: str) -> Tuple[Any, int]:
-    """Restore a local-shard emergency store into `template`'s shardings via
-    the same tree-path matching + placement as topology-elastic restore
-    (utils/checkpointing.place_host_leaves): matched leaves round-trip
-    through the host bit-identical; manifest-recorded partial leaves (and
-    shape-mismatched topology-bound leaves) keep the template's fresh value.
-    With several survivors' stores present, the lowest process index wins —
-    replicated leaves are identical across survivors by construction."""
-    import jax
+def emergency_step(path: str) -> Optional[int]:
+    """The step recorded in the winning survivor's manifest (None when `path`
+    is not an emergency store) — a manifest-only read, cheap enough for the
+    serving hot-swap watcher to poll."""
+    manifests = _find_manifests(str(path))
+    if not manifests:
+        return None
+    try:
+        with open(manifests[0]) as f:
+            return int(json.load(f)["step"])
+    except (OSError, ValueError, KeyError):
+        return None
 
-    from stoix_tpu.utils.checkpointing import place_host_leaves
 
+def read_emergency_raw(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, str], int]:
+    """Read a fleet emergency store's host leaves WITHOUT a template:
+    (arrays keyed by slash-joined tree path, the manifest's storage-widening
+    cast record, the saved step). With several survivors' stores present,
+    the lowest process index wins — replicated leaves are identical across
+    survivors by construction. Shared by restore_emergency (below) and the
+    serving loader (stoix_tpu/serve/checkpoint.py), which restores only the
+    actor-params subtree."""
     manifests = _find_manifests(str(path))
     if not manifests:
         raise FileNotFoundError(f"no fleet emergency manifest under {path}")
@@ -955,13 +965,27 @@ def restore_emergency(template: Any, path: str) -> Tuple[Any, int]:
     directory = os.path.dirname(manifest_path)
     with np.load(os.path.join(directory, _STATE_FILE)) as data:
         raw = {key: data[key] for key in data.files}
+    return raw, dict(manifest.get("casts") or {}), step
+
+
+def restore_emergency(template: Any, path: str) -> Tuple[Any, int]:
+    """Restore a local-shard emergency store into `template`'s shardings via
+    the same tree-path matching + placement as topology-elastic restore
+    (utils/checkpointing.place_host_leaves): matched leaves round-trip
+    through the host bit-identical; manifest-recorded partial leaves (and
+    shape-mismatched topology-bound leaves) keep the template's fresh value."""
+    import jax
+
+    from stoix_tpu.utils.checkpointing import place_host_leaves
+
+    raw, casts, step = read_emergency_raw(path)
     # Cast storage-widened leaves back to the template's dtype (bfloat16 was
     # stored as float32 — lossless to round-trip through the wider float).
     template_dtypes = {
         "/".join(_leaf_path_key(p)): getattr(leaf, "dtype", np.asarray(leaf).dtype)
         for p, leaf in jax.tree_util.tree_flatten_with_path(template)[0]
     }
-    for key in manifest.get("casts", {}):
+    for key in casts:
         if key in raw and key in template_dtypes:
             raw[key] = raw[key].astype(template_dtypes[key])
     raw_by_path = {tuple(key.split("/")): value for key, value in raw.items()}
@@ -971,7 +995,7 @@ def restore_emergency(template: Any, path: str) -> Tuple[Any, int]:
     get_logger("stoix_tpu.checkpoint").warning(
         "[fleet] emergency restore of step %d from %s: %d leaf(s) restored "
         "bit-identical, %d kept template initialization%s",
-        step, directory, matched, len(reinitialized),
+        step, path, matched, len(reinitialized),
         f" ({'; '.join(reinitialized)})" if reinitialized else "",
     )
     return restored, step

@@ -1,0 +1,42 @@
+"""stoix_tpu.serve — dynamic-batching policy serving (docs/DESIGN.md §2.8).
+
+Training is not the only traffic shape: this subsystem gives a trained
+policy its production life. It composes pieces the repo already had —
+Sebulba's inference discipline, PR 4's topology-elastic restore (any
+checkpoint serves on any mesh), PR 2's metrics registry — into a second,
+LATENCY-shaped traffic class:
+
+  * `PolicyServer` — checkpoint in, concurrent `submit`/`infer` out; one
+    worker thread owns the jitted forward pass.
+  * `DynamicBatcher` — pending requests coalesce into padded fixed-bucket
+    batches under a max-wait deadline (batch size never recompiles).
+  * `InferenceEngine` — the jitted apply with atomic parameter hot-swap and
+    a trace-count recompile probe.
+  * `ParameterWatcher` — polls the checkpoint store; a live learner feeds a
+    live server.
+  * `ServeTelemetry` — `stoix_tpu_serve_*` SLO metrics (p50/p95/p99).
+  * `run_loadgen` — open-loop latency-shaped load generation (bench.py
+    --serve).
+"""
+
+from stoix_tpu.serve.batcher import (  # noqa: F401 — public API
+    DEFAULT_BUCKETS,
+    DynamicBatcher,
+    PendingRequest,
+)
+from stoix_tpu.serve.checkpoint import (  # noqa: F401
+    PolicyBundle,
+    PolicySource,
+    load_policy,
+)
+from stoix_tpu.serve.engine import InferenceEngine  # noqa: F401
+from stoix_tpu.serve.errors import (  # noqa: F401
+    RequestTimeoutError,
+    ServeError,
+    ServerClosedError,
+    ServerOverloadError,
+)
+from stoix_tpu.serve.hotswap import ParameterWatcher  # noqa: F401
+from stoix_tpu.serve.loadgen import run_loadgen  # noqa: F401
+from stoix_tpu.serve.server import PolicyServer, ServeResult  # noqa: F401
+from stoix_tpu.serve.telemetry import ServeTelemetry  # noqa: F401

@@ -1,0 +1,192 @@
+"""Dynamic request batching (docs/DESIGN.md §2.8).
+
+The TorchBeast idiom (arxiv 1910.03552 §3.1): concurrent callers enqueue
+single observations; a worker coalesces whatever is pending into ONE padded
+device batch. The two knobs:
+
+  * `max_wait_s` — how long the oldest pending request may be held open
+    waiting for company. 0 = flush immediately (latency-optimal, batch of
+    whatever arrived during the previous device step); larger values trade
+    first-request latency for occupancy.
+  * bucket sizes — pending requests are padded UP to a fixed bucket
+    (1, 2, 4, ... by default), so the jitted forward pass only ever sees
+    len(buckets) distinct shapes: batch-size changes never recompile
+    (STX012; pinned by the engine's compile-count probe in test_serve.py).
+
+Backpressure is a BOUND, not a blocking put: `submit` past `max_queue`
+raises the typed ServerOverloadError (docs/DESIGN.md §2.8 graceful
+degradation) — an unbounded queue converts overload into unbounded latency
+for every later caller.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional, Sequence
+
+from stoix_tpu.serve.errors import (
+    RequestTimeoutError,
+    ServerClosedError,
+    ServerOverloadError,
+)
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def normalize_buckets(buckets: Sequence[int]) -> tuple:
+    """Sorted, deduplicated, validated bucket ladder — the ONE definition
+    shared by DynamicBatcher and InferenceEngine (both are built from the
+    same config list; duplicated normalization drifts)."""
+    cleaned = sorted({int(b) for b in buckets})
+    if not cleaned or cleaned[0] < 1:
+        raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+    return tuple(cleaned)
+
+
+def bucket_for(buckets: Sequence[int], n: int) -> int:
+    """Smallest bucket >= n (requests are padded up to it)."""
+    for bucket in buckets:
+        if n <= bucket:
+            return bucket
+    raise ValueError(f"batch of {n} exceeds the largest bucket {buckets[-1]}")
+
+
+class PendingRequest:
+    """One in-flight inference request: the caller's future."""
+
+    __slots__ = ("observation", "enqueue_t", "done_t", "_event", "_result", "_error")
+
+    def __init__(self, observation: Any):
+        self.observation = observation
+        self.enqueue_t = time.perf_counter()
+        self.done_t: Optional[float] = None
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    # -- worker side ----------------------------------------------------------
+    def set_result(self, result: Any) -> None:
+        self.done_t = time.perf_counter()
+        self._result = result
+        self._event.set()
+
+    def set_error(self, error: BaseException) -> None:
+        self.done_t = time.perf_counter()
+        self._error = error
+        self._event.set()
+
+    # -- caller side ----------------------------------------------------------
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float = 30.0) -> bool:
+        return self._event.wait(timeout=timeout)
+
+    def result(self, timeout: float = 30.0) -> Any:
+        if not self._event.wait(timeout=timeout):
+            raise RequestTimeoutError(timeout)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @property
+    def ok(self) -> bool:
+        return self._event.is_set() and self._error is None
+
+    @property
+    def latency_s(self) -> float:
+        """Enqueue-to-result wall time (0.0 while still in flight)."""
+        if self.done_t is None:
+            return 0.0
+        return self.done_t - self.enqueue_t
+
+
+class DynamicBatcher:
+    """Bounded pending buffer + deadline-driven batch formation."""
+
+    def __init__(
+        self,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_wait_s: float = 0.005,
+        max_queue: int = 256,
+    ):
+        self.buckets = normalize_buckets(buckets)
+        self.max_batch = self.buckets[-1]
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = int(max_queue)
+        if self.max_queue < self.max_batch:
+            raise ValueError(
+                f"max_queue ({self.max_queue}) must be >= the largest bucket "
+                f"({self.max_batch}) or full batches could never form"
+            )
+        self._pending: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest configured bucket >= n (requests are padded up to it)."""
+        return bucket_for(self.buckets, n)
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._pending)
+
+    # -- caller side ----------------------------------------------------------
+    def submit(self, observation: Any) -> PendingRequest:
+        """Enqueue one observation; raises ServerOverloadError at the bound
+        (the request is SHED — never silently queued past it) and
+        ServerClosedError after close()."""
+        request = PendingRequest(observation)
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError()
+            if len(self._pending) >= self.max_queue:
+                raise ServerOverloadError(len(self._pending), self.max_queue)
+            self._pending.append(request)
+            self._cond.notify()
+        return request
+
+    # -- worker side ----------------------------------------------------------
+    def next_batch(self, idle_timeout: float = 0.1) -> List[PendingRequest]:
+        """Dequeue the next batch (worker thread).
+
+        Blocks up to `idle_timeout` for the FIRST request ([] on timeout, so
+        the worker can poll its lifetime). Once one request is pending, the
+        batch is held open until either the largest bucket is full or the
+        OLDEST request has waited `max_wait_s` — the deadline is anchored to
+        the oldest enqueue time, so no request's batching delay can exceed
+        max_wait_s regardless of arrival pattern."""
+        with self._cond:
+            if not self._pending:
+                if self._closed:
+                    return []
+                self._cond.wait(timeout=idle_timeout)
+                if not self._pending:
+                    return []
+            while not self._closed and len(self._pending) < self.max_batch:
+                oldest = self._pending[0]
+                remaining = self.max_wait_s - (time.perf_counter() - oldest.enqueue_t)
+                if remaining <= 0.0:
+                    break
+                self._cond.wait(timeout=remaining)
+            n = min(len(self._pending), self.max_batch)
+            return [self._pending.popleft() for _ in range(n)]
+
+    def close(self, drain_error: Optional[BaseException] = None) -> int:
+        """Stop accepting work and fail whatever is still pending with
+        `drain_error` (default ServerClosedError) — a dropped request must
+        never leave its caller blocked until result() times out. Returns the
+        number of drained requests."""
+        error = drain_error if drain_error is not None else ServerClosedError(
+            "server shut down before this request was batched"
+        )
+        with self._cond:
+            self._closed = True
+            drained = list(self._pending)
+            self._pending.clear()
+            self._cond.notify_all()
+        for request in drained:
+            request.set_error(error)
+        return len(drained)

@@ -1,0 +1,121 @@
+"""Jitted policy forward pass for serving (docs/DESIGN.md §2.8).
+
+One `jax.jit`-wrapped function built ONCE at engine construction (never in a
+loop — STX012); each configured bucket size is one shape specialization of
+it, compiled up front by `warmup()` so no live request ever pays a compile.
+The trace-time `compile_count` probe makes the no-recompile property
+TESTABLE: tracing the wrapped function is the only way the count moves, so
+steady-state traffic across arbitrary batch sizes must leave it at
+len(buckets) (pinned in tests/test_serve.py).
+
+Parameter hot-swap discipline (same as Sebulba's ParameterServer.reprime):
+fresh params are device_put OFF the request path, then installed with one
+atomic reference assignment. The worker reads the reference once per batch —
+an in-flight forward pass keeps the params it started with; no request ever
+sees a torn mix of two versions.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from stoix_tpu.serve.batcher import DEFAULT_BUCKETS, bucket_for, normalize_buckets
+
+
+class InferenceEngine:
+    """Bucket-padded jitted `apply` over a hot-swappable params reference."""
+
+    def __init__(
+        self,
+        apply_fn: Callable[[Any, Any], Any],
+        params: Any,
+        obs_template: Any,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        greedy: bool = True,
+        key: Optional[jax.Array] = None,
+    ):
+        self.buckets = normalize_buckets(buckets)
+        self._obs_template = obs_template
+        self._params = jax.device_put(params)
+        self._params_version = 0
+        self._swap_lock = threading.Lock()
+        self._base_key = key if key is not None else jax.random.PRNGKey(0)
+        self._batch_index = 0
+        self._trace_count = 0
+
+        def _forward(p: Any, observation: Any, sample_key: jax.Array):
+            # Trace-time side effect: this line runs ONCE per (shape, dtype)
+            # specialization, which is exactly what the no-recompile tests
+            # need to observe. It is not device code and costs nothing at
+            # execution time.
+            self._trace_count += 1
+            dist = apply_fn(p, observation)
+            action = dist.mode() if greedy else dist.sample(seed=sample_key)
+            extras = {}
+            logits = getattr(dist, "logits", None)
+            if logits is not None:
+                extras["logits"] = logits
+            return action, extras
+
+        self._step = jax.jit(_forward)
+
+    # -- params ---------------------------------------------------------------
+    @property
+    def params_version(self) -> int:
+        return self._params_version
+
+    def set_params(self, params: Any) -> int:
+        """Install fresh params under the in-flight jitted step: device_put
+        first (the expensive part, off the request path), then ONE reference
+        assignment. Returns the new version number."""
+        local = jax.device_put(params)
+        with self._swap_lock:
+            self._params = local
+            self._params_version += 1
+            return self._params_version
+
+    # -- inference ------------------------------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Distinct jit specializations traced so far (the recompile probe)."""
+        return self._trace_count
+
+    def bucket_for(self, n: int) -> int:
+        return bucket_for(self.buckets, n)
+
+    def batch_observations(self, observations: List[Any], bucket: int) -> Any:
+        """Stack single-observation pytrees into one [bucket, ...] batch,
+        padding the tail by repeating the last observation (pad rows ride the
+        same forward pass and are sliced off the outputs)."""
+        pad = bucket - len(observations)
+        return jax.tree.map(
+            lambda *leaves: np.stack(
+                [np.asarray(leaf) for leaf in leaves]
+                + [np.asarray(leaves[-1])] * pad
+            ),
+            *observations,
+        )
+
+    def infer(self, observations: List[Any]) -> Tuple[Any, Any, int]:
+        """Run one padded batch; returns (action, extras, bucket) with
+        leading dim `bucket` — the caller slices [:len(observations)]."""
+        n = len(observations)
+        bucket = self.bucket_for(n)
+        batched = self.batch_observations(observations, bucket)
+        sample_key = jax.random.fold_in(self._base_key, self._batch_index)
+        self._batch_index += 1
+        params = self._params  # ONE read: the whole batch sees one version
+        action, extras = self._step(params, batched, sample_key)
+        return action, extras, bucket
+
+    def warmup(self) -> int:
+        """Compile every bucket specialization up front (call under the
+        server's first-compile watchdog). Returns the compile count."""
+        for bucket in self.buckets:
+            action, extras, _ = self.infer([self._obs_template] * bucket)
+            jax.block_until_ready((action, extras))
+        return self._trace_count

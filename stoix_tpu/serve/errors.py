@@ -1,0 +1,44 @@
+"""Typed serving failures (docs/DESIGN.md §2.8).
+
+The serving path's graceful-degradation contract: a server past its queue
+bound SHEDS load with a typed, caller-distinguishable error instead of
+letting the pending buffer grow without bound (queue growth is latency debt
+every later request pays — shedding keeps the p99 of ACCEPTED requests
+inside the SLO).
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class for policy-serving failures (stoix_tpu/serve/)."""
+
+
+class ServerOverloadError(ServeError):
+    """The pending-request buffer is at its configured bound; this request
+    was shed. Callers retry with backoff or surface the 429-equivalent."""
+
+    def __init__(self, pending: int, bound: int):
+        self.pending = int(pending)
+        self.bound = int(bound)
+        super().__init__(
+            f"server overloaded: {pending} request(s) pending >= bound "
+            f"{bound} — request shed (retry with backoff)"
+        )
+
+
+class ServerClosedError(ServeError):
+    """Submit after shutdown, or a request dropped by server teardown."""
+
+    def __init__(self, detail: str = "server is closed"):
+        super().__init__(detail)
+
+
+class RequestTimeoutError(ServeError):
+    """A caller's result() wait expired before the batch completed."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = float(timeout_s)
+        super().__init__(
+            f"inference result not ready within {timeout_s:.1f}s"
+        )

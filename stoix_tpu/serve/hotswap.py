@@ -1,0 +1,85 @@
+"""Parameter hot-swap: a live learner feeds a live server (docs/DESIGN.md
+§2.8).
+
+A watcher thread polls the checkpoint store's step listing (a directory scan
+— no leaf I/O) every `poll_interval_s`; when a NEWER step appears it loads
+the actor subtree through the same PolicySource the server booted from and
+installs it with the engine's atomic swap (device_put off the request path,
+then one reference assignment — the ParameterServer.reprime discipline).
+In-flight batches finish on the params they started with; requests batched
+after the swap see the new version. A failed poll — half-written checkpoint,
+transient I/O — is counted, logged, and SKIPPED: the server keeps serving
+the params it has (orbax's atomic step-directory commit makes a torn read a
+transient, not a corruption).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from stoix_tpu.observability import get_logger
+from stoix_tpu.serve.engine import InferenceEngine
+from stoix_tpu.serve.telemetry import ServeTelemetry
+
+
+class ParameterWatcher:
+    """Background poll -> load -> atomic swap loop."""
+
+    def __init__(
+        self,
+        source,  # serve.checkpoint.PolicySource
+        engine: InferenceEngine,
+        telemetry: ServeTelemetry,
+        current_step: int,
+        poll_interval_s: float = 2.0,
+    ):
+        self._source = source
+        self._engine = engine
+        self._telemetry = telemetry
+        self.current_step = int(current_step)
+        self.poll_interval_s = float(poll_interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="serve-hotswap", daemon=True
+        )
+        self._log = get_logger("stoix_tpu.serve")
+
+    def start(self) -> "ParameterWatcher":
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=join_timeout)
+
+    def check_now(self) -> Optional[int]:
+        """One synchronous poll (tests and deterministic swap points): swap
+        if the store advanced; returns the new step, or None for no-op/error."""
+        try:
+            latest = self._source.latest_step()
+            if latest is None or latest <= self.current_step:
+                return None
+            params, step = self._source.load(latest)
+            version = self._engine.set_params(params)
+            previous, self.current_step = self.current_step, step
+            self._telemetry.hot_swap()
+            self._log.info(
+                "[serve] hot-swapped params: step %d -> %d (version %d)",
+                previous, step, version,
+            )
+            return step
+        except Exception as exc:  # noqa: BLE001 — a half-written checkpoint
+            # or transient I/O error must not kill serving; keep the params
+            # we have and retry next poll.
+            self._telemetry.hot_swap_error()
+            self._log.warning(
+                "[serve] hot-swap poll failed (%s: %s) — serving step %d "
+                "until the next poll", type(exc).__name__, exc, self.current_step,
+            )
+            return None
+
+    def _run(self) -> None:
+        while not self._stop.wait(timeout=self.poll_interval_s):
+            self.check_now()

@@ -1,0 +1,101 @@
+"""Latency-shaped load generation (docs/DESIGN.md §2.8).
+
+OPEN-loop: requests are injected at the offered rate regardless of how fast
+the server answers (closed-loop generators hide overload by self-throttling
+— the coordinated-omission trap). Each request is an async `submit`; latency
+is stamped inside the request future (enqueue -> result-ready), so the
+generator thread never blocks on results and the offered rate holds.
+
+The report is the serving bench's payload body: offered vs achieved QPS,
+nearest-rank latency percentiles (the SAME nearest-rank definition as the
+SLO telemetry window — one percentile semantics repo-wide), batch-fill
+ratio, shed/error counts, and the hot-swap count over the window.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from stoix_tpu.serve.batcher import PendingRequest
+from stoix_tpu.serve.errors import ServerOverloadError
+from stoix_tpu.utils.timing import TimingTracker
+
+
+def run_loadgen(
+    server: Any,  # PolicyServer
+    offered_qps: float,
+    duration_s: float,
+    observation_fn: Optional[Callable[[int], Any]] = None,
+    result_timeout_s: float = 30.0,
+) -> Dict[str, Any]:
+    """Drive `server` at `offered_qps` for `duration_s`; returns the latency
+    report dict. `observation_fn(i)` supplies the i-th request's observation
+    (default: the server's observation template every time)."""
+    if offered_qps <= 0 or duration_s <= 0:
+        raise ValueError("offered_qps and duration_s must be positive")
+    if observation_fn is None:
+        observation_fn = lambda _i: server.obs_template  # noqa: E731
+
+    swaps_before = server.telemetry.n_hot_swaps
+    batches_before = server.telemetry.n_batches
+    interval = 1.0 / float(offered_qps)
+    requests: List[PendingRequest] = []
+    shed = 0
+    start = time.perf_counter()
+    i = 0
+    while True:
+        now = time.perf_counter()
+        if now - start >= duration_s:
+            break
+        target = start + i * interval
+        if now < target:
+            time.sleep(min(target - now, 0.010))
+            continue
+        try:
+            requests.append(server.submit(observation_fn(i)))
+        except ServerOverloadError:
+            shed += 1
+        i += 1
+    offered = i  # attempted submissions, shed included
+    # QPS denominators use the INJECTION window only: the collect phase below
+    # can wait up to result_timeout_s on a straggler, and folding that wait
+    # into the denominator would let one slow request collapse the reported
+    # rate (completed/32s instead of completed/2s).
+    inject_elapsed = time.perf_counter() - start
+
+    # Collect: every request either completes or times out (counted, never
+    # hung — the generator must terminate even against a wedged server).
+    deadline = time.perf_counter() + result_timeout_s
+    timed_out = 0
+    errors = 0
+    tracker = TimingTracker(maxlen=max(1, len(requests)))
+    for request in requests:
+        remaining = deadline - time.perf_counter()
+        if not request.wait(timeout=max(0.0, remaining)):
+            timed_out += 1
+            continue
+        if request.ok:
+            tracker.record("latency", request.latency_s)
+        else:
+            errors += 1
+    completed = len(requests) - timed_out - errors
+    percentiles = tracker.percentiles("latency")
+
+    report: Dict[str, Any] = {
+        "duration_s": round(inject_elapsed, 3),
+        "offered_qps": round(offered / inject_elapsed, 2) if inject_elapsed > 0 else 0.0,
+        "achieved_qps": round(completed / inject_elapsed, 2) if inject_elapsed > 0 else 0.0,
+        "requests": offered,
+        "completed": completed,
+        "shed": shed,
+        "errors": errors,
+        "timed_out": timed_out,
+        "latency_ms": {
+            name: round(value * 1000.0, 3) for name, value in percentiles.items()
+        },
+        "batch_fill_ratio": round(server.telemetry.batch_fill_ratio(), 4),
+        "batches": server.telemetry.n_batches - batches_before,
+        "hot_swaps": server.telemetry.n_hot_swaps - swaps_before,
+    }
+    return report

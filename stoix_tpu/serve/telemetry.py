@@ -1,0 +1,159 @@
+"""SLO telemetry for the serving path (docs/DESIGN.md §2.8).
+
+Two layers, one set of increments:
+
+  * the process-wide metrics registry (`stoix_tpu_serve_*` in the
+    `stoix_tpu_<area>_<name>` convention, docs/DESIGN.md §2.2) — Prometheus
+    text exposition + JSONL via the existing exporters, so a scraper sees
+    serving traffic next to training telemetry;
+  * per-server local counters and a rolling TimingTracker window — the
+    precise nearest-rank p50/p95/p99 snapshot an SLO check or the load
+    generator reads without decoding Prometheus buckets (and without being
+    polluted by a previous server in the same process).
+
+All instruments are host-memory only: recording never touches a device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from stoix_tpu.observability import get_registry, write_prometheus
+from stoix_tpu.utils.timing import TimingTracker
+
+# Request latencies are ms-scale, not host-loop-phase scale: resolve the
+# sub-100ms region the default phase buckets lump together.
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+
+
+class ServeTelemetry:
+    """One server's SLO instruments; registry series are shared process-wide
+    (get-or-create), the local snapshot state is per-instance."""
+
+    def __init__(self, window: int = 4096):
+        registry = get_registry()
+        self._requests = registry.counter(
+            "stoix_tpu_serve_requests_total",
+            "Inference requests by outcome (ok|shed|error)",
+        )
+        self._queue_depth = registry.gauge(
+            "stoix_tpu_serve_queue_depth",
+            "Requests currently buffered in the dynamic batcher",
+        )
+        self._occupancy = registry.gauge(
+            "stoix_tpu_serve_batch_occupancy",
+            "Fill ratio (valid/bucket) of the most recent inference batch",
+        )
+        self._fill = registry.histogram(
+            "stoix_tpu_serve_batch_fill_ratio",
+            "Fill ratio (valid/bucket) per inference batch",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        )
+        self._request_latency = registry.histogram(
+            "stoix_tpu_serve_request_latency_seconds",
+            "End-to-end latency per request (enqueue -> result ready)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._batch_latency = registry.histogram(
+            "stoix_tpu_serve_batch_latency_seconds",
+            "Device forward-pass wall time per batch (incl. host transfer)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._hot_swaps = registry.counter(
+            "stoix_tpu_serve_hot_swaps_total",
+            "Parameter hot-swaps applied by the checkpoint watcher",
+        )
+        self._swap_errors = registry.counter(
+            "stoix_tpu_serve_hot_swap_errors_total",
+            "Checkpoint-watcher polls that failed (server keeps old params)",
+        )
+        self._lock = threading.Lock()
+        self._tracker = TimingTracker(maxlen=window)
+        # Local mirrors: per-server values for slo_snapshot() (registry
+        # counters are process-cumulative across servers/tests).
+        self.n_ok = 0
+        self.n_shed = 0
+        self.n_error = 0
+        self.n_batches = 0
+        self.n_hot_swaps = 0
+        self._fill_sum = 0.0
+
+    # -- recording ------------------------------------------------------------
+    def queue_depth(self, depth: int) -> None:
+        self._queue_depth.set(float(depth))
+
+    def request_ok(self, latency_s: float) -> None:
+        self._requests.inc(labels={"outcome": "ok"})
+        self._request_latency.observe(latency_s)
+        with self._lock:
+            self.n_ok += 1
+            self._tracker.record("request_latency", latency_s)
+
+    def request_shed(self) -> None:
+        self._requests.inc(labels={"outcome": "shed"})
+        with self._lock:
+            self.n_shed += 1
+
+    def request_error(self, n: int = 1) -> None:
+        self._requests.inc(float(n), labels={"outcome": "error"})
+        with self._lock:
+            self.n_error += int(n)
+
+    def batch_done(self, valid: int, bucket: int, latency_s: float) -> None:
+        ratio = float(valid) / float(bucket)
+        self._occupancy.set(ratio)
+        self._fill.observe(ratio)
+        self._batch_latency.observe(latency_s)
+        with self._lock:
+            self.n_batches += 1
+            self._fill_sum += ratio
+
+    def hot_swap(self) -> None:
+        self._hot_swaps.inc()
+        with self._lock:
+            self.n_hot_swaps += 1
+
+    def hot_swap_error(self) -> None:
+        self._swap_errors.inc()
+
+    # -- reading --------------------------------------------------------------
+    def latency_percentiles_ms(self) -> Dict[str, float]:
+        """Nearest-rank p50/p95/p99/max (ms) over the rolling request window
+        ({} before the first completed request)."""
+        with self._lock:
+            stats = self._tracker.percentiles("request_latency")
+        return {k: v * 1000.0 for k, v in stats.items()}
+
+    def batch_fill_ratio(self) -> float:
+        """Mean fill ratio over every batch this server ran (0.0 when idle)."""
+        with self._lock:
+            return self._fill_sum / self.n_batches if self.n_batches else 0.0
+
+    def slo_snapshot(self) -> Dict[str, float]:
+        """The SLO dashboard dict: request outcomes, latency percentiles
+        (ms), batch occupancy, hot-swap count."""
+        snap: Dict[str, float] = {
+            "requests_ok": self.n_ok,
+            "requests_shed": self.n_shed,
+            "requests_error": self.n_error,
+            "batches": self.n_batches,
+            "batch_fill_ratio": round(self.batch_fill_ratio(), 4),
+            "hot_swaps": self.n_hot_swaps,
+        }
+        for name, value in self.latency_percentiles_ms().items():
+            snap[f"latency_ms_{name}"] = round(value, 3)
+        return snap
+
+    def export(self, directory: str) -> str:
+        """Write the registry's Prometheus text snapshot (serving series
+        included) under `directory`; returns the file path."""
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, "serve_metrics.prom")
+        write_prometheus(path)
+        return path

@@ -156,6 +156,37 @@ def place_host_leaves(
     return treedef.unflatten(placed), matched, reinitialized
 
 
+def read_host_leaves(store_dir: str, step: int) -> Dict[Tuple[str, ...], Any]:
+    """Materialize one checkpoint step to HOST numpy leaves keyed by
+    normalized tree-path — the read half of the topology-elastic restore
+    (docs/DESIGN.md §2.4), shared with the serving path (stoix_tpu/serve/
+    checkpoint.py), which restores a params SUBTREE onto whatever device
+    topology the server runs.
+
+    Reads through a standalone PyTree handler with restore_type=ndarray: the
+    MANAGER's restore (with or without a template) reconstructs jax.Arrays on
+    the devices recorded AT SAVE TIME, which need not exist on the restoring
+    host — forcing numpy never touches device placement."""
+    step_path = os.path.join(store_dir, str(step), "default")
+    if not os.path.isdir(step_path):  # older orbax layouts: no item subdir
+        step_path = os.path.join(store_dir, str(step))
+    reader = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    try:
+        raw_meta = reader.metadata(step_path)
+        restore_args = jax.tree.map(
+            lambda _m: ocp.RestoreArgs(restore_type=np.ndarray), raw_meta
+        )
+        raw = reader.restore(
+            step_path, args=ocp.args.PyTreeRestore(restore_args=restore_args)
+        )
+    finally:
+        reader.close()
+    return {
+        _path_key(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(raw)[0]
+    }
+
+
 class Checkpointer:
     def __init__(
         self,
@@ -359,29 +390,7 @@ class Checkpointer:
         topology — they raise CheckpointIntegrityError."""
         from stoix_tpu.observability import get_logger
 
-        # Read through a standalone PyTree handler with restore_type=ndarray:
-        # the MANAGER's restore (with or without a template) reconstructs
-        # jax.Arrays on the devices recorded AT SAVE TIME, which do not exist
-        # in a different topology — the whole point of this path is that the
-        # saving mesh is gone. Forcing numpy never touches device placement.
-        step_path = os.path.join(self.directory, str(step), "default")
-        if not os.path.isdir(step_path):  # older orbax layouts: no item subdir
-            step_path = os.path.join(self.directory, str(step))
-        reader = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-        try:
-            raw_meta = reader.metadata(step_path)
-            restore_args = jax.tree.map(
-                lambda _m: ocp.RestoreArgs(restore_type=np.ndarray), raw_meta
-            )
-            raw = reader.restore(
-                step_path, args=ocp.args.PyTreeRestore(restore_args=restore_args)
-            )
-        finally:
-            reader.close()
-        raw_by_path = {
-            _path_key(path): leaf
-            for path, leaf in jax.tree_util.tree_flatten_with_path(raw)[0]
-        }
+        raw_by_path = read_host_leaves(self.directory, step)
         restored, matched, reinitialized = place_host_leaves(
             raw_by_path, template, step
         )

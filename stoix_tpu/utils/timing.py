@@ -24,6 +24,11 @@ class TimingTracker:
                 time.perf_counter() - start
             )
 
+    def record(self, name: str, seconds: float) -> None:
+        """Record an externally measured duration (the serving path measures
+        request latency at completion time, not around a with-block)."""
+        self._times.setdefault(name, deque(maxlen=self._maxlen)).append(float(seconds))
+
     def mean(self, name: str) -> float:
         times = self._times.get(name)
         return sum(times) / len(times) if times else 0.0
@@ -36,9 +41,11 @@ class TimingTracker:
         return {f"{prefix}{k}_time": self.mean(k) for k in self._times}
 
     def percentiles(self, name: str) -> Dict[str, float]:
-        """p50/p95/max over the current rolling window (nearest-rank on the
-        sorted window: p50 of a single sample is that sample). Empty window
-        -> {} so callers can `.update()` unconditionally."""
+        """p50/p95/p99/max over the current rolling window (nearest-rank on
+        the sorted window: p50 of a single sample is that sample). p99 exists
+        for the serving SLOs (docs/DESIGN.md §2.8) — tail latency is the
+        metric a latency SLO is written against. Empty window -> {} so
+        callers can `.update()` unconditionally."""
         times = self._times.get(name)
         if not times:
             return {}
@@ -48,7 +55,12 @@ class TimingTracker:
         def rank(q: float) -> float:
             return ordered[min(n - 1, max(0, int(q * n + 0.5) - 1))]
 
-        return {"p50": rank(0.50), "p95": rank(0.95), "max": ordered[-1]}
+        return {
+            "p50": rank(0.50),
+            "p95": rank(0.95),
+            "p99": rank(0.99),
+            "max": ordered[-1],
+        }
 
     def all_percentiles(self, prefix: str = "") -> Dict[str, float]:
         out: Dict[str, float] = {}

@@ -74,6 +74,62 @@ def test_band_is_max_of_spreads_and_threshold():
     assert code == 1
 
 
+def test_lower_is_better_latency_rise_fails_drop_passes():
+    """Serve payloads carry direction=lower_is_better (docs/DESIGN.md §2.8):
+    a latency RISE beyond the band is the regression, a drop never is —
+    the exact mirror of the throughput rule."""
+    bench = _bench()
+    base = _payload(
+        metric="serve_ppo_identity_game_p99_latency_ms",
+        median=3.0, rel_spread=0.05, direction="lower_is_better",
+    )
+    # Rise beyond the band: fail.
+    code, verdicts = bench.check_payloads(
+        [base],
+        [_payload(
+            metric="serve_ppo_identity_game_p99_latency_ms",
+            median=4.0, rel_spread=0.02, direction="lower_is_better",
+        )],
+    )
+    assert code == 1 and verdicts[0]["status"] == "fail", verdicts
+    assert "lower is better" in verdicts[0]["reason"]
+    assert verdicts[0]["direction"] == "lower_is_better"
+    # A big latency DROP (would fail the throughput rule) passes.
+    code, verdicts = bench.check_payloads(
+        [base],
+        [_payload(
+            metric="serve_ppo_identity_game_p99_latency_ms",
+            median=1.0, rel_spread=0.02, direction="lower_is_better",
+        )],
+    )
+    assert code == 0 and verdicts[0]["status"] == "pass", verdicts
+    # Rise INSIDE the band is jitter, not a regression.
+    code, verdicts = bench.check_payloads(
+        [base],
+        [_payload(
+            metric="serve_ppo_identity_game_p99_latency_ms",
+            median=3.1, rel_spread=0.02, direction="lower_is_better",
+        )],
+    )
+    assert code == 0 and verdicts[0]["status"] == "pass", verdicts
+
+
+def test_lower_is_better_direction_taken_from_baseline_on_disagreement():
+    """The BASELINE's direction defines the metric: a candidate missing the
+    field still gates the right way up (and vice versa a candidate-only
+    direction is honored for fresh metrics)."""
+    bench = _bench()
+    base = _payload(metric="m_lat", median=3.0, direction="lower_is_better")
+    cand = _payload(metric="m_lat", median=10.0)  # no direction field
+    code, verdicts = bench.check_payloads([base], [cand])
+    assert code == 1 and verdicts[0]["status"] == "fail", verdicts
+    # Candidate-only direction (baseline predates the field).
+    base = _payload(metric="m_lat2", median=3.0)
+    cand = _payload(metric="m_lat2", median=1.0, direction="lower_is_better")
+    code, verdicts = bench.check_payloads([base], [cand])
+    assert code == 0 and verdicts[0]["status"] == "pass", verdicts
+
+
 def test_improvement_never_fails():
     bench = _bench()
     code, verdicts = bench.check_payloads(

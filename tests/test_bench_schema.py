@@ -112,6 +112,59 @@ def test_rep_stats_and_reps_parsing():
     assert bench._parse_reps(["--smoke", "--reps", "5"]) == 5
 
 
+def test_bench_serve_payload_schema():
+    """`bench.py --serve` (docs/DESIGN.md §2.8): the latency-shaped payload
+    is schema-complete — direction=lower_is_better (so --check inverts its
+    comparison), value = the BEST (minimum) p99 rep, the full percentile
+    ladder, offered/achieved QPS, batch-fill ratio, shed and hot-swap
+    counts — alongside the standard rep-dispersion and fallback fields."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--serve", "--smoke", "--cpu", "--reps", "2",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "STOIX_BENCH_NO_FALLBACK": "1"},
+    )
+    assert proc.returncode == 0, f"bench.py --serve failed:\n{proc.stdout}\n{proc.stderr}"
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, f"expected exactly one JSON line:\n{proc.stdout}"
+    payload = json.loads(json_lines[0])
+
+    assert payload["metric"] == "serve_ppo_identity_game_p99_latency_ms"
+    assert payload["direction"] == "lower_is_better"
+    assert isinstance(payload["value"], (int, float)) and payload["value"] > 0
+    assert "p99" in payload["unit"] and "ms" in payload["unit"]
+    assert payload["vs_baseline"] is None  # no latency baseline tracked yet
+
+    # Rep dispersion (same contract as the throughput payloads), with the
+    # best-rep semantics MIRRORED: value is the fastest (minimum) p99.
+    assert payload["reps"] == 2
+    assert payload["min"] <= payload["median"] <= payload["max"]
+    assert abs(payload["value"] - payload["min"]) <= 0.11, payload
+    assert payload["rel_spread"] >= 0.0
+
+    # The latency body: percentile ladder ordered, occupancy in (0, 1],
+    # graceful-degradation counters present.
+    latency = payload["latency_ms"]
+    assert 0 < latency["p50"] <= latency["p95"] <= latency["p99"] <= latency["max"]
+    assert payload["offered_qps"] > 0 and payload["achieved_qps"] > 0
+    assert payload["requests"] > 0
+    assert payload["shed"] >= 0 and payload["errors"] == 0
+    assert 0.0 < payload["batch_fill_ratio"] <= 1.0
+    assert payload["hot_swaps"] >= 0
+    # Every bucket compiled exactly once (the no-recompile probe rides the
+    # payload as compile_count).
+    assert payload["compile_count"] >= 1
+
+    # Launch-hardening posture fields are universal across workloads.
+    assert payload["fallback"] is False
+    assert payload["fallback_reason"] is None
+
+
 def test_bench_backend_wedge_aborts_typed_within_deadline():
     # Acceptance pin (docs/DESIGN.md §2.4): with the probe subprocess wedged
     # (backend_wedge chaos fault — the child sleeps before touching jax),

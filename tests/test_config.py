@@ -102,4 +102,11 @@ def test_real_tree_composes_all_defaults():
     assert len(defaults) >= 30
     for rel in defaults:
         cfg = config_lib.compose(root, rel, [])
-        assert "arch" in cfg and "system" in cfg and "env" in cfg, rel
+        assert "arch" in cfg, rel
+        if cfg.arch.get("architecture_name") == "serve":
+            # The serving root (docs/DESIGN.md §2.8) deliberately composes NO
+            # system/network/env groups: the policy's network and observation
+            # spec come from the checkpoint's own saved training config.
+            assert "serve" in cfg.arch, rel
+            continue
+        assert "system" in cfg and "env" in cfg, rel

@@ -250,8 +250,9 @@ def test_timing_tracker_percentiles_empty_and_single():
     assert timer.all_percentiles() == {}
     timer._times.setdefault("x", __import__("collections").deque(maxlen=10)).append(0.5)
     stats = timer.percentiles("x")
-    assert stats == {"p50": 0.5, "p95": 0.5, "max": 0.5}
+    assert stats == {"p50": 0.5, "p95": 0.5, "p99": 0.5, "max": 0.5}
     assert timer.all_percentiles(prefix="pre_")["pre_x_p95"] == 0.5
+    assert timer.all_percentiles(prefix="pre_")["pre_x_p99"] == 0.5
 
 
 def test_timing_tracker_percentiles_window_eviction():
@@ -265,8 +266,27 @@ def test_timing_tracker_percentiles_window_eviction():
     assert stats["max"] == 5.0  # the evicted outlier is gone
     assert stats["p50"] == 3.0
     assert stats["p95"] == 5.0
+    assert stats["p99"] == 5.0
     # all_means API intact alongside.
     assert abs(timer.mean("y") - 3.0) < 1e-9
+
+
+def test_timing_tracker_p99_separates_tail_from_p50(monkeypatch=None):
+    """p99 is the SLO tail statistic (docs/DESIGN.md §2.8): with a window
+    large enough to resolve it, one outlier moves p99 but not p50/p95."""
+    from collections import deque
+
+    timer = TimingTracker(maxlen=50)
+    d = timer._times.setdefault("lat", deque(maxlen=50))
+    for _ in range(49):
+        d.append(0.010)
+    d.append(9.0)  # one tail request
+    stats = timer.percentiles("lat")
+    assert stats["p50"] == 0.010
+    assert stats["p95"] == 0.010
+    # nearest-rank with n=50: p99 -> index int(0.99*50+0.5)-1 = 49, the tail.
+    assert stats["p99"] == 9.0
+    assert stats["max"] == 9.0
 
 
 # --------------------------------------- telemetry off == seed behavior

@@ -1,0 +1,527 @@
+"""Policy-serving subsystem (stoix_tpu/serve, docs/DESIGN.md §2.8).
+
+Covers the ISSUE-11 acceptance surface end-to-end on CPU:
+  * dynamic batcher semantics — deadline flush, full-bucket flush, bucket
+    padding, and the no-recompile property pinned via the engine's
+    compile-count probe;
+  * overload shed — bounded queue raises typed ServerOverloadError, counted;
+  * hot-swap atomicity — concurrent requests under rapid parameter swaps
+    never observe a torn params mix;
+  * checkpoint -> serve — a real tiny ff_ppo training run's checkpoint loads
+    through the topology-elastic path and serves logits BIT-identical to a
+    direct network apply, survives a mid-traffic hot swap, and the load
+    generator emits a schema-valid latency payload;
+  * the emergency-store source and the `launcher.py serve --loadgen` CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.serve import (
+    DynamicBatcher,
+    InferenceEngine,
+    PolicyServer,
+    ServerClosedError,
+    ServerOverloadError,
+    load_policy,
+    run_loadgen,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Fakes: a linear "policy" so engine/server tests need no training run.
+# ---------------------------------------------------------------------------
+
+
+class _LinearDist:
+    def __init__(self, logits):
+        self.logits = logits
+
+    def mode(self):
+        return jnp.argmax(self.logits, axis=-1)
+
+    def sample(self, *, seed):
+        return jax.random.categorical(seed, self.logits, axis=-1)
+
+
+def _linear_apply(params, observation):
+    return _LinearDist(observation @ params)
+
+
+_OBS_DIM, _N_ACT = 6, 4
+_OBS_TEMPLATE = np.zeros((_OBS_DIM,), np.float32)
+
+
+def _obs(i: int) -> np.ndarray:
+    return (np.arange(_OBS_DIM, dtype=np.float32) + float(i)) / 7.0
+
+
+def _linear_server(**kwargs) -> PolicyServer:
+    params = jnp.asarray(
+        np.random.default_rng(0).normal(size=(_OBS_DIM, _N_ACT)).astype(np.float32)
+    )
+    defaults = dict(
+        apply_fn=_linear_apply,
+        params=params,
+        obs_template=_OBS_TEMPLATE,
+        buckets=[1, 2, 4],
+        max_wait_s=0.002,
+        max_queue=64,
+        greedy=True,
+    )
+    defaults.update(kwargs)
+    return PolicyServer(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# Dynamic batcher semantics
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_deadline_flush_releases_partial_batch():
+    """A lone request must not wait for company beyond max_wait_s."""
+    batcher = DynamicBatcher(buckets=[1, 2, 8], max_wait_s=0.15, max_queue=16)
+    batcher.submit(_obs(0))
+    start = time.perf_counter()
+    batch = batcher.next_batch(idle_timeout=1.0)
+    waited = time.perf_counter() - start
+    assert len(batch) == 1
+    # Flushed BY the deadline (anchored to the submit), not the idle timeout.
+    assert waited < 0.5
+    # And not immediately: the batch was genuinely held open for company.
+    assert waited > 0.05
+
+
+def test_batcher_full_bucket_flushes_before_deadline():
+    batcher = DynamicBatcher(buckets=[1, 2, 4], max_wait_s=5.0, max_queue=16)
+    for i in range(4):
+        batcher.submit(_obs(i))
+    start = time.perf_counter()
+    batch = batcher.next_batch(idle_timeout=1.0)
+    assert len(batch) == 4  # the largest bucket
+    assert time.perf_counter() - start < 1.0  # did NOT wait the 5s deadline
+
+
+def test_batcher_overload_sheds_with_typed_error():
+    batcher = DynamicBatcher(buckets=[1, 2], max_wait_s=1.0, max_queue=3)
+    for i in range(3):
+        batcher.submit(_obs(i))
+    with pytest.raises(ServerOverloadError) as excinfo:
+        batcher.submit(_obs(99))
+    assert excinfo.value.pending == 3 and excinfo.value.bound == 3
+    # Close fails the still-pending requests so no caller hangs.
+    assert batcher.close() == 3
+    with pytest.raises(ServerClosedError):
+        batcher.submit(_obs(0))
+
+
+def test_batcher_bucket_for_padding_ladder():
+    batcher = DynamicBatcher(buckets=[1, 2, 4, 8], max_wait_s=0.0, max_queue=16)
+    assert [batcher.bucket_for(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError):
+        batcher.bucket_for(9)
+    # Engine and batcher share ONE bucket normalization: invalid ladders
+    # raise in both (an engine padding every batch to bucket 0 would be a
+    # silent garbage server).
+    with pytest.raises(ValueError):
+        InferenceEngine(_linear_apply, jnp.zeros(1), _OBS_TEMPLATE, buckets=[0, 2])
+    with pytest.raises(ValueError):
+        DynamicBatcher(buckets=[], max_wait_s=0.0, max_queue=16)
+
+
+# ---------------------------------------------------------------------------
+# Engine: padding correctness + the no-recompile probe
+# ---------------------------------------------------------------------------
+
+
+def test_engine_pads_to_bucket_and_results_match_unpadded():
+    params = jnp.eye(_OBS_DIM, _N_ACT)
+    engine = InferenceEngine(
+        _linear_apply, params, _OBS_TEMPLATE, buckets=[1, 2, 4], greedy=True
+    )
+    observations = [_obs(0), _obs(1), _obs(2)]
+    action, extras, bucket = engine.infer(observations)
+    assert bucket == 4 and action.shape[0] == 4
+    direct = np.asarray(jnp.stack([jnp.asarray(o) for o in observations]) @ params)
+    np.testing.assert_array_equal(np.asarray(extras["logits"])[:3], direct)
+    # Pad rows repeat the LAST observation — sliced off by the server.
+    np.testing.assert_array_equal(
+        np.asarray(extras["logits"])[3], direct[2]
+    )
+
+
+def test_engine_compile_count_pins_no_recompile_across_batch_sizes():
+    params = jnp.eye(_OBS_DIM, _N_ACT)
+    engine = InferenceEngine(
+        _linear_apply, params, _OBS_TEMPLATE, buckets=[1, 2, 4], greedy=True
+    )
+    assert engine.warmup() == 3  # one trace per bucket
+    for n in (1, 2, 3, 4, 1, 3, 2, 4):
+        engine.infer([_obs(i) for i in range(n)])
+    assert engine.compile_count == 3  # traffic at ANY size: zero retraces
+    # A hot-swap must not recompile either (same shapes/dtypes).
+    engine.set_params(params * 2.0)
+    engine.infer([_obs(0)])
+    assert engine.compile_count == 3
+
+
+# ---------------------------------------------------------------------------
+# Server: shed path + hot-swap atomicity under concurrent traffic
+# ---------------------------------------------------------------------------
+
+
+def test_server_sheds_past_queue_bound_and_recovers():
+    server = _linear_server(max_queue=8, max_wait_s=0.0)
+    with server:
+        # Slow the worker's jitted step so the pending buffer can fill.
+        original_step = server._engine._step
+
+        def slow_step(*args):
+            time.sleep(0.05)
+            return original_step(*args)
+
+        server._engine._step = slow_step
+        futures, shed = [], 0
+        for i in range(64):
+            try:
+                futures.append(server.submit(_obs(i)))
+            except ServerOverloadError:
+                shed += 1
+        assert shed >= 1  # the bound actually shed
+        assert server.telemetry.n_shed == shed
+        # Accepted requests still complete — shedding is degradation, not
+        # failure.
+        for future in futures:
+            assert future.result(timeout=30.0).action is not None
+        server._engine._step = original_step
+        # Recovery: the next request is served normally.
+        assert server.infer(_obs(0)).action is not None
+
+
+def test_hot_swap_atomicity_under_concurrent_requests():
+    """Rapid swaps between params A and B while 4 threads stream requests:
+    every response must equal the A-result or the B-result EXACTLY — a torn
+    read of half-swapped params would produce a third value."""
+    params_a = jnp.asarray(np.full((_OBS_DIM, _N_ACT), 1.0, np.float32))
+    params_b = jnp.asarray(np.full((_OBS_DIM, _N_ACT), -1.0, np.float32))
+    fixed = _obs(3)
+    expected = {
+        np.asarray(jnp.asarray(fixed) @ params_a).tobytes(),
+        np.asarray(jnp.asarray(fixed) @ params_b).tobytes(),
+    }
+    server = _linear_server(params=params_a, max_wait_s=0.001, max_queue=512)
+    stop = threading.Event()
+    torn = []
+
+    def client():
+        while not stop.is_set():
+            try:
+                result = server.infer(fixed, timeout=10.0)
+            except ServerOverloadError:
+                continue
+            if result.extras["logits"].tobytes() not in expected:
+                torn.append(np.asarray(result.extras["logits"]))
+                return
+
+    with server:
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for thread in threads:
+            thread.start()
+        for i in range(40):
+            server._engine.set_params(params_b if i % 2 == 0 else params_a)
+            time.sleep(0.005)
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=30.0)
+    assert not torn, f"torn params observed: {torn[:1]}"
+    assert server.params_version >= 40
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint -> serve (real tiny ff_ppo run; module-scoped fixture)
+# ---------------------------------------------------------------------------
+
+_UID = "serve-test"
+
+
+@pytest.fixture(scope="module")
+def trained_store(tmp_path_factory):
+    """One tiny ff_ppo training run with checkpointing on; yields
+    (store_dir, train_root_dir)."""
+    from stoix_tpu.systems.ppo.anakin import ff_ppo
+    from stoix_tpu.utils import config as config_lib
+
+    root = tmp_path_factory.mktemp("serve_ckpt")
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        [
+            "env=identity_game",
+            "arch.total_num_envs=16",
+            "arch.total_timesteps=1024",
+            "arch.num_evaluation=1",
+            "arch.num_eval_episodes=8",
+            "arch.absolute_metric=False",
+            "system.rollout_length=8",
+            "system.num_minibatches=2",
+            "logger.use_console=False",
+            f"logger.base_exp_path={root}/results",
+            "logger.checkpointing.save_model=True",
+            f"logger.checkpointing.save_args.checkpoint_uid={_UID}",
+        ],
+    )
+    cwd = os.getcwd()
+    os.chdir(root)
+    try:
+        ff_ppo.run_experiment(config)
+    finally:
+        os.chdir(cwd)
+    store = os.path.join(str(root), "checkpoints", _UID, "ff_ppo")
+    assert os.path.isdir(store)
+    return store, str(root)
+
+
+def _serve_config(store, extra=()):
+    from stoix_tpu.utils import config as config_lib
+
+    return config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/serve.yaml",
+        [
+            f"arch.serve.checkpoint.path={store}",
+            "arch.serve.batching.max_wait_ms=1.0",
+            "arch.serve.hot_swap.poll_interval_s=0.2",
+            *extra,
+        ],
+    )
+
+
+def test_checkpoint_serve_logits_bit_identical_to_direct_apply(trained_store):
+    store, _ = trained_store
+    config = _serve_config(store)
+    bundle = load_policy(config)
+    observations = [
+        jax.tree.map(
+            lambda x, i=i: (x + i).astype(np.asarray(x).dtype)
+            if np.issubdtype(np.asarray(x).dtype, np.floating)
+            else x,
+            bundle.obs_template,
+        )
+        for i in range(5)
+    ]
+    batched = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *observations)
+    # "Direct apply" = the jitted network call a batch-inference user runs.
+    # (An EAGER apply can differ from any jitted program by one float ulp on
+    # CPU — XLA fuses the compiled graph differently — so bitwise identity
+    # is defined against the compiled apply, like training's pinned tests.)
+    direct = np.asarray(
+        jax.jit(lambda p, o: bundle.apply_fn(p, o).logits)(bundle.params, batched)
+    )
+
+    server = PolicyServer.from_config(config)
+    with server:
+        futures = [server.submit(obs) for obs in observations]
+        for i, future in enumerate(futures):
+            served = future.result(timeout=30.0).extras["logits"]
+            np.testing.assert_array_equal(served, direct[i])
+        warmed = server.compile_count
+        # Concurrent mixed-size traffic never recompiles (STX012 in spirit).
+        for i in range(30):
+            server.submit(observations[i % 5])
+        time.sleep(0.5)
+        assert server.compile_count == warmed
+
+
+def test_mid_traffic_hot_swap_serves_new_checkpoint(trained_store):
+    """A second (newer-step) checkpoint appears under live traffic: the
+    watcher swaps it in atomically; post-swap responses match the NEW params'
+    direct apply bit-identically and the swap is counted."""
+    from stoix_tpu.systems.anakin import broadcast_to_update_batch
+    from stoix_tpu.utils.checkpointing import Checkpointer
+
+    store, root = trained_store
+    config = _serve_config(store)
+    bundle = load_policy(config)
+    new_params = jax.tree.map(lambda x: x + 0.25, bundle.params)
+    update_batch = int(bundle.train_config.arch.get("update_batch_size", 1))
+
+    # All-valid action mask: identity_game's template mask pins the masked
+    # logits regardless of params, which would hide the swap.
+    obs = bundle.obs_template._replace(
+        action_mask=jnp.ones_like(jnp.asarray(bundle.obs_template.action_mask))
+    )
+    batched = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
+    # Jitted direct apply: the bitwise reference (see the note in
+    # test_checkpoint_serve_logits_bit_identical_to_direct_apply).
+    direct = jax.jit(lambda p, o: bundle.apply_fn(p, o).logits)
+    old_logits = np.asarray(direct(bundle.params, batched))[0]
+    new_logits = np.asarray(direct(new_params, batched))[0]
+    assert not np.array_equal(old_logits, new_logits)
+
+    server = PolicyServer.from_config(config)
+    with server:
+        assert np.array_equal(server.infer(obs).extras["logits"], old_logits)
+        # Keep background traffic flowing while the new checkpoint lands.
+        stop = threading.Event()
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    server.infer(obs, timeout=10.0)
+                except ServerOverloadError:
+                    pass
+
+        thread = threading.Thread(target=traffic)
+        thread.start()
+        try:
+            # The learner side: a newer step written into the SAME store.
+            # Serving only reads the params/actor_params subtree, so the
+            # saved tree only needs that path.
+            saver = Checkpointer(
+                model_name="ff_ppo",
+                rel_dir=os.path.join(root, "checkpoints"),
+                checkpoint_uid=_UID,
+                max_to_keep=None,
+            )
+            saver.save(
+                2048,
+                {
+                    "params": {
+                        "actor_params": broadcast_to_update_batch(
+                            new_params, update_batch
+                        )
+                    }
+                },
+                force=True,
+            )
+            saver.close()
+            swapped = server.watcher.check_now()
+            assert swapped == 2048
+        finally:
+            stop.set()
+            thread.join(timeout=30.0)
+        assert server.telemetry.n_hot_swaps == 1
+        np.testing.assert_array_equal(
+            server.infer(obs).extras["logits"], new_logits
+        )
+
+
+def test_emergency_store_source_serves_identical_params(trained_store):
+    """A fleet local-shard emergency store (npz + manifest) serves the same
+    params as the orbax store — the 'any checkpoint' half of the tentpole."""
+    import hashlib
+
+    from stoix_tpu.resilience.fleet import MANIFEST_NAME
+    from stoix_tpu.systems.anakin import broadcast_to_update_batch
+
+    store, root = trained_store
+    config = _serve_config(store)
+    bundle = load_policy(config)
+    update_batch = int(bundle.train_config.arch.get("update_batch_size", 1))
+    params_u = broadcast_to_update_batch(bundle.params, update_batch)
+
+    from stoix_tpu.utils.checkpointing import _path_key
+
+    emergency = os.path.join(root, "fleet_emergency", "p0")
+    os.makedirs(emergency, exist_ok=True)
+    arrays, digests = {}, {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params_u)[0]:
+        key = "/".join(("params", "actor_params") + _path_key(path))
+        arr = np.asarray(leaf)
+        arrays[key] = arr
+        digests[key] = hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+    np.savez(os.path.join(emergency, "state.npz"), **arrays)
+    with open(os.path.join(emergency, MANIFEST_NAME), "w") as f:
+        json.dump(
+            {
+                "format": 1, "step": 1024, "process_index": 0,
+                "process_count": 2, "partial": [], "casts": {},
+                "digests": digests,
+            },
+            f,
+        )
+
+    em_config = _serve_config(
+        os.path.join(root, "fleet_emergency"),
+        extra=[
+            "arch.serve.checkpoint.train_config=default/anakin/default_ff_ppo.yaml",
+            "arch.serve.checkpoint.train_overrides=[env=identity_game,arch.total_num_envs=16]",
+        ],
+    )
+    em_bundle = load_policy(em_config)
+    assert em_bundle.source.is_emergency and em_bundle.step == 1024
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        bundle.params, em_bundle.params,
+    )
+    # An emergency store holds ONE step: an explicit timestep it cannot
+    # honor refuses instead of silently serving a different policy.
+    with pytest.raises(FileNotFoundError):
+        em_bundle.source.load(999)
+
+
+def test_loadgen_emits_schema_valid_latency_payload(trained_store):
+    store, _ = trained_store
+    server = PolicyServer.from_config(_serve_config(store))
+    with server:
+        report = run_loadgen(server, offered_qps=150.0, duration_s=1.0)
+    assert report["requests"] > 0 and report["completed"] > 0
+    assert report["errors"] == 0 and report["timed_out"] == 0
+    assert report["achieved_qps"] > 0
+    latency = report["latency_ms"]
+    assert set(latency) == {"p50", "p95", "p99", "max"}
+    assert 0 < latency["p50"] <= latency["p95"] <= latency["p99"] <= latency["max"]
+    assert 0.0 < report["batch_fill_ratio"] <= 1.0
+    assert report["batches"] > 0
+    assert report["hot_swaps"] == 0
+    # The SLO snapshot mirrors the same traffic.
+    snap = server.telemetry.slo_snapshot()
+    assert snap["requests_ok"] >= report["completed"]
+    assert snap["latency_ms_p99"] > 0
+
+
+def test_launcher_serve_loadgen_cli(trained_store):
+    """The CI smoke path: `launcher.py serve --loadgen` starts the server
+    in-process, drives the load generator, and prints ONE JSON report line."""
+    store, _ = trained_store
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "stoix_tpu.launcher", "serve", "--loadgen",
+            f"arch.serve.checkpoint.path={store}",
+            "arch.serve.loadgen.offered_qps=100",
+            "arch.serve.loadgen.duration_s=1.0",
+            "arch.serve.batching.max_wait_ms=1.0",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"serve --loadgen failed:\n{proc.stdout}\n{proc.stderr}"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    report = json.loads(lines[0])
+    assert report["completed"] > 0 and report["latency_ms"]["p99"] > 0
+
+
+def test_server_close_fails_pending_requests_typed(trained_store):
+    store, _ = trained_store
+    server = PolicyServer.from_config(_serve_config(store))
+    server.start()
+    result = server.infer(server.obs_template)
+    assert result.action is not None
+    server.close()
+    with pytest.raises(ServerClosedError):
+        server.submit(server.obs_template)
