@@ -13,7 +13,7 @@ and Sebulba hot paths are perf-tracked alongside the PPO path
     sebulba_ppo_cartpole      — actor/learner split over the native C++ pool
 
 Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
-                       [--serve] [--cpu] [--reps N]
+                       [--serve] [--cpu] [--reps N] [--integrity]
        python bench.py --check BASELINE.json --candidate CAND.json
                        [--check-threshold 0.05] [--check-require-all]
   --all       run all five tracked configs, one JSON line each
@@ -31,6 +31,12 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
               carries direction=lower_is_better (the --check gate inverts
               its comparison), the full latency percentile set, offered vs
               achieved QPS, batch-fill ratio, shed count, and hot-swap count
+  --integrity arm the state-integrity sentinel (arch.integrity, docs/
+              DESIGN.md §2.9) in the Anakin probe run so the payload's
+              first-class `integrity` fields (enabled / fingerprint_checks /
+              overhead_s / probe_runs) carry a measured per-window cost;
+              without the flag the fields still ride every payload with the
+              disabled shape, so a sentinel can never tax a number invisibly
   --cpu       force the CPU backend (a site hook can force a remote platform
               even over JAX_PLATFORMS=cpu; this flag wins)
   --check     variance-aware regression gate (no benchmark is run, no jax is
@@ -343,6 +349,10 @@ def main() -> None:
     sebulba = "--sebulba" in sys.argv
     pixel = "--pixel" in sys.argv  # Sebulba on 84x84x4 frames + Nature CNN
     serve = "--serve" in sys.argv  # latency frontier: dynamic-batching policy serving
+    # Arm the state-integrity sentinel in the Anakin probe run so the payload's
+    # integrity fields carry a MEASURED per-window fingerprint overhead
+    # (docs/DESIGN.md §2.9) instead of the disabled zeros.
+    integrity_on = "--integrity" in sys.argv
     run_all = "--all" in sys.argv
     if large and cartpole:
         sys.exit("--large is the MXU-bound Ant variant; it does not compose with --cartpole")
@@ -350,6 +360,11 @@ def main() -> None:
         sys.exit("--sebulba/--pixel are their own workloads; they do not compose")
     if serve and (large or cartpole or sebulba or pixel):
         sys.exit("--serve is its own (latency-shaped) workload; it does not compose")
+    if serve and integrity_on:
+        # Refuse rather than silently measure nothing: the training sentinel
+        # never runs in the serving workload (its integrity story is the
+        # hot-swap canary, always on).
+        sys.exit("--integrity arms the TRAINING sentinel; it does not compose with --serve")
     if run_all and (large or cartpole or sebulba or pixel or serve):
         sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
@@ -532,7 +547,8 @@ def main() -> None:
     if run_all:
         workloads = [
             ("anakin_ppo_ant_env_steps_per_sec",
-             lambda: _run_anakin_ppo(smoke, False, False, n_devices, reps=reps)),
+             lambda: _run_anakin_ppo(smoke, False, False, n_devices, reps=reps,
+                                     integrity_on=integrity_on)),
             ("anakin_c51_snake_env_steps_per_sec",
              lambda: _run_anakin_generic(
                  "anakin_c51_snake_env_steps_per_sec",
@@ -554,7 +570,7 @@ def main() -> None:
             ("sebulba_ppo_cartpole_env_steps_per_sec",
              lambda: _run_sebulba(
                  "sebulba_ppo_cartpole_env_steps_per_sec", smoke, n_devices,
-                 reps=reps)),
+                 reps=reps, integrity_on=integrity_on)),
         ]
         payloads = []
         for name, workload in workloads:
@@ -590,6 +606,7 @@ def main() -> None:
                 num_evaluation=2 if smoke else 4,
                 pool_desc="84x84x4 C++ pixel pool, Nature CNN",
                 reps=reps,
+                integrity_on=integrity_on,
             )
         ])
         return
@@ -599,10 +616,17 @@ def main() -> None:
         return
 
     if sebulba:
-        _finish([_run_sebulba(metric, smoke, n_devices, reps=reps)])
+        _finish([
+            _run_sebulba(metric, smoke, n_devices, reps=reps, integrity_on=integrity_on)
+        ])
         return
 
-    _finish([_run_anakin_ppo(smoke, cartpole, large, n_devices, metric=metric, reps=reps)])
+    _finish([
+        _run_anakin_ppo(
+            smoke, cartpole, large, n_devices, metric=metric, reps=reps,
+            integrity_on=integrity_on,
+        )
+    ])
 
 
 def _resilience_selfcheck(config, skipped_before: float) -> dict:
@@ -624,6 +648,27 @@ def _skipped_updates_base() -> float:
     from stoix_tpu.resilience import guards
 
     return guards.skipped_counter().value()
+
+
+def _integrity_report(stats_source) -> dict:
+    """First-class integrity fields for a bench payload (docs/DESIGN.md
+    §2.9): whether the state-integrity sentinel ran, how many fingerprint
+    checks it performed, and its host-side overhead in seconds — so the
+    sentinel's hot-path cost is VISIBLE next to the throughput number it
+    taxes (and a BENCH_*.json line can never hide an active sentinel). The
+    numbers come from the run's LAST_RUN_STATS (the probe run for Anakin
+    payloads); a run without the sentinel reports the disabled shape."""
+    from stoix_tpu.resilience import integrity as integrity_mod
+
+    stats = dict((stats_source or {}).get("integrity") or {})
+    if not stats:
+        return integrity_mod.disabled_stats()
+    return {
+        "enabled": bool(stats.get("enabled", False)),
+        "fingerprint_checks": int(stats.get("fingerprint_checks", 0)),
+        "overhead_s": round(float(stats.get("overhead_s", 0.0)), 6),
+        "probe_runs": int(stats.get("probe_runs", 0)),
+    }
 
 
 def _timed_anakin_run(config, learner_setup, smoke: bool, reps: int | None = None):
@@ -776,7 +821,9 @@ def _phase_breakdown_probe(
         observability.shutdown()
 
 
-def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None, reps=None) -> dict:
+def _run_anakin_ppo(
+    smoke, cartpole, large, n_devices, metric=None, reps=None, integrity_on=False
+) -> dict:
     from stoix_tpu.utils import config as config_lib
 
     env_tag = "cartpole" if cartpole else "ant"
@@ -793,6 +840,11 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None, reps=None) -
     if not cartpole:
         overrides.append("env=ant")
     probe_overrides = [] if cartpole else ["env=ant"]
+    if integrity_on:
+        # --integrity: arm the state-integrity sentinel in the probe run so
+        # its per-window fingerprint overhead is measured by the REAL
+        # pipelined runner and surfaces in the payload's integrity fields.
+        probe_overrides.append("arch.integrity.enabled=True")
     if large:
         large_overrides = [
             "network.actor_network.pre_torso.layer_sizes=[1024,1024]",
@@ -826,6 +878,8 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None, reps=None) -
     phase_breakdown, telemetry = _phase_breakdown_probe(
         default_yaml, learner_setup.__module__, probe_overrides, smoke, n_devices,
     )
+    from stoix_tpu.systems import runner as anakin_runner
+
     return {
         "metric": metric,
         "value": round(steps_per_sec, 1),
@@ -839,6 +893,9 @@ def _run_anakin_ppo(smoke, cartpole, large, n_devices, metric=None, reps=None) -
         "phase_breakdown": phase_breakdown,
         "telemetry": telemetry,
         "resilience": _resilience_selfcheck(config, skipped_before),
+        # Sentinel posture of the probe run (the probe exercises the real
+        # runner, fingerprints included when --integrity arms them).
+        "integrity": _integrity_report(anakin_runner.LAST_RUN_STATS),
     }
 
 
@@ -938,6 +995,9 @@ def _run_serve(metric, smoke, n_devices, reps=None) -> dict:
             "batch_fill_ratio": best["batch_fill_ratio"],
             "hot_swaps": best["hot_swaps"],
             "compile_count": warmed,
+            # Serving's integrity story is the hot-swap canary; the training
+            # sentinel never runs here — disabled shape, never a missing key.
+            "integrity": _integrity_report(None),
         }
     finally:
         os.chdir(cwd)
@@ -994,6 +1054,10 @@ def _run_anakin_generic(
         **_rep_stats(rep_values),
         **compile_info,
         "resilience": _resilience_selfcheck(config, skipped_before),
+        # The generic timed loop drives the learner directly (no runner, no
+        # sentinel): the integrity fields still ride with the disabled
+        # shape, so consumers never see a missing key.
+        "integrity": _integrity_report(None),
     }
 
 
@@ -1008,6 +1072,7 @@ def _run_sebulba(
     num_evaluation: int | None = None,
     pool_desc: str = "C++ pool",
     reps: int | None = None,
+    integrity_on: bool = False,
 ) -> dict:
     """Sebulba PPO on the native C++ pool; steady-state SPS. Default workload
     is the CartPole pool; `--pixel` swaps in the full-resolution 84x84x4
@@ -1039,6 +1104,11 @@ def _run_sebulba(
         % (rollout_length if rollout_length is not None else (8 if smoke else 64)),
         "logger.use_console=False",
     ]
+    if integrity_on:
+        # --integrity: Sebulba checks fingerprints at eval boundaries
+        # (docs/DESIGN.md §2.9); the cost lands in the payload's integrity
+        # fields via LAST_RUN_STATS.
+        overrides.append("arch.integrity.enabled=True")
     config = config_lib.compose(
         config_lib.default_config_dir(), "default/sebulba/default_ff_ppo.yaml", overrides
     )
@@ -1103,6 +1173,7 @@ def _run_sebulba(
         "cache_hits": compilecache.cache_stats()["hits"] - cache_before["hits"],
         "telemetry": telemetry,
         "resilience": resilience,
+        "integrity": _integrity_report(sebulba_ppo.LAST_RUN_STATS),
     }
 
 

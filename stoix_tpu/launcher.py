@@ -36,14 +36,19 @@ config fails the batch in seconds instead of after scheduling.
 `--changed-only` restricts the lint stage to git-changed files so the prolog
 stays fast as the rule count grows.
 
-`--supervise N` (docs/DESIGN.md §2.6) makes `--local` runs elastic: a job
-that exits with the fleet-partition code (87, resilience/fleet.py — a peer
-host died and the survivors secured a local-shard emergency checkpoint) is
-relaunched up to N times at the surviving topology with resume overrides
+`--supervise N` (docs/DESIGN.md §2.6 + §2.9) makes `--local` runs elastic: a
+job that exits with the fleet-partition code (87, resilience/fleet.py — a
+peer host died and the survivors secured a local-shard emergency checkpoint)
+is relaunched up to N times at the surviving topology with resume overrides
 appended (`logger.checkpointing.load_model=true` + the emergency-store
 load_path); topology-elastic restore brings the params back bit-identical on
-the shrunk mesh. Any other exit code is final — 87 is the ONLY code that
-means "the run is healthy, the fleet was not".
+the shrunk mesh. A job that exits with the state-corruption code (88,
+resilience/integrity.py — the integrity sentinel proved a silent replica
+mismatch or a failed determinism probe) is relaunched with the resume
+overrides the quarantine file records, restoring the newest DIGEST-VERIFIED
+checkpoint; the offending host stays named in `--quarantine-file` for the
+scheduler to drain. Any other exit code is final — 87 and 88 are the only
+codes that mean "the run is healthy, the hardware was not".
 """
 
 from __future__ import annotations
@@ -185,40 +190,79 @@ def run_supervised(
     env: Optional[dict],
     max_relaunches: int,
     resume_overrides: List[str],
+    quarantine_file: Optional[str] = None,
 ) -> int:
-    """Supervision loop for one job (docs/DESIGN.md §2.6): relaunch on the
-    fleet-partition exit code — the code resilience/fleet.py reserves for "a
-    peer died, a local-shard emergency checkpoint is on disk" — with the
-    resume overrides appended so the relaunch restores through the
-    topology-elastic path at whatever topology survived. Every OTHER exit
-    code (clean 0, watchdog 86, crash 1) is final: only a partition is a
-    relaunch-and-resume situation. Returns the final exit code."""
+    """Supervision loop for one job (docs/DESIGN.md §2.6 + §2.9). Two exit
+    codes mean "the run is healthy, relaunch-and-resume":
+
+      * 87 (fleet partition, resilience/fleet.py) — a peer died and the
+        survivors secured a local-shard emergency checkpoint; relaunch with
+        `resume_overrides` so topology-elastic restore resumes at whatever
+        topology survived.
+      * 88 (state corruption, resilience/integrity.py) — the integrity
+        sentinel proved silent corruption (replica fingerprint mismatch or a
+        failed determinism probe) and recorded the offending host(s) in the
+        quarantine file; relaunch with the quarantine record's resume
+        overrides so the run restores the newest DIGEST-VERIFIED checkpoint.
+        The quarantine file is the scheduler/operator's drain list — this
+        loop cannot evict a host from its own allocation, but it names the
+        offender with proof and keeps the job moving.
+
+    Every OTHER exit code (clean 0, watchdog 86, crash 1) is final. Returns
+    the final exit code."""
     from stoix_tpu.resilience.fleet import EXIT_CODE_FLEET_PARTITION
+    from stoix_tpu.resilience.integrity import (
+        EXIT_CODE_STATE_CORRUPTION,
+        corruption_resume_overrides,
+        read_quarantine,
+    )
 
     log = get_logger("stoix_tpu.launcher")
     relaunches = 0
     extra: List[str] = []
     while True:
         rc = subprocess.run(cmd + extra, env=env).returncode
-        if rc != EXIT_CODE_FLEET_PARTITION:
+        if rc not in (EXIT_CODE_FLEET_PARTITION, EXIT_CODE_STATE_CORRUPTION):
             if relaunches:
                 log.info(
-                    "[launcher] job finished (rc %d) after %d fleet "
+                    "[launcher] job finished (rc %d) after %d supervised "
                     "relaunch(es)", rc, relaunches,
                 )
             return rc
+        reason = (
+            "fleet partition" if rc == EXIT_CODE_FLEET_PARTITION
+            else "state corruption"
+        )
         if relaunches >= max_relaunches:
             log.error(
-                "[launcher] fleet-partition exit (rc %d) with the relaunch "
-                "budget (%d) exhausted — giving up", rc, max_relaunches,
+                "[launcher] %s exit (rc %d) with the relaunch budget (%d) "
+                "exhausted — giving up", reason, rc, max_relaunches,
             )
             return rc
         relaunches += 1
-        extra = list(resume_overrides)
+        if rc == EXIT_CODE_FLEET_PARTITION:
+            extra = list(resume_overrides)
+        else:
+            quarantined = read_quarantine(quarantine_file or "").get("quarantined") or []
+            if quarantined:
+                latest = quarantined[-1]
+                log.error(
+                    "[launcher] QUARANTINE: process(es) %s (device(s) %s) "
+                    "flagged for %s at step %s — recorded in %s; drain them "
+                    "before the budget runs out",
+                    latest.get("processes"), latest.get("devices"),
+                    latest.get("kind"), latest.get("step"), quarantine_file,
+                )
+            extra = corruption_resume_overrides(quarantine_file or "")
+            if not extra:
+                log.warning(
+                    "[launcher] corruption exit with no recorded resume "
+                    "overrides (checkpointing was off?) — relaunching FRESH"
+                )
         log.warning(
-            "[launcher] fleet partition (rc %d): relaunching (%d/%d) at the "
-            "surviving topology with %s",
-            rc, relaunches, max_relaunches, " ".join(extra),
+            "[launcher] %s (rc %d): relaunching (%d/%d)%s",
+            reason, rc, relaunches, max_relaunches,
+            f" with {' '.join(extra)}" if extra else "",
         )
 
 
@@ -259,8 +303,13 @@ def serve_main(argv: List[str]) -> int:
     config = config_lib.compose(
         config_lib.default_config_dir(), args.config, args.overrides
     )
+    from stoix_tpu.resilience import faultinject
     from stoix_tpu.serve import PolicyServer, run_loadgen
 
+    # Arm the chaos plan exactly like the training entry points do (env var
+    # wins over arch.fault_spec): `STOIX_TPU_FAULT=swap_poison` must reach
+    # the hot-swap canary (docs/DESIGN.md §2.9) when serving standalone.
+    faultinject.configure((config.get("arch") or {}).get("fault_spec"))
     log = get_logger("stoix_tpu.launcher")
     server = PolicyServer.from_config(config)
     serve_cfg = config.arch.serve
@@ -356,15 +405,27 @@ def main(argv: List[str] | None = None) -> None:
         metavar="N",
         help="with --local: relaunch a job up to N times when it exits with "
         "the fleet-partition code (87 — a peer host died and a local-shard "
-        "emergency checkpoint was secured; stoix_tpu/resilience/fleet.py), "
-        "appending resume overrides so topology-elastic restore resumes at "
-        "the surviving topology. 0 (default) disables supervision.",
+        "emergency checkpoint was secured; stoix_tpu/resilience/fleet.py) "
+        "or the state-corruption code (88 — the integrity sentinel proved "
+        "silent corruption and quarantined the offender; "
+        "stoix_tpu/resilience/integrity.py), appending the matching resume "
+        "overrides so the relaunch restores the right store. 0 (default) "
+        "disables supervision.",
     )
     parser.add_argument(
         "--fleet-resume-path",
         default=os.path.join("checkpoints", "fleet_emergency"),
         help="emergency-store path the supervised relaunch resumes from "
         "(must match arch.fleet.emergency_dir)",
+    )
+    parser.add_argument(
+        "--quarantine-file",
+        default=os.path.join("checkpoints", "quarantine.json"),
+        help="quarantine record the integrity sentinel writes on a "
+        "state-corruption exit (rc 88, stoix_tpu/resilience/integrity.py; "
+        "must match arch.integrity.quarantine_file). --supervise reads the "
+        "offender + resume overrides from it and relaunches restoring the "
+        "newest digest-verified checkpoint",
     )
     parser.add_argument(
         "--compile-cache",
@@ -451,7 +512,10 @@ def main(argv: List[str] | None = None) -> None:
             log.info("[launcher] running %s", job["name"])
             cmd = [sys.executable, "-m", job["module"], *job["overrides"]]
             if args.supervise > 0:
-                rc = run_supervised(cmd, env, args.supervise, resume_overrides)
+                rc = run_supervised(
+                    cmd, env, args.supervise, resume_overrides,
+                    quarantine_file=args.quarantine_file,
+                )
                 if rc != 0:
                     sys.exit(rc)
             else:

@@ -38,6 +38,17 @@ Zero-dependency, off-by-default-transparent. Four pillars:
     launcher's elastic-relaunch supervision; per-host window wall-time skew
     telemetry (`stoix_tpu_fleet_*`); and deadline-guarded barriers. Opt-in
     via `arch.fleet`; off = bit-identical.
+  * **State-integrity sentinel** (integrity.py, docs/DESIGN.md §2.9): in-jit
+    per-device replica fingerprints riding the coalesced metric fetch prove
+    the post-pmean bit-identity invariant every window — a finite-but-wrong
+    HBM bit-flip raises a typed `StateCorruptionError` naming the deviating
+    device(s) instead of training silently to garbage; an optional
+    determinism probe replays a recorded learn step and compares bitwise
+    (wrong-math cores at replica count 1); per-leaf sha256 digest manifests
+    ride every orbax save and are verified on restore (bit-rot is rejected,
+    not resumed); exit code 88 + a quarantine file drive
+    `launcher.py --supervise`'s restore-and-quarantine relaunch. Opt-in via
+    `arch.integrity`; off = bit-identical.
 
 With everything at defaults (`update_guard=off`, no faults armed, no crashes)
 training is bit-identical to a build without this package — guards add zero
@@ -45,7 +56,7 @@ ops, the signal handler only reacts to signals, and supervision only acts on
 failures (tests/test_resilience.py pins the trajectory equality).
 """
 
-from stoix_tpu.resilience import faultinject, fleet, guards, preflight  # noqa: F401 — public API
+from stoix_tpu.resilience import faultinject, fleet, guards, integrity, preflight  # noqa: F401 — public API
 from stoix_tpu.resilience.errors import (  # noqa: F401
     BackendUnavailableError,
     CheckpointIntegrityError,
@@ -60,6 +71,7 @@ from stoix_tpu.resilience.errors import (  # noqa: F401
     InjectedFault,
     PreflightError,
     ResourcePreflightError,
+    StateCorruptionError,
 )
 from stoix_tpu.resilience.fleet import (  # noqa: F401
     EXIT_CODE_FLEET_PARTITION,
@@ -67,6 +79,11 @@ from stoix_tpu.resilience.fleet import (  # noqa: F401
     FleetCoordinator,
     FleetStragglerWarning,
     fleet_from_config,
+)
+from stoix_tpu.resilience.integrity import (  # noqa: F401
+    EXIT_CODE_STATE_CORRUPTION,
+    StateIntegritySentinel,
+    sentinel_from_config,
 )
 from stoix_tpu.resilience.preemption import PreemptionHandler  # noqa: F401
 from stoix_tpu.resilience.supervisor import (  # noqa: F401

@@ -68,15 +68,80 @@ class EvaluatorStallError(RuntimeError):
 
 
 class CheckpointIntegrityError(RuntimeError):
-    """A restored checkpoint failed validation (tree-structure mismatch or a
-    non-finite value where the template is finite). Restore falls back to the
-    newest VALID checkpoint when one exists; this error surfaces only when no
-    candidate passes."""
+    """A restored checkpoint failed validation. `kind` names the distinct
+    rejection class — 'structure' (tree/leaf/dtype mismatch), 'non_finite'
+    (NaN/inf where the template is finite), or 'digest' (on-disk bytes no
+    longer match the per-leaf sha256 manifest recorded at save time:
+    bit-rot, docs/DESIGN.md §2.9) — so the fallback walk's log and
+    `Checkpointer.last_restore_report` carry typed reasons, not prose.
+    Restore falls back to the newest VALID checkpoint when one exists; this
+    error surfaces only when no candidate passes."""
 
-    def __init__(self, step: int, reason: str):
+    def __init__(self, step: int, reason: str, kind: str = "structure"):
         self.step = int(step)
         self.reason = reason
-        super().__init__(f"checkpoint at step {step} failed integrity validation: {reason}")
+        self.kind = str(kind)
+        super().__init__(
+            f"checkpoint at step {step} failed integrity validation "
+            f"[{self.kind}]: {reason}"
+        )
+
+
+class StateCorruptionError(RuntimeError):
+    """The state-integrity sentinel (resilience/integrity.py, docs/DESIGN.md
+    §2.9) proved silent state corruption: either the per-device replica
+    fingerprints of a replicated state group disagree (`kind=
+    'replica_mismatch'` — an HBM bit-flip or a wrong-math core broke the
+    post-pmean bit-identity invariant; names the deviating device(s) and
+    process(es)), or the determinism probe's replay of a recorded
+    (state, minibatch) pair through the learn step no longer matches its
+    recorded output fingerprint (`kind='determinism'` — wrong math even at
+    replica count 1). The values involved are FINITE — no divergence guard
+    or finiteness check can see this class. The handling path records the
+    offender in the quarantine file and exits with
+    integrity.EXIT_CODE_STATE_CORRUPTION (88) so a supervising launcher
+    restores the newest digest-verified checkpoint."""
+
+    def __init__(
+        self,
+        kind: str,
+        groups: list,
+        devices: list,
+        processes: list,
+        window: int,
+        step: int,
+        detail: str = "",
+    ):
+        self.kind = str(kind)
+        self.groups = [str(g) for g in groups]
+        self.devices = [int(d) for d in devices]
+        self.processes = sorted(int(p) for p in processes)
+        self.window = int(window)
+        self.step = int(step)
+        self.detail = detail
+        if self.kind == "determinism":
+            what = (
+                f"learn-step replay diverged from its recorded fingerprint "
+                f"for state group(s) {', '.join(self.groups)} — the same "
+                f"compiled program on the same input computed a different "
+                f"answer (wrong-math core)"
+            )
+        else:
+            names = ", ".join(f"device {d}" for d in self.devices) or "unknown device"
+            procs = ", ".join(f"process {p}" for p in self.processes)
+            what = (
+                f"replica fingerprints of state group(s) "
+                f"{', '.join(self.groups)} diverge at window {self.window} "
+                f"(step {self.step}): {names} (on {procs}) disagree(s) with "
+                f"the fleet majority — the post-pmean bit-identity invariant "
+                f"is broken (HBM bit-flip or wrong-math core)"
+            )
+        super().__init__(
+            f"silent state corruption detected: {what}"
+            f"{(' — ' + detail) if detail else ''}. Recovery: restore the "
+            f"newest digest-verified checkpoint and quarantine the offending "
+            f"host (launcher.py --supervise relaunches on exit code 88)."
+        )
 
 
 class PreflightError(RuntimeError):

@@ -53,6 +53,18 @@ Spec grammar: comma-separated `name[:arg]` entries (a mapping
                   the barrier — a peer that never shows up — so the barrier
                   deadline watchdog's FleetBarrierTimeout path runs
                   deterministically without a real dead host
+  bitflip:N       one mantissa bit of ONE replica's params is flipped going
+                  into eval window N (one-shot): the replicated learner
+                  state is reassembled with the lowest-id local device's
+                  copy differing by one ulp — a simulated HBM bit-flip.
+                  Finite, silent, and exactly the class only the integrity
+                  sentinel's replica fingerprints can see
+                  (resilience/integrity.py, docs/DESIGN.md §2.9). On a
+                  multi-process run only process 0 flips its device.
+  swap_poison     the serving hot-swap watcher's NEXT loaded candidate gets
+                  a NaN written into its first float leaf (one-shot) —
+                  drives the hot-swap canary's reject-and-keep-serving path
+                  (serve/hotswap.py) deterministically
 
 All injection points are no-ops (a single None check) when no plan is armed,
 and `configure()` is called once per experiment so one-shot state never leaks
@@ -68,6 +80,8 @@ import signal
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
+
+import numpy as np
 
 from stoix_tpu.observability import get_logger, get_registry
 from stoix_tpu.resilience.errors import InjectedFault
@@ -85,6 +99,8 @@ _KNOWN = (
     "host_loss",
     "host_stall",
     "barrier_wedge",
+    "bitflip",
+    "swap_poison",
 )
 
 
@@ -320,6 +336,128 @@ def maybe_barrier_wedge(barrier: str, max_wedge_s: float = 3600.0) -> None:
     deadline = time.monotonic() + max_wedge_s
     while time.monotonic() < deadline:
         time.sleep(0.05)
+
+
+# Top-mantissa-bit position per float dtype: flipping it perturbs the value
+# by ~50% relative — large enough that the very next `params + update`
+# cannot round the divergence away (a LOW mantissa flip of a near-zero
+# param is a denormal that evaporates on the first add; a real HBM flip can
+# land anywhere, and the sentinel must be proven against one that STICKS).
+_TOP_MANTISSA_BIT = {"float16": 9, "bfloat16": 6, "float32": 22, "float64": 51}
+
+
+def _flip_one_replica(leaf: Any) -> Any:
+    """Rebuild a fully-replicated jax.Array with the lowest-id LOCAL device's
+    copy differing by ONE flipped mantissa bit (top mantissa bit of the
+    largest-magnitude element) — the bit surgery behind `bitflip:N`. The
+    sharding still CLAIMS replication; nothing in jax checks the buffers
+    agree, which is exactly the silent-corruption hole the integrity
+    sentinel exists to close. The result is finite: an exponent/sign flip
+    could produce inf and be caught by the PR 3 guards — the class under
+    test is finite-but-wrong."""
+    import jax
+
+    devices = sorted(leaf.sharding.addressable_devices, key=lambda d: d.id)
+    host = np.asarray(leaf.addressable_data(0))
+    flipped = np.array(host, copy=True)
+    width = {2: np.uint16, 4: np.uint32, 8: np.uint64}[flipped.dtype.itemsize]
+    shift = _TOP_MANTISSA_BIT.get(str(flipped.dtype), 0)
+    magnitude = np.abs(flipped.astype(np.float64, copy=False))
+    element = int(np.argmax(magnitude)) if flipped.size else 0
+    bits = flipped.view(width)
+    bits.flat[element] ^= width(1 << shift)
+    target = devices[0] if jax.process_index() == 0 else None
+    shards = [
+        jax.device_put(flipped if device == target else host, device)
+        for device in devices
+    ]
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, shards
+    )
+
+
+def maybe_bitflip(state: Any, window_idx: int) -> Any:
+    """Flip one mantissa bit in one replica's params going INTO eval window N
+    when `bitflip:N` is armed (one-shot); returns the (possibly rebuilt)
+    state. The chosen leaf is the first fully-replicated floating leaf whose
+    tree-path mentions 'param' (fallback: any fully-replicated float leaf).
+    Unarmed this is a single None check — zero work, zero host syncs."""
+    plan = get_plan()
+    if plan is None:
+        return state
+    at = plan.arg("bitflip")
+    if at is None or window_idx != at or not plan.consume("bitflip"):
+        return state
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+
+    def eligible(leaf: Any) -> bool:
+        return (
+            isinstance(leaf, jax.Array)
+            and jax.numpy.issubdtype(leaf.dtype, jax.numpy.floating)
+            and leaf.dtype.itemsize in (2, 4, 8)
+            and leaf.sharding.is_fully_replicated
+        )
+
+    # Prefer the LARGEST eligible leaf of the top-level params group (a
+    # weight matrix, nonzero after init) over biases/scalars: the flip must
+    # be numerically persistent through the next update, not a denormal that
+    # rounds away on the first add. Fallback: any path mentioning 'param'
+    # (optax moments nest a 'params' dict), then any replicated float leaf.
+    def _ranked(predicate):
+        return [
+            (leaf.size, i) for i, (path, leaf) in enumerate(flat)
+            if eligible(leaf) and predicate(jax.tree_util.keystr(path).lower())
+        ]
+
+    candidates = (
+        _ranked(lambda key: key.startswith(".params") or key.startswith("['params']"))
+        or _ranked(lambda key: "param" in key)
+        or _ranked(lambda key: True)
+    )
+    target_idx = max(candidates, default=(0, None))[1]
+    if target_idx is None:
+        get_logger("stoix_tpu.resilience").warning(
+            "[faultinject] bitflip armed but the state has no fully-"
+            "replicated float leaf to corrupt — skipping"
+        )
+        return state
+    path, leaf = flat[target_idx]
+    _injected_counter().inc(labels={"fault": "bitflip"})
+    get_logger("stoix_tpu.resilience").warning(
+        "[faultinject] flipping one mantissa bit of %s on one replica going "
+        "into window %d", jax.tree_util.keystr(path), window_idx,
+    )
+    leaves = [entry for _path, entry in flat]
+    leaves[target_idx] = _flip_one_replica(leaf)
+    return treedef.unflatten(leaves)
+
+
+def maybe_poison_swap(params: Any) -> Any:
+    """Write NaN into the first float leaf of a hot-swap candidate when
+    `swap_poison` is armed (one-shot) — the non-finite-restore case the
+    serving canary must reject. Returns the (possibly poisoned) tree."""
+    plan = get_plan()
+    if plan is None:
+        return params
+    if plan.arg("swap_poison") is None or not plan.consume("swap_poison"):
+        return params
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            poisoned = np.array(arr, copy=True)
+            poisoned.flat[0] = np.nan
+            leaves[i] = poisoned
+            _injected_counter().inc(labels={"fault": "swap_poison"})
+            get_logger("stoix_tpu.resilience").warning(
+                "[faultinject] poisoned hot-swap candidate with NaN"
+            )
+            return treedef.unflatten(leaves)
+    return params
 
 
 def backend_wedge_armed() -> bool:

@@ -56,7 +56,6 @@ bit-identical (tests/test_fleet.py pins this).
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import sys
@@ -86,6 +85,12 @@ EXIT_CODE_FLEET_PARTITION = 87
 FLAG_PREEMPT = 1  # SIGTERM/SIGINT observed on this host
 FLAG_FAULT = 2  # host-local unrecoverable fault (embedder-raised)
 FLAG_PARTITION = 4  # this host's monitor already declared a partition
+# The integrity sentinel (resilience/integrity.py, docs/DESIGN.md §2.9)
+# proved silent state corruption. Every host computes the same verdict from
+# the same replicated fingerprint vector, so in the Anakin transport this
+# flag is observability (all hosts already break at the same window); in the
+# KV-vote transport it is the agreement carrier.
+FLAG_CORRUPT = 8
 
 MANIFEST_NAME = "fleet_manifest.json"
 _STATE_FILE = "state.npz"
@@ -282,6 +287,8 @@ def describe_flags(bits: int) -> str:
         names.append("fault")
     if bits & FLAG_PARTITION:
         names.append("partition")
+    if bits & FLAG_CORRUPT:
+        names.append("corrupt")
     return "+".join(names) if names else "healthy"
 
 
@@ -671,10 +678,17 @@ class FleetCoordinator:
                 sys.stderr.flush()
                 os._exit(EXIT_CODE_FLEET_PARTITION)
 
+        self._hook = hook
         sys.excepthook = hook
 
     def _restore_excepthook(self) -> None:
-        if self._prev_excepthook is not None:
+        # Restore ONLY if the installed hook is still ours: another layer
+        # (the integrity sentinel's 88-hook, §2.9) may have chained on top
+        # of us after install — blindly re-assigning our saved prev would
+        # silently uninstall IT.
+        if self._prev_excepthook is not None and sys.excepthook is getattr(
+            self, "_hook", None
+        ):
             sys.excepthook = self._prev_excepthook
             self._prev_excepthook = None
 
@@ -802,6 +816,7 @@ class FleetCoordinator:
         step, state = staged
         import jax
 
+        from stoix_tpu.resilience import integrity
         from stoix_tpu.utils.checkpointing import _path_key
 
         directory = os.path.join(
@@ -811,7 +826,6 @@ class FleetCoordinator:
         arrays: Dict[str, np.ndarray] = {}
         partial: List[str] = []
         casts: Dict[str, str] = {}
-        digests: Dict[str, str] = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
             key = "/".join(_path_key(path))
             value = self._host_value(leaf)
@@ -823,7 +837,11 @@ class FleetCoordinator:
                 casts[key] = str(arr.dtype)
                 arr = arr.astype(np.float32)
             arrays[key] = arr
-            digests[key] = hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+        # Per-leaf sha256 manifest (resilience/integrity.py — the shared
+        # digest module also used by the orbax _digests.json sidecar and the
+        # serving canary): restore verifies every leaf's bytes, so bit-rot
+        # in a rescue store is rejected instead of resumed.
+        digests = integrity.digest_arrays(arrays)
         np.savez(os.path.join(directory, _STATE_FILE), **arrays)
         manifest = {
             "format": 1,
@@ -965,6 +983,22 @@ def read_emergency_raw(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, str]
     directory = os.path.dirname(manifest_path)
     with np.load(os.path.join(directory, _STATE_FILE)) as data:
         raw = {key: data[key] for key in data.files}
+    # Digest-verify every loaded leaf against the manifest (docs/DESIGN.md
+    # §2.9): a rescue store that rotted on disk — or was truncated by the
+    # dying host — must be rejected here, not resumed into a fleet that just
+    # proved it cares about bit-level integrity.
+    from stoix_tpu.resilience import integrity
+    from stoix_tpu.resilience.errors import CheckpointIntegrityError
+
+    mismatched = integrity.verify_digests(raw, dict(manifest.get("digests") or {}))
+    if mismatched:
+        raise CheckpointIntegrityError(
+            step,
+            f"emergency store {directory} failed sha256 verification for "
+            f"{len(mismatched)} leaf(s): {', '.join(mismatched[:5])}"
+            f"{'...' if len(mismatched) > 5 else ''}",
+            kind="digest",
+        )
     return raw, dict(manifest.get("casts") or {}), step
 
 
@@ -989,7 +1023,7 @@ def restore_emergency(template: Any, path: str) -> Tuple[Any, int]:
         if key in raw and key in template_dtypes:
             raw[key] = raw[key].astype(template_dtypes[key])
     raw_by_path = {tuple(key.split("/")): value for key, value in raw.items()}
-    restored, matched, reinitialized = place_host_leaves(
+    restored, matched, reinitialized, _reinit_keys = place_host_leaves(
         raw_by_path, template, step, allow_missing=True
     )
     get_logger("stoix_tpu.checkpoint").warning(

@@ -136,7 +136,29 @@ class PolicySource:
         found = int(step) if step is not None else self.latest_step()
         if found is None:
             raise FileNotFoundError(f"no checkpoint steps under {self.path}")
-        return read_host_leaves(self.path, found), found
+        raw = read_host_leaves(self.path, found)
+        # Digest verification when the store carries a manifest
+        # (docs/DESIGN.md §2.9): a bit-rotted or half-synced checkpoint is
+        # REJECTED here — the hot-swap watcher counts the error and keeps
+        # serving the params it has — instead of being swapped into live
+        # traffic. (Emergency stores verify inside fleet.read_emergency_raw.)
+        from stoix_tpu.resilience import integrity
+        from stoix_tpu.utils.checkpointing import saved_digest_record
+
+        record = saved_digest_record(self.path).get(found) or {}
+        if record:
+            mismatched = integrity.verify_digests(
+                {"/".join(key): arr for key, arr in raw.items()}, record
+            )
+            if mismatched:
+                raise CheckpointIntegrityError(
+                    found,
+                    f"store {self.path} failed sha256 verification for "
+                    f"{len(mismatched)} leaf(s): {', '.join(mismatched[:5])}"
+                    f"{'...' if len(mismatched) > 5 else ''}",
+                    kind="digest",
+                )
+        return raw, found
 
     def load(self, step: Optional[int] = None) -> Tuple[Any, int]:
         """Restore the configured subtrees at `step` (None = newest) and
@@ -151,7 +173,9 @@ class PolicySource:
                 for key, value in raw_by_path.items()
                 if key[: len(prefix)] == prefix
             }
-            placed, _matched, reinitialized = place_host_leaves(sub, template, found)
+            placed, _matched, reinitialized, _reinit_keys = place_host_leaves(
+                sub, template, found
+            )
             if reinitialized:
                 raise CheckpointIntegrityError(
                     found,

@@ -119,3 +119,52 @@ class InferenceEngine:
             action, extras, _ = self.infer([self._obs_template] * bucket)
             jax.block_until_ready((action, extras))
         return self._trace_count
+
+    def canary(self, params: Any) -> Optional[str]:
+        """Validate a hot-swap CANDIDATE without installing it; returns None
+        when it passes, else a reason string. See validate_candidate."""
+        return self.validate_candidate(params)[0]
+
+    def validate_candidate(self, params: Any) -> Tuple[Optional[str], Any]:
+        """The hot-swap canary (docs/DESIGN.md §2.9): every float parameter
+        leaf must be finite, and a golden-input forward pass (the obs
+        template through the smallest bucket — an already-compiled
+        specialization, so the no-recompile pin holds across canaries) must
+        produce finite outputs. Returns (reason, local): reason is None on
+        pass, and `local` is the candidate ALREADY transferred to device —
+        hand it straight to set_params so an accepted swap pays the
+        host->device transfer once, not twice. The sample key is fixed: the
+        canary must be deterministic, and it must not advance the serving
+        batch counter."""
+        bad = _first_nonfinite_leaf(params)
+        if bad is not None:
+            return f"candidate params carry non-finite values at {bad}", None
+        bucket = self.buckets[0]
+        batched = self.batch_observations([self._obs_template] * bucket, bucket)
+        local = jax.device_put(params)
+        try:
+            action, extras = self._step(local, batched, self._base_key)
+            outputs = jax.tree.map(np.asarray, (action, extras))
+        except Exception as exc:  # noqa: BLE001 — a candidate that cannot even
+            # run the forward pass (shape/dtype drift) must be rejected, not
+            # crash the watcher thread.
+            return f"golden forward pass failed: {type(exc).__name__}: {exc}", None
+        bad = _first_nonfinite_leaf(outputs)
+        if bad is not None:
+            return f"golden forward pass produced non-finite outputs at {bad}", None
+        return None, local
+
+
+def _first_nonfinite_leaf(tree: Any) -> Optional[str]:
+    """Tree-path of the first float leaf carrying NaN/inf, or None. Narrow
+    floats (bfloat16) widen to float32 for the check, mirroring the
+    checkpoint validator's discipline."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if not jax.numpy.issubdtype(arr.dtype, jax.numpy.floating):
+            continue
+        if arr.dtype not in (np.float16, np.float32, np.float64):
+            arr = arr.astype(np.float32)
+        if not np.isfinite(arr).all():
+            return jax.tree_util.keystr(path)
+    return None

@@ -1,16 +1,34 @@
 """Parameter hot-swap: a live learner feeds a live server (docs/DESIGN.md
-§2.8).
+§2.8, canary semantics §2.9).
 
 A watcher thread polls the checkpoint store's step listing (a directory scan
 — no leaf I/O) every `poll_interval_s`; when a NEWER step appears it loads
-the actor subtree through the same PolicySource the server booted from and
-installs it with the engine's atomic swap (device_put off the request path,
-then one reference assignment — the ParameterServer.reprime discipline).
-In-flight batches finish on the params they started with; requests batched
-after the swap see the new version. A failed poll — half-written checkpoint,
-transient I/O — is counted, logged, and SKIPPED: the server keeps serving
-the params it has (orbax's atomic step-directory commit makes a torn read a
-transient, not a corruption).
+the actor subtree through the same PolicySource the server booted from,
+validates it, and installs it with the engine's atomic swap (device_put off
+the request path, then one reference assignment — the
+ParameterServer.reprime discipline). In-flight batches finish on the params
+they started with; requests batched after the swap see the new version.
+
+Three gates stand between a fresh checkpoint and live traffic:
+
+  * **digest verification** (PolicySource / fleet.read_emergency_raw): when
+    the store carries a sha256 manifest, the loaded bytes must match it —
+    bit-rot and half-synced stores are rejected at read time;
+  * **the canary** (`InferenceEngine.canary`, on by default via
+    `arch.serve.hot_swap.canary`): every float leaf of the candidate must be
+    finite, and a golden-input forward pass through an already-compiled
+    bucket specialization must produce finite outputs. A learner that
+    diverged to NaN — or a store that restored garbage — keeps the OLD
+    params serving; previously `ParameterWatcher` swapped in whatever
+    restored.
+  * **typed failure accounting**: a failed poll, digest mismatch, or canary
+    rejection increments `stoix_tpu_serve_hot_swap_errors_total`, logs the
+    reason, and is SKIPPED — the server keeps serving (orbax's atomic
+    step-directory commit makes a torn read a transient, not a corruption).
+
+`STOIX_TPU_FAULT=swap_poison` (resilience/faultinject.py) poisons exactly
+one loaded candidate with NaN so the reject-and-keep-serving path is
+provable end-to-end (tests/test_integrity.py).
 """
 
 from __future__ import annotations
@@ -19,12 +37,13 @@ import threading
 from typing import Optional
 
 from stoix_tpu.observability import get_logger
+from stoix_tpu.resilience import faultinject
 from stoix_tpu.serve.engine import InferenceEngine
 from stoix_tpu.serve.telemetry import ServeTelemetry
 
 
 class ParameterWatcher:
-    """Background poll -> load -> atomic swap loop."""
+    """Background poll -> load -> canary -> atomic swap loop."""
 
     def __init__(
         self,
@@ -33,12 +52,14 @@ class ParameterWatcher:
         telemetry: ServeTelemetry,
         current_step: int,
         poll_interval_s: float = 2.0,
+        canary: bool = True,
     ):
         self._source = source
         self._engine = engine
         self._telemetry = telemetry
         self.current_step = int(current_step)
         self.poll_interval_s = float(poll_interval_s)
+        self.canary = bool(canary)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="serve-hotswap", daemon=True
@@ -56,12 +77,29 @@ class ParameterWatcher:
 
     def check_now(self) -> Optional[int]:
         """One synchronous poll (tests and deterministic swap points): swap
-        if the store advanced; returns the new step, or None for no-op/error."""
+        if the store advanced AND the candidate passes the canary; returns
+        the new step, or None for no-op/rejected/error."""
         try:
             latest = self._source.latest_step()
             if latest is None or latest <= self.current_step:
                 return None
             params, step = self._source.load(latest)
+            # Chaos (`swap_poison`, one-shot): hand the canary a non-finite
+            # candidate — the class of restore the gate exists to stop.
+            params = faultinject.maybe_poison_swap(params)
+            if self.canary:
+                reason, local = self._engine.validate_candidate(params)
+                if reason is not None:
+                    self._telemetry.hot_swap_error()
+                    self._log.warning(
+                        "[serve] hot-swap canary REJECTED step %d (%s) — "
+                        "keeping step %d serving until the next poll",
+                        step, reason, self.current_step,
+                    )
+                    return None
+                # The canary already transferred the candidate to device;
+                # installing `local` makes set_params' device_put a no-op.
+                params = local
             version = self._engine.set_params(params)
             previous, self.current_step = self.current_step, step
             self._telemetry.hot_swap()
@@ -70,9 +108,9 @@ class ParameterWatcher:
                 previous, step, version,
             )
             return step
-        except Exception as exc:  # noqa: BLE001 — a half-written checkpoint
-            # or transient I/O error must not kill serving; keep the params
-            # we have and retry next poll.
+        except Exception as exc:  # noqa: BLE001 — a half-written checkpoint,
+            # digest mismatch, or transient I/O error must not kill serving;
+            # keep the params we have and retry next poll.
             self._telemetry.hot_swap_error()
             self._log.warning(
                 "[serve] hot-swap poll failed (%s: %s) — serving step %d "

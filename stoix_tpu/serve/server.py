@@ -56,6 +56,7 @@ class PolicyServer:
         source: Any = None,
         initial_step: int = 0,
         hot_swap_poll_s: float = 0.0,
+        hot_swap_canary: bool = True,
         compile_deadline_s: float = 600.0,
     ):
         self.telemetry = ServeTelemetry()
@@ -81,6 +82,7 @@ class PolicyServer:
                 self.telemetry,
                 current_step=initial_step,
                 poll_interval_s=hot_swap_poll_s,
+                canary=hot_swap_canary,
             )
 
     @classmethod
@@ -106,6 +108,7 @@ class PolicyServer:
             hot_swap_poll_s=(
                 float(hot_swap.poll_interval_s) if bool(hot_swap.enabled) else 0.0
             ),
+            hot_swap_canary=bool(hot_swap.get("canary", True)),
             compile_deadline_s=float(serve_cfg.compile_deadline_s),
         )
 

@@ -54,6 +54,7 @@ from stoix_tpu.resilience import (
     faultinject,
     fleet,
     guards,
+    integrity,
     preflight,
     supervisor_from_config,
 )
@@ -491,6 +492,16 @@ def run_experiment(
         config, learner_mesh,
     )
 
+    # State-integrity sentinel (docs/DESIGN.md §2.9, arch.integrity): Sebulba
+    # has no coalesced fetch to piggyback fingerprints on, so the learner
+    # loop checks the replicated learner state synchronously at each eval
+    # boundary (the vector is [num_learner_devices] uint32 — tiny). Off (the
+    # default) = None = unchanged loop.
+    sentinel = integrity.sentinel_from_config(config)
+    if sentinel is not None:
+        sentinel.bind(learner_mesh, learner_state)
+        sentinel.install_excepthook()
+
     normalize_obs = bool(config.system.get("normalize_observations", False))
 
     def eval_apply(payload, observation):
@@ -705,12 +716,27 @@ def run_experiment(
                     # been paid (end of the first eval block).
                     steady_start_time = time.perf_counter()
                     steady_start_steps = t_steps
+                window_idx = (update_idx + 1) // int(config.arch.num_updates_per_eval)
+                corruption = None
+                if sentinel is not None:
+                    # Integrity check at the eval boundary (docs/DESIGN.md
+                    # §2.9): synchronous fingerprint + compare of the
+                    # replicated learner state. A verdict becomes this
+                    # host's FLAG_CORRUPT on the window's fleet vote (so the
+                    # stop reason is agreed and visible fleet-wide) and is
+                    # raised below — never swallowed by the agreed break.
+                    corruption = sentinel.check_state(
+                        learner_state, window_idx, t_steps
+                    )
+                    if corruption is not None and fleet_coord is not None:
+                        fleet_coord.request_stop(
+                            fleet.FLAG_CORRUPT, note=str(corruption)
+                        )
                 if fleet_coord is not None:
                     # Window-boundary agreement: exchange stop votes for THIS
                     # window through the KV store — identical decision on
                     # every host, so all drain together — and swap straggler
                     # wall-times for the skew gauges.
-                    window_idx = (update_idx + 1) // int(config.arch.num_updates_per_eval)
                     now = time.perf_counter()
                     fleet_coord.observe_window_wall(
                         window_idx, now - fleet_window_started
@@ -718,6 +744,8 @@ def run_experiment(
                     fleet_window_started = now
                     decision = fleet_coord.agree_at_window(window_idx)
                     if decision.stop:
+                        if corruption is not None:
+                            raise corruption
                         if preempt.stop_requested():
                             preempt.acknowledge(t_steps)
                         else:
@@ -727,6 +755,8 @@ def run_experiment(
                                 decision.describe(), window_idx,
                             )
                         break
+                if corruption is not None:
+                    raise corruption
         # Close the window BEFORE shutdown: thread joins / evaluator drain in
         # the finally block below can take tens of seconds and must not
         # deflate the steady-state number.
@@ -742,6 +772,11 @@ def run_experiment(
         raise
     finally:
         preempt.uninstall()
+        if sentinel is not None:
+            # BEFORE fleet stop: the excepthook chain unwinds in reverse
+            # install order. Keeps the hook across a propagating corruption
+            # verdict (it must still translate to exit code 88).
+            sentinel.deactivate()
         if fleet_coord is not None:
             fleet_coord.stop()
         lifetime.stop()
@@ -792,6 +827,9 @@ def run_experiment(
         "resume_capable": False,
         "fleet": fleet_coord is not None,
     }
+    LAST_RUN_STATS["integrity"] = (
+        sentinel.stats() if sentinel is not None else integrity.disabled_stats()
+    )
 
     logger.close()
     return eval_results[-1] if eval_results else 0.0

@@ -81,6 +81,18 @@ Restore is topology-elastic (utils/checkpointing.py): a checkpoint saved on
 an 8-device mesh resumes on 1 device (and vice versa) with bit-identical
 params — the state materializes to host and re-places via the fresh
 template's shardings.
+
+State integrity (stoix_tpu/resilience/integrity.py, docs/DESIGN.md §2.9,
+`arch.integrity`): with the sentinel on, every window's dispatch also
+enqueues a tiny shard_mapped fingerprint program over the replicated state
+groups; the resulting [num_devices] uint32 vectors ride the SAME coalesced
+metric fetch (zero extra collectives) and are compared on the host when the
+window materializes — a cross-replica disagreement (HBM bit-flip, wrong-math
+core) raises StateCorruptionError BEFORE that window's checkpoint snapshot
+is handed to orbax, so a corrupt state is never saved. The optional
+determinism probe replays a recorded learn step every N windows and compares
+output fingerprints bitwise. Off (the default) adds zero dispatches and zero
+host work — bit-identical (tests/test_integrity.py pins on AND off).
 """
 
 from __future__ import annotations
@@ -116,6 +128,7 @@ from stoix_tpu.resilience import (
     faultinject,
     fleet,
     guards,
+    integrity,
     preflight,
 )
 from stoix_tpu.ops import scan_kernels
@@ -251,6 +264,10 @@ def run_anakin_experiment(
     fleet_coord = fleet.fleet_from_config(config)
     if fleet_coord is not None:
         fleet_coord.start()
+    # State-integrity sentinel (docs/DESIGN.md §2.9, arch.integrity): bound
+    # below once the learner state exists. None (the default) = zero extra
+    # dispatches, zero host work, bit-identical host loop.
+    sentinel = integrity.sentinel_from_config(config)
     config = check_total_timesteps(config, int(mesh.shape["data"]))
     config.logger.system_name = config.system.system_name
 
@@ -269,6 +286,7 @@ def run_anakin_experiment(
     # sharded) template (reference ff_ppo.py:504-512 via Checkpointer.restore).
     ckpt_cfg = config.logger.checkpointing
     start_step = 0
+    restore_skipped = 0
     if ckpt_cfg.get("load_model", False):
         load_args = ckpt_cfg.get("load_args") or {}
         load_path = load_args.get("load_path")
@@ -292,15 +310,31 @@ def run_anakin_experiment(
             learner_state, start_step = loader.restore(
                 learner_state, load_args.get("timestep")
             )
+            # How many newer-but-unusable checkpoints the fallback walk
+            # rejected (with typed reasons — structure / non_finite /
+            # digest), surfaced in LAST_RUN_STATS.resilience below.
+            restore_skipped = len(loader.last_restore_report)
         if is_coordinator():
             get_logger("stoix_tpu.checkpoint").info(
-                "[checkpoint] restored state from step %d", start_step
+                "[checkpoint] restored state from step %d%s", start_step,
+                f" ({restore_skipped} newer checkpoint(s) rejected)"
+                if restore_skipped else "",
             )
 
     make_evaluators = evaluator_setup_fn or evaluator_setup
     evaluator, absolute_evaluator = make_evaluators(eval_env, setup.eval_act_fn, config, mesh)
     logger = StoixLogger(config)
     checkpointer = checkpointer_from_config(config, config.system.system_name)
+
+    if sentinel is not None:
+        # Bind AFTER restore: the fingerprint program is built once for this
+        # mesh + state structure (never per window — STX012). The resume info
+        # points a rc-88 relaunch at THIS run's orbax store, whose newest
+        # digest-verified step is the recovery target.
+        sentinel.bind(mesh, learner_state)
+        if checkpointer is not None:
+            sentinel.set_resume_info(checkpointer.directory)
+        sentinel.install_excepthook()
 
     steps_per_eval = (
         int(config.system.rollout_length)
@@ -472,6 +506,13 @@ def run_anakin_experiment(
                 # collectives, and the cross-host collective SEQUENCE stays
                 # exactly the fetch stream (docs/DESIGN.md §2.6).
                 tree["fleet"] = fleet_coord.telemetry_for_fetch(mesh)
+            if sentinel is not None:
+                # Replica fingerprints (docs/DESIGN.md §2.9): each device
+                # folds ITS copy of the replicated state groups to a uint32
+                # — the reduction is device-local, and the [num_devices]
+                # vectors ride this same fetch, so the integrity check adds
+                # zero collectives to the window.
+                tree["integrity"] = sentinel.fingerprints(output.learner_state)
             metrics = fetch_global_async(tree, mesh)
         phases.add("fetch_s", time.perf_counter() - ts)
         return _Window(eval_idx, t, snapshot, ckpt_state, metrics)
@@ -491,11 +532,34 @@ def run_anakin_experiment(
         window_done_at = now
         window_walls.append(wall)
 
+        if sentinel is not None:
+            # Integrity verdict FIRST — before this window's checkpoint
+            # snapshot is handed to orbax AND before confirm_candidate
+            # promotes this window's state to the fleet rescue snapshot: a
+            # corrupt state must never be persisted by EITHER path (a
+            # concurrent partition would otherwise rescue-save exactly the
+            # corruption being proven; window N-1's verified state stays the
+            # candidate). The fingerprint vector is replicated data, so every
+            # host computes the SAME verdict at the SAME window — the
+            # corruption flag on the fleet byte is observability, not the
+            # agreement mechanism.
+            integrity_payload = fetched.pop("integrity")
+            corruption = sentinel.verify(integrity_payload, window.eval_idx, window.t)
+            if corruption is not None:
+                if fleet_coord is not None:
+                    fleet_coord.request_stop(fleet.FLAG_CORRUPT, note=str(corruption))
+                raise corruption
+            if window.eval_idx == 0:
+                # Window 0's fingerprint IS fingerprint(learn(probe_input))
+                # — the determinism probe's reference, recorded for free.
+                sentinel.record_probe_reference(integrity_payload)
+
         if fleet_coord is not None:
             # This window's metrics are on the host, so (stream ordering) its
-            # learn completed: promote the rescue candidate, decode the
-            # fleet-wide flags + straggler wall-times, and record this
-            # window's wall for the next dispatch's payload.
+            # learn completed — and the sentinel (above) vouched for its
+            # state: promote the rescue candidate, decode the fleet-wide
+            # flags + straggler wall-times, and record this window's wall for
+            # the next dispatch's payload.
             fleet_coord.confirm_candidate(window.t)
             payload = fetched.pop("fleet")
             decision = fleet_coord.decide_from_fetch(payload, mesh)
@@ -569,9 +633,25 @@ def run_anakin_experiment(
     skipped_base = guards.skipped_counter().value()
     dispatched_t = start_step
     pending: Optional[_Window] = None
+    if sentinel is not None and sentinel.probe_enabled:
+        # Determinism-probe input: a donation-safe copy of the state going
+        # into window 0 (every replay runs learn on a fresh copy of it).
+        sentinel.capture_probe_input(_tree_copy(learner_state))
     try:
         for eval_idx in range(num_evaluation):
             faultinject.maybe_host_stall(eval_idx)
+            # Chaos: `bitflip:N` rebuilds the replicated state with ONE
+            # mantissa bit flipped in one device's copy going INTO window N
+            # — the silent-corruption class only the sentinel can see.
+            learner_state = faultinject.maybe_bitflip(learner_state, eval_idx)
+            if sentinel is not None and sentinel.should_probe(eval_idx):
+                probe_err = sentinel.run_probe(setup.learn, _tree_copy)
+                if probe_err is not None:
+                    if fleet_coord is not None:
+                        fleet_coord.request_stop(
+                            fleet.FLAG_CORRUPT, note=str(probe_err)
+                        )
+                    raise probe_err
             if eval_idx == profile_window:
                 try:
                     jax.profiler.start_trace(profile_dir)
@@ -701,6 +781,12 @@ def run_anakin_experiment(
         raise
     finally:
         preempt.uninstall()
+        if sentinel is not None:
+            # BEFORE fleet stop, so the excepthook chain unwinds in reverse
+            # install order. Restores the hook UNLESS a corruption verdict
+            # is propagating — that error must still translate to exit code
+            # 88 for the supervising launcher after this finally completes.
+            sentinel.deactivate()
         if fleet_coord is not None:
             fleet_coord.stop()
         if checkpointer is not None:
@@ -736,7 +822,12 @@ def run_anakin_experiment(
                 "fleet_agreed_stop": (
                     agreed_stop.describe() if agreed_stop is not None else None
                 ),
+                "restore_skipped": restore_skipped,
             },
+            "integrity": (
+                sentinel.stats() if sentinel is not None
+                else integrity.disabled_stats()
+            ),
         }
     )
     return final_return

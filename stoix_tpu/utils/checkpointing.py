@@ -57,6 +57,30 @@ CHECKPOINTER_VERSION = 3.0
 # file is invisible to it.
 TOPOLOGY_SIDECAR = "_topology.json"
 
+# Sidecar recording each step's per-leaf sha256 digests (docs/DESIGN.md
+# §2.9): {"steps": {"<step>": {"<slash-joined tree path>": "<hex>"}}}.
+# Written by save() from the exact host bytes orbax serializes; restore()
+# recomputes digests from what came back and REJECTS the step on mismatch
+# (on-disk bit-rot walks to the next-newest checkpoint instead of resuming
+# as garbage). Shares the digest helpers with the fleet emergency store and
+# the serving canary (resilience/integrity.py).
+DIGEST_SIDECAR = "_digests.json"
+
+
+def saved_digest_record(store_dir: str) -> Dict[int, Dict[str, str]]:
+    """Per-step digest records from a store's `_digests.json` ({} when
+    absent). Module-level so the serving loader (stoix_tpu/serve) can verify
+    a store it reads without constructing a Checkpointer."""
+    try:
+        with open(os.path.join(str(store_dir), DIGEST_SIDECAR)) as f:
+            data = json.load(f)
+        return {
+            int(step): {str(k): str(v) for k, v in (record or {}).items()}
+            for step, record in (data.get("steps") or {}).items()
+        }
+    except (OSError, ValueError):
+        return {}
+
 
 def _device_footprint(tree: Any) -> Optional[int]:
     """Number of distinct devices the tree's jax.Array leaves span, or None
@@ -93,13 +117,15 @@ def place_host_leaves(
     template: Any,
     step: int,
     allow_missing: bool = False,
-) -> Tuple[Any, int, List[str]]:
+) -> Tuple[Any, int, List[str], List[Tuple[str, ...]]]:
     """Place host-materialized leaves into `template`'s structure and
     shardings, matching by normalized tree-path — the placement half of the
     topology-elastic restore (docs/DESIGN.md §2.4), shared with the fleet
     local-shard emergency restore (resilience/fleet.py, §2.6).
 
-    Returns (tree, matched_count, reinitialized_descriptions). Shape
+    Returns (tree, matched_count, reinitialized_descriptions,
+    reinitialized_keys) — the keys let digest verification (§2.9) skip
+    leaves that deliberately kept the template's fresh value. Shape
     mismatches are topology-dependent state and keep the template's value;
     dtype mismatches raise CheckpointIntegrityError (corruption, not
     topology). A missing leaf raises unless `allow_missing` (the fleet store
@@ -108,6 +134,7 @@ def place_host_leaves(
     template_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     placed: List[Any] = []
     reinitialized: List[str] = []
+    reinitialized_keys: List[Tuple[str, ...]] = []
     matched = 0
     for path, ref in template_leaves:
         key = _path_key(path)
@@ -116,6 +143,7 @@ def place_host_leaves(
                 reinitialized.append(
                     f"{jax.tree_util.keystr(path)} (absent from the store)"
                 )
+                reinitialized_keys.append(key)
                 placed.append(ref)
                 continue
             raise CheckpointIntegrityError(
@@ -140,6 +168,7 @@ def place_host_leaves(
                 f"{jax.tree_util.keystr(path)} (saved {arr.shape} vs "
                 f"template {ref_shape})"
             )
+            reinitialized_keys.append(key)
             placed.append(ref)
             continue
         matched += 1
@@ -153,7 +182,7 @@ def place_host_leaves(
             "resharded restore matched ZERO leaves by shape — this is a "
             "different state entirely, not a topology change",
         )
-    return treedef.unflatten(placed), matched, reinitialized
+    return treedef.unflatten(placed), matched, reinitialized, reinitialized_keys
 
 
 def read_host_leaves(store_dir: str, step: int) -> Dict[Tuple[str, ...], Any]:
@@ -234,6 +263,9 @@ class Checkpointer:
             "process_count": jax.process_count(),
         }
         self._save_interval_steps = int(save_interval_steps)
+        # Typed rejection log of the most recent restore()'s fallback walk
+        # (docs/DESIGN.md §2.9): [{"step", "reason", "error"}, ...].
+        self.last_restore_report: List[Dict[str, str]] = []
         self._manager = ocp.CheckpointManager(
             self.directory,
             options=options,
@@ -285,6 +317,7 @@ class Checkpointer:
         )
         if saved and jax.process_index() == 0:
             self._record_topology(timestep, footprint)
+            self._record_digests(timestep, state)
         # Chaos hook (`STOIX_TPU_FAULT=ckpt_corrupt`, one-shot): mangle this
         # step's files AFTER serialization completes, so the restore-fallback
         # path is exercised against a real on-disk layout.
@@ -336,6 +369,92 @@ class Checkpointer:
         except (OSError, ValueError):
             return {}
 
+    # -- digest sidecar (docs/DESIGN.md §2.9) --------------------------------
+    def _record_digests(self, timestep: int, state: Any) -> None:
+        """Record per-leaf sha256 digests of the exact host bytes orbax is
+        serializing for `timestep` (read-modify-write; entries for steps the
+        retention policy deleted are pruned). Best-effort like the topology
+        sidecar: a missing record only disables digest VERIFICATION for this
+        step — restore still runs its structural + finiteness gates.
+
+        Cost: one device->host materialization of the snapshot per save —
+        paid on the overlapped host half of the pipelined runner, never on
+        the device stream. Leaves not fully addressable from this process
+        (multi-host shards) are skipped and simply not verified."""
+        from stoix_tpu.resilience import integrity
+
+        try:
+            digests: Dict[str, str] = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+                if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                    continue
+                digests["/".join(_path_key(path))] = integrity.leaf_digest(
+                    np.asarray(leaf)
+                )
+            record = self.saved_digests()
+            record[int(timestep)] = digests
+            try:
+                on_disk = set(self._manager.all_steps())
+            except Exception:  # noqa: BLE001 — pruning is housekeeping only
+                on_disk = set(record)
+            keep = {step for step in record if step in on_disk or step == int(timestep)}
+            path = os.path.join(self.directory, DIGEST_SIDECAR)
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "steps": {
+                            str(step): record[step] for step in sorted(keep)
+                        }
+                    },
+                    f,
+                )
+        except OSError as exc:
+            from stoix_tpu.observability import get_logger
+
+            get_logger("stoix_tpu.checkpoint").warning(
+                "[checkpoint] could not record digest sidecar for step %d "
+                "(%s) — this step will restore without digest verification",
+                timestep, exc,
+            )
+
+    def saved_digests(self) -> Dict[int, Dict[str, str]]:
+        """Per-step digest records from this store's sidecar ({} = none)."""
+        return saved_digest_record(self.directory)
+
+    def _verify_digests(
+        self, restored: Any, step: int, skip_keys: Optional[set] = None
+    ) -> None:
+        """Recompute each restored leaf's digest and compare against the
+        record made at save time; a mismatch is on-disk bit-rot and raises
+        the typed 'digest' rejection (the fallback walk tries the next-
+        newest step). `skip_keys` excludes leaves the elastic restore
+        deliberately reinitialized from the template. No record for this
+        step (pre-digest store, sidecar lost) = skip, logged at debug."""
+        from stoix_tpu.resilience import integrity
+
+        record = self.saved_digests().get(int(step)) or {}
+        if not record:
+            return
+        skip = skip_keys or set()
+        arrays: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]:
+            key = _path_key(path)
+            if key in skip:
+                continue
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                continue
+            arrays["/".join(key)] = np.asarray(leaf)
+        mismatched = integrity.verify_digests(arrays, record)
+        if mismatched:
+            raise CheckpointIntegrityError(
+                step,
+                f"sha256 digest mismatch on {len(mismatched)} leaf(s) — the "
+                f"bytes on disk are not the bytes that were saved (bit-rot "
+                f"or tampering): {', '.join(mismatched[:5])}"
+                f"{'...' if len(mismatched) > 5 else ''}",
+                kind="digest",
+            )
+
     @staticmethod
     def _validate(restored: Any, template: Any, step: int) -> None:
         """Integrity gate: identical tree structure, and every float leaf
@@ -346,7 +465,9 @@ class Checkpointer:
         want = jax.tree.structure(template)
         if got != want:
             raise CheckpointIntegrityError(
-                step, f"tree structure mismatch: restored {got} != template {want}"
+                step,
+                f"tree structure mismatch: restored {got} != template {want}",
+                kind="structure",
             )
         def _as_float_array(leaf: Any):
             """Host float array for finiteness checks, or None for non-float
@@ -375,14 +496,17 @@ class Checkpointer:
                 step,
                 f"non-finite values in leaf {jax.tree_util.keystr(path)} "
                 f"(template expects finite values here)",
+                kind="non_finite",
             )
 
-    def _restore_resharded(self, step: int, template: Any) -> Any:
+    def _restore_resharded(self, step: int, template: Any) -> Tuple[Any, set]:
         """Topology-elastic restore path (docs/DESIGN.md §2.4): materialize
         the checkpoint to host with NO sharded template, match leaves to the
         template by normalized tree-path, and re-place each onto the
         template's own sharding. Values round-trip through the host
-        untouched — params restore bit-identical across meshes.
+        untouched — params restore bit-identical across meshes. Returns
+        (tree, reinitialized_key_set) so digest verification skips the
+        leaves that deliberately kept the template's fresh value.
 
         Shape-mismatched leaves are topology-dependent state (the per-shard
         RNG keys, [num_shards, ...]): they keep the TEMPLATE's value and are
@@ -391,7 +515,7 @@ class Checkpointer:
         from stoix_tpu.observability import get_logger
 
         raw_by_path = read_host_leaves(self.directory, step)
-        restored, matched, reinitialized = place_host_leaves(
+        restored, matched, reinitialized, reinit_keys = place_host_leaves(
             raw_by_path, template, step
         )
         if reinitialized:
@@ -401,7 +525,7 @@ class Checkpointer:
                 "template initialization: %s",
                 step, matched, len(reinitialized), "; ".join(reinitialized),
             )
-        return restored
+        return restored, set(reinit_keys)
 
     def restore(
         self,
@@ -414,11 +538,16 @@ class Checkpointer:
         """Restore into the shape/sharding of `template`; returns (state, step).
 
         Latest-step restores walk newest-to-oldest past corrupt/truncated/
-        non-finite checkpoints (each rejection logged) until one validates —
-        a preempted or chaos-corrupted save costs one checkpoint interval,
-        not the run. An EXPLICIT `timestep` never falls back: a missing step
-        raises FileNotFoundError listing what IS available, and a corrupt one
-        raises its own error (the caller asked for that step by name).
+        non-finite/digest-mismatched checkpoints until one validates — a
+        preempted, chaos-corrupted, or bit-rotted save costs one checkpoint
+        interval, not the run. Each rejection is logged with its DISTINCT
+        typed reason ('structure' | 'non_finite' | 'digest' | the raising
+        exception's type) and recorded in `self.last_restore_report`
+        (docs/DESIGN.md §2.9; the runner surfaces the count as
+        LAST_RUN_STATS.resilience.restore_skipped). An EXPLICIT `timestep`
+        never falls back: a missing step raises FileNotFoundError listing
+        what IS available, and a corrupt one raises its own error (the
+        caller asked for that step by name).
 
         `reshard` controls topology elasticity (docs/DESIGN.md §2.4):
         'auto' (default) takes the resharding path when the sidecar-recorded
@@ -430,6 +559,7 @@ class Checkpointer:
 
         if reshard not in ("auto", "never", "force"):
             raise ValueError(f"reshard must be auto|never|force, got {reshard!r}")
+        self.last_restore_report: List[Dict[str, str]] = []
         steps = self.all_steps()
         if timestep is not None:
             if int(timestep) not in steps:
@@ -457,13 +587,14 @@ class Checkpointer:
                 and int(saved_fp) != int(template_footprint)
             )
             try:
+                digest_skip: set = set()
                 if proactive_reshard:
                     log.info(
                         "[checkpoint] step %d saved on %s device(s), template "
                         "spans %s — taking the elastic (resharding) restore "
                         "path", step, saved_fp or "?", template_footprint,
                     )
-                    restored = self._restore_resharded(step, template)
+                    restored, digest_skip = self._restore_resharded(step, template)
                 else:
                     try:
                         restored = self._manager.restore(
@@ -485,20 +616,28 @@ class Checkpointer:
                             "failed (%s: %s) — retrying through the elastic "
                             "resharding path", step, type(exc).__name__, exc,
                         )
-                        restored = self._restore_resharded(step, template)
+                        restored, digest_skip = self._restore_resharded(
+                            step, template
+                        )
                 if validate:
                     self._validate(restored, template, step)
+                    self._verify_digests(restored, step, skip_keys=digest_skip)
                 return restored, int(step)
             except Exception as exc:  # noqa: BLE001 — each candidate's failure
                 # mode differs (orbax I/O error, msgpack truncation, integrity
-                # rejection); all mean "try the next-newest".
+                # rejection, digest mismatch); all mean "try the next-newest",
+                # each with its DISTINCT typed reason in the log + report.
                 if not fallback:
                     raise
                 last_error = exc
+                reason = getattr(exc, "kind", None) or type(exc).__name__
+                self.last_restore_report.append(
+                    {"step": str(step), "reason": str(reason), "error": str(exc)}
+                )
                 log.warning(
-                    "[checkpoint] step %d unusable (%s: %s) — falling back to "
-                    "the next-newest checkpoint",
-                    step, type(exc).__name__, exc,
+                    "[checkpoint] step %d unusable [reason: %s] (%s: %s) — "
+                    "falling back to the next-newest checkpoint",
+                    step, reason, type(exc).__name__, exc,
                 )
         raise CheckpointIntegrityError(
             candidates[-1],

@@ -77,6 +77,15 @@ def test_bench_smoke_payload_schema():
     assert resilience["skipped_updates"] == 0, resilience
     assert isinstance(resilience["resume_capable"], bool), resilience
 
+    # State-integrity fields (docs/DESIGN.md §2.9): first-class on every
+    # payload so an armed sentinel can never tax a number invisibly — and a
+    # disabled one reports the zeroed shape, never a missing key.
+    integrity = payload["integrity"]
+    assert integrity["enabled"] is False, integrity
+    assert integrity["fingerprint_checks"] == 0, integrity
+    assert integrity["overhead_s"] == 0.0, integrity
+    assert integrity["probe_runs"] == 0, integrity
+
     # Launch-hardening fields (docs/DESIGN.md §2.4): CPU fallback is a
     # FIRST-CLASS part of the schema, not a unit-string suffix. An explicit
     # --cpu run is not a fallback and needed no probe.

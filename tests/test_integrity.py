@@ -1,0 +1,727 @@
+"""State-integrity sentinel (stoix_tpu/resilience/integrity.py, DESIGN §2.9).
+
+Covers the full silent-corruption story: fingerprint construction and the
+replica-mismatch verdict (unit, against a hand-built replicated state), the
+end-to-end `bitflip:N` fault through the real Anakin runner (detected within
+one window, FLAG_CORRUPT recorded, corrupt state never checkpointed, the
+pre-corruption checkpoint restores digest-verified), the determinism probe,
+the orbax digest sidecar (bit-rot rejected with a typed 'digest' reason and
+the fallback walk finding the previous good step), the fleet emergency
+store's digest verification, the hot-swap canary (swap_poison rejected,
+server keeps serving), the launcher's rc-88 supervision branch, and the
+bit-identical pins for integrity off AND on. The full subprocess
+exit-code-88 + supervised-restore proof lives in
+test_bitflip_exit_code_and_quarantined_relaunch.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.observability import get_registry
+from stoix_tpu.parallel.mesh import create_mesh, replicate
+from stoix_tpu.resilience import faultinject, fleet, integrity
+from stoix_tpu.resilience.errors import (
+    CheckpointIntegrityError,
+    StateCorruptionError,
+)
+from stoix_tpu.utils import config as config_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leakage():
+    yield
+    faultinject.reset()
+
+
+def _settings(tmp_path, probe_interval=0):
+    return integrity.IntegritySettings(
+        enabled=True,
+        determinism_probe_interval=int(probe_interval),
+        quarantine_file=str(tmp_path / "quarantine.json"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Settings / construction
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_from_config_default_off_and_settings_resolve():
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml", []
+    )
+    assert integrity.sentinel_from_config(cfg) is None  # off by default
+    settings = integrity.settings_from_config(cfg)
+    assert settings.enabled is False
+    assert settings.determinism_probe_interval == 0
+    assert settings.quarantine_file == os.path.join("checkpoints", "quarantine.json")
+    on = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        ["arch.integrity.enabled=True", "arch.integrity.determinism_probe_interval=3"],
+    )
+    sentinel = integrity.sentinel_from_config(on)
+    assert sentinel is not None and sentinel.probe_enabled
+
+
+def test_digest_helpers_roundtrip_and_mismatch():
+    arrays = {
+        "a": np.arange(6, dtype=np.float32),
+        "b": np.asarray([True, False]),
+    }
+    record = integrity.digest_arrays(arrays)
+    assert integrity.verify_digests(arrays, record) == []
+    tampered = {**arrays, "a": arrays["a"] + 1.0}
+    assert integrity.verify_digests(tampered, record) == ["a"]
+    # Keys absent from either side are not this function's verdict.
+    assert integrity.verify_digests({"a": arrays["a"]}, record) == []
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints: agreement, deviation, mixed dtypes
+# ---------------------------------------------------------------------------
+
+
+def _replicated_state(mesh):
+    from typing import Any, NamedTuple
+
+    class State(NamedTuple):
+        params: Any
+        opt_states: Any
+        key: Any
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = replicate(
+        {
+            "w": jnp.arange(24, dtype=jnp.float32).reshape(4, 6) + 1.0,
+            "b": jnp.ones((6,), jnp.bfloat16),
+            "mask": jnp.asarray([True, False, True]),
+        },
+        mesh,
+    )
+    opt = replicate({"mu": jnp.zeros((4, 6)), "count": jnp.asarray(7, jnp.int32)}, mesh)
+    key = jax.device_put(
+        jnp.arange(16, dtype=jnp.uint32).reshape(8, 2),
+        NamedSharding(mesh, P("data")),
+    )
+    return State(params, opt, key)
+
+
+def test_fingerprint_groups_exclude_sharded_leaves(devices):
+    mesh = create_mesh({"data": -1})
+    state = _replicated_state(mesh)
+    groups = integrity.replicated_group_specs(state)
+    assert [name for name, _ in groups] == ["params", "opt_states"]  # key sharded
+
+
+def test_fingerprint_agrees_healthy_and_names_flipped_device(devices, tmp_path):
+    mesh = create_mesh({"data": -1})
+    state = _replicated_state(mesh)
+    fn, groups = integrity.build_fingerprint_fn(mesh, state)
+    healthy = {name: np.asarray(vec) for name, vec in fn(state).items()}
+    for name, vec in healthy.items():
+        assert vec.shape == (8,) and vec.dtype == np.uint32
+        assert len(set(vec.tolist())) == 1, f"{name} must agree on a healthy state"
+
+    faultinject.configure("bitflip:2")
+    flipped = faultinject.maybe_bitflip(state, 2)
+    deviant = {name: np.asarray(vec) for name, vec in fn(flipped).items()}
+    assert len(set(deviant["params"].tolist())) == 2  # ONE device deviates
+    assert len(set(deviant["opt_states"].tolist())) == 1  # other groups clean
+
+    sentinel = integrity.StateIntegritySentinel(_settings(tmp_path)).bind(mesh, state)
+    err = sentinel.verify(deviant, window_idx=2, step=128)
+    assert isinstance(err, StateCorruptionError)
+    assert err.kind == "replica_mismatch"
+    assert err.devices == [0] and err.processes == [0]
+    assert err.groups == ["params"] and err.window == 2 and err.step == 128
+    record = json.loads((tmp_path / "quarantine.json").read_text())
+    assert record["quarantined"][0]["devices"] == [0]
+    # Healthy payload after a recorded verdict still answers None.
+    assert sentinel.verify(healthy, 3, 192) is None
+    stats = sentinel.stats()
+    assert stats["enabled"] and stats["fingerprint_checks"] == 2
+
+
+def test_two_replica_tie_names_both_devices_not_a_guess(devices, tmp_path):
+    # With 2 replicas a disagreement is a 1-vs-1 tie: corruption is proven
+    # but attribution is undecidable — the verdict must name BOTH devices
+    # rather than confidently quarantining whichever fingerprint happens to
+    # sort first (a coin-flip that drains the healthy host half the time).
+    mesh = create_mesh({"data": 2}, devices=jax.devices()[:2])
+    state = _replicated_state(mesh)
+    sentinel = integrity.StateIntegritySentinel(_settings(tmp_path)).bind(mesh, state)
+    err = sentinel.verify(
+        {"params": np.asarray([1, 2], np.uint32),
+         "opt_states": np.asarray([7, 7], np.uint32)},
+        window_idx=0, step=0,
+    )
+    assert isinstance(err, StateCorruptionError)
+    assert err.devices == [0, 1] and "undecidable" in err.detail
+
+
+def test_bitflip_changes_exactly_one_bit_and_stays_finite(devices):
+    mesh = create_mesh({"data": -1})
+    state = _replicated_state(mesh)
+    faultinject.configure("bitflip:0")
+    flipped = faultinject.maybe_bitflip(state, 0)
+    before = np.asarray(state.params["w"].addressable_data(0))
+    shards = [
+        np.asarray(shard.data) for shard in flipped.params["w"].addressable_shards
+    ]
+    untouched = [s for s in shards if np.array_equal(s, before)]
+    touched = [s for s in shards if not np.array_equal(s, before)]
+    assert len(touched) == 1 and len(untouched) == 7  # ONE replica flipped
+    assert np.isfinite(touched[0]).all()  # finite-but-wrong, by design
+    diff_bits = np.unpackbits(
+        (touched[0].view(np.uint32) ^ before.view(np.uint32)).view(np.uint8)
+    )
+    assert diff_bits.sum() == 1  # exactly ONE flipped bit
+
+
+def test_new_fault_specs_parse_and_are_noops_unarmed(devices):
+    plan = faultinject.parse_spec("bitflip:3,swap_poison")
+    assert plan.arg("bitflip") == 3 and plan.arg("swap_poison") == 0
+    faultinject.reset()
+    mesh = create_mesh({"data": -1})
+    state = _replicated_state(mesh)
+    assert faultinject.maybe_bitflip(state, 3) is state  # no plan: no-op
+    params = {"w": np.ones((2, 2), np.float32)}
+    assert faultinject.maybe_poison_swap(params) is params
+    faultinject.configure("bitflip:5")
+    assert faultinject.maybe_bitflip(state, 3) is state  # wrong window: no-op
+
+
+# ---------------------------------------------------------------------------
+# Determinism probe
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_probe_passes_replay_and_catches_wrong_math(devices, tmp_path):
+    mesh = create_mesh({"data": -1})
+    state = _replicated_state(mesh)
+    sentinel = integrity.StateIntegritySentinel(
+        _settings(tmp_path, probe_interval=2)
+    ).bind(mesh, state)
+
+    copy = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+    learn = jax.jit(lambda s: s._replace(params=jax.tree.map(
+        lambda x: x * 2 if jnp.issubdtype(x.dtype, jnp.floating) else x, s.params
+    )))
+    sentinel.capture_probe_input(copy(state))
+    reference = {
+        name: np.asarray(vec)
+        for name, vec in sentinel.fingerprints(learn(copy(state))).items()
+    }
+    sentinel.record_probe_reference(reference)
+    assert not sentinel.should_probe(0)  # never probes window 0
+    assert not sentinel.should_probe(3)  # off-interval window
+    assert sentinel.should_probe(2) and sentinel.should_probe(4)
+    assert sentinel.run_probe(learn, copy) is None  # same math: bitwise equal
+
+    drifting = jax.jit(lambda s: s._replace(params=jax.tree.map(
+        lambda x: x * 2.03 if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        s.params,
+    )))
+    err = sentinel.run_probe(drifting, copy)
+    assert isinstance(err, StateCorruptionError) and err.kind == "determinism"
+    assert sentinel.stats()["probe_runs"] == 2
+
+
+def test_determinism_probe_through_runner_is_clean(devices, tmp_path, monkeypatch):
+    # A healthy run with the probe armed must complete with zero verdicts:
+    # XLA replay of the same program on the same input is bitwise stable.
+    # Pipelining note: the probe reference is window 0's OWN fingerprint,
+    # which materializes while window 1 is already dispatched — so the first
+    # armable probe is window 2 (1 probe across 3 windows at interval 1),
+    # and the probe's extra learn call shows up in the recorded trajectory.
+    monkeypatch.chdir(tmp_path)
+    traj, _ = _run_recorded(
+        [
+            "arch.integrity.enabled=True",
+            "arch.integrity.determinism_probe_interval=1",
+            "arch.num_updates=6",
+            "arch.num_evaluation=3",
+        ]
+    )
+    from stoix_tpu.systems.runner import LAST_RUN_STATS
+
+    assert LAST_RUN_STATS["integrity"]["probe_runs"] == 1
+    assert len(traj) == 4  # 3 windows + 1 probe replay
+
+
+# ---------------------------------------------------------------------------
+# Runner integration: bit-identity pins + the bitflip end-to-end proof
+# ---------------------------------------------------------------------------
+
+BASE_OVERRIDES = [
+    "env=identity_game",
+    "arch.total_num_envs=16",
+    "arch.num_updates=4",
+    "arch.total_timesteps=~",
+    "arch.num_evaluation=2",
+    "arch.num_eval_episodes=8",
+    "arch.absolute_metric=False",
+    "system.rollout_length=4",
+    "system.epochs=1",
+    "system.num_minibatches=2",
+    "logger.use_console=False",
+]
+
+
+def _run_recorded(extra):
+    from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
+    from stoix_tpu.systems.runner import run_anakin_experiment
+
+    config = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        BASE_OVERRIDES + list(extra),
+    )
+    trajectory = []
+
+    def recording_setup(env, cfg, mesh, key):
+        setup = learner_setup(env, cfg, mesh, key)
+        inner = setup.learn
+
+        def recording_learn(state):
+            out = inner(state)
+            trajectory.append(jax.tree.map(np.asarray, out.learner_state.params))
+            return out
+
+        return setup._replace(learn=recording_learn)
+
+    final_return = run_anakin_experiment(config, recording_setup)
+    return trajectory, final_return
+
+
+def test_integrity_on_trajectory_bit_identical(devices):
+    # The §2.9 off-path pin: arch.integrity only ADDS fingerprint vectors to
+    # the fetch tree — the dispatched learn sequence, and hence the
+    # trajectory, must be bit-identical with the sentinel on or off.
+    off_traj, _ = _run_recorded([])
+    on_traj, _ = _run_recorded(["arch.integrity.enabled=True"])
+    assert len(off_traj) == len(on_traj) and off_traj
+    for step, (ta, tb) in enumerate(zip(off_traj, on_traj)):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                a, b, err_msg=f"trajectory diverged at window {step}"
+            ),
+            ta, tb,
+        )
+    from stoix_tpu.systems.runner import LAST_RUN_STATS
+
+    stats = LAST_RUN_STATS["integrity"]
+    assert stats["enabled"] is True
+    assert stats["fingerprint_checks"] == 2  # one verdict per window
+    assert stats["overhead_s"] >= 0.0
+
+
+def test_bitflip_detected_within_one_window_and_never_checkpointed(
+    devices, tmp_path, monkeypatch
+):
+    # The tentpole proof, in-process: one replica's params flip going into
+    # window 1 -> the sentinel's verdict lands while processing window 1
+    # (within one window), FLAG_CORRUPT is recorded on the fleet byte, the
+    # corrupt window is NEVER handed to orbax, and the surviving store's
+    # newest checkpoint restores digest-verified.
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("STOIX_TPU_FAULT", "bitflip:1")
+    corrupt_counter = get_registry().counter(
+        "stoix_tpu_fleet_stop_requests_total",
+        "Host-local fleet stop requests, by reason",
+    )
+    corrupt_before = corrupt_counter.value({"reason": "corrupt"})
+    with pytest.raises(StateCorruptionError) as excinfo:
+        _run_recorded(
+            [
+                "arch.integrity.enabled=True",
+                "arch.fleet.enabled=True",
+                f"arch.integrity.quarantine_file={tmp_path / 'q.json'}",
+                "logger.checkpointing.save_model=True",
+                "logger.checkpointing.save_args.checkpoint_uid=bitflip",
+                "logger.checkpointing.save_args.save_interval_steps=1",
+                "logger.checkpointing.save_args.max_to_keep=4",
+            ]
+        )
+    err = excinfo.value
+    assert err.kind == "replica_mismatch" and err.window == 1
+    assert err.devices == [0] and "params" in err.groups
+    # FLAG_CORRUPT joined the fleet flag byte (observability + vote carrier).
+    assert corrupt_counter.value({"reason": "corrupt"}) == corrupt_before + 1
+    assert fleet.describe_flags(fleet.FLAG_CORRUPT) == "corrupt"
+    # The quarantine record names the offender and carries resume overrides.
+    record = json.loads((tmp_path / "q.json").read_text())
+    assert record["quarantined"][0]["processes"] == [0]
+    resume = record["resume_overrides"]
+    assert any("load_model=true" in o for o in resume)
+    assert any("checkpoint_uid=bitflip" in o for o in resume)
+    # The corrupt window was never checkpointed: only window 0's step is on
+    # disk, and it restores with every digest verifying.
+    from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup
+    from stoix_tpu.systems.runner import run_anakin_experiment
+
+    monkeypatch.delenv("STOIX_TPU_FAULT")
+    faultinject.reset()
+    store = tmp_path / "checkpoints" / "bitflip" / "ff_ppo"
+    steps = sorted(int(p.name) for p in store.iterdir() if p.name.isdigit())
+    assert steps == [128], steps  # window 0 only — window 1 was corrupt,
+    # and its verdict landed BEFORE its snapshot reached orbax
+    resumed = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/anakin/default_ff_ppo.yaml",
+        BASE_OVERRIDES + [
+            "logger.checkpointing.load_model=True",
+            "logger.checkpointing.load_args.load_path=checkpoints",
+            "logger.checkpointing.load_args.checkpoint_uid=bitflip",
+        ],
+    )
+    final = run_anakin_experiment(resumed, learner_setup)
+    from stoix_tpu.systems.runner import LAST_RUN_STATS
+
+    assert LAST_RUN_STATS["resilience"]["restore_skipped"] == 0
+    assert np.isfinite(final)
+
+
+def test_bitflip_exit_code_and_quarantined_relaunch(tmp_path):
+    # The acceptance path as PROCESSES: run 1 (bitflip armed) must die with
+    # EXIT_CODE_STATE_CORRUPTION via the sentinel's excepthook and leave a
+    # quarantine record; run 2, launched with the record's resume overrides
+    # (exactly what `launcher.py --supervise` appends on rc 88), restores
+    # the digest-verified checkpoint and finishes cleanly.
+    script = (
+        "import os\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import sys\n"
+        "from stoix_tpu.utils import config as config_lib\n"
+        "from stoix_tpu.systems.ppo.anakin.ff_ppo import learner_setup\n"
+        "from stoix_tpu.systems.runner import run_anakin_experiment\n"
+        "cfg = config_lib.compose(config_lib.default_config_dir(),\n"
+        "    'default/anakin/default_ff_ppo.yaml', sys.argv[1:])\n"
+        "run_anakin_experiment(cfg, learner_setup)\n"
+    )
+    overrides = BASE_OVERRIDES + [
+        "arch.integrity.enabled=True",
+        # Fleet ON too: the run installs BOTH excepthooks, and the exit code
+        # must still be 88 (the sentinel's hook chains over the fleet's
+        # 87-hook and neither stop()/deactivate() may unhook the other).
+        "arch.fleet.enabled=True",
+        "arch.integrity.quarantine_file=quarantine.json",
+        "logger.checkpointing.save_model=True",
+        "logger.checkpointing.save_args.checkpoint_uid=e2e",
+        "logger.checkpointing.save_args.save_interval_steps=1",
+    ]
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "STOIX_TPU_FAULT": "bitflip:1",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    first = subprocess.run(
+        [sys.executable, "-c", script, *overrides],
+        capture_output=True, text=True, timeout=420, cwd=tmp_path, env=env,
+    )
+    assert first.returncode == integrity.EXIT_CODE_STATE_CORRUPTION, (
+        first.returncode, first.stderr[-2000:],
+    )
+    assert "StateCorruptionError" in first.stderr
+    resume = integrity.corruption_resume_overrides(str(tmp_path / "quarantine.json"))
+    assert resume, "quarantine record must carry resume overrides"
+    env.pop("STOIX_TPU_FAULT")  # the offender is 'drained': no re-flip
+    second = subprocess.run(
+        [sys.executable, "-c", script, *overrides, *resume],
+        capture_output=True, text=True, timeout=420, cwd=tmp_path, env=env,
+    )
+    assert second.returncode == 0, second.stderr[-2000:]
+
+
+def test_run_supervised_relaunches_on_corruption_code(tmp_path):
+    # The launcher branch in isolation (no jax): rc 88 relaunches with the
+    # QUARANTINE file's resume overrides, not the fleet ones.
+    from stoix_tpu.launcher import run_supervised
+
+    quarantine = tmp_path / "quarantine.json"
+    quarantine.write_text(json.dumps({
+        "quarantined": [{"processes": [1], "devices": [5], "kind":
+                        "replica_mismatch", "step": 512}],
+        "resume_overrides": [
+            "logger.checkpointing.load_model=true",
+            "logger.checkpointing.load_args.checkpoint_uid=q-test",
+        ],
+    }))
+    marker = str(tmp_path / "died_once")
+    argv_log = str(tmp_path / "argv.log")
+    child = (
+        "import os, sys\n"
+        "marker, argv_log = sys.argv[1], sys.argv[2]\n"
+        "with open(argv_log, 'a') as f:\n"
+        "    f.write('ARGS:' + ' '.join(sys.argv[3:]) + '\\n')\n"
+        "if os.path.exists(marker):\n"
+        "    sys.exit(0)\n"
+        "open(marker, 'w').close()\n"
+        "sys.exit(88)\n"
+    )
+    rc = run_supervised(
+        [sys.executable, "-c", child, marker, argv_log],
+        env=dict(os.environ),
+        max_relaunches=2,
+        resume_overrides=["logger.checkpointing.load_args.load_path=fleet_emergency"],
+        quarantine_file=str(quarantine),
+    )
+    assert rc == 0
+    lines = open(argv_log).read().splitlines()
+    assert len(lines) == 2, lines
+    assert lines[0] == "ARGS:"
+    assert "checkpoint_uid=q-test" in lines[1]
+    assert "fleet_emergency" not in lines[1]  # corruption != partition resume
+
+
+def test_sebulba_integrity_checks_at_eval_boundaries(devices, tmp_path, monkeypatch):
+    # Sebulba wiring (docs/DESIGN.md §2.9): no coalesced fetch to ride, so
+    # the learner loop fingerprint-checks the replicated learner state
+    # synchronously at each eval boundary; a healthy run completes with the
+    # checks counted in LAST_RUN_STATS.
+    monkeypatch.chdir(tmp_path)
+    from stoix_tpu.systems.ppo.sebulba import ff_ppo
+
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/sebulba/default_ff_ppo.yaml",
+        [
+            "env=identity_game",
+            "arch.total_num_envs=8",
+            "arch.total_timesteps=2048",
+            "arch.num_evaluation=2",
+            "arch.num_eval_episodes=8",
+            "system.rollout_length=8",
+            "logger.use_console=False",
+            "arch.integrity.enabled=True",
+            f"arch.integrity.quarantine_file={tmp_path / 'q.json'}",
+        ],
+    )
+    ret = ff_ppo.run_experiment(cfg)
+    assert np.isfinite(ret)
+    stats = ff_ppo.LAST_RUN_STATS["integrity"]
+    assert stats["enabled"] is True and stats["fingerprint_checks"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# Digest-verified checkpoints (orbax sidecar + emergency manifest)
+# ---------------------------------------------------------------------------
+
+
+def _mkstate(seed):
+    key = jax.random.PRNGKey(seed)
+    return {
+        "params": {"w": jax.random.normal(key, (4, 4)), "b": jnp.zeros((4,))},
+        "count": jnp.asarray(seed, jnp.int32),
+    }
+
+
+def test_orbax_digest_sidecar_rejects_bitrot_with_typed_fallback(tmp_path, monkeypatch):
+    import shutil
+
+    from stoix_tpu.utils.checkpointing import Checkpointer, saved_digest_record
+
+    monkeypatch.chdir(tmp_path)
+    ck = Checkpointer("m", rel_dir="ckA", checkpoint_uid="u",
+                      save_interval_steps=1, max_to_keep=4)
+    ck.save(1, _mkstate(1)); ck.save(2, _mkstate(2)); ck.wait()
+    record = saved_digest_record(ck.directory)
+    assert sorted(record) == [1, 2]
+    assert sorted(record[1]) == ["count", "params/b", "params/w"]
+
+    template = jax.tree.map(jnp.zeros_like, _mkstate(0))
+    _state, step = ck.restore(template)
+    assert step == 2 and ck.last_restore_report == []
+
+    # Bit-rot simulation: step 2's bytes are replaced with a DIFFERENT valid
+    # orbax payload — structurally perfect, finite, and wrong. Digest is the
+    # only gate that can see it.
+    other = Checkpointer("m", rel_dir="ckB", checkpoint_uid="u")
+    other.save(2, _mkstate(99)); other.wait()
+    shutil.rmtree(os.path.join(ck.directory, "2"))
+    shutil.copytree(os.path.join(other.directory, "2"), os.path.join(ck.directory, "2"))
+
+    state, step = ck.restore(template)
+    assert step == 1, "the fallback walk must find the previous GOOD step"
+    assert [r["reason"] for r in ck.last_restore_report] == ["digest"]
+    np.testing.assert_array_equal(
+        np.asarray(state["params"]["w"]), np.asarray(_mkstate(1)["params"]["w"])
+    )
+    # An explicitly-pinned tampered step refuses instead of falling back.
+    with pytest.raises(CheckpointIntegrityError) as excinfo:
+        ck.restore(template, timestep=2)
+    assert excinfo.value.kind == "digest"
+    ck.close(); other.close()
+
+
+def test_fallback_reasons_are_distinct_per_failure_class(tmp_path, monkeypatch):
+    import shutil
+
+    from stoix_tpu.utils.checkpointing import Checkpointer
+
+    monkeypatch.chdir(tmp_path)
+    ck = Checkpointer("m", rel_dir="ck", checkpoint_uid="u",
+                      save_interval_steps=1, max_to_keep=8)
+    ck.save(1, _mkstate(1))
+    nan_state = _mkstate(2)
+    nan_state["params"]["w"] = nan_state["params"]["w"].at[0, 0].set(jnp.nan)
+    ck.save(2, nan_state)  # non-finite where the template is finite
+    ck.save(3, _mkstate(3))
+    ck.wait()
+    # Step 3 gets its payload bytes swapped for a different valid state
+    # (digest rejection); step 2 carries NaN (non_finite rejection).
+    other = Checkpointer("m", rel_dir="ckO", checkpoint_uid="u")
+    other.save(3, _mkstate(77)); other.wait()
+    shutil.rmtree(os.path.join(ck.directory, "3"))
+    shutil.copytree(os.path.join(other.directory, "3"), os.path.join(ck.directory, "3"))
+
+    template = jax.tree.map(jnp.zeros_like, _mkstate(0))
+    state, step = ck.restore(template)
+    assert step == 1
+    reasons = [r["reason"] for r in ck.last_restore_report]
+    assert reasons == ["digest", "non_finite"], ck.last_restore_report
+    ck.close(); other.close()
+
+
+def test_emergency_store_digest_verification_rejects_tamper(tmp_path):
+    from stoix_tpu.resilience.fleet import FleetCoordinator, FleetSettings
+
+    settings = FleetSettings(
+        enabled=True, heartbeat_interval_s=1.0, heartbeat_timeout_s=10.0,
+        monitor_poll_s=1.0, barrier_deadline_s=10.0, skew_warn_ratio=2.0,
+        exit_grace_s=0.0, emergency_dir=str(tmp_path / "emergency"),
+    )
+    coord = FleetCoordinator(
+        settings, process_index=0, process_count=1, interrupt_on_partition=False
+    )
+    state = _mkstate(5)
+    coord.stage_candidate(64, state)
+    coord.confirm_candidate(64)
+    path = coord.emergency_save()
+    template = jax.tree.map(jnp.zeros_like, _mkstate(0))
+    restored, step = fleet.restore_emergency(template, str(tmp_path / "emergency"))
+    assert step == 64
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+    # Tamper the npz payload in place: the manifest digests must reject it.
+    with np.load(os.path.join(path, "state.npz")) as data:
+        arrays = {k: data[k] for k in data.files}
+    arrays["params/w"] = arrays["params/w"] + 1.0
+    np.savez(os.path.join(path, "state.npz"), **arrays)
+    with pytest.raises(CheckpointIntegrityError) as excinfo:
+        fleet.read_emergency_raw(str(tmp_path / "emergency"))
+    assert excinfo.value.kind == "digest"
+
+
+# ---------------------------------------------------------------------------
+# Hot-swap canary
+# ---------------------------------------------------------------------------
+
+
+class _CanaryDist:
+    def __init__(self, logits):
+        self.logits = logits
+
+    def mode(self):
+        return jnp.argmax(self.logits, axis=-1)
+
+    def sample(self, *, seed):
+        return jax.random.categorical(seed, self.logits, axis=-1)
+
+
+def _canary_apply(params, observation):
+    return _CanaryDist(observation @ params)
+
+
+class _FakeSource:
+    """Scriptable PolicySource stand-in: a dict of step -> params."""
+
+    def __init__(self, steps):
+        self.steps = dict(steps)
+
+    def latest_step(self):
+        return max(self.steps) if self.steps else None
+
+    def load(self, step=None):
+        step = max(self.steps) if step is None else int(step)
+        return self.steps[step], step
+
+
+def _canary_fixture():
+    from stoix_tpu.serve.engine import InferenceEngine
+    from stoix_tpu.serve.telemetry import ServeTelemetry
+
+    # NONZERO golden input: a zero observation would multiply any weight
+    # pathology away and the forward-pass gate would be vacuous.
+    obs_template = np.full((6,), 0.5, np.float32)
+    good = jnp.asarray(np.eye(6, 4, dtype=np.float32))
+    engine = InferenceEngine(_canary_apply, good, obs_template, buckets=[1, 2])
+    engine.warmup()
+    return engine, ServeTelemetry(), good
+
+
+def test_engine_canary_accepts_good_and_rejects_nonfinite_params():
+    engine, _telemetry, good = _canary_fixture()
+    assert engine.canary(np.asarray(good)) is None
+    bad = np.asarray(good).copy()
+    bad[0, 0] = np.nan
+    reason = engine.canary(bad)
+    assert reason is not None and "non-finite" in reason
+    # Finite params whose FORWARD PASS explodes are also rejected: inf
+    # weights saturate the golden-input logits.
+    saturating = np.full((6, 4), np.finfo(np.float32).max, np.float32)
+    with np.errstate(over="ignore"):
+        assert engine.canary(saturating) is not None
+
+
+def test_swap_poison_rejected_and_server_keeps_serving():
+    from stoix_tpu.serve.hotswap import ParameterWatcher
+
+    engine, telemetry, good = _canary_fixture()
+    source = _FakeSource({1: good})
+    watcher = ParameterWatcher(source, engine, telemetry, current_step=1,
+                              poll_interval_s=60.0, canary=True)
+    version_before = engine.params_version
+
+    # A poisoned candidate at step 2: canary rejects, params stay, error
+    # counted. `swap_poison` is one-shot — the SAME step retried on the next
+    # poll is clean and swaps.
+    faultinject.configure("swap_poison")
+    source.steps[2] = good * 2.0
+    assert watcher.check_now() is None
+    assert engine.params_version == version_before
+    assert telemetry.n_hot_swaps == 0
+    assert watcher.current_step == 1
+
+    assert watcher.check_now() == 2  # fault consumed: candidate is clean now
+    assert engine.params_version == version_before + 1
+    assert telemetry.n_hot_swaps == 1
+    # The canary reused an already-compiled bucket specialization.
+    assert engine.compile_count == 2
+
+
+def test_canary_off_restores_preexisting_swap_anything_behavior():
+    from stoix_tpu.serve.hotswap import ParameterWatcher
+
+    engine, telemetry, good = _canary_fixture()
+    bad = np.asarray(good).copy()
+    bad[0, 0] = np.nan
+    source = _FakeSource({1: good, 2: bad})
+    watcher = ParameterWatcher(source, engine, telemetry, current_step=1,
+                              poll_interval_s=60.0, canary=False)
+    assert watcher.check_now() == 2  # canary=false: swaps whatever restores
