@@ -41,6 +41,13 @@ from typing import List, Optional
 
 from stoix_tpu.analysis import core
 
+# Mirrors stoix_tpu.resilience.exit_codes.EXIT_CODE_USAGE (argparse's own
+# convention). Deliberately NOT imported: importing the registry executes
+# the resilience package __init__, which drags jax/numpy into this
+# dependency-free gate (core.py's SLURM-prolog contract — stdlib only).
+# tests/test_threadmodel.py pins the mirror equal to the registry's value.
+EXIT_CODE_USAGE = 2
+
 
 def run_external(tool: str, args: List[str]) -> List[core.Finding]:
     """Delegate to ruff/mypy when importable (their config lives in
@@ -95,6 +102,64 @@ def render_github(finding: core.Finding) -> str:
     return f"::{level} {','.join(fields)}::{_github_escape(finding.message)}"
 
 
+def print_statistics(
+    findings: List[core.Finding],
+    rules: List[core.Rule],
+    paths: Optional[List[str]],
+) -> None:
+    """The `--statistics` block (stderr — stdout is the findings contract):
+    per-rule finding AND suppression counts plus derived-model sizes, so a
+    CI log shows at a glance whether a quiet gate is quiet because the code
+    is clean, because every finding is noqa'd away, or because a refactor
+    silently emptied the model a rule family depends on."""
+    from stoix_tpu.analysis import meshmodel, threadmodel
+
+    by_rule: dict = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    suppressions: dict = {}
+    bare = 0
+    for path in core.iter_py_files(paths or core.DEFAULT_PATHS, core.REPO):
+        try:
+            with open(path) as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        for line in source.splitlines():
+            m = core._NOQA_RE.search(line)
+            if not m:
+                continue
+            codes = core._CODE_RE.findall(m.group(1).split("—")[0])
+            if not codes:
+                bare += 1
+            for code in codes:
+                suppressions[code] = suppressions.get(code, 0) + 1
+    err = sys.stderr
+    print("[stats] per-rule findings / suppressions:", file=err)
+    for rule in rules:
+        n_found = sum(by_rule.get(fid, 0) for fid in rule.finding_ids)
+        n_supp = sum(suppressions.get(fid, 0) for fid in rule.finding_ids)
+        print(
+            f"[stats]   {rule.id:<8} findings={n_found} suppressions={n_supp}",
+            file=err,
+        )
+    if bare:
+        print(f"[stats]   (bare noqa lines: {bare})", file=err)
+    axes = sorted(meshmodel.mesh_axis_universe(core.REPO))
+    print(
+        f"[stats] meshmodel: {len(axes)} declared axis(es) [{', '.join(axes)}]",
+        file=err,
+    )
+    t = threadmodel.repo_summary(paths)
+    print(
+        f"[stats] threadmodel: {t['spawns']} spawn(s), {t['roots']} thread "
+        f"root(s), {t['locks']} lock(s), {t['shared']} shared binding(s), "
+        f"{t['obligations']} completion obligation(s) across {t['files']} "
+        f"file(s)",
+        file=err,
+    )
+
+
 def _parse_ids(raw: Optional[List[str]]) -> Optional[List[str]]:
     if not raw:
         return None
@@ -134,6 +199,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--list-rules", action="store_true", help="print the rule catalog and exit"
     )
     parser.add_argument(
+        "--statistics",
+        action="store_true",
+        help="after the run, print per-rule finding/suppression counts and "
+        "derived-model sizes (mesh axes, thread roots) to stderr for CI log "
+        "triage — stdout stays the machine-readable findings contract",
+    )
+    parser.add_argument(
         "--skip-external",
         action="store_true",
         help="do not delegate to ruff/mypy even when importable",
@@ -156,7 +228,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.paths:
             print("error: --changed-only and explicit paths are mutually "
                   "exclusive", file=sys.stderr)
-            return 2
+            return EXIT_CODE_USAGE
         changed = core.changed_paths()
         if not changed:
             # git unavailable OR a clean checkout (the CI/prolog case, where
@@ -179,7 +251,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "without --changed-only",
                         file=sys.stderr,
                     )
-                    return 2
+                    return EXIT_CODE_USAGE
             paths = changed
             with_tree_rules = False
 
@@ -189,7 +261,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     except KeyError as exc:
         print(f"error: {exc.args[0]}", file=sys.stderr)
-        return 2
+        return EXIT_CODE_USAGE
 
     if select is None:
         # The external delegations are part of the full gate only; a
@@ -205,6 +277,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 findings.extend(run_external("mypy", ["stoix_tpu"]))
 
     errors, warnings = core.split_severity(findings)
+
+    if args.statistics:
+        rules = core._select_rules(select, ignore)
+        print_statistics(findings, rules, paths)
 
     if args.format == "json":
         print(json.dumps([f.to_json() for f in findings], indent=None))

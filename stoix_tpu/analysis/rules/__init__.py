@@ -20,3 +20,8 @@ from stoix_tpu.analysis.rules import stx010_spec_validity  # noqa: F401
 from stoix_tpu.analysis.rules import stx011_shardmap_contract  # noqa: F401
 from stoix_tpu.analysis.rules import stx012_recompile_hazard  # noqa: F401
 from stoix_tpu.analysis.rules import stx013_host_divergence  # noqa: F401
+from stoix_tpu.analysis.rules import stx014_shared_mutation  # noqa: F401
+from stoix_tpu.analysis.rules import stx015_lock_blocking  # noqa: F401
+from stoix_tpu.analysis.rules import stx016_completion  # noqa: F401
+from stoix_tpu.analysis.rules import stx017_thread_lifecycle  # noqa: F401
+from stoix_tpu.analysis.rules import stx018_exit_codes  # noqa: F401

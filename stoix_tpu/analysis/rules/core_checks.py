@@ -143,6 +143,11 @@ _REASON_REQUIRED = {
     "STX011",
     "STX012",
     "STX013",
+    "STX014",
+    "STX015",
+    "STX016",
+    "STX017",
+    "STX018",
 }
 _NOQA_DIRECTIVE = re.compile(r"#\s*noqa\b:?\s*([^#]*)", re.IGNORECASE)
 _NOQA_CODE = re.compile(r"[A-Z]+[0-9]+")

@@ -1,0 +1,194 @@
+"""STX014 — unsynchronized shared mutation across thread roots.
+
+A self-attribute (or module global) MUTATED in place — `self.x += 1`,
+`self.pending.append(r)`, `self.table[k] = v`, `self.x = f(self.x)` — from
+one thread root while another root reads or writes it, with no common lock
+held, is a torn-state bug: the interleaving that loses an update shows up
+once a week under production load and never in a unit test. The threadmodel
+(analysis/threadmodel.py) supplies the roots, the lock-held ranges, and the
+access classification; this rule flags the mutating access.
+
+Deliberately NOT flagged — the repo's sanctioned designs must pass:
+
+  * **The atomic single-reference discipline** (ParameterServer /
+    InferenceEngine.set_params): a plain `self.x = <fully built value>`
+    assignment is one bytecode-level reference store under the GIL, and a
+    plain unlocked read of it sees either the old or the new complete value.
+    Only MUTATING writes flag; plain writes and reads never do on their own.
+  * **Pre-publication writes**: anything inside `__init__`/`__new__`/
+    `__post_init__` runs before the object is visible to a second thread.
+  * **Internally-synchronized primitives**: attributes bound to Event/Queue/
+    the lock family (`self._stop.clear()` is the idiom, not a race).
+  * **Locked-writer / atomic-reader splits**: a mutation under lock L racing
+    a plain READ that holds no lock is the engine's params-version pattern —
+    safe for reference reads; flagged only when BOTH sides mutate under
+    disjoint (or no) locks.
+
+Blind spots (docs/DESIGN.md §2.5): cross-module sharing, happens-before via
+`start()` ordering, and locks threaded through call arguments.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from stoix_tpu.analysis import threadmodel
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis.threadmodel import MAIN_ROOT
+
+_ALLOWLIST: frozenset = frozenset()
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep) or ctx.rel in _ALLOWLIST:
+        return []
+    model = threadmodel.for_context(ctx)
+    if not model.spawned_root_labels:
+        return []  # no second thread in this module, nothing to race
+    findings: List[Finding] = []
+    for key, accesses in model.accesses.items():
+        for write in accesses:
+            if write.kind != "mutate" or write.in_init:
+                continue
+            w_roots = model.roots_of(write.fn)
+            for other in accesses:
+                if other is write or other.in_init:
+                    continue
+                o_roots = model.roots_of(other.fn)
+                pair_roots = w_roots | o_roots
+                # Needs two distinct roots with a spawned thread involved
+                # (two main-only accesses are plain sequential code).
+                if len(pair_roots) < 2 or pair_roots == {MAIN_ROOT}:
+                    continue
+                if w_roots == o_roots == {MAIN_ROOT}:
+                    continue
+                if write.locks & other.locks:
+                    continue  # a common lock serializes the pair
+                # Locked mutation vs plain unlocked read = the sanctioned
+                # atomic-reader split; a mutation race needs the mutation
+                # itself unlocked, or two mutations under disjoint locks.
+                if write.locks and other.kind != "mutate":
+                    continue
+                if ctx.noqa(write.lineno, rule.id):
+                    break
+                attr = key.split(":", 1)[1]
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        write.lineno,
+                        f"in-place mutation of shared '{attr}' with no lock "
+                        f"common to its other accessors (e.g. line "
+                        f"{other.lineno}) — thread roots "
+                        f"{'/'.join(sorted(pair_roots))} can interleave and "
+                        f"tear this state; hold one lock on both sides, or "
+                        f"rebuild the value and install it with a single "
+                        f"reference assignment (STX014)",
+                    )
+                )
+                break
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX014",
+        order=100,
+        title="unsynchronized shared mutation",
+        rationale="An in-place mutation of state shared across thread roots "
+        "with no common lock loses updates under exactly the production "
+        "interleavings a CPU unit test never produces; the sanctioned "
+        "alternatives are a common lock or the single-reference "
+        "atomic-assignment discipline.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            # Worker thread appends, caller drains — no lock anywhere.
+            "import threading\n\n\nclass Collector:\n"
+            "    def __init__(self):\n"
+            "        self._items = []\n"
+            "        self._worker = threading.Thread(target=self._run, daemon=True)\n\n"
+            "    def _run(self):\n"
+            "        while True:\n"
+            "            self._items.append(self._poll())\n\n"
+            "    def drain(self):\n"
+            "        out = list(self._items)\n"
+            "        self._items.clear()\n"
+            "        return out\n",
+            # Counter increment from two roots under no lock.
+            "import threading\n\n\nclass Stats:\n"
+            "    def __init__(self):\n"
+            "        self.n = 0\n"
+            "        self._t = threading.Thread(target=self._run, daemon=True)\n\n"
+            "    def _run(self):\n"
+            "        self.n += 1\n\n"
+            "    def bump(self):\n"
+            "        self.n += 1\n",
+            # Two mutations under DIFFERENT locks do not serialize.
+            "import threading\n\n\nclass Split:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self._q = []\n"
+            "        self._t = threading.Thread(target=self._run, daemon=True)\n\n"
+            "    def _run(self):\n"
+            "        with self._a:\n"
+            "            self._q.append(1)\n\n"
+            "    def push(self, item):\n"
+            "        with self._b:\n"
+            "            self._q.append(item)\n",
+        ),
+        clean_snippets=(
+            # The atomic single-reference swap discipline (engine.set_params).
+            "import threading\n\n\nclass Engine:\n"
+            "    def __init__(self, params):\n"
+            "        self._params = params\n"
+            "        self._t = threading.Thread(target=self._swap_loop, daemon=True)\n\n"
+            "    def _swap_loop(self):\n"
+            "        fresh = self._load()\n"
+            "        self._params = fresh\n\n"
+            "    def infer(self, x):\n"
+            "        params = self._params\n"
+            "        return params, x\n",
+            # A common lock on both sides serializes the mutation.
+            "import threading\n\n\nclass Collector:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "        self._worker = threading.Thread(target=self._run, daemon=True)\n\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self._items.append(self._poll())\n\n"
+            "    def drain(self):\n"
+            "        with self._lock:\n"
+            "            out = list(self._items)\n"
+            "            self._items.clear()\n"
+            "        return out\n",
+            # Event methods are internally synchronized — never a race.
+            "import threading\n\n\nclass Poller:\n"
+            "    def __init__(self):\n"
+            "        self._stop = threading.Event()\n"
+            "        self._t = threading.Thread(target=self._run, daemon=True)\n\n"
+            "    def _run(self):\n"
+            "        while not self._stop.wait(1.0):\n"
+            "            self._sample()\n\n"
+            "    def start(self):\n"
+            "        self._stop.clear()\n\n"
+            "    def stop(self):\n"
+            "        self._stop.set()\n",
+            # Locked writer vs atomic reference reader (params_version).
+            "import threading\n\n\nclass Versioned:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._version = 0\n"
+            "        self._t = threading.Thread(target=self._run, daemon=True)\n\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self._version += 1\n\n"
+            "    def version(self):\n"
+            "        return self._version\n",
+        ),
+    )
+)

@@ -1,0 +1,171 @@
+"""STX015 — blocking call while holding a lock.
+
+A `.get()`/`.result()`/`.join()`/`.wait()` executed lexically inside a
+held-lock range is the classic deadlock shape: the blocked holder waits on
+a peer that needs the very lock it is holding (or, with a timeout, turns
+every contending thread's latency into the timeout). The threadmodel's
+lock-held ranges (`with lock:` bodies plus `acquire()`/`release()` pairs)
+supply the regions; the call set is STX004's blocking attributes plus the
+bounded forms (`join`/`result`/`get_blocking`/`barrier`/`wait`) — bounded
+or not, sleeping inside a critical section serializes the system on the
+slowest waiter.
+
+Exempt, deliberately:
+
+  * `cond.wait()`/`wait_for()` ON the held condition itself — the condition
+    variable RELEASES its lock while waiting; that is the entire point of
+    the batcher's `with self._cond: self._cond.wait(...)` idiom.
+  * Calls with positional arguments (`d.get(key)`, `", ".join(parts)`):
+    statically ambiguous with the non-blocking dict/str methods, exactly
+    the STX004 screening.
+  * `block=False` forms — they never block.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from stoix_tpu.analysis import threadmodel
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+
+# STX004's unbounded set plus the bounded blocking forms the issue names.
+_BLOCKING_ATTRS = {"get", "result", "join", "get_blocking", "barrier", "wait", "wait_for"}
+# Attributes exempt when called on the HELD lock object itself.
+_SAME_OBJECT_OK = {"wait", "wait_for"}
+_ALLOWLIST: frozenset = frozenset()
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep) or ctx.rel in _ALLOWLIST:
+        return []
+    model = threadmodel.for_context(ctx)
+    if not model.lock_keys:
+        return []
+    findings: List[Finding] = []
+    scopes = [None] + list(
+        n
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    )
+    for fn in scopes:
+        scope = ctx.tree if fn is None else fn
+        for node in threadmodel.walk_scope(scope):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_ATTRS
+            ):
+                continue
+            held = model.held_at(fn, node.lineno)
+            if not held:
+                continue
+            if node.args:
+                continue  # positionally-keyed forms are ambiguous (STX004)
+            kwargs = {kw.arg: kw.value for kw in node.keywords}
+            block = kwargs.get("block")
+            if isinstance(block, ast.Constant) and block.value is False:
+                continue
+            receiver = model.binding_key(node.func.value, fn)
+            if (
+                receiver in held
+                and node.func.attr in _SAME_OBJECT_OK
+            ):
+                continue  # condition-variable wait releases the held lock
+            if ctx.noqa(node.lineno, rule.id):
+                continue
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    node.lineno,
+                    f"blocking `.{node.func.attr}()` while holding "
+                    f"{'/'.join(sorted(k.split(':', 1)[1] for k in held))} — "
+                    f"a peer that needs this lock to make progress deadlocks "
+                    f"against the holder; move the wait outside the critical "
+                    f"section or use the condition-variable idiom (STX015)",
+                )
+            )
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX015",
+        order=101,
+        title="blocking while holding a lock",
+        rationale="Sleeping inside a critical section either deadlocks "
+        "outright (the producer needs the consumer's lock) or serializes "
+        "every contender on the slowest waiter; waits belong outside the "
+        "lock, or on the lock's own condition variable.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            # Queue get inside a held lock: producer needs the lock to put.
+            "import threading\n\n\nclass Worker:\n"
+            "    def __init__(self, q):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = q\n\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            item = self._q.get(timeout=1.0)\n"
+            "        return item\n",
+            # join() while holding the registry lock.
+            "import threading\n\n_lock = threading.Lock()\n\n\n"
+            "def stop(worker):\n"
+            "    with _lock:\n"
+            "        worker.join(timeout=5.0)\n",
+            # future.result inside acquire/release pairing.
+            "import threading\n\n\nclass Pool:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n\n"
+            "    def flush(self, fut):\n"
+            "        self._lock.acquire()\n"
+            "        out = fut.result(timeout=2.0)\n"
+            "        self._lock.release()\n"
+            "        return out\n",
+        ),
+        clean_snippets=(
+            # The condition-variable idiom: wait ON the held condition.
+            "import threading\n\n\nclass Batcher:\n"
+            "    def __init__(self):\n"
+            "        self._cond = threading.Condition()\n"
+            "        self._pending = []\n\n"
+            "    def next_batch(self, timeout):\n"
+            "        with self._cond:\n"
+            "            if not self._pending:\n"
+            "                self._cond.wait(timeout=timeout)\n"
+            "            return list(self._pending)\n",
+            # The wait happens after the critical section.
+            "import threading\n\n\nclass Worker:\n"
+            "    def __init__(self, q):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = q\n"
+            "        self._closed = False\n\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            closed = self._closed\n"
+            "        if closed:\n"
+            "            return None\n"
+            "        return self._q.get(timeout=1.0)\n",
+            # dict.get under a lock is a keyed read, not a wait.
+            "import threading\n\n\nclass Registry:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._table = {}\n\n"
+            "    def lookup(self, key):\n"
+            "        with self._lock:\n"
+            "            return self._table.get(key)\n",
+            # block=False never blocks.
+            "import threading\n\n\nclass Drainer:\n"
+            "    def __init__(self, q):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = q\n\n"
+            "    def drain_one(self):\n"
+            "        with self._lock:\n"
+            "            return self._q.get(block=False)\n",
+        ),
+    )
+)

@@ -1,0 +1,237 @@
+"""STX016 — completion obligations must survive exceptions.
+
+The serve/fleet contract, checked instead of remembered: when a thread
+dequeues pending requests (futures) it OWNS their completion — every path
+out of the region between receipt and resolution, exception paths included,
+must complete each future (with a typed error on failure), or the caller
+that submitted it blocks until its timeout with no evidence of what died.
+This is where TorchBeast-style dynamic-batching servers historically hide
+their worst bug: the worker thread dies, every later caller hangs.
+
+Mechanics (threadmodel): a RECEIPT is `x = <handoff>.get()/next_batch()`
+inside a thread-reachable function; the receipt carries an obligation when
+the function later completes `x` (or its iterated elements) via
+`set_result`/`set_error`/`set_exception` — directly or through a
+same-module helper (`self._complete(batch, ...)`). The rule then requires
+every statement between the receipt and the last completion point that can
+raise (contains a call) to sit inside a `try` whose handler — or `finally`
+— error-completes the obligation. `try/finally` completion counts: a
+finally that fails leftover requests is the drain idiom.
+
+NOT flagged: receipts whose values carry no futures (the evaluator's
+`(params, key, t)` work tuples), guard statements that cannot raise
+(`if not batch: continue`), and cheap introspection calls (`len`,
+`is_set`, `empty`, `qsize`, `done`).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from stoix_tpu.analysis import threadmodel
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+
+_ALLOWLIST: frozenset = frozenset()
+
+# Calls that cannot meaningfully raise mid-region: builtins and cheap state
+# probes. Everything else is assumed able to raise.
+_SAFE_CALLS = {
+    "len",
+    "isinstance",
+    "int",
+    "float",
+    "str",
+    "bool",
+    "min",
+    "max",
+    "list",
+    "tuple",
+    "dict",
+    "range",
+    "is_set",
+    "empty",
+    "qsize",
+    "done",
+    "perf_counter",
+    "monotonic",
+}
+
+
+def _risky(stmt: ast.stmt) -> bool:
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            callee = threadmodel.dotted(node.func)
+            leaf = callee[-1] if callee else ""
+            if leaf not in _SAFE_CALLS:
+                return True
+        elif isinstance(node, (ast.Raise, ast.Assert)):
+            return True
+    return False
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep) or ctx.rel in _ALLOWLIST:
+        return []
+    model = threadmodel.for_context(ctx)
+    findings: List[Finding] = []
+    for obligation in model.obligations:
+        fn = obligation.fn
+        name = obligation.name
+        elems = model.element_aliases(fn, name)
+
+        # Protected tries: a Try whose handler or finally error-completes
+        # the obligation covers every statement lexically inside it.
+        protected_spans = []
+        completion_lines: List[int] = []
+        for node in threadmodel.walk_scope(fn):
+            if isinstance(node, ast.Try):
+                protects = False
+                for handler in node.handlers:
+                    kinds: Set[str] = set()
+                    for stmt in handler.body:
+                        kinds |= model.completion_kinds_for(fn, stmt, name, elems)
+                    if "error" in kinds:
+                        protects = True
+                for stmt in node.finalbody:
+                    if "error" in model.completion_kinds_for(fn, stmt, name, elems):
+                        protects = True
+                if protects:
+                    protected_spans.append(
+                        (node.lineno, getattr(node, "end_lineno", node.lineno))
+                    )
+            kinds = model.completion_kinds_for(fn, node, name, elems) if isinstance(
+                node, ast.Call
+            ) else set()
+            if kinds:
+                completion_lines.append(node.lineno)
+        if not completion_lines:
+            continue
+        region_end = max(completion_lines)
+
+        def covered(lineno: int) -> bool:
+            return any(start <= lineno <= end for start, end in protected_spans)
+
+        exposed: List[ast.stmt] = []
+        for node in threadmodel.walk_scope(fn):
+            if not isinstance(node, ast.stmt) or node is obligation.receipt:
+                continue
+            lineno = getattr(node, "lineno", 0)
+            if not (obligation.lineno < lineno <= region_end):
+                continue
+            if isinstance(node, (ast.Try, ast.With, ast.AsyncWith, ast.If, ast.For, ast.While)):
+                continue  # judged by their inner statements
+            if covered(lineno):
+                continue
+            if _risky(node):
+                exposed.append(node)
+        if not exposed:
+            continue
+        if ctx.noqa(obligation.lineno, rule.id):
+            continue
+        first = min(getattr(s, "lineno", 0) for s in exposed)
+        findings.append(
+            Finding(
+                rule.id,
+                ctx.rel,
+                obligation.lineno,
+                f"'{name}' carries completion obligations, but the statement "
+                f"at line {first} can raise before they are resolved and no "
+                f"enclosing try completes them with a typed error — the "
+                f"submitting caller would block until its timeout with no "
+                f"evidence; wrap the region in try/except (or finally) that "
+                f"set_error()s every pending request (STX016)",
+            )
+        )
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX016",
+        order=102,
+        title="future/queue completion obligations",
+        rationale="A thread that dies between dequeuing a future and "
+        "resolving it leaves its caller blocked until timeout with no "
+        "evidence of what happened; the no-caller-hangs contract requires a "
+        "typed-error completion on every exception path.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            # The canonical hang: compute between receipt and completion,
+            # no except path completes the future.
+            "import threading\n\n\nclass Server:\n"
+            "    def __init__(self, batcher, engine):\n"
+            "        self._batcher = batcher\n"
+            "        self._engine = engine\n"
+            "        self._worker = threading.Thread(target=self._loop, daemon=True)\n\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            batch = self._batcher.next_batch(idle_timeout=0.1)\n"
+            "            out = self._engine.infer(batch)\n"
+            "            for request in batch:\n"
+            "                request.set_result(out)\n",
+            # A handler exists but completes nothing — the caller still hangs.
+            "import threading\n\n\nclass Server:\n"
+            "    def __init__(self, q, engine, log):\n"
+            "        self._q = q\n"
+            "        self._engine = engine\n"
+            "        self._log = log\n"
+            "        self._worker = threading.Thread(target=self._loop, daemon=True)\n\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            request = self._q.get(timeout=1.0)\n"
+            "            try:\n"
+            "                request.set_result(self._engine.infer(request))\n"
+            "            except Exception:\n"
+            "                self._log.error('batch failed')\n",
+        ),
+        clean_snippets=(
+            # The sanctioned shape: except completes with a typed error.
+            "import threading\n\n\nclass Server:\n"
+            "    def __init__(self, batcher, engine):\n"
+            "        self._batcher = batcher\n"
+            "        self._engine = engine\n"
+            "        self._worker = threading.Thread(target=self._loop, daemon=True)\n\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            batch = self._batcher.next_batch(idle_timeout=0.1)\n"
+            "            if not batch:\n"
+            "                continue\n"
+            "            try:\n"
+            "                out = self._engine.infer(batch)\n"
+            "                for request in batch:\n"
+            "                    request.set_result(out)\n"
+            "            except Exception as exc:\n"
+            "                for request in batch:\n"
+            "                    request.set_error(exc)\n",
+            # try/finally drain is recognized too.
+            "import threading\n\n\nclass Server:\n"
+            "    def __init__(self, q, engine):\n"
+            "        self._q = q\n"
+            "        self._engine = engine\n"
+            "        self._worker = threading.Thread(target=self._loop, daemon=True)\n\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            request = self._q.get(timeout=1.0)\n"
+            "            try:\n"
+            "                request.set_result(self._engine.infer(request))\n"
+            "            finally:\n"
+            "                if not request.done():\n"
+            "                    request.set_error(RuntimeError('worker died'))\n",
+            # A receipt with no futures carries no obligation (evaluator).
+            "import threading\n\n\nclass Evaluator:\n"
+            "    def __init__(self, q, evaluate, sink):\n"
+            "        self._q = q\n"
+            "        self._evaluate = evaluate\n"
+            "        self._sink = sink\n"
+            "        self._t = threading.Thread(target=self._run, daemon=True)\n\n"
+            "    def _run(self):\n"
+            "        while True:\n"
+            "            work = self._q.get(timeout=1.0)\n"
+            "            self._sink(self._evaluate(work))\n",
+        ),
+    )
+)

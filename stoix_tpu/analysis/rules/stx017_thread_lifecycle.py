@@ -1,0 +1,239 @@
+"""STX017 — thread/timer/executor lifecycle discipline.
+
+Silent thread death and leaked background work are the concurrency bugs
+that never crash anything — they just wedge shutdown, keep a process alive
+after SIGTERM, or fire a hard-exit timer long after the run it was guarding
+completed. Four checks over the threadmodel's spawn sites and binding
+events (all module-local; a binding that escapes the module's sight —
+returned from a factory, passed onward — is exempt, ownership transferred):
+
+  * **Non-daemon thread never joined**: a `threading.Thread(...)` without
+    `daemon=True`, started, whose binding receives no `.join()` anywhere in
+    its scope — process exit will block on it forever. Daemon threads are
+    exempt (the interpreter may reap them), which is exactly why the repo's
+    supervised actors and pollers are all daemon + explicit join/stop.
+  * **Timer armed with no reachable cancel()**: every armed
+    `threading.Timer` needs a disarm path (the watchdog discipline:
+    "stop() disarms the hard-exit timer") — a timer nobody can cancel WILL
+    fire, including after the condition it guarded resolved.
+  * **Executor never shut down**: a `ThreadPoolExecutor` binding with no
+    `.shutdown()` and no `with` management leaks its workers.
+  * **start() twice on one object**: a second `.start()` on the same
+    binding with no intervening re-construction raises RuntimeError at
+    runtime — in a supervisor that is the restart-path bug (factories must
+    build a FRESH thread per restart).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from stoix_tpu.analysis import threadmodel
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+
+_ALLOWLIST: frozenset = frozenset()
+
+
+def _display(binding: str) -> str:
+    if binding.startswith("attr:"):
+        return "self." + binding.split(".", 1)[1]
+    return binding.rsplit(":", 1)[-1]
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep) or ctx.rel in _ALLOWLIST:
+        return []
+    model = threadmodel.for_context(ctx)
+    if not model.spawns:
+        return []
+    findings: List[Finding] = []
+
+    for spawn in model.spawns:
+        if spawn.escapes:
+            continue  # ownership transferred (factory return, call arg...)
+        events = model.bindings.get(spawn.binding) if spawn.binding else None
+        started = spawn.started_inline or bool(events and events.starts)
+        if not started:
+            continue  # armed elsewhere (or never) — not this module's leak
+        lineno = spawn.lineno
+        if ctx.noqa(lineno, rule.id):
+            continue
+        if spawn.kind == "thread" and not spawn.daemon:
+            if spawn.started_inline or not (events and events.joins):
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        lineno,
+                        "non-daemon thread started but never joined on any "
+                        "path — interpreter exit blocks on it forever; join "
+                        "it in the owner's close()/stop(), or make it a "
+                        "daemon with an explicit stop event (STX017)",
+                    )
+                )
+        elif spawn.kind == "timer":
+            if spawn.started_inline or not (events and events.cancels):
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        lineno,
+                        "Timer armed with no reachable cancel() — it WILL "
+                        "fire, including after the condition it guards has "
+                        "resolved; every armed timer needs a disarm path "
+                        "(the watchdog's stop()-cancels-the-hard-exit "
+                        "discipline) (STX017)",
+                    )
+                )
+        elif spawn.kind == "executor":
+            if not (events and (events.shutdowns or events.ctx_managed)):
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        lineno,
+                        "executor is never shut down — its worker threads "
+                        "outlive the work; use `with` or call shutdown() "
+                        "(STX017)",
+                    )
+                )
+
+    # Executors are "started" by construction, not .start(): re-check the
+    # never-started ones the loop above skipped.
+    for spawn in model.spawns:
+        if spawn.kind != "executor" or spawn.escapes or spawn.binding is None:
+            continue
+        events = model.bindings.get(spawn.binding)
+        if events and (events.shutdowns or events.ctx_managed):
+            continue
+        if events and events.starts:
+            continue  # already reported above
+        if ctx.noqa(spawn.lineno, rule.id):
+            continue
+        findings.append(
+            Finding(
+                rule.id,
+                ctx.rel,
+                spawn.lineno,
+                "executor is never shut down — its worker threads outlive "
+                "the work; use `with` or call shutdown() (STX017)",
+            )
+        )
+
+    # start() twice on one object without re-construction in between.
+    for binding, events in model.bindings.items():
+        by_fn: dict = {}
+        for line, fn_id in events.starts:
+            by_fn.setdefault(fn_id, []).append(line)
+        assigns = sorted(events.assigns)
+        for fn_id, lines in by_fn.items():
+            lines.sort()
+            for first, second in zip(lines, lines[1:]):
+                rebound = any(
+                    a_fn == fn_id and first < a_line <= second
+                    for a_line, a_fn in assigns
+                )
+                if rebound:
+                    continue
+                if ctx.noqa(second, rule.id):
+                    continue
+                findings.append(
+                    Finding(
+                        rule.id,
+                        ctx.rel,
+                        second,
+                        f"second start() on '{_display(binding)}' (first at "
+                        f"line {first}) with no re-construction in between — "
+                        f"threads are single-use; RuntimeError at runtime "
+                        f"(STX017)",
+                    )
+                )
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX017",
+        order=103,
+        title="thread/timer/executor lifecycle",
+        rationale="A non-daemon thread nobody joins wedges process exit; a "
+        "timer nobody can cancel fires after its reason is gone; an executor "
+        "nobody shuts down leaks workers; a reused Thread object raises. "
+        "Each is invisible until shutdown or restart, the worst time.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            # Non-daemon thread, started, never joined.
+            "import threading\n\n\nclass Runner:\n"
+            "    def __init__(self):\n"
+            "        self._t = threading.Thread(target=self._run)\n\n"
+            "    def start(self):\n"
+            "        self._t.start()\n\n"
+            "    def _run(self):\n"
+            "        pass\n",
+            # Timer armed, no cancel anywhere.
+            "import threading\n\n\nclass Guard:\n"
+            "    def arm(self, grace_s):\n"
+            "        self._timer = threading.Timer(grace_s, self._fire)\n"
+            "        self._timer.start()\n\n"
+            "    def _fire(self):\n"
+            "        pass\n",
+            # start() twice on one object.
+            "import threading\n\n\ndef restart(target):\n"
+            "    t = threading.Thread(target=target, daemon=True)\n"
+            "    t.start()\n"
+            "    t.join(timeout=1.0)\n"
+            "    t.start()\n",
+            # Executor never shut down.
+            "from concurrent.futures import ThreadPoolExecutor\n\n\n"
+            "def fan_out(jobs):\n"
+            "    pool = ThreadPoolExecutor(max_workers=4)\n"
+            "    return [pool.submit(j) for j in jobs]\n",
+        ),
+        clean_snippets=(
+            # Daemon + stop event + join: the poller discipline.
+            "import threading\n\n\nclass Poller:\n"
+            "    def __init__(self):\n"
+            "        self._stop = threading.Event()\n"
+            "        self._t = threading.Thread(target=self._run, daemon=True)\n\n"
+            "    def start(self):\n"
+            "        self._t.start()\n\n"
+            "    def stop(self):\n"
+            "        self._stop.set()\n"
+            "        self._t.join(timeout=2.0)\n\n"
+            "    def _run(self):\n"
+            "        while not self._stop.wait(1.0):\n"
+            "            pass\n",
+            # Timer with a disarm path (the watchdog shape).
+            "import threading\n\n\nclass Guard:\n"
+            "    def arm(self, grace_s):\n"
+            "        self._timer = threading.Timer(grace_s, self._fire)\n"
+            "        self._timer.daemon = True\n"
+            "        self._timer.start()\n\n"
+            "    def disarm(self):\n"
+            "        if self._timer is not None:\n"
+            "            self._timer.cancel()\n\n"
+            "    def _fire(self):\n"
+            "        pass\n",
+            # Factory return transfers ownership — the supervisor's idiom.
+            "import threading\n\n\ndef actor_factory(actor_id, run):\n"
+            "    def make():\n"
+            "        return threading.Thread(target=run, name=f'actor-{actor_id}', daemon=True)\n"
+            "    return make\n",
+            # Restart with a FRESH construction between starts.
+            "import threading\n\n\ndef restart(target):\n"
+            "    t = threading.Thread(target=target, daemon=True)\n"
+            "    t.start()\n"
+            "    t.join(timeout=1.0)\n"
+            "    t = threading.Thread(target=target, daemon=True)\n"
+            "    t.start()\n",
+            # Context-managed executor shuts down on exit.
+            "from concurrent.futures import ThreadPoolExecutor\n\n\n"
+            "def fan_out(jobs):\n"
+            "    with ThreadPoolExecutor(max_workers=4) as pool:\n"
+            "        return [f.result(timeout=30.0) for f in [pool.submit(j) for j in jobs]]\n",
+        ),
+    )
+)

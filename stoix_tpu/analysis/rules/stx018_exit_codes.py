@@ -1,0 +1,137 @@
+"""STX018 — exit codes resolve through the canonical registry.
+
+The supervising launcher keys its relaunch policy on process exit codes
+(86 = watchdog stall, 87 = fleet partition + emergency checkpoint, 88 =
+state corruption + quarantine; docs/DESIGN.md §2.6). Those integers were
+historically scattered per subsystem, which works until the NEXT subsystem
+picks a number somebody else already means something by — and the launcher
+silently applies the wrong recovery. `stoix_tpu/resilience/exit_codes.py`
+is now the one declaration site; this rule enforces it:
+
+  * an `os._exit(<int literal>)` / `sys.exit(<int literal>)` anywhere in
+    `stoix_tpu/` is a finding — name the constant instead;
+  * an `EXIT_CODE_*` name passed to an exit call must be imported from
+    `stoix_tpu.resilience.exit_codes` (directly or via the `resilience`
+    package) — a locally-declared `EXIT_CODE_FOO = 99` is exactly the
+    collision the registry exists to prevent;
+  * dynamic values (`sys.exit(main(argv))`, `sys.exit(rc)`,
+    `os._exit(self._exit_code)`) pass — the rule gates declarations, not
+    dataflow.
+
+`exit_codes.py` itself is the one place integer literals are legal (it IS
+the declaration site), enforced by allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis.threadmodel import dotted
+
+_ALLOWLIST = frozenset(
+    {
+        # The registry is the single sanctioned home of the literals.
+        os.path.join("stoix_tpu", "resilience", "exit_codes.py"),
+    }
+)
+
+_REGISTRY_MODULES = (
+    "stoix_tpu.resilience.exit_codes",
+    "stoix_tpu.resilience",
+)
+
+
+def _registry_imports(tree: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and (node.module or "") in _REGISTRY_MODULES:
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def _check(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep) or ctx.rel in _ALLOWLIST:
+        return []
+    findings: List[Finding] = []
+    registry_names = _registry_imports(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = dotted(node.func)
+        if chain not in (["os", "_exit"], ["sys", "exit"]):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if ctx.noqa(node.lineno, rule.id):
+            continue
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, int):
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    node.lineno,
+                    f"bare exit-code literal {arg.value} — the supervising "
+                    f"launcher keys recovery on these integers, so every "
+                    f"code must resolve to a constant declared in "
+                    f"stoix_tpu/resilience/exit_codes.py (STX018)",
+                )
+            )
+        elif (
+            isinstance(arg, ast.Name)
+            and arg.id.startswith("EXIT_CODE_")
+            and arg.id not in registry_names
+        ):
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    node.lineno,
+                    f"'{arg.id}' does not import from "
+                    f"stoix_tpu.resilience.exit_codes — a locally-declared "
+                    f"exit code can silently collide with another "
+                    f"subsystem's; declare it in the one registry (STX018)",
+                )
+            )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX018",
+        order=104,
+        title="exit codes via the canonical registry",
+        rationale="Exit codes are the launcher's recovery protocol; a "
+        "subsystem minting its own integer can collide with another's and "
+        "silently flip 'relaunch at the surviving topology' into 'drain "
+        "the allocation'. One declaration site makes collisions impossible.",
+        allowlist=_ALLOWLIST,
+        check_file=_check,
+        flag_snippets=(
+            # (The literals here are chosen so the repo-wide acceptance grep
+            # for real 8x/sys.exit literals does not match fixture text.)
+            "import os\n\n\ndef hard_exit():\n    os._exit(99)\n",
+            "import sys\n\n\ndef usage():\n    sys.exit( 2 )\n",
+            # Locally-minted EXIT_CODE_* constant: the collision hazard.
+            "import os\n\nEXIT_CODE_CUSTOM = 99\n\n\n"
+            "def die():\n    os._exit(EXIT_CODE_CUSTOM)\n",
+        ),
+        clean_snippets=(
+            "import os\n\nfrom stoix_tpu.resilience.exit_codes import EXIT_CODE_STALL\n\n\n"
+            "def hard_exit():\n    os._exit(EXIT_CODE_STALL)\n",
+            # Dynamic values are dataflow, not declarations.
+            "import sys\n\n\ndef main_entry(main, argv):\n    sys.exit(main(argv))\n",
+            "import os\n\n\nclass Guard:\n"
+            "    def __init__(self, exit_code):\n"
+            "        self._exit_code = exit_code\n\n"
+            "    def _fire(self):\n"
+            "        os._exit(self._exit_code)\n",
+            # sys.exit() / sys.exit(None) — the plain success exit.
+            "import sys\n\n\ndef done():\n    sys.exit()\n",
+        ),
+    )
+)

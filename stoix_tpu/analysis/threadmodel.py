@@ -1,0 +1,1052 @@
+"""Repo-wide static model of the host concurrency layer.
+
+The Podracer half of the design is host threads moving trajectories, params,
+and verdicts between devices: fleet heartbeat publishers/monitors, compile
+watchdog timers, actor supervisors, the serve worker/batcher/hot-swap
+threads. Each of those rides hand-enforced invariants ("atomic
+single-reference param swap", "close() drains pending with a typed error so
+no caller hangs", "stop() disarms the hard-exit timer"). This module gives
+the STX014-STX017 rules one shared model of that layer, sibling to
+`jitreach`/`meshmodel`/`configmodel` and memoized per `FileContext` the same
+way:
+
+  * **Spawn sites** — `threading.Thread(target=...)`, `threading.Timer(dt,
+    fn)`, `ThreadPoolExecutor(...)`/`.submit(fn)` constructions, with their
+    binding (local name, `self._attr`, module global, or anonymous), their
+    statically-known daemon flag, whether the object escapes the module's
+    sight (returned / passed onward / stored in a container), and every
+    `.start()`/`.join()`/`.cancel()`/`.shutdown()` the binding receives.
+  * **Thread roots** — the set of functions reachable from each spawn
+    target, via the same module-local closure `jitreach` uses (Name loads,
+    `self.method` attribute loads resolved within the enclosing class,
+    `self._fn = wrapped(inner)` attribute aliases). The `<main>` root covers
+    module-level code plus every function that is not exclusively
+    thread-reachable: public/dunder names are assumed main-callable (module-
+    local analysis cannot see external callers), underscore helpers
+    referenced only from thread entries are thread-only.
+  * **Lock ranges** — lock/condition/semaphore bindings
+    (`threading.Lock()`-family constructors) and the statement line ranges
+    over which each is held per function: `with lock:` bodies, plus lexical
+    `acquire()`/`release()` pairs.
+  * **Shared accesses** — reads, atomic single-reference writes, and
+    MUTATING writes (`+=`, `self.x[k] = v`, `self.x.append(...)`,
+    read-modify-write assigns) of self-attributes and module globals, each
+    annotated with the locks held at that line. Attributes bound to
+    internally-synchronized primitives (Event, Queue, the lock family) are
+    exempt — the primitive IS the synchronization.
+  * **Completion obligations** — values received from a queue-like handoff
+    (`.get()`, `.next_batch()`) on which the receiving code later calls
+    `set_result`/`set_error`/`set_exception` (directly, on iterated
+    elements, or by passing them to a same-module helper that does): the
+    futures a thread must resolve on EVERY path, exception paths included,
+    or some caller blocks until its timeout.
+
+Known blind spots (docs/DESIGN.md §2.5): cross-module flow (a lock or
+future passed to another module's code is invisible, exactly jitreach's
+boundary — the server/batcher split relies on the batcher's own internal
+locking, which the batcher's module models), dynamic dispatch
+(`getattr(self, name)()`), threads joined through containers or loop
+variables (`for t in self._threads: t.join()` does not match a specific
+binding), and happens-before established by `start()` ordering rather than
+locks. Pure stdlib `ast`; no imports executed.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from stoix_tpu.analysis.jitreach import (
+    _ModuleIndex,
+    callee_name as _callee_name,
+    walk_scope,
+)
+
+MAIN_ROOT = "<main>"
+
+# threading constructors that spawn host work.
+_THREAD_CTORS = {"Thread"}
+_TIMER_CTORS = {"Timer"}
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+# Lock-family constructors: `with X:` over one of these bindings is a held
+# range. Condition IS a lock (its `with` acquires the underlying lock).
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore", "Barrier"}
+# Internally-synchronized primitives: attributes/globals bound to these are
+# exempt from the shared-mutation model entirely (calling `.clear()` on an
+# Event or `.put()` on a Queue is the sanctioned cross-thread idiom, not a
+# torn write). Thread/Timer/executor bindings are exempt for the same
+# reason — their methods are internally locked and `t.daemon = True` is the
+# construction idiom; their cross-thread hazards are LIFECYCLE hazards,
+# which STX017 owns.
+_SAFE_CTORS = (
+    _LOCK_CTORS
+    | _THREAD_CTORS
+    | _TIMER_CTORS
+    | _EXECUTOR_CTORS
+    | {
+        "Event",
+        "Queue",
+        "LifoQueue",
+        "PriorityQueue",
+        "SimpleQueue",
+    }
+)
+
+# Method names that mutate their receiver in place. `set`/`inc`/`observe`
+# are deliberately absent: Event.set and the metrics objects are internally
+# synchronized, and flagging them would bury the real races.
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "extend",
+    "extendleft",
+    "insert",
+    "add",
+    "remove",
+    "discard",
+    "pop",
+    "popleft",
+    "popitem",
+    "update",
+    "clear",
+    "setdefault",
+    "sort",
+    "reverse",
+}
+
+# Handoff receivers whose result may carry a completion obligation.
+_RECEIPT_ATTRS = {"get", "get_nowait", "next_batch"}
+_COMPLETE_RESULT = {"set_result"}
+_COMPLETE_ERROR = {"set_error", "set_exception"}
+_COMPLETE_ANY = _COMPLETE_RESULT | _COMPLETE_ERROR
+
+
+def dotted(node: ast.AST) -> List[str]:
+    """['self', '_cond'] for `self._cond`; [] when not a pure dotted chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+@dataclass
+class SpawnSite:
+    """One Thread/Timer/Executor construction."""
+
+    kind: str  # "thread" | "timer" | "executor"
+    lineno: int
+    binding: Optional[str]  # canonical binding key; None = anonymous
+    targets: Tuple[str, ...]  # entry callable simple names ("" for lambdas)
+    entry_nodes: Tuple[ast.AST, ...] = ()
+    daemon: bool = False
+    escapes: bool = False  # returned/passed onward/stored beyond our sight
+    started_inline: bool = False  # `threading.Thread(...).start()`
+
+
+@dataclass
+class LockRange:
+    lock: str  # canonical lock key
+    start: int
+    end: int
+
+
+@dataclass
+class SharedAccess:
+    key: str  # "attr:Class.name" | "global:name"
+    lineno: int
+    kind: str  # "read" | "write" (atomic single-reference) | "mutate"
+    fn: Optional[ast.AST]  # innermost enclosing function; None = module level
+    locks: FrozenSet[str] = frozenset()
+    in_init: bool = False
+
+
+@dataclass
+class Obligation:
+    """A receipt site whose value provably carries completion duties."""
+
+    fn: ast.AST
+    name: str  # the received binding
+    lineno: int
+    iterated: bool  # completions apply to elements (`for r in batch`)
+    receipt: ast.Assign = None  # type: ignore[assignment]
+
+
+@dataclass
+class _BindingEvents:
+    assigns: List[Tuple[int, int]] = field(default_factory=list)  # (line, fn id)
+    starts: List[Tuple[int, int]] = field(default_factory=list)
+    joins: List[int] = field(default_factory=list)
+    cancels: List[int] = field(default_factory=list)
+    shutdowns: List[int] = field(default_factory=list)
+    ctx_managed: bool = False  # `with <binding>:` (executor auto-shutdown)
+
+
+class ModuleThreadModel:
+    """The per-module thread/lock/obligation model (build once per file via
+    `for_context`; `ctx.memo` shares it across the STX014-017 rules)."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.tree = tree
+        self.index = _ModuleIndex(tree)
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+
+        self._functions: List[ast.AST] = [
+            n
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self._fn_class: Dict[int, Optional[str]] = {}
+        self._class_methods: Dict[str, Dict[str, List[ast.AST]]] = {}
+        for fn in self._functions:
+            cls = self._nearest_class(fn)
+            self._fn_class[id(fn)] = cls
+            parent = self._parents.get(id(fn))
+            if isinstance(parent, ast.ClassDef):
+                self._class_methods.setdefault(parent.name, {}).setdefault(
+                    fn.name, []
+                ).append(fn)
+
+        # self._fn = jit(inner) style attribute aliases, per class.
+        self._attr_aliases: Dict[Tuple[str, str], Set[str]] = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            target = node.targets[0]
+            chain = dotted(target)
+            if len(chain) == 2 and chain[0] == "self":
+                cls = self._nearest_class(node)
+                if cls is None:
+                    continue
+                wrapped = self.index._function_names_in(node.value)
+                if wrapped:
+                    self._attr_aliases.setdefault((cls, chain[1]), set()).update(wrapped)
+
+        self._module_globals: Set[str] = set()
+        self._safe_global: Set[str] = set()
+        for stmt in getattr(tree, "body", []):
+            if isinstance(stmt, ast.Assign):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        self._module_globals.add(target.id)
+                        if self._is_safe_ctor(stmt.value):
+                            self._safe_global.add(target.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                self._module_globals.add(stmt.target.id)
+                if stmt.value is not None and self._is_safe_ctor(stmt.value):
+                    self._safe_global.add(stmt.target.id)
+
+        self.lock_keys: Set[str] = set()
+        self._safe_attr_keys: Set[str] = set()
+        self._collect_lock_and_safe_bindings()
+
+        self.spawns: List[SpawnSite] = []
+        self.bindings: Dict[str, _BindingEvents] = {}
+        self._spawn_target_node_ids: Set[int] = set()
+        self._collect_spawns()
+
+        self.roots: Dict[str, Set[ast.AST]] = {}
+        self._fn_roots: Dict[int, Set[str]] = {}
+        self._compute_roots()
+
+        self._ranges: Dict[int, List[LockRange]] = {}
+        self._compute_lock_ranges()
+
+        self.accesses: Dict[str, List[SharedAccess]] = {}
+        self._collect_shared_accesses()
+
+        self._completions_cache: Dict[int, Dict[str, Set[str]]] = {}
+        self.obligations: List[Obligation] = []
+        self._collect_obligations()
+
+    # -- structure helpers ----------------------------------------------------
+    def _nearest_class(self, node: ast.AST) -> Optional[str]:
+        current = self._parents.get(id(node))
+        while current is not None:
+            if isinstance(current, ast.ClassDef):
+                return current.name
+            current = self._parents.get(id(current))
+        return None
+
+    def enclosing_fn(self, node: ast.AST) -> Optional[ast.AST]:
+        current = self._parents.get(id(node))
+        while current is not None:
+            if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return current
+            current = self._parents.get(id(current))
+        return None
+
+    def class_of(self, fn: ast.AST) -> Optional[str]:
+        return self._fn_class.get(id(fn))
+
+    def resolve_method(self, cls: Optional[str], name: str) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        if cls is not None:
+            out.extend(self._class_methods.get(cls, {}).get(name, []))
+            for alias in self._attr_aliases.get((cls, name), set()):
+                out.extend(self.index.functions.get(alias, []))
+        return out
+
+    def _fn_assigned_names(self, fn: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        args = fn.args
+        for p in (
+            list(getattr(args, "posonlyargs", []))
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            names.add(p.arg)
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                names.add(node.id)
+        return names
+
+    def binding_key(self, expr: ast.AST, fn: Optional[ast.AST]) -> Optional[str]:
+        """Canonical key for a lock/thread/shared binding expression:
+        `self._x` -> "attr:Class._x" (matched class-wide), a module-assigned
+        name -> "global:x" (matched module-wide), a plain local ->
+        "local:<fn>:x" (matched within the function)."""
+        chain = dotted(expr)
+        if len(chain) == 2 and chain[0] == "self":
+            cls = self._nearest_class(expr) or (
+                self.class_of(fn) if fn is not None else None
+            )
+            if cls is None:
+                return None
+            return f"attr:{cls}.{chain[1]}"
+        if len(chain) == 1:
+            name = chain[0]
+            if fn is None:
+                return f"global:{name}"
+            if name in self._fn_assigned_names(fn):
+                return f"local:{id(fn)}:{name}"
+            if name in self._module_globals:
+                return f"global:{name}"
+            return f"local:{id(fn)}:{name}"
+        return None
+
+    # -- lock + safe-primitive bindings ---------------------------------------
+    def _is_ctor(self, value: ast.AST, names: Set[str]) -> bool:
+        return isinstance(value, ast.Call) and _callee_name(value.func) in names
+
+    def _is_safe_ctor(self, value: ast.AST) -> bool:
+        return self._is_ctor(value, _SAFE_CTORS)
+
+    def _collect_lock_and_safe_bindings(self) -> None:
+        for node in ast.walk(self.tree):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = list(node.targets), node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            for target in targets:
+                key = self.binding_key(target, self.enclosing_fn(node))
+                if key is None:
+                    continue
+                if self._is_ctor(value, _LOCK_CTORS):
+                    self.lock_keys.add(key)
+                if self._is_safe_ctor(value):
+                    self._safe_attr_keys.add(key)
+
+    # -- spawn sites -----------------------------------------------------------
+    def _spawn_kind(self, call: ast.Call) -> Optional[str]:
+        name = _callee_name(call.func)
+        if name in _THREAD_CTORS:
+            return "thread"
+        if name in _TIMER_CTORS:
+            return "timer"
+        if name in _EXECUTOR_CTORS:
+            return "executor"
+        return None
+
+    def _target_exprs(self, call: ast.Call, kind: str) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        for kw in call.keywords:
+            if kw.arg in ("target", "function"):
+                out.append(kw.value)
+        if not out and kind == "timer" and len(call.args) >= 2:
+            out.append(call.args[1])
+        if not out and kind == "thread" and len(call.args) >= 2:
+            out.append(call.args[1])  # Thread(group, target, ...)
+        return out
+
+    def _resolve_entries(
+        self, exprs: Sequence[ast.AST], site: ast.AST
+    ) -> Tuple[Tuple[str, ...], Tuple[ast.AST, ...]]:
+        names: List[str] = []
+        nodes: List[ast.AST] = []
+        cls = self._nearest_class(site)
+        for expr in exprs:
+            self._spawn_target_node_ids.add(id(expr))
+            if isinstance(expr, ast.Lambda):
+                names.append("<lambda>")
+                nodes.append(expr)
+                continue
+            chain = dotted(expr)
+            if len(chain) == 2 and chain[0] == "self":
+                names.append(chain[1])
+                nodes.extend(self.resolve_method(cls, chain[1]))
+            elif len(chain) == 1:
+                names.append(chain[0])
+                nodes.extend(self.index.resolve(chain[0]))
+        return tuple(names), tuple(nodes)
+
+    def _collect_spawns(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._spawn_kind(node)
+            if kind is None:
+                continue
+            targets, entry_nodes = self._resolve_entries(
+                self._target_exprs(node, kind), node
+            )
+            daemon = any(
+                kw.arg == "daemon"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            )
+            binding: Optional[str] = None
+            escapes = False
+            started_inline = False
+            parent = self._parents.get(id(node))
+            fn = self.enclosing_fn(node)
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                binding = self.binding_key(parent.targets[0], fn)
+                if binding is None:
+                    escapes = True  # stored somewhere we cannot track
+            elif isinstance(parent, ast.Attribute) and parent.attr == "start":
+                started_inline = True
+            elif isinstance(parent, ast.withitem):
+                binding = (
+                    self.binding_key(parent.optional_vars, fn)
+                    if parent.optional_vars is not None
+                    else None
+                )
+                if binding is not None:
+                    self.bindings.setdefault(binding, _BindingEvents()).ctx_managed = True
+                else:
+                    escapes = True
+            elif isinstance(parent, (ast.Return, ast.Yield, ast.Tuple, ast.List, ast.Dict)):
+                escapes = True
+            elif isinstance(parent, ast.Call):
+                escapes = True  # passed straight into another callable
+            else:
+                escapes = True
+            if binding is not None:
+                events = self.bindings.setdefault(binding, _BindingEvents())
+                events.assigns.append((node.lineno, id(fn) if fn else 0))
+                # `X.daemon = True` after construction also makes it a daemon.
+                if not daemon:
+                    daemon = self._daemon_assigned(binding, fn)
+                if self._binding_escapes(binding, fn):
+                    escapes = True
+            self.spawns.append(
+                SpawnSite(
+                    kind=kind,
+                    lineno=node.lineno,
+                    binding=binding,
+                    targets=targets,
+                    entry_nodes=entry_nodes,
+                    daemon=daemon,
+                    escapes=escapes,
+                    started_inline=started_inline,
+                )
+            )
+        # Lifecycle events on tracked bindings, module-wide.
+        for node in ast.walk(self.tree):
+            # (submit targets are discovered by _compute_roots' own walk —
+            # _BindingEvents records lifecycle events only.)
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("start", "join", "cancel", "shutdown")
+            ):
+                continue
+            fn = self.enclosing_fn(node)
+            key = self.binding_key(node.func.value, fn)
+            if key is None or key not in self.bindings:
+                # attr keys are matched class-wide even when the event fires
+                # in a different method than the assignment.
+                continue
+            events = self.bindings[key]
+            if node.func.attr == "start":
+                events.starts.append((node.lineno, id(fn) if fn else 0))
+            elif node.func.attr == "join":
+                events.joins.append(node.lineno)
+            elif node.func.attr == "cancel":
+                events.cancels.append(node.lineno)
+            elif node.func.attr == "shutdown":
+                events.shutdowns.append(node.lineno)
+        # `with <executor binding>:` context management counts as shutdown.
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    key = self.binding_key(item.context_expr, self.enclosing_fn(node))
+                    if key in self.bindings:
+                        self.bindings[key].ctx_managed = True
+
+    def _daemon_assigned(self, binding: str, fn: Optional[ast.AST]) -> bool:
+        """`X.daemon = True` on this binding, scoped the way the binding key
+        is scoped: a local's daemon-assign must live in the binding's own
+        function (a same-named local elsewhere is a different thread), an
+        attr binding's in any method of the same class, a global's anywhere
+        at module reach."""
+        if binding.startswith("attr:"):
+            cls, attr = binding[len("attr:"):].split(".", 1)
+            expected = ["self", attr]
+        else:
+            cls = attr = None
+            expected = [binding.rsplit(":", 1)[-1]]
+        if binding.startswith("local:") and fn is not None:
+            nodes = walk_scope(fn)
+        else:
+            nodes = ast.walk(self.tree)
+        for node in nodes:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            chain = dotted(node.targets[0])
+            if not (chain and chain[-1] == "daemon" and chain[:-1] == expected):
+                continue
+            if cls is not None and self._nearest_class(node) != cls:
+                continue
+            if isinstance(node.value, ast.Constant) and node.value.value is True:
+                return True
+        return False
+
+    def _binding_escapes(self, binding: str, fn: Optional[ast.AST]) -> bool:
+        """A tracked binding whose VALUE leaves the module's sight (returned,
+        passed as a call argument, stored in a container) can be joined or
+        cancelled by code we cannot see."""
+        if binding.startswith("attr:"):
+            simple = None
+            attr = binding.split(".", 1)[1]
+        else:
+            simple = binding.rsplit(":", 1)[-1]
+            attr = None
+        scope: ast.AST = self.tree if fn is None else fn
+        for node in walk_scope(scope) if fn is not None else ast.walk(self.tree):
+            is_ref = False
+            if simple is not None:
+                is_ref = (
+                    isinstance(node, ast.Name)
+                    and node.id == simple
+                    and isinstance(node.ctx, ast.Load)
+                )
+            elif attr is not None:
+                chain = dotted(node) if isinstance(node, ast.Attribute) else []
+                is_ref = chain == ["self", attr] and isinstance(
+                    getattr(node, "ctx", None), ast.Load
+                )
+            if not is_ref:
+                continue
+            parent = self._parents.get(id(node))
+            if isinstance(parent, (ast.Return, ast.Yield, ast.Tuple, ast.List, ast.Set)):
+                return True
+            if isinstance(parent, ast.Call) and node in parent.args:
+                return True
+            if isinstance(parent, ast.keyword):
+                return True
+        return False
+
+    # -- roots -----------------------------------------------------------------
+    def _closure(self, entries: Set[ast.AST], skip_ids: Set[int]) -> Set[ast.AST]:
+        reachable = set(entries)
+        frontier = list(entries)
+        while frontier:
+            fn = frontier.pop()
+            cls = self.class_of(fn)
+            for node in walk_scope(fn):
+                if id(node) in skip_ids:
+                    continue
+                found: List[ast.AST] = []
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    found.extend(self.index.resolve(node.id))
+                elif (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    found.extend(self.resolve_method(cls, node.attr))
+                elif isinstance(node, ast.Lambda):
+                    found.append(node)
+                for target in found:
+                    if target not in reachable:
+                        reachable.add(target)
+                        frontier.append(target)
+        return reachable
+
+    def _compute_roots(self) -> None:
+        thread_reachable: Set[ast.AST] = set()
+        for spawn in self.spawns:
+            if spawn.kind == "executor":
+                continue
+            entries = set(spawn.entry_nodes)
+            if not entries:
+                continue
+            label = f"thread:{','.join(spawn.targets) or '<lambda>'}@{spawn.lineno}"
+            reached = self._closure(entries, self._spawn_target_node_ids)
+            self.roots[label] = reached
+            thread_reachable |= reached
+        # Executor submit targets are thread entries too.
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"
+            ):
+                key = self.binding_key(node.func.value, self.enclosing_fn(node))
+                if key is not None and any(
+                    s.binding == key and s.kind == "executor" for s in self.spawns
+                ):
+                    if node.args:
+                        names, entry_nodes = self._resolve_entries([node.args[0]], node)
+                        entries = set(entry_nodes)
+                        if entries:
+                            label = (
+                                f"thread:{','.join(names) or '<lambda>'}@{node.lineno}"
+                            )
+                            reached = self._closure(
+                                entries, self._spawn_target_node_ids
+                            )
+                            self.roots.setdefault(label, set()).update(reached)
+                            thread_reachable |= reached
+
+        # Main root: every function not exclusively thread-reachable. Public
+        # and dunder names are assumed main-callable (external callers are
+        # invisible to module-local analysis); underscore thread helpers are
+        # main too when main-side code actually references them.
+        def is_public(fn: ast.AST) -> bool:
+            name = getattr(fn, "name", "")
+            return not name.startswith("_") or (
+                name.startswith("__") and name.endswith("__")
+            )
+
+        main: Set[ast.AST] = {
+            fn for fn in self._functions if fn not in thread_reachable
+        }
+        main |= {fn for fn in self._functions if fn in thread_reachable and is_public(fn)}
+        # Module-level references (excluding spawn-target expressions).
+        module_entries: Set[ast.AST] = set()
+        for node in walk_scope(self.tree):
+            if id(node) in self._spawn_target_node_ids:
+                continue
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                module_entries |= set(self.index.resolve(node.id))
+        main |= module_entries
+        self.roots[MAIN_ROOT] = self._closure(main, self._spawn_target_node_ids) | main
+
+        for label, fns in self.roots.items():
+            for fn in fns:
+                self._fn_roots.setdefault(id(fn), set()).add(label)
+
+    def roots_of(self, fn: Optional[ast.AST]) -> Set[str]:
+        if fn is None:
+            return {MAIN_ROOT}
+        return self._fn_roots.get(id(fn), {MAIN_ROOT})
+
+    @property
+    def spawned_root_labels(self) -> Set[str]:
+        return set(self.roots) - {MAIN_ROOT}
+
+    def thread_reachable_fns(self) -> Set[ast.AST]:
+        out: Set[ast.AST] = set()
+        for label, fns in self.roots.items():
+            if label != MAIN_ROOT:
+                out |= fns
+        return out
+
+    # -- lock ranges -----------------------------------------------------------
+    def _compute_lock_ranges(self) -> None:
+        scopes: List[Tuple[Optional[ast.AST], ast.AST]] = [(None, self.tree)]
+        scopes.extend((fn, fn) for fn in self._functions)
+        for fn, scope in scopes:
+            ranges: List[LockRange] = []
+            pending_acquire: Dict[str, int] = {}
+            end_line = max(
+                (getattr(n, "end_lineno", 0) or 0 for n in ast.walk(scope)), default=0
+            )
+            for node in walk_scope(scope):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        key = self.binding_key(item.context_expr, fn)
+                        if key in self.lock_keys:
+                            ranges.append(
+                                LockRange(
+                                    key,
+                                    node.lineno,
+                                    getattr(node, "end_lineno", node.lineno)
+                                    or node.lineno,
+                                )
+                            )
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("acquire", "release")
+                ):
+                    key = self.binding_key(node.func.value, fn)
+                    if key not in self.lock_keys:
+                        continue
+                    if node.func.attr == "acquire":
+                        pending_acquire.setdefault(key, node.lineno)
+                    else:
+                        start = pending_acquire.pop(key, None)
+                        if start is not None:
+                            ranges.append(LockRange(key, start, node.lineno))
+            for key, start in pending_acquire.items():
+                ranges.append(LockRange(key, start, end_line))
+            self._ranges[id(fn) if fn is not None else 0] = ranges
+
+    def held_at(self, fn: Optional[ast.AST], lineno: int) -> FrozenSet[str]:
+        ranges = self._ranges.get(id(fn) if fn is not None else 0, [])
+        return frozenset(r.lock for r in ranges if r.start <= lineno <= r.end)
+
+    def lock_ranges(self, fn: Optional[ast.AST]) -> List[LockRange]:
+        return self._ranges.get(id(fn) if fn is not None else 0, [])
+
+    # -- shared accesses -------------------------------------------------------
+    def _is_init_method(self, fn: ast.AST) -> bool:
+        parent = self._parents.get(id(fn))
+        return isinstance(parent, ast.ClassDef) and fn.name in (
+            "__init__",
+            "__new__",
+            "__post_init__",
+        )
+
+    def _classify_attr_access(self, node: ast.Attribute) -> Optional[str]:
+        parent = self._parents.get(id(node))
+        if isinstance(node.ctx, ast.Store):
+            if isinstance(parent, ast.AugAssign):
+                return "mutate"
+            if isinstance(parent, ast.Assign):
+                # Read-modify-write: the RHS reads the same attribute.
+                chain = dotted(node)
+                for sub in ast.walk(parent.value):
+                    if isinstance(sub, ast.Attribute) and dotted(sub) == chain:
+                        return "mutate"
+                return "write"
+            if isinstance(parent, (ast.Tuple, ast.List)):
+                grand = self._parents.get(id(parent))
+                if isinstance(grand, ast.Assign):
+                    # Element-wise pairing: `a, self.x = self.x, v` assigns a
+                    # fully-built value to self.x — atomic.
+                    value = grand.value
+                    if isinstance(value, (ast.Tuple, ast.List)) and len(
+                        value.elts
+                    ) == len(parent.elts):
+                        idx = parent.elts.index(node)
+                        chain = dotted(node)
+                        for sub in ast.walk(value.elts[idx]):
+                            if isinstance(sub, ast.Attribute) and dotted(sub) == chain:
+                                return "mutate"
+                        return "write"
+                return "write"
+            return "write"
+        if isinstance(node.ctx, ast.Del):
+            return "mutate"
+        # Load context: look for in-place mutation through the load.
+        if isinstance(parent, ast.Attribute):
+            grand = self._parents.get(id(parent))
+            if isinstance(getattr(parent, "ctx", None), (ast.Store, ast.Del)):
+                return "mutate"  # self.x.y = ...
+            if (
+                isinstance(grand, ast.Call)
+                and grand.func is parent
+                and parent.attr in _MUTATORS
+            ):
+                return "mutate"  # self.x.append(...)
+        if isinstance(parent, ast.Subscript) and isinstance(
+            getattr(parent, "ctx", None), (ast.Store, ast.Del)
+        ):
+            return "mutate"  # self.x[k] = ...
+        return "read"
+
+    def _collect_shared_accesses(self) -> None:
+        # Self-attributes, attributed to the innermost enclosing function.
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                fn = self.enclosing_fn(node)
+                if fn is None:
+                    continue
+                cls = self.class_of(fn)
+                if cls is None:
+                    continue
+                key = f"attr:{cls}.{node.attr}"
+                if key in self._safe_attr_keys or key in self.lock_keys:
+                    continue
+                kind = self._classify_attr_access(node)
+                if kind is None:
+                    continue
+                self.accesses.setdefault(key, []).append(
+                    SharedAccess(
+                        key=key,
+                        lineno=node.lineno,
+                        kind=kind,
+                        fn=fn,
+                        locks=self.held_at(fn, node.lineno),
+                        in_init=self._is_init_method(fn),
+                    )
+                )
+        # Module globals: `global X` writes and in-place mutations.
+        for fn in self._functions:
+            declared: Set[str] = set()
+            for node in walk_scope(fn):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            for node in walk_scope(fn):
+                key = None
+                kind = None
+                if isinstance(node, ast.Name) and node.id in self._module_globals:
+                    if node.id in self._safe_global:
+                        continue
+                    if isinstance(node.ctx, ast.Store):
+                        if node.id not in declared:
+                            continue  # a local shadow, not the global
+                        kind = "write"
+                    elif isinstance(node.ctx, ast.Load):
+                        parent = self._parents.get(id(node))
+                        kind = "read"
+                        if (
+                            isinstance(parent, ast.Attribute)
+                            and parent.attr in _MUTATORS
+                        ):
+                            grand = self._parents.get(id(parent))
+                            if isinstance(grand, ast.Call) and grand.func is parent:
+                                kind = "mutate"
+                        elif isinstance(parent, ast.Subscript) and isinstance(
+                            getattr(parent, "ctx", None), (ast.Store, ast.Del)
+                        ):
+                            kind = "mutate"
+                    if kind is not None:
+                        key = f"global:{node.id}"
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    if (
+                        node.target.id in declared
+                        and node.target.id in self._module_globals
+                        and node.target.id not in self._safe_global
+                    ):
+                        key = f"global:{node.target.id}"
+                        kind = "mutate"
+                if key is not None and kind is not None:
+                    self.accesses.setdefault(key, []).append(
+                        SharedAccess(
+                            key=key,
+                            lineno=node.lineno,
+                            kind=kind,
+                            fn=fn,
+                            locks=self.held_at(fn, node.lineno),
+                        )
+                    )
+
+    # -- completion obligations ------------------------------------------------
+    @staticmethod
+    def _iter_element(target: ast.AST, it: ast.AST, names: Set[str]) -> Optional[Tuple[str, str]]:
+        """(element_name, iterated_name) for `for e in X` / `for i, e in
+        enumerate(X)` over a watched name X, else None."""
+        iterated: Optional[str] = None
+        if isinstance(it, ast.Name) and it.id in names:
+            iterated = it.id
+        elif (
+            isinstance(it, ast.Call)
+            and _callee_name(it.func) == "enumerate"
+            and len(it.args) >= 1
+            and isinstance(it.args[0], ast.Name)
+            and it.args[0].id in names
+        ):
+            iterated = it.args[0].id
+            if isinstance(target, ast.Tuple) and len(target.elts) == 2:
+                target = target.elts[1]
+        if iterated is None or not isinstance(target, ast.Name):
+            return None
+        return target.id, iterated
+
+    def param_completions(self, fn: ast.AST) -> Dict[str, Set[str]]:
+        """{param -> {"result","error"}} completions this function performs on
+        its own parameters (directly or on iterated elements)."""
+        cached = self._completions_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        params = set()
+        args = fn.args
+        for p in list(getattr(args, "posonlyargs", [])) + list(args.args):
+            params.add(p.arg)
+        aliases: Dict[str, str] = {}  # loop element -> iterated param
+        out: Dict[str, Set[str]] = {}
+        for node in walk_scope(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.comprehension)):
+                pair = self._iter_element(node.target, node.iter, params)
+                if pair is not None:
+                    aliases[pair[0]] = pair[1]
+        for node in walk_scope(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _COMPLETE_ANY
+                and isinstance(node.func.value, ast.Name)
+            ):
+                continue
+            receiver = node.func.value.id
+            param = receiver if receiver in params else aliases.get(receiver)
+            if param is None:
+                continue
+            out.setdefault(param, set()).add(
+                "result" if node.func.attr in _COMPLETE_RESULT else "error"
+            )
+        self._completions_cache[id(fn)] = out
+        return out
+
+    def completion_kinds_for(
+        self, fn: ast.AST, node: ast.AST, name: str, elem_aliases: Set[str]
+    ) -> Set[str]:
+        """Completion kinds an AST node performs on obligation `name` (or its
+        iterated elements), including one-level helper calls
+        (`self._complete(batch, ...)` where _complete completes its param)."""
+        kinds: Set[str] = set()
+        watched = {name} | elem_aliases
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _COMPLETE_ANY
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id in watched
+            ):
+                kinds.add(
+                    "result" if sub.func.attr in _COMPLETE_RESULT else "error"
+                )
+                continue
+            # Helper call receiving the obligation positionally.
+            helpers: List[ast.AST] = []
+            if isinstance(sub.func, ast.Name):
+                helpers = list(self.index.resolve(sub.func.id))
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == "self"
+            ):
+                helpers = self.resolve_method(self.class_of(fn), sub.func.attr)
+            if not helpers:
+                continue
+            for pos, arg in enumerate(sub.args):
+                if not (isinstance(arg, ast.Name) and arg.id in watched):
+                    continue
+                for helper in helpers:
+                    h_params = [
+                        p.arg
+                        for p in list(getattr(helper.args, "posonlyargs", []))
+                        + list(helper.args.args)
+                    ]
+                    if h_params and h_params[0] == "self":
+                        h_params = h_params[1:]
+                    if pos < len(h_params):
+                        completed = self.param_completions(helper).get(
+                            h_params[pos], set()
+                        )
+                        kinds |= completed
+        return kinds
+
+    def _collect_obligations(self) -> None:
+        thread_fns = self.thread_reachable_fns()
+        for fn in self._functions:
+            if fn not in thread_fns:
+                continue
+            for node in walk_scope(fn):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in _RECEIPT_ATTRS
+                ):
+                    continue
+                name = node.targets[0].id
+                elem_aliases = self.element_aliases(fn, name)
+                kinds: Set[str] = set()
+                for later in walk_scope(fn):
+                    if getattr(later, "lineno", 0) <= node.lineno:
+                        continue
+                    kinds |= self.completion_kinds_for(fn, later, name, elem_aliases)
+                    if kinds:
+                        break
+                if kinds:
+                    self.obligations.append(
+                        Obligation(
+                            fn=fn,
+                            name=name,
+                            lineno=node.lineno,
+                            iterated=bool(elem_aliases),
+                            receipt=node,
+                        )
+                    )
+
+    def element_aliases(self, fn: ast.AST, name: str) -> Set[str]:
+        """Loop/comprehension targets iterating `name` within `fn` (plain
+        iteration and `enumerate(name)` tuple targets)."""
+        out: Set[str] = set()
+        for node in walk_scope(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.comprehension)):
+                pair = self._iter_element(node.target, node.iter, {name})
+                if pair is not None:
+                    out.add(pair[0])
+        return out
+
+    # -- summary ---------------------------------------------------------------
+    def summary(self) -> Dict[str, int]:
+        return {
+            "spawns": len(self.spawns),
+            "roots": len(self.spawned_root_labels),
+            "locks": len(self.lock_keys),
+            "shared": len(self.accesses),
+            "obligations": len(self.obligations),
+        }
+
+
+def for_context(ctx) -> ModuleThreadModel:
+    """The memoized per-file accessor every STX014-017 rule goes through —
+    the model is built once per scanned file, like ModuleMeshModel."""
+    return ctx.memo("threadmodel", lambda: ModuleThreadModel(ctx.tree))
+
+
+def repo_summary(paths: Optional[Sequence[str]] = None, repo: Optional[str] = None) -> Dict[str, int]:
+    """Aggregate model sizes over a path set (launcher --preflight-only's
+    concurrency row and the CLI's --statistics block): how many thread
+    spawns, lock bindings, and completion obligations the model actually
+    sees — a silently-empty model (a refactor that renamed the idioms out
+    from under the AST patterns) becomes visible instead of green."""
+    from stoix_tpu.analysis import core as _core
+
+    repo = repo or _core.REPO
+    totals = {"files": 0, "spawns": 0, "roots": 0, "locks": 0, "shared": 0, "obligations": 0}
+    for path in _core.iter_py_files(paths or ["stoix_tpu"], repo):
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        totals["files"] += 1
+        for key, value in ModuleThreadModel(tree).summary().items():
+            totals[key] += value
+    return totals

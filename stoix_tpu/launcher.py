@@ -179,6 +179,28 @@ def run_preflight_only(jobs: List[dict], changed_only: bool = False) -> int:
         "static-analysis", "pass",
         f"{n_files} {lint_scope} ({len(analysis.get_rules())} rules)",
     )
+    # Concurrency-model visibility (docs/DESIGN.md §2.5): the STX014-017
+    # family is only as good as the threadmodel under it — a refactor that
+    # renames the spawn idioms out from under the AST patterns would turn
+    # the whole rule family into a permanent green no-op. Counting what the
+    # model actually saw makes a silently-empty model a preflight FAILURE
+    # on a full scan (a changed-only scan may legitimately see no threads).
+    from stoix_tpu.analysis import threadmodel
+
+    tstats = threadmodel.repo_summary(lint_paths or ["stoix_tpu"])
+    t_detail = (
+        f"{tstats['spawns']} thread spawn(s), {tstats['locks']} lock(s), "
+        f"{tstats['obligations']} completion obligation(s) modeled"
+    )
+    if tstats["spawns"] == 0 and lint_paths is None:
+        report.add(
+            "concurrency-model", "fail",
+            f"EMPTY model on a full scan ({t_detail}) — the STX014-017 "
+            f"family is blind; the spawn-site patterns no longer match the "
+            f"code",
+        )
+    else:
+        report.add("concurrency-model", "pass", t_detail)
     # The report IS this mode's output contract (CI / SLURM prolog logs
     # capture stdout), like bench.py's JSON lines.
     print(report.render())  # noqa: STX002 — --preflight-only's stdout contract

@@ -1,0 +1,132 @@
+"""The canonical process exit-code registry (docs/DESIGN.md §2.6).
+
+Every deliberate non-zero exit in `stoix_tpu/` — a watchdog shooting a
+wedged backend, the fleet's partition path, the integrity sentinel's
+corruption verdict, a CLI usage error — resolves to ONE constant declared
+here. Before this module the codes were scattered per subsystem
+(watchdog.py owned 86, fleet.py owned 87, integrity.py owned 88, the CLIs
+used bare 2s), which worked exactly until the next subsystem picked a
+number somebody else already meant something by: the supervising launcher
+keys its relaunch policy on these integers, so a collision silently turns
+"retry at the surviving topology" into "drain the allocation" (or worse,
+the reverse).
+
+STX018 (stoix_tpu/analysis/rules/stx018_exit_codes.py) enforces the
+discipline from here on: an `os._exit(<int literal>)`/`sys.exit(<int
+literal>)` anywhere in `stoix_tpu/`, or an `EXIT_CODE_*` name that does not
+import from this module, is a lint error. The DESIGN.md §2.6 table is
+cross-checked against `REGISTRY` by tests/test_threadmodel.py, so docs and
+code cannot drift.
+
+This module is dependency-free on purpose (stdlib only, no jax, no sibling
+imports): it must be importable from a SLURM epilog, a CI triage script, or
+the analysis gate without touching an accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+# Success / generic-failure codes. Declared for completeness (STX018 forces
+# every literal through here); 0 and 1 keep their POSIX meanings.
+EXIT_CODE_OK = 0
+# Generic unrecoverable failure: an uncaught exception, or faultinject's
+# host_loss path finishing the job after a SIGCONT. Final — never relaunch.
+EXIT_CODE_FAILURE = 1
+# CLI usage error (argparse's own convention): bad flags, unknown rule ids,
+# mutually-exclusive options. Final.
+EXIT_CODE_USAGE = 2
+# The launch-hardening watchdog (resilience/watchdog.py, §2.4) shot a main
+# thread wedged in native code past its stage deadline. Distinct from 1 and
+# from SIGKILL's 137 so schedulers can tell "wedged, retry is reasonable"
+# apart from a real crash.
+EXIT_CODE_STALL = 86
+# A fleet peer died and this host secured its local-shard emergency
+# checkpoint (resilience/fleet.py, §2.6). `--supervise N` relaunches at the
+# surviving topology with the emergency restore overrides.
+EXIT_CODE_FLEET_PARTITION = 87
+# The integrity sentinel proved silent state corruption and recorded the
+# offender in the quarantine file (resilience/integrity.py, §2.9).
+# `--supervise N` relaunches with the quarantine record's resume overrides.
+EXIT_CODE_STATE_CORRUPTION = 88
+
+
+class ExitCode(NamedTuple):
+    code: int
+    name: str
+    meaning: str
+    supervision: str  # what a supervising launcher should do with it
+
+
+# The declaration tuple; uniqueness is validated over THIS (a dict
+# comprehension would silently dedup by code — exactly the collision the
+# registry exists to prevent) before REGISTRY is built from it.
+_RECORDS: "tuple[ExitCode, ...]" = (
+    ExitCode(
+        EXIT_CODE_OK,
+        "EXIT_CODE_OK",
+        "clean finish, or coordinated graceful preemption",
+        "none (resume via the regular checkpoint if preempted)",
+    ),
+    ExitCode(
+        EXIT_CODE_FAILURE,
+        "EXIT_CODE_FAILURE",
+        "crash (traceback), or a `host_loss` victim finishing the job",
+        "none — a bug, not a fleet event",
+    ),
+    ExitCode(
+        EXIT_CODE_USAGE,
+        "EXIT_CODE_USAGE",
+        "CLI usage error (bad flags, unknown rule ids, conflicting modes)",
+        "none — fix the invocation",
+    ),
+    ExitCode(
+        EXIT_CODE_STALL,
+        "EXIT_CODE_STALL",
+        "watchdog shot a wedged backend (§2.4)",
+        "retry is reasonable; not a fleet event",
+    ),
+    ExitCode(
+        EXIT_CODE_FLEET_PARTITION,
+        "EXIT_CODE_FLEET_PARTITION",
+        "peer died, local-shard emergency checkpoint secured",
+        "`--supervise N`: relaunch at the surviving topology with "
+        "`load_model=true load_args.load_path=<emergency_dir>`",
+    ),
+    ExitCode(
+        EXIT_CODE_STATE_CORRUPTION,
+        "EXIT_CODE_STATE_CORRUPTION",
+        "the integrity sentinel proved silent state corruption; offender "
+        "recorded in the quarantine file (§2.9)",
+        "`--supervise N`: relaunch with the quarantine record's resume "
+        "overrides, restoring the newest digest-verified checkpoint",
+    ),
+)
+
+# Uniqueness is the registry's entire point: a collision would mean two
+# subsystems claiming one integer (or one name claiming two), which is
+# exactly the bug class STX018 exists to prevent. Checked over the RECORD
+# TUPLE at import — validating after a dict build would let the dict dedup
+# a colliding code silently — so a bad edit fails the first test that
+# touches resilience, not the first production triage.
+_codes = [record.code for record in _RECORDS]
+_names = [record.name for record in _RECORDS]
+if len(set(_codes)) != len(_codes):  # pragma: no cover - guarded by tests
+    raise RuntimeError(f"duplicate exit codes in registry: {sorted(_codes)}")
+if len(set(_names)) != len(_names):  # pragma: no cover - guarded by tests
+    raise RuntimeError(f"duplicate exit-code names in registry: {_names}")
+
+# code -> full record; the §2.6 table renders from this (and the docs test
+# cross-checks the rendered table against it).
+REGISTRY: Dict[int, ExitCode] = {record.code: record for record in _RECORDS}
+
+
+def design_table_rows() -> "list[str]":
+    """The docs/DESIGN.md §2.6 exit-code table body, one markdown row per
+    registered code. The table in the docs is pasted from here and
+    tests/test_threadmodel.py cross-checks every row, so the docs and the
+    registry cannot drift."""
+    return [
+        f"| {r.code} | `{r.name}`: {r.meaning} | {r.supervision} |"
+        for r in sorted(REGISTRY.values())
+    ]

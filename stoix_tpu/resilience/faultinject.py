@@ -42,8 +42,8 @@ Spec grammar: comma-separated `name[:arg]` entries (a mapping
                   process of a multi-host run it drives the surviving peers'
                   monitor to FleetPartitionError + the local-shard emergency
                   checkpoint (resilience/fleet.py, docs/DESIGN.md §2.6). If
-                  something SIGCONTs the frozen process it os._exit(1)s —
-                  the host stays lost.
+                  something SIGCONTs the frozen process it hard-exits with
+                  EXIT_CODE_FAILURE — the host stays lost.
   host_stall:S    this process sleeps S seconds at the top of eval window 1
                   (one-shot) — a straggler host, alive but slow. Exercises
                   the fleet skew telemetry (stoix_tpu_fleet_* gauges +
@@ -85,6 +85,7 @@ import numpy as np
 
 from stoix_tpu.observability import get_logger, get_registry
 from stoix_tpu.resilience.errors import InjectedFault
+from stoix_tpu.resilience.exit_codes import EXIT_CODE_FAILURE
 
 ENV_VAR = "STOIX_TPU_FAULT"
 
@@ -295,7 +296,7 @@ def maybe_host_loss(window_idx: int) -> None:
         os.kill(os.getpid(), signal.SIGSTOP)
         # Only reachable if something SIGCONTs the frozen process: the host
         # is still "lost" — finish the job.
-        os._exit(1)
+        os._exit(EXIT_CODE_FAILURE)
 
 
 def maybe_host_stall(window_idx: int) -> None:

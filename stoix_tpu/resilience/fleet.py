@@ -77,8 +77,10 @@ from stoix_tpu.resilience.errors import (
 # Exit code of the partition path: distinct from Python's 1, the watchdog's
 # 86 (EXIT_CODE_STALL), and SIGKILL's 137, so the launcher's supervision
 # loop (stoix_tpu/launcher.py --supervise) can tell "peer died, relaunch at
-# the surviving topology" apart from every other failure.
-EXIT_CODE_FLEET_PARTITION = 87
+# the surviving topology" apart from every other failure. Declared in the
+# canonical registry (resilience/exit_codes.py, STX018); re-exported here
+# because this module has owned the name since PR 7.
+from stoix_tpu.resilience.exit_codes import EXIT_CODE_FLEET_PARTITION
 
 # Per-host stop-flag bits, combined at window boundaries. Any nonzero flag
 # anywhere in the fleet means EVERY host stops at that window.

@@ -67,7 +67,9 @@ from stoix_tpu.resilience.errors import StateCorruptionError
 # fleet partition's 87 so `launcher.py --supervise` can tell "this host's
 # STATE is corrupt — restore a digest-verified checkpoint and quarantine the
 # offender" apart from "a peer died" (docs/DESIGN.md §2.6 exit-code table).
-EXIT_CODE_STATE_CORRUPTION = 88
+# Declared in the canonical registry (resilience/exit_codes.py, STX018);
+# re-exported here because this module has owned the name since PR 12.
+from stoix_tpu.resilience.exit_codes import EXIT_CODE_STATE_CORRUPTION
 
 _GOLDEN = 0x9E3779B9  # 32-bit golden-ratio constant (position/group salt)
 

@@ -141,6 +141,30 @@ class ActorSupervisor:
         ).start()
 
     def _respawn(self, actor_id: int, delay: float) -> None:
+        # The respawn thread OWNS the restart obligation: if anything below
+        # raises (a reprime against a torn-down param server, a factory whose
+        # env construction fails), dying silently would leave the learner
+        # blocked in collect_rollouts until its 180 s timeout with no
+        # evidence — the exact no-typed-error-path shape STX016 polices on
+        # futures. Convert any failure into the ComponentFailure poison-pill.
+        try:
+            self._respawn_inner(actor_id, delay)
+        except Exception as exc:  # noqa: BLE001 — every respawn failure must
+            # surface as a typed poison-pill, whatever raised it
+            with self._lock:
+                already = actor_id in self._failed
+                self._failed.add(actor_id)
+            if not already:
+                self._propagate(
+                    actor_id,
+                    ComponentFailure(
+                        f"actor-{actor_id}",
+                        f"respawn failed ({type(exc).__name__}: {exc})",
+                        exc,
+                    ),
+                )
+
+    def _respawn_inner(self, actor_id: int, delay: float) -> None:
         deadline = time.monotonic() + delay
         while time.monotonic() < deadline:
             if self._lifetime.should_stop():
@@ -196,45 +220,62 @@ class ActorSupervisor:
         def _watch() -> None:
             while not self._lifetime.should_stop():
                 time.sleep(poll_interval_s)
-                for actor_id, thread in self.threads().items():
-                    with self._lock:
-                        if actor_id in self._failed:
-                            continue
-                        spawned_at = self._spawned_at.get(actor_id)
-                    if not thread.is_alive():
-                        continue  # crash path owns dead threads
-                    age = heartbeats.age(f"actor-{actor_id}")
-                    since_spawn = (
-                        time.monotonic() - spawned_at
-                        if spawned_at is not None
-                        else age
-                    )
-                    if age is None or (since_spawn is not None and age > since_spawn):
-                        # No beat since the latest (re)spawn: grade the fresh
-                        # thread on its own clock, with compile headroom.
-                        age = since_spawn if since_spawn is not None else 0.0
-                        budget = 4.0 * self.wedge_timeout_s
-                    else:
-                        budget = self.wedge_timeout_s
-                    if age <= budget:
-                        continue
-                    with self._lock:
-                        if actor_id in self._failed:
-                            continue
-                        self._failed.add(actor_id)
-                    self._propagate(
-                        actor_id,
-                        ComponentFailure(
-                            f"actor-{actor_id}",
-                            f"wedged: thread alive but silent for {age:.1f}s "
-                            f"(wedge_timeout_s={self.wedge_timeout_s})",
-                        ),
+                try:
+                    self._watch_once(heartbeats)
+                except Exception:  # noqa: BLE001 — a poll that raises must
+                    # not silently disarm wedge detection for the rest of
+                    # the run; log, count, keep polling.
+                    import traceback
+
+                    get_registry().counter(
+                        "stoix_tpu_resilience_watchdog_errors_total",
+                        "Supervisor wedge-watchdog polls that raised",
+                    ).inc()
+                    self._log.error(
+                        "[supervisor] wedge-watchdog poll FAILED "
+                        "(detection still armed):\n%s", traceback.format_exc(),
                     )
 
         self._watchdog = threading.Thread(
             target=_watch, name="supervisor-watchdog", daemon=True
         )
         self._watchdog.start()
+
+    def _watch_once(self, heartbeats: HeartbeatBoard) -> None:
+        for actor_id, thread in self.threads().items():
+            with self._lock:
+                if actor_id in self._failed:
+                    continue
+                spawned_at = self._spawned_at.get(actor_id)
+            if not thread.is_alive():
+                continue  # crash path owns dead threads
+            age = heartbeats.age(f"actor-{actor_id}")
+            since_spawn = (
+                time.monotonic() - spawned_at
+                if spawned_at is not None
+                else age
+            )
+            if age is None or (since_spawn is not None and age > since_spawn):
+                # No beat since the latest (re)spawn: grade the fresh
+                # thread on its own clock, with compile headroom.
+                age = since_spawn if since_spawn is not None else 0.0
+                budget = 4.0 * self.wedge_timeout_s
+            else:
+                budget = self.wedge_timeout_s
+            if age <= budget:
+                continue
+            with self._lock:
+                if actor_id in self._failed:
+                    continue
+                self._failed.add(actor_id)
+            self._propagate(
+                actor_id,
+                ComponentFailure(
+                    f"actor-{actor_id}",
+                    f"wedged: thread alive but silent for {age:.1f}s "
+                    f"(wedge_timeout_s={self.wedge_timeout_s})",
+                ),
+            )
 
 
 def supervisor_from_config(

@@ -42,7 +42,9 @@ from stoix_tpu.resilience.errors import CompileStallError
 
 # Exit code for the hard-exit path: distinct from Python's 1 and SIGKILL's
 # 137 so schedulers/wrappers can tell "watchdog shot a wedged run" apart.
-EXIT_CODE_STALL = 86
+# Declared in the canonical registry (resilience/exit_codes.py, STX018);
+# re-exported here because this module has owned the name since PR 4.
+from stoix_tpu.resilience.exit_codes import EXIT_CODE_STALL
 
 _board_lock = threading.Lock()
 _board: Optional[HeartbeatBoard] = None

@@ -8,12 +8,15 @@ asserts zero error-severity findings. Consuming the machine-readable JSON
 same contract CI uses, so a format regression fails here too.
 
 This subsumes the old test_lint.py::test_lint_gate_clean and puts the
-JAX-aware rules (STX005-STX009) AND the sharding-layer rules (STX010-STX013,
-backed by the repo-wide mesh model in analysis/meshmodel.py) on the
-always-green surface: an axis-name typo, a reused PRNG key, a typo'd config
-read, a P() axis no mesh declares, a shard_map replication lie, a recompile
-hazard, or a host-divergent value feeding a collective anywhere in
-stoix_tpu/ now fails the test suite directly.
+JAX-aware rules (STX005-STX009), the sharding-layer rules (STX010-STX013,
+backed by analysis/meshmodel.py), AND the host-concurrency rules
+(STX014-STX018, backed by analysis/threadmodel.py + the exit-code registry)
+on the always-green surface: an axis-name typo, a reused PRNG key, a typo'd
+config read, a P() axis no mesh declares, a shard_map replication lie, a
+recompile hazard, a host-divergent value feeding a collective, an
+unsynchronized shared mutation, a blocking call under a lock, a future
+nobody error-completes, a leaked thread/timer, or a bare exit-code literal
+anywhere in stoix_tpu/ now fails the test suite directly.
 """
 
 import json
@@ -56,6 +59,30 @@ def test_mesh_rules_clean_json():
             "stoix_tpu.analysis",
             "--select",
             "STX010,STX011,STX012,STX013",
+            "--format",
+            "json",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    findings = json.loads(proc.stdout)
+    assert proc.returncode == 0 and findings == [], findings
+
+
+def test_concurrency_rules_clean_json():
+    # The ISSUE 13 acceptance criterion, verbatim: the five host-concurrency
+    # rules (threadmodel-backed STX014-017 + the exit-code registry STX018)
+    # alone exit 0 on the shipped tree — a narrower, faster assertion than
+    # the full gate, so a future full-gate allowlist change cannot silently
+    # waive them.
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "stoix_tpu.analysis",
+            "--select",
+            "STX014,STX015,STX016,STX017,STX018",
             "--format",
             "json",
         ],

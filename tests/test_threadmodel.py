@@ -1,0 +1,682 @@
+"""Host-concurrency static analysis (ISSUE 13): the threadmodel-backed
+rules STX014-STX018.
+
+Three layers, mirroring the PR 5/6 precedent:
+
+  * **Seeded violations in copies of real modules** (the acceptance
+    criterion): each rule is proven live by mutating one invariant out of a
+    real concurrency module (supervisor/fleet/server/watchdog) and catching
+    it at the exact file:line — not just synthetic fixtures. The unmodified
+    copy must stay clean, so the seed is the ONLY delta.
+  * **Targeted semantics**: the exemptions that make the repo's sanctioned
+    designs pass (atomic single-reference assignment, lock-range nesting,
+    try/finally completion, daemon threads, registry-resolved exits).
+  * **Pinned regressions for the true positives fixed this PR**: the
+    supervisor respawn thread converts its own failure into the typed
+    poison-pill instead of dying silently; the wedge watchdog survives a
+    raising poll; the exit-code consolidation stays consolidated (the
+    pre-consolidation forms re-trip STX018).
+
+The registry-driven fixture replay in tests/test_lint.py auto-covers the
+five rules' flag/clean snippets; the repo-wide clean gate (incl. a
+--select STX014..018 run) lives in tests/test_analysis_clean.py.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from stoix_tpu.analysis import get_rule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _line_of(source, needle, extra=0):
+    return source[: source.index(needle)].count("\n") + 1 + extra
+
+
+# ---------------------------------------------------------------------------
+# Seeded violations in copies of real modules — one per rule, exact line.
+
+
+def test_stx014_catches_unlocked_registry_write_in_supervisor_copy():
+    # Strip the lock from _respawn's thread-registry update: the respawn
+    # root now mutates dicts that register()/the watchdog read under
+    # ActorSupervisor._lock — the torn-restart race.
+    rule = get_rule("STX014")
+    source = _read("stoix_tpu/resilience/supervisor.py")
+    rel = "stoix_tpu/resilience/_supervisor_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = (
+        "        with self._lock:\n"
+        "            self._threads[actor_id] = thread\n"
+        "            self._spawned_at[actor_id] = time.monotonic()\n"
+        "        thread.start()\n"
+    )
+    assert target in source
+    bad = source.replace(
+        target, target.replace("with self._lock:", "if True:"), 1
+    )
+    findings = rule.run_on_source(bad, rel=rel)
+    assert findings, "seeded unlocked mutation not caught"
+    assert all(f.rule == "STX014" for f in findings)
+    # The unlocked write inside _respawn is pinned at its exact line.
+    seeded_line = _line_of(source, target, extra=1)
+    assert seeded_line in [f.line for f in findings], (
+        [(f.line, f.message) for f in findings],
+        seeded_line,
+    )
+    assert any("_threads" in f.message for f in findings)
+
+
+def test_stx015_catches_join_under_lock_in_fleet_copy():
+    # Move FleetCoordinator.stop()'s thread joins inside the flag lock: the
+    # monitor thread takes _flag_lock in _declare_partition, so stop()
+    # would deadlock against the very thread it joins.
+    rule = get_rule("STX015")
+    source = _read("stoix_tpu/resilience/fleet.py")
+    rel = "stoix_tpu/resilience/_fleet_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = (
+        "        for thread in (self._publisher, self._monitor):\n"
+        "            if thread is not None:\n"
+        "                thread.join(timeout=5.0)\n"
+    )
+    assert target in source
+    seeded = (
+        "        with self._flag_lock:\n"
+        "            for thread in (self._publisher, self._monitor):\n"
+        "                if thread is not None:\n"
+        "                    thread.join(timeout=5.0)\n"
+    )
+    bad = source.replace(target, seeded, 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert len(findings) == 1 and findings[0].rule == "STX015", findings
+    assert findings[0].line == _line_of(bad, "thread.join(timeout=5.0)")
+    assert "_flag_lock" in findings[0].message
+
+
+def test_stx016_catches_dropped_error_completion_in_server_copy():
+    # Remove the worker's typed-error drain: a failing batch would leave
+    # every submitted future unresolved — the exact caller-hang the serve
+    # contract forbids. Flagged at the receipt line.
+    rule = get_rule("STX016")
+    source = _read("stoix_tpu/serve/server.py")
+    rel = "stoix_tpu/serve/_server_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = (
+        "                for request in batch:\n"
+        "                    request.set_error(exc)\n"
+    )
+    assert target in source
+    bad = source.replace(target, "                pass  # requests dropped\n", 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert len(findings) == 1 and findings[0].rule == "STX016", findings
+    receipt = "batch = self._batcher.next_batch(idle_timeout=0.05)"
+    assert findings[0].line == _line_of(bad, receipt)
+    assert "'batch'" in findings[0].message
+
+
+def test_stx017_catches_uncancellable_hard_timer_in_watchdog_copy():
+    # Remove __exit__'s hard-timer disarm: the os._exit(86) timer armed by
+    # _on_deadline could then fire after the protected section completed.
+    rule = get_rule("STX017")
+    source = _read("stoix_tpu/resilience/watchdog.py")
+    rel = "stoix_tpu/resilience/_watchdog_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = (
+        "        if self._hard_timer is not None:\n"
+        "            self._hard_timer.cancel()\n"
+    )
+    assert target in source
+    bad = source.replace(target, "        pass\n", 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert len(findings) == 1 and findings[0].rule == "STX017", findings
+    armed = "self._hard_timer = threading.Timer(self.hard_exit_grace_s, self._hard_exit)"
+    assert findings[0].line == _line_of(bad, armed)
+    assert "cancel" in findings[0].message
+
+
+def test_stx018_catches_bare_literal_in_fleet_copy():
+    rule = get_rule("STX018")
+    source = _read("stoix_tpu/resilience/fleet.py")
+    rel = "stoix_tpu/resilience/_fleet_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = "os._exit(EXIT_CODE_FLEET_PARTITION)"
+    assert target in source
+    bad = source.replace(target, "os._exit(87)", 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert len(findings) == 1 and findings[0].rule == "STX018", findings
+    assert findings[0].line == _line_of(source, target)
+    assert "87" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Pinned regressions: the exit-code consolidation stays consolidated. Each
+# test reconstructs the PRE-consolidation form of a real module and asserts
+# STX018 trips — reverting a fix re-fails the suite.
+
+
+def test_stx018_pre_consolidation_local_constant_flags():
+    # fleet.py used to declare EXIT_CODE_FLEET_PARTITION = 87 locally; a
+    # local EXIT_CODE_* fed to os._exit must flag (the collision hazard).
+    rule = get_rule("STX018")
+    source = _read("stoix_tpu/resilience/fleet.py")
+    imp = "from stoix_tpu.resilience.exit_codes import EXIT_CODE_FLEET_PARTITION"
+    assert imp in source
+    bad = source.replace(imp, "EXIT_CODE_FLEET_PARTITION = 87", 1)
+    findings = rule.run_on_source(bad, rel="stoix_tpu/resilience/_fleet_copy.py")
+    assert findings and all(
+        "EXIT_CODE_FLEET_PARTITION" in f.message for f in findings
+    )
+
+
+def test_stx018_pre_consolidation_faultinject_literal_flags():
+    rule = get_rule("STX018")
+    source = _read("stoix_tpu/resilience/faultinject.py")
+    fixed = "os._exit(EXIT_CODE_FAILURE)"
+    assert fixed in source
+    bad = source.replace(fixed, "os._exit(1)", 1)
+    findings = rule.run_on_source(bad, rel="stoix_tpu/resilience/_fi_copy.py")
+    assert len(findings) == 1 and "literal 1" in findings[0].message
+
+
+def test_no_bare_exit_literals_anywhere_in_package():
+    # The acceptance grep, as a test (the exact pattern from the issue):
+    # `os._exit(8x` / `sys.exit(<digit>` must not appear in stoix_tpu/
+    # source — every real exit resolves through exit_codes.py constants.
+    pattern = re.compile(r"os\._exit\(8|sys\.exit\([0-9]")
+    offenders = []
+    for root, _dirs, files in os.walk(os.path.join(REPO, "stoix_tpu")):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                for i, line in enumerate(f, 1):
+                    if pattern.search(line):
+                        offenders.append(f"{os.path.relpath(path, REPO)}:{i}: {line.strip()}")
+    assert offenders == [], offenders
+
+
+def test_design_exit_table_matches_registry():
+    # The §2.6 table is rendered from exit_codes.design_table_rows(); every
+    # registered code must appear verbatim, and the table must not carry
+    # codes the registry does not know.
+    from stoix_tpu.resilience import exit_codes
+
+    design = _read("docs/DESIGN.md")
+    for row in exit_codes.design_table_rows():
+        assert row in design, f"DESIGN.md §2.6 is missing/stale for row:\n{row}"
+    table_codes = set(
+        int(m.group(1))
+        for m in re.finditer(r"^\| (\d+) \| `EXIT_CODE_", design, re.MULTILINE)
+    )
+    assert table_codes == set(exit_codes.REGISTRY), (
+        table_codes,
+        set(exit_codes.REGISTRY),
+    )
+
+
+def test_registry_rejects_code_collision_over_records():
+    # The dict-build dedups by code, so validation must run over the RECORD
+    # tuple: appending a second record claiming 87 (the exact next-subsystem
+    # collision the module documents) must be detectable there.
+    from stoix_tpu.resilience import exit_codes
+
+    colliding = exit_codes._RECORDS + (
+        exit_codes.ExitCode(87, "EXIT_CODE_SOMETHING_NEW", "x", "y"),
+    )
+    codes = [r.code for r in colliding]
+    assert len(set(codes)) != len(codes)
+    # And the shipped tuple is collision-free by the same measure.
+    shipped = [r.code for r in exit_codes._RECORDS]
+    assert len(set(shipped)) == len(shipped)
+    assert len(exit_codes.REGISTRY) == len(exit_codes._RECORDS)
+
+
+def test_analysis_cli_usage_code_mirrors_registry():
+    # The analysis CLI cannot import the registry (the resilience package
+    # __init__ drags jax into the dependency-free gate), so it mirrors the
+    # constant — this pin is what keeps the mirror honest.
+    from stoix_tpu.analysis import __main__ as cli
+    from stoix_tpu.resilience import exit_codes
+
+    assert cli.EXIT_CODE_USAGE == exit_codes.EXIT_CODE_USAGE
+
+
+def test_stx017_daemon_assign_in_other_function_does_not_leak():
+    # `t.daemon = True` on a SAME-NAMED local in an unrelated function must
+    # not mark this function's non-daemon thread as daemon (the binding key
+    # is function-scoped; the daemon scan must be too).
+    rule = get_rule("STX017")
+    source = (
+        "import threading\n\n\ndef run_a(target):\n"
+        "    t = threading.Thread(target=target)\n"
+        "    t.start()\n\n\n"
+        "def run_b(target):\n"
+        "    t = threading.Thread(target=target)\n"
+        "    t.daemon = True\n"
+        "    t.start()\n"
+        "    t.join(timeout=1.0)\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1 and findings[0].line == 5, findings
+    assert "non-daemon" in findings[0].message
+
+
+def test_registry_codes_are_unique_and_canonical():
+    from stoix_tpu.resilience import exit_codes
+    from stoix_tpu.resilience.fleet import EXIT_CODE_FLEET_PARTITION
+    from stoix_tpu.resilience.integrity import EXIT_CODE_STATE_CORRUPTION
+    from stoix_tpu.resilience.watchdog import EXIT_CODE_STALL
+
+    # The historical per-module names are the SAME objects as the registry's.
+    assert EXIT_CODE_STALL == exit_codes.EXIT_CODE_STALL == 86
+    assert EXIT_CODE_FLEET_PARTITION == exit_codes.EXIT_CODE_FLEET_PARTITION == 87
+    assert EXIT_CODE_STATE_CORRUPTION == exit_codes.EXIT_CODE_STATE_CORRUPTION == 88
+    names = [r.name for r in exit_codes.REGISTRY.values()]
+    assert len(set(names)) == len(names)
+    for code, record in exit_codes.REGISTRY.items():
+        assert record.code == code
+        assert getattr(exit_codes, record.name) == code
+
+
+# ---------------------------------------------------------------------------
+# Targeted semantics (the satellite list).
+
+
+def test_stx014_atomic_assignment_exemption_engine_discipline():
+    # A COPY of the real engine-style swap: locked version bump + unlocked
+    # single-reference read is sanctioned; the tuple-assign step update in
+    # hotswap style is atomic per element.
+    rule = get_rule("STX014")
+    source = (
+        "import threading\n\n\nclass Watcher:\n"
+        "    def __init__(self):\n"
+        "        self.current_step = 0\n"
+        "        self._t = threading.Thread(target=self._run, daemon=True)\n\n"
+        "    def _run(self):\n"
+        "        step = self._poll()\n"
+        "        previous, self.current_step = self.current_step, step\n"
+        "        self._log(previous)\n\n"
+        "    def snapshot(self):\n"
+        "        return self.current_step\n"
+    )
+    assert rule.run_on_source(source) == []
+    # The same shape through a helper call is read-modify-write: flagged.
+    bad = source.replace(
+        "previous, self.current_step = self.current_step, step",
+        "previous, self.current_step = self.current_step, self._merge(self.current_step)",
+    )
+    findings = rule.run_on_source(bad)
+    assert len(findings) == 1 and "current_step" in findings[0].message
+
+
+def test_stx015_lock_range_nesting_inner_and_outer_held():
+    rule = get_rule("STX015")
+    source = (
+        "import threading\n\n\nclass Nested:\n"
+        "    def __init__(self, q):\n"
+        "        self._outer = threading.Lock()\n"
+        "        self._inner = threading.Lock()\n"
+        "        self._q = q\n\n"
+        "    def step(self):\n"
+        "        with self._outer:\n"
+        "            with self._inner:\n"
+        "                return self._q.get(timeout=1.0)\n"
+    )
+    findings = rule.run_on_source(source)
+    assert len(findings) == 1
+    assert "_inner" in findings[0].message and "_outer" in findings[0].message
+
+
+def test_stx015_condition_wait_exempt_even_under_outer_lock():
+    # cond.wait() releases ITS OWN lock only: waiting on the held condition
+    # is sanctioned; the rule still sees the outer lock as held but the
+    # receiver-in-held-set exemption applies to the condition.
+    rule = get_rule("STX015")
+    source = (
+        "import threading\n\n\nclass Batcher:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._pending = []\n\n"
+        "    def wait_for_work(self, timeout):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait(timeout=timeout)\n"
+        "            return len(self._pending)\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx016_try_finally_completion_recognized():
+    rule = get_rule("STX016")
+    source = (
+        "import threading\n\n\nclass Server:\n"
+        "    def __init__(self, q, engine):\n"
+        "        self._q = q\n"
+        "        self._engine = engine\n"
+        "        self._worker = threading.Thread(target=self._loop, daemon=True)\n\n"
+        "    def _loop(self):\n"
+        "        while True:\n"
+        "            request = self._q.get(timeout=1.0)\n"
+        "            try:\n"
+        "                request.set_result(self._engine.infer(request))\n"
+        "            finally:\n"
+        "                if not request.done():\n"
+        "                    request.set_error(RuntimeError('worker died'))\n"
+    )
+    assert rule.run_on_source(source) == []
+    # Dropping the finally re-exposes the region.
+    bad = source[: source.index("            try:")] + (
+        "            request.set_result(self._engine.infer(request))\n"
+    )
+    findings = rule.run_on_source(bad)
+    assert len(findings) == 1 and findings[0].rule == "STX016"
+
+
+def test_stx017_daemon_thread_exemption():
+    rule = get_rule("STX017")
+    daemon = (
+        "import threading\n\n\nclass Poller:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._run, daemon=True)\n\n"
+        "    def start(self):\n"
+        "        self._t.start()\n\n"
+        "    def _run(self):\n"
+        "        pass\n"
+    )
+    assert rule.run_on_source(daemon) == []
+    # The identical module without daemon=True (and no join) flags.
+    bad = daemon.replace(", daemon=True", "")
+    findings = rule.run_on_source(bad)
+    assert len(findings) == 1 and "non-daemon" in findings[0].message
+
+
+def test_stx017_factory_return_transfers_ownership():
+    rule = get_rule("STX017")
+    source = (
+        "import threading\n\n\ndef make_actor(run):\n"
+        "    return threading.Thread(target=run)\n"
+    )
+    assert rule.run_on_source(source) == []
+
+
+def test_stx018_dynamic_values_pass_unknown_literal_flags():
+    rule = get_rule("STX018")
+    assert (
+        rule.run_on_source("import sys\n\n\ndef bye(rc):\n    sys.exit(rc)\n") == []
+    )
+    # An unknown literal — a code the registry has never heard of — flags
+    # like any literal: declare it first.
+    findings = rule.run_on_source(
+        "import os\n\n\ndef bye():\n    os._exit(93)\n"
+    )
+    assert len(findings) == 1 and "93" in findings[0].message
+
+
+def test_stx016_noqa_with_reason_suppresses_and_reason_required():
+    rule = get_rule("STX016")
+    flagging = rule.flag_snippets[0]
+    needle = "batch = self._batcher.next_batch(idle_timeout=0.1)"
+    suppressed = flagging.replace(
+        needle, needle + "  # noqa: STX016 — engine.infer cannot raise here"
+    )
+    assert rule.run_on_source(suppressed) == []
+    noqa_rule = get_rule("NOQA")
+    bare_coded = flagging.replace(needle, needle + "  # noqa: STX016")
+    findings = noqa_rule.run_on_source(bare_coded)
+    assert len(findings) == 1 and "STX016" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Pinned regressions for the true positives fixed this PR (supervisor).
+
+
+class _FakeLifetime:
+    def __init__(self):
+        self._stop = False
+
+    def should_stop(self):
+        return self._stop
+
+    def stop(self):
+        self._stop = True
+
+
+class _FakePipeline:
+    def __init__(self):
+        self.failures = []
+
+    def fail(self, actor_id, failure):
+        self.failures.append((actor_id, failure))
+
+
+class _ExplodingParamServer:
+    def __init__(self):
+        self.failed = []
+
+    def reprime(self, actor_id):
+        raise RuntimeError("param server already torn down")
+
+    def fail(self, failure, actor_id):
+        self.failed.append((actor_id, failure))
+
+
+def test_respawn_failure_propagates_typed_poison_pill():
+    # THE fixed true positive: a respawn thread whose reprime raises used to
+    # die silently — actor never restarted, learner blocked until its 180 s
+    # collect timeout. It must now convert the failure into the
+    # ComponentFailure poison-pill (typed-error completion for the thread
+    # root's obligation).
+    from stoix_tpu.resilience.errors import ComponentFailure
+    from stoix_tpu.resilience.supervisor import ActorSupervisor
+
+    lifetime = _FakeLifetime()
+    pipeline = _FakePipeline()
+    params = _ExplodingParamServer()
+    sup = ActorSupervisor(
+        lifetime, pipeline, param_server=params,
+        max_restarts=2, backoff_base_s=0.01, backoff_max_s=0.01,
+    )
+    import threading
+
+    started = threading.Event()
+    sup.register(0, lambda: threading.Thread(target=started.set, daemon=True))
+    sup.report_crash(0, RuntimeError("actor exploded"))
+    deadline = time.monotonic() + 5.0
+    while not pipeline.failures and time.monotonic() < deadline:
+        time.sleep(0.01)
+    lifetime.stop()
+    assert pipeline.failures, "respawn failure died silently — no poison pill"
+    actor_id, failure = pipeline.failures[0]
+    assert actor_id == 0 and isinstance(failure, ComponentFailure)
+    assert "respawn failed" in str(failure)
+    assert params.failed and params.failed[0][0] == 0
+
+
+class _FlakyHeartbeats:
+    """age() raises on its first call (the pre-fix watchdog-killer), then
+    reports an age that is over budget but under since-spawn."""
+
+    def __init__(self):
+        self.calls = 0
+        self.t0 = time.monotonic()
+
+    def age(self, component):
+        self.calls += 1
+        if self.calls == 1:
+            raise RuntimeError("registry snapshot torn")
+        return max(0.0, time.monotonic() - self.t0 - 0.05)
+
+
+def test_wedge_watchdog_survives_raising_poll():
+    # THE second fixed true positive: one raising poll used to kill the
+    # wedge-watchdog thread silently, disarming wedge detection for the rest
+    # of the run. It must now log, count, and keep polling — the wedged
+    # actor is still detected afterwards.
+    from stoix_tpu.resilience.errors import ComponentFailure
+    from stoix_tpu.resilience.supervisor import ActorSupervisor
+
+    import threading
+
+    lifetime = _FakeLifetime()
+    pipeline = _FakePipeline()
+    sup = ActorSupervisor(
+        lifetime, pipeline, max_restarts=0, wedge_timeout_s=0.05,
+    )
+
+    def _alive():
+        while not lifetime.should_stop():
+            time.sleep(0.01)
+
+    heartbeats = _FlakyHeartbeats()
+    sup.register(0, lambda: threading.Thread(target=_alive, daemon=True))
+    sup.start_watchdog(heartbeats, poll_interval_s=0.02)
+    deadline = time.monotonic() + 5.0
+    while not pipeline.failures and time.monotonic() < deadline:
+        time.sleep(0.01)
+    lifetime.stop()
+    assert heartbeats.calls > 1, "watchdog died on the first raising poll"
+    assert pipeline.failures, "wedge never detected after the raising poll"
+    _actor_id, failure = pipeline.failures[0]
+    assert isinstance(failure, ComponentFailure) and "wedged" in str(failure)
+
+
+# ---------------------------------------------------------------------------
+# CLI + preflight wiring.
+
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "stoix_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+    )
+
+
+def test_list_rules_includes_concurrency_family_in_order():
+    proc = _run_cli(["--list-rules"])
+    assert proc.returncode == 0
+    positions = [proc.stdout.index(rid) for rid in
+                 ("STX013", "STX014", "STX015", "STX016", "STX017", "STX018")]
+    assert positions == sorted(positions), "registry print order broken"
+
+
+def test_cli_statistics_reports_rule_counts_and_model_sizes():
+    proc = _run_cli(
+        ["--select", "STX014,STX015,STX016,STX017,STX018", "--statistics",
+         "--format", "json", "--skip-external", "stoix_tpu/serve", "stoix_tpu/resilience"]
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.strip() == "[]"  # stdout stays the findings contract
+    for rid in ("STX014", "STX015", "STX016", "STX017", "STX018"):
+        assert re.search(rf"\[stats\]\s+{rid}\s+findings=0", proc.stderr), proc.stderr
+    m = re.search(r"\[stats\] threadmodel: (\d+) spawn", proc.stderr)
+    assert m and int(m.group(1)) > 0, proc.stderr
+    assert "meshmodel:" in proc.stderr
+
+
+def test_cli_github_format_for_seeded_stx018(tmp_path):
+    scratch = os.path.join(REPO, "stoix_tpu", "_stx18_scratch_probe.py")
+    with open(scratch, "w") as f:
+        f.write("import os\n\n\ndef die():\n    os._exit(99)\n")
+    try:
+        proc = _run_cli(
+            ["--select", "STX018", "--format", "github",
+             "stoix_tpu/_stx18_scratch_probe.py"]
+        )
+    finally:
+        os.remove(scratch)
+    assert proc.returncode == 1
+    annotations = [l for l in proc.stdout.splitlines() if l.startswith("::error")]
+    assert annotations and "title=STX018" in annotations[0]
+    assert "file=stoix_tpu/_stx18_scratch_probe.py,line=5" in annotations[0]
+
+
+def test_preflight_reports_concurrency_model_row(monkeypatch, capsys):
+    from stoix_tpu import launcher
+    from stoix_tpu.resilience import preflight
+
+    def fake_run_preflight(configs=None, settings=None):
+        report = preflight.PreflightReport()
+        report.add("backend_probe", "pass", "stubbed")
+        return report
+
+    monkeypatch.setattr(preflight, "run_preflight", fake_run_preflight)
+    rc = launcher.run_preflight_only([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "concurrency-model" in out
+    m = re.search(r"concurrency-model\s+\[PASS\]\s+(\d+) thread spawn", out)
+    assert m and int(m.group(1)) > 0, out
+    assert "completion obligation(s) modeled" in out
+
+
+def test_preflight_fails_on_silently_empty_thread_model(monkeypatch, capsys):
+    from stoix_tpu import launcher
+    from stoix_tpu.analysis import threadmodel
+    from stoix_tpu.resilience import preflight
+
+    def fake_run_preflight(configs=None, settings=None):
+        report = preflight.PreflightReport()
+        report.add("backend_probe", "pass", "stubbed")
+        return report
+
+    monkeypatch.setattr(preflight, "run_preflight", fake_run_preflight)
+    monkeypatch.setattr(
+        threadmodel,
+        "repo_summary",
+        lambda paths=None, repo=None: {
+            "files": 180, "spawns": 0, "roots": 0, "locks": 0,
+            "shared": 0, "obligations": 0,
+        },
+    )
+    rc = launcher.run_preflight_only([])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "EMPTY model" in out
+
+
+# ---------------------------------------------------------------------------
+# Model sanity on the real tree: the numbers the preflight row rests on.
+
+
+def test_threadmodel_sees_the_real_concurrency_layer():
+    from stoix_tpu.analysis import threadmodel
+
+    totals = threadmodel.repo_summary(["stoix_tpu"])
+    # The shipped tree has ~12 spawn sites (server worker, hot-swap watcher,
+    # fleet publisher/monitor/exit-timer, watchdog timers, supervisor
+    # respawn/watchdog, evaluator, poller, actor factories) and 20+ locks;
+    # assert loose floors so refactors trip this only when the model goes
+    # BLIND, not when a thread is added/removed.
+    assert totals["spawns"] >= 8, totals
+    assert totals["locks"] >= 10, totals
+    assert totals["obligations"] >= 1, totals  # the serve worker's batch
+
+
+@pytest.mark.parametrize("rel", [
+    os.path.join("stoix_tpu", "serve", "server.py"),
+    os.path.join("stoix_tpu", "resilience", "supervisor.py"),
+    os.path.join("stoix_tpu", "resilience", "watchdog.py"),
+    os.path.join("stoix_tpu", "resilience", "fleet.py"),
+])
+def test_threadmodel_finds_spawns_in_known_concurrency_modules(rel):
+    import ast as _ast
+
+    from stoix_tpu.analysis.threadmodel import ModuleThreadModel
+
+    model = ModuleThreadModel(_ast.parse(_read(rel)))
+    assert model.spawns, rel
+    assert model.spawned_root_labels, rel
