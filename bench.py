@@ -13,7 +13,7 @@ and Sebulba hot paths are perf-tracked alongside the PPO path
     sebulba_ppo_cartpole      — actor/learner split over the native C++ pool
 
 Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
-                       [--serve] [--cpu] [--reps N] [--integrity]
+                       [--serve] [--replay] [--cpu] [--reps N] [--integrity]
        python bench.py --check BASELINE.json --candidate CAND.json
                        [--check-threshold 0.05] [--check-require-all]
   --all       run all five tracked configs, one JSON line each
@@ -31,6 +31,15 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
               carries direction=lower_is_better (the --check gate inverts
               its comparison), the full latency percentile set, offered vs
               achieved QPS, batch-fill ratio, shed count, and hot-swap count
+  --replay    the device-resident sharded replay service microbench
+              (docs/DESIGN.md §2.10): prioritized add/sample/set_priorities
+              cycles against an 8-shard (on CPU: virtual-device) mesh,
+              reporting sampled items/sec as the headline plus add
+              throughput and the transport ledger — ingested_bytes_total
+              (raw experience, never crosses shards) vs
+              sampled_bytes_crossed (the sample psum's payload) — so the
+              samples-not-experience claim is a measured number the --check
+              gate can hold
   --integrity arm the state-integrity sentinel (arch.integrity, docs/
               DESIGN.md §2.9) in the Anakin probe run so the payload's
               first-class `integrity` fields (enabled / fingerprint_checks /
@@ -349,6 +358,7 @@ def main() -> None:
     sebulba = "--sebulba" in sys.argv
     pixel = "--pixel" in sys.argv  # Sebulba on 84x84x4 frames + Nature CNN
     serve = "--serve" in sys.argv  # latency frontier: dynamic-batching policy serving
+    replay = "--replay" in sys.argv  # sharded replay service microbench
     # Arm the state-integrity sentinel in the Anakin probe run so the payload's
     # integrity fields carry a MEASURED per-window fingerprint overhead
     # (docs/DESIGN.md §2.9) instead of the disabled zeros.
@@ -365,12 +375,18 @@ def main() -> None:
         # never runs in the serving workload (its integrity story is the
         # hot-swap canary, always on).
         sys.exit("--integrity arms the TRAINING sentinel; it does not compose with --serve")
-    if run_all and (large or cartpole or sebulba or pixel or serve):
+    if replay and (large or cartpole or sebulba or pixel or serve):
+        sys.exit("--replay is its own (transport-shaped) workload; it does not compose")
+    if replay and integrity_on:
+        sys.exit("--integrity arms the TRAINING sentinel; it does not compose with --replay")
+    if run_all and (large or cartpole or sebulba or pixel or serve or replay):
         sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
     env_tag = "cartpole" if cartpole else "ant"
     if run_all:
         metric = "bench_all"
+    elif replay:
+        metric = "replay_sharded_sample_items_per_sec"
     elif serve:
         metric = "serve_ppo_identity_game_p99_latency_ms"
     elif pixel:
@@ -509,6 +525,16 @@ def main() -> None:
     # THIS process's own backend init, which the probe cannot fully vouch for.
     watchdog.start()
 
+    if replay and "--cpu" in sys.argv:
+        # The replay microbench measures CROSS-SHARD transport: a 1-device
+        # CPU run would measure nothing, so fan the host platform out to 8
+        # virtual devices (the tests/conftest harness) before jax imports.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            )
+
     import jax
 
     if "--cpu" in sys.argv:
@@ -609,6 +635,10 @@ def main() -> None:
                 integrity_on=integrity_on,
             )
         ])
+        return
+
+    if replay:
+        _finish([_run_replay(metric, smoke, n_devices, reps=reps)])
         return
 
     if serve:
@@ -896,6 +926,106 @@ def _run_anakin_ppo(
         # Sentinel posture of the probe run (the probe exercises the real
         # runner, fingerprints included when --integrity arms them).
         "integrity": _integrity_report(anakin_runner.LAST_RUN_STATS),
+    }
+
+
+def _run_replay(metric, smoke, n_devices, reps=None) -> dict:
+    """Sharded replay service microbench (docs/DESIGN.md §2.10): prioritized
+    add -> sample -> set_priorities cycles against a data mesh spanning every
+    device, with a DQN-shaped transition row (64-float observations). The
+    headline is sampled items/sec (best rep); the payload's transport ledger
+    — ingested_bytes_total vs sampled_bytes_crossed — is the measured form
+    of the samples-not-experience claim: raw experience is written to its
+    owning shard and never moves, only sampled minibatches (plus index/
+    priority vectors) ride the interconnect."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from stoix_tpu.replay import ShardedReplayService
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("data",))
+    obs_dim = 64
+    item = {
+        "obs": jnp.zeros((obs_dim,), jnp.float32),
+        "action": jnp.zeros((), jnp.int32),
+        "reward": jnp.zeros((), jnp.float32),
+        "done": jnp.zeros((), bool),
+        "next_obs": jnp.zeros((obs_dim,), jnp.float32),
+    }
+    capacity = 512 if smoke else 4096
+    batch = 128 if smoke else 512
+    chunk = (256 if smoke else 2048) // n_devices * n_devices
+    cycles = 8 if smoke else 64
+    service = ShardedReplayService(
+        mesh, item,
+        capacity_per_shard=capacity,
+        sample_batch_size=batch,
+        prioritized=True,
+        priority_exponent=0.6,
+    )
+    base = service.stats()
+    sharded = NamedSharding(mesh, P("data"))
+    rng = np.random.default_rng(0)
+    host_chunk = {
+        "obs": rng.normal(size=(chunk, obs_dim)).astype(np.float32),
+        "action": rng.integers(0, 4, size=(chunk,)).astype(np.int32),
+        "reward": rng.normal(size=(chunk,)).astype(np.float32),
+        "done": np.zeros((chunk,), bool),
+        "next_obs": rng.normal(size=(chunk, obs_dim)).astype(np.float32),
+    }
+    global_chunk = jax.device_put(host_chunk, sharded)
+    key = jax.random.PRNGKey(0)
+
+    def cycle(k):
+        service.add(global_chunk)
+        drawn = service.sample(k)
+        service.set_priorities(drawn.indices, jnp.abs(drawn.probabilities) + 0.5)
+        return drawn
+
+    # Warmup: pay every op's compile outside the timed window.
+    key, wk = jax.random.split(key)
+    jax.block_until_ready(cycle(wk).probabilities)
+
+    rep_sample_rates, rep_add_rates = [], []
+    for _ in range(reps if reps is not None else 3):
+        start = time.perf_counter()
+        drawn = None
+        for _ in range(cycles):
+            key, ck = jax.random.split(key)
+            drawn = cycle(ck)
+        jax.block_until_ready(drawn.probabilities)
+        wall = time.perf_counter() - start
+        rep_sample_rates.append(cycles * batch / wall)
+        rep_add_rates.append(cycles * chunk / wall)
+    best_idx = max(range(len(rep_sample_rates)), key=lambda i: rep_sample_rates[i])
+    stats = service.stats()
+    delta = {k: stats[k] - base[k] for k in stats}
+    occupancy = service.observe()
+    return {
+        "metric": metric,
+        "value": round(rep_sample_rates[best_idx], 1),
+        "unit": (
+            f"sampled transitions/sec ({n_devices}-shard mesh, prioritized, "
+            f"batch {batch}, {obs_dim}-float obs)"
+        ),
+        "vs_baseline": None,
+        **_rep_stats(rep_sample_rates),
+        "add_items_per_sec": round(rep_add_rates[best_idx], 1),
+        "sample_items_per_sec": round(rep_sample_rates[best_idx], 1),
+        "shards": n_devices,
+        "ingested_bytes_total": delta["ingested_bytes_total"],
+        "sampled_bytes_crossed": delta["sampled_bytes_crossed"],
+        "sampled_to_ingested_ratio": round(
+            delta["sampled_bytes_crossed"] / max(delta["ingested_bytes_total"], 1), 4
+        ),
+        "occupancy": occupancy["occupancy"],
+        "priority_mass": occupancy["priority_mass"],
+        # The microbench drives the service directly (no runner, no
+        # sentinel): disabled shape, never a missing key.
+        "integrity": _integrity_report(None),
     }
 
 

@@ -16,12 +16,63 @@ it keeps sampling inside the jitted learner.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 Array = jax.Array
+
+
+class EmptyBufferSampleError(RuntimeError):
+    """Sampling a buffer that cannot sample yet (docs/DESIGN.md §2.10).
+
+    The buffers silently return ZERO-initialized items/sequences when
+    nothing sampleable has been written (the documented foot-gun
+    off_policy_core.require_first_add_samplable guards statically for the
+    warmup-less AZ/MZ family) — this error makes the dynamic case loud
+    under the opt-in debug guard."""
+
+
+_SAMPLE_GUARD = os.environ.get("STOIX_TPU_BUFFER_DEBUG", "") not in ("", "0")
+
+
+def set_sample_guard(enabled: bool) -> bool:
+    """Toggle the debug sample guard (also armed by STOIX_TPU_BUFFER_DEBUG=1).
+
+    The flag is read at TRACE time: programs compiled while it is on carry
+    the check (an eager sample raises EmptyBufferSampleError directly; a
+    traced sample raises through jax.debug.callback at run time, surfacing
+    as an XlaRuntimeError whose message names EmptyBufferSampleError).
+    Returns the previous value so tests can restore it."""
+    global _SAMPLE_GUARD
+    previous = _SAMPLE_GUARD
+    _SAMPLE_GUARD = bool(enabled)
+    return previous
+
+
+def _raise_empty(ok: Any, what: str) -> None:
+    if not bool(ok):
+        raise EmptyBufferSampleError(
+            f"EmptyBufferSampleError: sample() on an unfilled {what} — "
+            "can_sample() is False, the returned batch would be "
+            "zero-initialized garbage (guard armed by "
+            "STOIX_TPU_BUFFER_DEBUG / buffers.set_sample_guard)"
+        )
+
+
+def _guard_sample(ok: Array, what: str) -> None:
+    """Debug-only can_sample enforcement; a literal no-op unless armed."""
+    if not _SAMPLE_GUARD:
+        return
+    if isinstance(ok, jax.core.Tracer):
+        # In-jit path: the callback runs when the compiled program does;
+        # its raise aborts execution with the typed message. Host transfer
+        # is the point here — opt-in debug instrumentation only.
+        jax.debug.callback(_raise_empty, ok, what)  # noqa: STX006 — opt-in debug guard
+    else:
+        _raise_empty(ok, what)
 
 
 class ItemBufferState(NamedTuple):
@@ -73,6 +124,7 @@ def make_item_buffer(
         )
 
     def sample(state: ItemBufferState, key: Array) -> ItemBufferSample:
+        _guard_sample(can_sample(state), "item buffer")
         current_size = jnp.minimum(state.num_added, max_length)
         idx = jax.random.randint(key, (sample_batch_size,), 0, jnp.maximum(current_size, 1))
         return ItemBufferSample(
@@ -175,6 +227,7 @@ def make_trajectory_buffer(
         return _trajectory_add(state, batch, time_capacity)
 
     def sample(state: TrajectoryBufferState, key: Array) -> TrajectoryBufferSample:
+        _guard_sample(can_sample(state), "trajectory buffer")
         row_key, start_key = jax.random.split(key)
         rows = jax.random.randint(row_key, (sample_batch_size,), 0, add_batch_size)
         n_starts, oldest = _valid_starts(state, time_capacity, sample_sequence_length)
@@ -265,6 +318,7 @@ def make_prioritised_trajectory_buffer(
         )
 
     def sample(state: PrioritisedTrajectoryBufferState, key: Array) -> PrioritisedSample:
+        _guard_sample(can_sample(state), "prioritised trajectory buffer")
         n_starts, oldest = _valid_starts(
             TrajectoryBufferState(state.experience, state.insert_pos, state.num_added),
             time_capacity,

@@ -109,17 +109,29 @@ def axis_size(mesh: Mesh, axis: str) -> int:
 
 
 def assemble_global_array(
-    per_device_arrays: Sequence[jax.Array], mesh: Mesh, axis: str = "data"
+    per_device_arrays: Sequence[jax.Array], mesh: Mesh, axis: str = "data",
+    array_axis: int = 0,
 ) -> jax.Array:
     """Build one global array from per-device shards without host concat —
     the Sebulba trajectory hand-off primitive (replaces the reference's
     `jax.device_put_sharded`, sebulba/ff_ppo.py:263; see SURVEY.md §7.1.3).
+
+    `array_axis` names the array dimension the shards tile (and the mesh
+    axis shards): 0 for leading-axis items (the replay service's transition
+    ingestion), 1 for `[T, E]` trajectories whose ENV axis is split across
+    learner devices — assembling those on axis 0 would concatenate
+    different devices' trajectories along TIME, which silently corrupts
+    every cross-step computation downstream (GAE bootstrapping across the
+    device seam).
     """
     shard = per_device_arrays[0]
-    global_shape = (shard.shape[0] * len(per_device_arrays),) + shard.shape[1:]
-    spec = P(*([axis] + [None] * (shard.ndim - 1)))
+    global_shape = list(shard.shape)
+    global_shape[array_axis] = shard.shape[array_axis] * len(per_device_arrays)
+    spec_slots: list = [None] * shard.ndim
+    spec_slots[array_axis] = axis
+    spec = P(*spec_slots)
     return jax.make_array_from_single_device_arrays(
-        global_shape, NamedSharding(mesh, spec), list(per_device_arrays)
+        tuple(global_shape), NamedSharding(mesh, spec), list(per_device_arrays)
     )
 
 

@@ -1,0 +1,22 @@
+"""Device-resident sharded replay service (docs/DESIGN.md §2.10).
+
+Buffer state sharded across learner HBM; prioritized sampling executed where
+the data lives so only sampled minibatches — never raw experience — cross
+the interconnect. `replay.core` is the per-shard functional layer (embeddable
+in any shard_map over the data axis), `replay.service` the host-facing jitted
+program set used by the Sebulba off-policy ingestion path.
+"""
+
+from stoix_tpu.replay.core import (  # noqa: F401 — public API
+    ShardedReplayCore,
+    ShardedReplayState,
+    ShardedSample,
+    make_reference_replay,
+    make_sharded_replay,
+    replicated_key,
+)
+from stoix_tpu.replay.service import (  # noqa: F401
+    ShardedReplayService,
+    service_from_config,
+    tree_bytes,
+)

@@ -1,0 +1,49 @@
+"""ItemBuffer-compatible facade over the sharded replay core.
+
+`systems/off_policy_core.py` (the DQN/SAC/DDPG family) talks to replay
+through the four-function ItemBuffer interface; this wrapper lets the SAME
+per-shard learner run against the cross-shard sampler with no interface
+change — only the sampling semantics move from per-shard-uniform to the
+GLOBAL draw of replay/core.py (`system.replay.impl = sharded`).
+
+The learner's per-shard keys differ across shards by construction (they
+drive env stepping); the global draw needs one key per update-batch replica
+identical across shards, so `sample` first replicates the incoming key over
+the axis (shard 0 wins — an all_gather of 8 bytes).
+"""
+
+from __future__ import annotations
+
+from stoix_tpu.buffers.buffers import ItemBuffer, ItemBufferSample
+from stoix_tpu.replay.core import make_sharded_replay, replicated_key
+
+
+def make_sharded_item_buffer(
+    capacity_per_shard: int,
+    sample_batch_size: int,
+    num_shards: int,
+    min_fill: int,
+    axis: str = "data",
+) -> ItemBuffer:
+    """`sample_batch_size` is GLOBAL; each shard receives its
+    sample_batch_size // num_shards slice — sized so the per-shard batch
+    matches the local impl's, only its content is drawn fleet-wide.
+
+    Always uniform: the four-function ItemBuffer interface has no
+    set_priorities seam, so a prioritized table could never be updated
+    (off_policy_core refuses replay.prioritized on this path; Sebulba
+    ff_dqn is the prioritized consumer, driving the core directly)."""
+    core = make_sharded_replay(
+        capacity=capacity_per_shard,
+        sample_batch_size=sample_batch_size,
+        num_shards=num_shards,
+        axis=axis,
+        prioritized=False,
+        min_fill=min_fill,
+    )
+
+    def sample(state, key):
+        drawn = core.sample(state, replicated_key(key, axis))
+        return ItemBufferSample(experience=drawn.experience)
+
+    return ItemBuffer(core.init, core.add, sample, core.can_sample)

@@ -1,0 +1,286 @@
+"""Per-shard functional core of the device-resident replay service.
+
+The buffer state never leaves learner HBM: each data shard owns an
+independent ring of `capacity` items plus a per-slot priority table, and
+every op is written PER SHARD so it can run inside any `shard_map` over the
+data axis — embedded in an Anakin learner (off_policy_core) or wrapped as a
+standalone jitted program (replay/service.py, the Sebulba path).
+
+Sampling where the data lives (docs/DESIGN.md §2.10; the thesis of
+"In-Network Experience Sampling", arxiv 2110.13506): a draw of the GLOBAL
+batch costs
+
+  1. one `all_gather` of the K scalar shard masses — the cross-shard
+     normalization. Every shard computes the same total mass and the same
+     exclusive-prefix ownership bounds, so the global inverse-CDF partitions
+     the unit interval across shards exactly (shard k owns u in
+     [bounds[k-1], bounds[k]) and the last shard additionally absorbs the
+     floating-point top edge).
+  2. one local prefix-sum + searchsorted per shard (the TPU-friendly
+     sum-tree equivalent: a fused cumsum+searchsorted beats pointer chasing
+     on the VPU and stays inside the compiled program, see buffers.py).
+  3. one `psum` of the OWNER-MASKED sampled rows — each drawn row is owned
+     by exactly one shard, every other shard contributes zeros, so the sum
+     reconstructs the batch on every shard and only the sampled minibatch
+     (plus its indices and probabilities) ever crosses the interconnect.
+     Raw experience never moves.
+
+On a single-shard mesh every collective degenerates to the identity, so the
+sharded sampler is BITWISE equal to the single-device reference below
+(tests/test_replay.py pins it).
+
+Determinism contract: `local_sample` must be called with a key REPLICATED
+across the axis (every shard draws the same uniforms — that is what makes
+ownership a partition). `replicated_key` converts a per-shard key.
+
+Axis names are parameters, never literals, so this module stays axis-generic
+(and STX007-clean by the variable-axis rule).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class ShardedReplayState(NamedTuple):
+    """One shard's view (leaves carry NO shard axis; shard_map adds it)."""
+
+    experience: Any  # pytree, leaves [capacity, ...]
+    priorities: Array  # [capacity] f32 — 0.0 marks an unwritten slot
+    insert_pos: Array  # int32 — next write slot in this shard's ring
+    num_added: Array  # int32 — items ever written to THIS shard
+
+
+class ShardedSample(NamedTuple):
+    """This shard's slice of one globally-drawn batch."""
+
+    experience: Any  # pytree, leaves [batch_per_shard, ...]
+    indices: Array  # [batch_per_shard] int32 global flat (shard * capacity + slot)
+    probabilities: Array  # [batch_per_shard] f32 — p_i under the GLOBAL draw
+
+
+class ShardedReplayCore(NamedTuple):
+    """Per-shard ops, all safe inside a shard_map over `axis`."""
+
+    init: Callable[[Any], ShardedReplayState]
+    add: Callable[[ShardedReplayState, Any], ShardedReplayState]
+    sample: Callable[[ShardedReplayState, Array], ShardedSample]
+    set_priorities: Callable[[ShardedReplayState, Array, Array], ShardedReplayState]
+    can_sample: Callable[[ShardedReplayState], Array]
+    occupancy: Callable[[ShardedReplayState], Array]
+
+
+def replicated_key(key: Array, axis: str) -> Array:
+    """Make a per-shard key identical on every shard (shard 0's key wins).
+    Identity on a 1-shard axis, so bitwise equivalence with the reference
+    sampler is preserved."""
+    return jax.lax.all_gather(key, axis)[0]
+
+
+def _where_rows(mask: Array, rows: Array) -> Array:
+    """Zero out non-owned rows (any dtype; bools pass through jnp.where)."""
+    expanded = mask.reshape(mask.shape + (1,) * (rows.ndim - 1))
+    return jnp.where(expanded, rows, jnp.zeros_like(rows))
+
+
+def make_sharded_replay(
+    capacity: int,
+    sample_batch_size: int,
+    num_shards: int,
+    axis: str = "data",
+    prioritized: bool = False,
+    priority_exponent: float = 0.6,
+    min_fill: int = 1,
+) -> ShardedReplayCore:
+    """Build the per-shard op set.
+
+    `capacity` and `sample_batch_size` are PER-SHARD and GLOBAL respectively:
+    each shard rings `capacity` items, one `sample` call draws
+    `sample_batch_size` items from the global priority distribution and
+    hands each shard its `sample_batch_size // num_shards` slice.
+    """
+    if sample_batch_size % num_shards != 0:
+        raise ValueError(
+            f"sample_batch_size ({sample_batch_size}) must divide evenly over "
+            f"{num_shards} shard(s) — every shard consumes an equal slice"
+        )
+    batch_per_shard = sample_batch_size // num_shards
+
+    def init(item: Any) -> ShardedReplayState:
+        experience = jax.tree.map(
+            lambda x: jnp.zeros((capacity,) + jnp.shape(x), jnp.asarray(x).dtype), item
+        )
+        return ShardedReplayState(
+            experience=experience,
+            priorities=jnp.zeros((capacity,), jnp.float32),
+            insert_pos=jnp.zeros((), jnp.int32),
+            num_added=jnp.zeros((), jnp.int32),
+        )
+
+    def add(state: ShardedReplayState, batch: Any) -> ShardedReplayState:
+        n = jax.tree.leaves(batch)[0].shape[0]
+        idx = (state.insert_pos + jnp.arange(n)) % capacity
+        experience = jax.tree.map(
+            lambda buf, new: buf.at[idx].set(new), state.experience, batch
+        )
+        if prioritized:
+            # New data samples at least once: written slots take the GLOBAL
+            # max priority (pmax degenerates to the local max on one shard,
+            # matching the single-device reference bitwise).
+            new_prio = jnp.maximum(
+                jax.lax.pmax(jnp.max(state.priorities), axis), 1.0
+            )
+        else:
+            # Uniform mode: every written slot weighs 1.0, so the global
+            # inverse-CDF is uniform over all FILLED slots fleet-wide even
+            # when shards fill unevenly (Sebulba actors are not lockstep).
+            new_prio = jnp.float32(1.0)
+        priorities = state.priorities.at[idx].set(new_prio)
+        return ShardedReplayState(
+            experience=experience,
+            priorities=priorities,
+            insert_pos=(state.insert_pos + n) % capacity,
+            num_added=state.num_added + n,
+        )
+
+    def sample(state: ShardedReplayState, key: Array) -> ShardedSample:
+        # Cross-shard normalization: ONE all_gather of the K scalar masses.
+        mass = jnp.sum(state.priorities)
+        masses = jax.lax.all_gather(mass, axis)  # [K], identical on all shards
+        total = jnp.sum(masses)
+        bounds = jnp.cumsum(masses)  # inclusive prefix, identical everywhere
+        k = jax.lax.axis_index(axis)
+        lower = jnp.where(k == 0, 0.0, bounds[jnp.maximum(k - 1, 0)])
+
+        # Same key on every shard => same uniforms => ownership partitions.
+        u = jax.random.uniform(key, (sample_batch_size,)) * total
+        owned = (u >= lower) & ((u < bounds[k]) | (k == num_shards - 1))
+        pos = u - lower
+        cdf = jnp.cumsum(state.priorities)
+        # Clip into the WRITTEN prefix of the ring, not just [0, capacity):
+        # f32 rounding slivers in the ownership bounds can push `pos` past
+        # this shard's own mass, where searchsorted lands one past the last
+        # written slot — an unwritten zero row with probability 0. The
+        # reference sampler applies the identical clip (bitwise pin).
+        filled = jnp.minimum(state.num_added, capacity)
+        idx = jnp.clip(
+            jnp.searchsorted(cdf, pos, side="right"), 0, jnp.maximum(filled - 1, 0)
+        )
+
+        rows = jax.tree.map(
+            lambda buf: _where_rows(owned, buf[idx]), state.experience
+        )
+        probs = jnp.where(owned, state.priorities[idx] / jnp.maximum(total, 1e-9), 0.0)
+        g_idx = jnp.where(owned, k.astype(jnp.int32) * capacity + idx, 0)
+
+        # The only payload that crosses the interconnect: the sampled batch.
+        rows, probs, g_idx = jax.lax.psum((rows, probs, g_idx), axis)
+
+        start = k * batch_per_shard
+        slice_rows = lambda x: jax.lax.dynamic_slice_in_dim(x, start, batch_per_shard)
+        return ShardedSample(
+            experience=jax.tree.map(slice_rows, rows),
+            indices=slice_rows(g_idx),
+            probabilities=slice_rows(probs),
+        )
+
+    def set_priorities(
+        state: ShardedReplayState, indices: Array, priorities: Array
+    ) -> ShardedReplayState:
+        # Each shard holds its slice of the batch's (index, priority) pairs —
+        # gather the full set (the indices/weights half of the interconnect
+        # cost) and scatter only the slots this shard owns.
+        all_idx = jax.lax.all_gather(indices, axis).reshape(-1)
+        all_p = jax.lax.all_gather(priorities, axis).reshape(-1)
+        k = jax.lax.axis_index(axis)
+        mine = (all_idx // capacity) == k
+        # Non-owned updates point one past the end and mode="drop"s away.
+        slot = jnp.where(mine, all_idx % capacity, capacity)
+        new = jnp.power(jnp.abs(all_p) + 1e-6, priority_exponent)
+        updated = state.priorities.at[slot].set(new, mode="drop")
+        return state._replace(priorities=updated)
+
+    def can_sample(state: ShardedReplayState) -> Array:
+        filled = jnp.minimum(state.num_added, capacity)
+        return jax.lax.psum(filled, axis) >= min_fill
+
+    def occupancy(state: ShardedReplayState) -> Array:
+        return jnp.minimum(state.num_added, capacity)
+
+    return ShardedReplayCore(init, add, sample, set_priorities, can_sample, occupancy)
+
+
+def make_reference_replay(
+    capacity: int,
+    sample_batch_size: int,
+    prioritized: bool = False,
+    priority_exponent: float = 0.6,
+    min_fill: int = 1,
+) -> ShardedReplayCore:
+    """The single-device reference sampler: the same math with every
+    collective removed. `make_sharded_replay` on a 1-shard mesh must match it
+    BITWISE (tests/test_replay.py) — this is the equivalence oracle, not a
+    production path (production single-shard runs use the sharded core on a
+    trivial mesh, one code path for every topology)."""
+
+    def init(item: Any) -> ShardedReplayState:
+        experience = jax.tree.map(
+            lambda x: jnp.zeros((capacity,) + jnp.shape(x), jnp.asarray(x).dtype), item
+        )
+        return ShardedReplayState(
+            experience=experience,
+            priorities=jnp.zeros((capacity,), jnp.float32),
+            insert_pos=jnp.zeros((), jnp.int32),
+            num_added=jnp.zeros((), jnp.int32),
+        )
+
+    def add(state: ShardedReplayState, batch: Any) -> ShardedReplayState:
+        n = jax.tree.leaves(batch)[0].shape[0]
+        idx = (state.insert_pos + jnp.arange(n)) % capacity
+        experience = jax.tree.map(
+            lambda buf, new: buf.at[idx].set(new), state.experience, batch
+        )
+        if prioritized:
+            new_prio = jnp.maximum(jnp.max(state.priorities), 1.0)
+        else:
+            new_prio = jnp.float32(1.0)
+        return ShardedReplayState(
+            experience=experience,
+            priorities=state.priorities.at[idx].set(new_prio),
+            insert_pos=(state.insert_pos + n) % capacity,
+            num_added=state.num_added + n,
+        )
+
+    def sample(state: ShardedReplayState, key: Array) -> ShardedSample:
+        mass = jnp.sum(state.priorities)
+        masses = mass[None]
+        total = jnp.sum(masses)
+        u = jax.random.uniform(key, (sample_batch_size,)) * total
+        cdf = jnp.cumsum(state.priorities)
+        filled = jnp.minimum(state.num_added, capacity)
+        idx = jnp.clip(
+            jnp.searchsorted(cdf, u, side="right"), 0, jnp.maximum(filled - 1, 0)
+        )
+        rows = jax.tree.map(lambda buf: _where_rows(jnp.ones_like(u, bool), buf[idx]),
+                            state.experience)
+        probs = state.priorities[idx] / jnp.maximum(total, 1e-9)
+        return ShardedSample(experience=rows, indices=idx, probabilities=probs)
+
+    def set_priorities(
+        state: ShardedReplayState, indices: Array, priorities: Array
+    ) -> ShardedReplayState:
+        new = jnp.power(jnp.abs(priorities) + 1e-6, priority_exponent)
+        return state._replace(priorities=state.priorities.at[indices].set(new))
+
+    def can_sample(state: ShardedReplayState) -> Array:
+        return jnp.minimum(state.num_added, capacity) >= min_fill
+
+    def occupancy(state: ShardedReplayState) -> Array:
+        return jnp.minimum(state.num_added, capacity)
+
+    return ShardedReplayCore(init, add, sample, set_priorities, can_sample, occupancy)

@@ -183,8 +183,137 @@ class OnPolicyPipeline:
         return drained
 
 
+class OffPolicyPipeline:
+    """Off-policy ingestion (docs/DESIGN.md §2.10): actor devices PUSH
+    transition shards whenever a rollout chunk is ready; the learner POLLS
+    whatever has arrived and samples its replay service independently — no
+    lockstep collect, so one slow/restarting actor never stalls the learner
+    (the on-policy pipeline's must-hear-from-every-actor rule is exactly
+    what an off-policy learner does not need).
+
+    A single bounded queue carries (actor_id, payload) pairs from every
+    actor; a full queue back-pressures producers (put blocks), an empty one
+    never blocks the learner past its chosen timeout. Failure semantics
+    mirror OnPolicyPipeline: the supervisor injects a typed ComponentFailure
+    poison-pill for an unrecoverable actor; the learner raises it on its
+    next poll instead of sampling forever against a quietly dead fleet."""
+
+    def __init__(self, num_actors: int, depth_per_actor: int = 2, fleet: Optional[Any] = None):
+        self.num_actors = num_actors
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, num_actors * depth_per_actor))
+        self.heartbeats = HeartbeatBoard()
+        self._depth, self._put_wait, self._get_wait = _queue_instruments()
+        self._failures: Dict[int, ComponentFailure] = {}
+        self._failure_lock = threading.Lock()
+        self._fleet = fleet
+
+    def _check_failures(self) -> None:
+        if self._fleet is not None:
+            self._fleet.check_partition()
+        with self._failure_lock:
+            for failure in self._failures.values():
+                raise failure
+
+    def fail(self, actor_id: int, failure: ComponentFailure) -> None:
+        """Poison-pill injection (supervisor path): record the failure and
+        wake a learner blocked in wait_for_data. The shared queue may be
+        full of healthy payloads — drop one to make room for the pill (the
+        learner consults _failures before blocking, so a lost put is never
+        a lost failure)."""
+        with self._failure_lock:
+            self._failures[actor_id] = failure
+        try:
+            self._queue.put_nowait(failure)
+        except queue.Full:
+            try:
+                self._queue.get_nowait()
+                self._queue.put_nowait(failure)
+            except (queue.Empty, queue.Full):
+                pass
+
+    def push(self, actor_id: int, payload: Any, timeout: Optional[float] = None) -> None:
+        labels = {"queue": "transitions", "actor": str(actor_id)}
+        start = time.perf_counter()
+        try:
+            with span("offpolicy_push", actor=actor_id):
+                self._queue.put((actor_id, payload), timeout=timeout)
+        finally:
+            # finally: a queue.Full timeout is the worst-case backpressure
+            # sample — the one this histogram exists to capture.
+            self._put_wait.observe(time.perf_counter() - start, labels)
+            self._depth.set(self._queue.qsize(), labels)
+        self.heartbeats.beat(f"actor-{actor_id}")
+
+    def poll(self, max_items: int = 64, timeout: float = 0.0) -> List[Any]:
+        """Drain up to `max_items` pending (actor_id, payload) pairs. Only
+        the FIRST get may block (up to `timeout`); the rest are non-blocking
+        — the learner ingests what exists and goes back to sampling. Raises
+        the typed ComponentFailure if any actor is unrecoverably gone."""
+        self._check_failures()
+        labels = {"queue": "transitions", "actor": "learner"}
+        items: List[Any] = []
+        start = time.perf_counter()
+        with span("offpolicy_poll"):
+            while len(items) < max_items:
+                try:
+                    got = self._queue.get(timeout=timeout if not items else 0.0)
+                except queue.Empty:
+                    break
+                if isinstance(got, ComponentFailure):
+                    raise got
+                items.append(got)
+        if items:
+            self._get_wait.observe(time.perf_counter() - start, labels)
+            self._depth.set(self._queue.qsize(), labels)
+            self.heartbeats.beat("learner")
+        return items
+
+    def wait_for_data(self, timeout: float = 180.0) -> List[Any]:
+        """Block until at least one payload arrives (warmup / starved-replay
+        path). A timeout names the stalest actor and its last-heartbeat age
+        instead of surfacing a bare queue.Empty."""
+        detector = StallDetector(self.heartbeats, stale_after_s=max(1.0, timeout / 4))
+        items = self.poll(timeout=timeout)
+        if not items:
+            # Name the most-starved producer: a never-beat actor outranks
+            # any stale one; otherwise the oldest heartbeat wins.
+            stalest, stalest_age = 0, -1.0
+            for actor_id in range(self.num_actors):
+                actor_age = self.heartbeats.age(f"actor-{actor_id}")
+                if actor_age is None:
+                    stalest, stalest_age = actor_id, None
+                    break
+                if stalest_age is not None and actor_age > stalest_age:
+                    stalest, stalest_age = actor_id, actor_age
+            raise ActorStarvationError(
+                stalest,
+                timeout,
+                detector.diagnose(waiting_on=f"actor-{stalest}"),
+                stalest_age,
+            )
+        return items
+
+    def drain(self, timeout: float = 0.5) -> int:
+        """Shutdown-path drain: unblock producers stuck in put() WITHOUT
+        recording wait/depth series or heartbeats (teardown artifacts, not
+        backpressure signal)."""
+        drained = 0
+        while True:
+            try:
+                self._queue.get(timeout=timeout)
+                drained += 1
+            except queue.Empty:
+                return drained
+
+
 class ParameterServer:
-    """Latest-params distribution to actor devices."""
+    """Latest-params distribution to actor devices.
+
+    Transfer economy: params are device_put ONCE PER DEVICE per version, not
+    once per actor — actors sharing a device receive the same placed copy
+    through their own queues (re-transferring identical bytes for every
+    co-located actor scaled the push cost with actors_per_device for no
+    reason). `reprime` reuses the version's placed copy the same way."""
 
     def __init__(
         self,
@@ -195,6 +324,11 @@ class ParameterServer:
         self._devices = [d for d in actor_devices for _ in range(actors_per_device)]
         self._queues: List[queue.Queue] = [queue.Queue(maxsize=1) for _ in self._devices]
         self._latest: Any = None  # last distributed params, for reprime()
+        # (params, {device: placed copy}) of the most recently COMPLETED
+        # push, identity-tagged so reprime can tell whether the placed
+        # copies belong to self._latest or to an older version a concurrent
+        # distribute is in the middle of replacing.
+        self._placed_entry: Optional[tuple] = None
         self.heartbeats = heartbeats if heartbeats is not None else HeartbeatBoard()
         self._depth, self._put_wait, self._get_wait = _queue_instruments()
         self._pushes = get_registry().counter(
@@ -203,24 +337,36 @@ class ParameterServer:
         )
         self._transfer = get_registry().histogram(
             "stoix_tpu_sebulba_param_transfer_seconds",
-            "Host-side device_put time per param push (NOT queue blocking)",
+            "Host-side device_put time per param placement (once per DEVICE "
+            "per version, not per actor; NOT queue blocking)",
         )
 
     @property
     def num_actors(self) -> int:
         return len(self._queues)
 
+    def _place(self, params: Any, device: jax.Device, placed: Dict[Any, Any]) -> Any:
+        """device_put once per device; later actors on the device reuse it."""
+        local = placed.get(device)
+        if local is None:
+            start = time.perf_counter()
+            local = jax.device_put(params, device)
+            self._transfer.observe(
+                time.perf_counter() - start, {"queue": "params", "device": str(device)}
+            )
+            placed[device] = local
+        return local
+
     def distribute_params(self, params: Any) -> None:
         self._latest = params
+        placed: Dict[Any, Any] = {}
         with span("param_push", actors=len(self._queues)):
             for actor_id, (device, q) in enumerate(zip(self._devices, self._queues)):
                 labels = {"queue": "params", "actor": str(actor_id)}
                 # Transfer cost and queue blocking are separate series: a
                 # slow push must be attributable to the right cause (large
                 # params vs an actor not draining its queue).
-                start = time.perf_counter()
-                local = jax.device_put(params, device)
-                self._transfer.observe(time.perf_counter() - start, labels)
+                local = self._place(params, device, placed)
                 start = time.perf_counter()
                 # Keep only the freshest params: drop a stale entry if present.
                 try:
@@ -231,16 +377,22 @@ class ParameterServer:
                 self._put_wait.observe(time.perf_counter() - start, labels)
                 self._depth.set(q.qsize(), labels)
                 self._pushes.inc(labels={"actor": str(actor_id)})
+        self._placed_entry = (params, placed)
         self.heartbeats.beat("param-server")
 
     def reprime(self, actor_id: int) -> bool:
         """Re-feed the LATEST distributed params to one actor queue (the
         supervisor calls this before starting a replacement actor). Never
         blocks: a concurrent learner push wins the maxsize-1 slot, which is
-        at least as fresh."""
-        if self._latest is None:
+        at least as fresh. Reuses the latest COMPLETED version's placed copy
+        for the actor's device when one exists — no redundant transfer; a
+        version still mid-push places fresh (its dict may hold older copies)."""
+        latest = self._latest
+        if latest is None:
             return False
-        local = jax.device_put(self._latest, self._devices[actor_id])
+        entry = self._placed_entry
+        placed = entry[1] if entry is not None and entry[0] is latest else {}
+        local = self._place(latest, self._devices[actor_id], placed)
         _replace_nowait(self._queues[actor_id], local)
         return True
 

@@ -51,17 +51,64 @@ def dummy_transition(env: envs.Environment, discrete_actions: bool = False) -> T
 
 
 def build_buffer(env: envs.Environment, config: Any, mesh: Mesh, discrete_actions: bool = False):
+    """Per-(shard, update-batch) replay, dispatched on `system.replay.impl`
+    (docs/DESIGN.md §2.10):
+
+      local (default)  today's replicated uniform item buffer — every shard
+                       samples only its own slice; bit-identical to the
+                       pre-dispatch behavior (tests/test_replay.py pins it).
+      sharded          the device-resident cross-shard sampler
+                       (stoix_tpu/replay): the same ItemBuffer interface,
+                       but `sample` draws the GLOBAL batch where the data
+                       lives — one all_gather of shard masses + one psum of
+                       the sampled minibatch — so per-shard HBM bounds only
+                       a SHARD of the experience, not all of it.
+    """
     n_shards = int(mesh.shape["data"])
     update_batch = int(config.arch.get("update_batch_size", 1))
     local_envs = int(config.arch.total_num_envs) // (n_shards * update_batch)
     buffer_size = max(1, int(config.system.total_buffer_size) // (n_shards * update_batch))
     batch_size = max(1, int(config.system.total_batch_size) // (n_shards * update_batch))
-    buffer = make_item_buffer(
-        max_length=buffer_size,
-        min_length=batch_size,
-        sample_batch_size=batch_size,
-        add_batch_size=int(config.system.rollout_length) * local_envs,
-    )
+    replay_cfg = dict(config.system.get("replay") or {})
+    impl = str(replay_cfg.get("impl", "local"))
+    if impl == "local":
+        buffer = make_item_buffer(
+            max_length=buffer_size,
+            min_length=batch_size,
+            sample_batch_size=batch_size,
+            add_batch_size=int(config.system.rollout_length) * local_envs,
+        )
+    elif impl == "sharded":
+        from stoix_tpu.replay.compat import make_sharded_item_buffer
+
+        if bool(replay_cfg.get("prioritized", False)):
+            # The 4-function ItemBuffer interface this family consumes has
+            # no set_priorities seam, so priorities would freeze at the
+            # insert value and sampling would stay exactly uniform —
+            # refuse rather than silently no-op the knob. The prioritized
+            # path is Sebulba ff_dqn, whose learn program scatters TD
+            # priorities in-program.
+            raise ValueError(
+                "system.replay.prioritized=true is not supported on the "
+                "Anakin item-buffer path (no set_priorities seam in the "
+                "ItemBuffer interface); use the Sebulba off-policy path "
+                "(systems/q_learning/sebulba/ff_dqn.py) for distributed "
+                "prioritized replay"
+            )
+        buffer = make_sharded_item_buffer(
+            capacity_per_shard=buffer_size,
+            sample_batch_size=batch_size * n_shards,
+            num_shards=n_shards,
+            min_fill=max(
+                batch_size * n_shards,
+                int(replay_cfg.get("min_fill", batch_size * n_shards)),
+            ),
+            axis="data",
+        )
+    else:
+        raise ValueError(
+            f"system.replay.impl must be 'local' or 'sharded', got {impl!r}"
+        )
     return buffer, buffer.init(dummy_transition(env, discrete_actions))
 
 

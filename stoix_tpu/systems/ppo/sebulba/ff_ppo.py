@@ -629,15 +629,19 @@ def run_experiment(
                 payloads = pipeline.collect_rollouts()
             with span("learner_assemble", update=update_idx), timer.time("assemble"):
                 # Per learner device: concat all actors' shards, then build one
-                # global array per leaf.
+                # global array per leaf. The shards are [T, E/n] slices of the
+                # ENV axis, so they tile array_axis=1 — assembling on the
+                # leading axis would stack devices' trajectories along TIME
+                # and let GAE bootstrap across the device seam.
                 def to_global(*leaves):
                     per_device = []
                     for d in range(len(learner_devices)):
                         shards = [leaf[d] for leaf in leaves]
                         with jax.default_device(learner_devices[d]):
                             per_device.append(jnp.concatenate(shards, axis=1))
-                    return assemble_global_array(per_device, learner_mesh, axis="data") \
-                        if len(per_device) > 1 else per_device[0]
+                    return assemble_global_array(
+                        per_device, learner_mesh, axis="data", array_axis=1
+                    ) if len(per_device) > 1 else per_device[0]
 
                 # leaves are lists of per-device arrays; traverse manually.
                 flat_payloads = [jax.tree.flatten(p, is_leaf=lambda x: isinstance(x, list))

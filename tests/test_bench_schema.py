@@ -208,3 +208,61 @@ def test_bench_backend_wedge_aborts_typed_within_deadline():
     assert "BACKEND UNAVAILABLE" in payload["unit"], payload
     assert payload["probe_attempts"] == 2, payload
     assert payload["fallback"] is False, payload
+
+
+def test_bench_replay_payload_schema():
+    """`bench.py --replay` (docs/DESIGN.md §2.10): the transport-shaped
+    payload is schema-complete — sampled items/sec headline with standard
+    rep dispersion, add/sample throughput, the per-shard occupancy and
+    priority-mass vectors, and the transport ledger proving the
+    samples-not-experience claim: sampled_bytes_crossed strictly below
+    ingested_bytes_total."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--replay", "--smoke", "--cpu", "--reps", "2",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "STOIX_BENCH_NO_FALLBACK": "1"},
+    )
+    assert proc.returncode == 0, f"bench.py --replay failed:\n{proc.stdout}\n{proc.stderr}"
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, f"expected exactly one JSON line:\n{proc.stdout}"
+    payload = json.loads(json_lines[0])
+
+    assert payload["metric"] == "replay_sharded_sample_items_per_sec"
+    assert isinstance(payload["value"], (int, float)) and payload["value"] > 0
+    assert "transitions/sec" in payload["unit"]
+    assert payload["vs_baseline"] is None
+
+    # Rep dispersion, best-rep semantics (max rate, like throughput payloads).
+    assert payload["reps"] == 2
+    assert payload["min"] <= payload["median"] <= payload["max"]
+    assert abs(payload["value"] - payload["max"]) <= 0.11, payload
+    assert payload["rel_spread"] >= 0.0
+
+    # The replay body: both throughputs, the CPU harness's 8 virtual shards,
+    # per-shard vectors sized to the mesh.
+    assert payload["add_items_per_sec"] > 0
+    assert payload["sample_items_per_sec"] == payload["value"]
+    assert payload["shards"] == 8
+    assert len(payload["occupancy"]) == 8
+    assert len(payload["priority_mass"]) == 8
+    assert all(m > 0 for m in payload["priority_mass"])
+
+    # The measured samples-not-experience claim (ISSUE acceptance): only
+    # sampled minibatches cross the interconnect, and they are strictly
+    # smaller than what was ingested.
+    assert payload["ingested_bytes_total"] > 0
+    assert payload["sampled_bytes_crossed"] > 0
+    assert payload["sampled_bytes_crossed"] < payload["ingested_bytes_total"]
+    assert 0.0 < payload["sampled_to_ingested_ratio"] < 1.0
+
+    # Universal posture fields.
+    assert payload["fallback"] is False
+    assert payload["fallback_reason"] is None
+    integrity = payload["integrity"]
+    assert integrity["enabled"] is False

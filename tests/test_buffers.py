@@ -3,14 +3,24 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from stoix_tpu.buffers import (
+    EmptyBufferSampleError,
     make_item_buffer,
     make_prioritised_trajectory_buffer,
     make_trajectory_buffer,
+    set_sample_guard,
 )
 
 KEY = jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def sample_guard():
+    previous = set_sample_guard(True)
+    yield
+    set_sample_guard(previous)
 
 
 def test_item_buffer_add_sample_wraparound():
@@ -137,3 +147,64 @@ def test_prioritised_buffer_alignment_after_wraparound():
     sample = buf.sample(state, KEY)
     np.testing.assert_allclose(sample.experience["t"], 6.0)
     np.testing.assert_array_equal(sample.indices[:, 1], 6)
+
+
+def test_sample_guard_raises_typed_on_unfilled_buffer(sample_guard):
+    buf = make_item_buffer(max_length=16, min_length=8, sample_batch_size=4, add_batch_size=2)
+    state = buf.init({"x": jnp.zeros(())})
+    with pytest.raises(EmptyBufferSampleError, match="unfilled item buffer"):
+        buf.sample(state, KEY)
+    # Once filled past min_length, the guarded sample passes untouched.
+    state = buf.add(state, {"x": jnp.ones((8,))})
+    np.testing.assert_allclose(buf.sample(state, KEY).experience["x"], 1.0)
+
+
+def test_sample_guard_fires_inside_jit(sample_guard):
+    buf = make_item_buffer(max_length=16, min_length=8, sample_batch_size=4, add_batch_size=2)
+    state = buf.init({"x": jnp.zeros(())})
+    jitted = jax.jit(buf.sample)
+    with pytest.raises(Exception, match="EmptyBufferSampleError"):
+        jax.block_until_ready(jitted(state, KEY).experience["x"])
+
+
+def test_sample_guard_off_keeps_silent_zero_fill():
+    # The documented legacy behavior stays the default: no guard, silent
+    # zero-initialized batch (off_policy_core.require_first_add_samplable
+    # guards the AZ/MZ family statically instead).
+    buf = make_item_buffer(max_length=16, min_length=8, sample_batch_size=4, add_batch_size=2)
+    state = buf.init({"x": jnp.zeros(())})
+    np.testing.assert_allclose(buf.sample(state, KEY).experience["x"], 0.0)
+
+
+def test_az_warmup_path_guard(sample_guard):
+    """The AZ/MZ warmup foot-gun (off_policy_core.py): a trajectory buffer
+    whose first add holds no full sequence silently serves zeros. The static
+    guard rejects the config; the debug sample guard catches the dynamic
+    case on the buffer itself."""
+    from stoix_tpu.systems.off_policy_core import require_first_add_samplable
+    from stoix_tpu.utils.config import Config
+
+    # Static config guard: sequence longer than the rollout -> loud error.
+    bad = Config.from_dict(
+        {"system": {"sample_sequence_length": 16, "rollout_length": 8}}
+    )
+    with pytest.raises(ValueError, match="sample_sequence_length"):
+        require_first_add_samplable(bad)
+
+    # Dynamic guard: sampling before any full sequence was written raises
+    # the typed error instead of training on zero-filled sequences.
+    buf = make_trajectory_buffer(
+        add_batch_size=2, sample_batch_size=4, sample_sequence_length=8,
+        period=1, max_length_time_axis=32,
+    )
+    state = buf.init({"t": jnp.zeros(())})
+    state = buf.add(state, {"t": jnp.ones((2, 4))})  # 4 < sequence length 8
+    with pytest.raises(EmptyBufferSampleError, match="unfilled trajectory buffer"):
+        buf.sample(state, KEY)
+    prio = make_prioritised_trajectory_buffer(
+        add_batch_size=1, sample_batch_size=4, sample_sequence_length=8,
+        period=1, max_length_time_axis=32,
+    )
+    pstate = prio.init({"t": jnp.zeros(())})
+    with pytest.raises(EmptyBufferSampleError, match="unfilled prioritised"):
+        prio.sample(pstate, KEY)

@@ -160,3 +160,38 @@ def test_impala_reward_normalization_is_shard_invariant(devices):
         )(traj)
         expected = (rewards - rewards.mean()) / (rewards.std() + 1e-8)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+
+def test_param_server_places_once_per_device_and_reprime_reuses(devices):
+    """Satellite (docs/DESIGN.md §2.10): distribute_params device_puts each
+    version once per DEVICE, not once per actor — actors sharing a device
+    receive the same placed copy — and reprime reuses it (zero transfers)."""
+    from stoix_tpu.observability import get_registry
+    from stoix_tpu.sebulba.core import ParameterServer
+
+    hist = get_registry().histogram("stoix_tpu_sebulba_param_transfer_seconds")
+    dev_a, dev_b = devices[0], devices[1]
+
+    def transfers():
+        return sum(
+            int(hist.summary({"queue": "params", "device": str(d)}).get("count", 0))
+            for d in (dev_a, dev_b)
+        )
+
+    server = ParameterServer([dev_a, dev_b], actors_per_device=3)
+    before = transfers()
+    server.distribute_params({"w": jnp.ones((4,), jnp.float32)})
+    assert transfers() - before == 2, "one device_put per device, not per actor"
+
+    got = [server.get_params(actor_id, timeout=2.0) for actor_id in range(6)]
+    # Actors 0-2 share dev_a and must hold the SAME placed copy (identity,
+    # not equality); likewise 3-5 on dev_b.
+    assert got[0] is got[1] is got[2]
+    assert got[3] is got[4] is got[5]
+    assert got[0] is not got[3]
+
+    # reprime re-feeds the placed copy without a new transfer.
+    before = transfers()
+    assert server.reprime(2)
+    assert transfers() == before
+    assert server.get_params(2, timeout=2.0) is got[0]
