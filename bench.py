@@ -359,6 +359,7 @@ def main() -> None:
     pixel = "--pixel" in sys.argv  # Sebulba on 84x84x4 frames + Nature CNN
     serve = "--serve" in sys.argv  # latency frontier: dynamic-batching policy serving
     replay = "--replay" in sys.argv  # sharded replay service microbench
+    population = "--population" in sys.argv  # P agents as one jitted program
     # Arm the state-integrity sentinel in the Anakin probe run so the payload's
     # integrity fields carry a MEASURED per-window fingerprint overhead
     # (docs/DESIGN.md §2.9) instead of the disabled zeros.
@@ -379,7 +380,15 @@ def main() -> None:
         sys.exit("--replay is its own (transport-shaped) workload; it does not compose")
     if replay and integrity_on:
         sys.exit("--integrity arms the TRAINING sentinel; it does not compose with --replay")
-    if run_all and (large or cartpole or sebulba or pixel or serve or replay):
+    if population and (large or cartpole or sebulba or pixel or serve or replay):
+        sys.exit("--population is its own workload family; it does not compose")
+    if population and integrity_on:
+        # The replica-fingerprint sentinel assumes replicated state; population
+        # members are SHARDED over the pop axis (the runner itself refuses the
+        # combination — docs/DESIGN.md §2.11), so refuse loudly here too.
+        sys.exit("--integrity does not compose with --population "
+                 "(use arch.population.member_fingerprints)")
+    if run_all and (large or cartpole or sebulba or pixel or serve or replay or population):
         sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
     env_tag = "cartpole" if cartpole else "ant"
@@ -393,6 +402,8 @@ def main() -> None:
         metric = "sebulba_ppo_breakout_pixel_env_steps_per_sec"
     elif sebulba:
         metric = "sebulba_ppo_cartpole_env_steps_per_sec"
+    elif population:
+        metric = "population_ppo_identity_game_env_steps_per_sec"
     else:
         metric = f"anakin_ppo_{env_tag}_env_steps_per_sec" + ("_large_bf16" if large else "")
 
@@ -643,6 +654,10 @@ def main() -> None:
 
     if serve:
         _finish([_run_serve(metric, smoke, n_devices, reps=reps)])
+        return
+
+    if population:
+        _finish(_run_population(smoke, n_devices, reps=reps))
         return
 
     if sebulba:
@@ -1191,6 +1206,92 @@ def _run_anakin_generic(
     }
 
 
+def _run_population(smoke: bool, n_devices: int, reps: int | None = None) -> list:
+    """`--population` (docs/DESIGN.md §2.11): P PPO agents trained as ONE
+    jitted program on the ("pop", "data") mesh (stoix_tpu/population), at
+    P=1 (the bit-identity anchor — population machinery at zero population)
+    and P=8 with lifted ent_coef + on-device PBT. Two payload lines, one per
+    P: value = AGGREGATE env-steps/sec (per-member steady-state SPS x P —
+    the number that makes vmapped-population scaling visible), plus
+    per-member fitness dispersion and the PBT exploit count."""
+    from stoix_tpu.population import runner as pop_runner
+    from stoix_tpu.systems import runner as anakin_runner
+    from stoix_tpu.utils import config as config_lib
+
+    payloads = []
+    for pop_size in (1, 8):
+        overrides = [
+            "arch=population",
+            "env=identity_game",
+            "arch.total_num_envs=%d" % (8 if smoke else 64),
+            "arch.num_updates=%d" % (4 if smoke else 32),
+            "arch.total_timesteps=~",
+            "arch.num_evaluation=2",
+            "arch.num_eval_episodes=8",
+            "arch.absolute_metric=False",
+            "system.rollout_length=%d" % (8 if smoke else 16),
+            "logger.use_console=False",
+        ]
+        config = config_lib.compose(
+            config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml",
+            overrides,
+        )
+        config_lib._set_dotted(config, "arch.population.size", pop_size)
+        if pop_size > 1:
+            # A real sweep shape: per-member exploration coefficients, with
+            # truncation selection live so the payload's exploit count is a
+            # MEASURED number, not a config echo.
+            config_lib._set_dotted(
+                config, "arch.population.hparams",
+                {"system.ent_coef": [round(0.001 * (i + 1), 4) for i in range(pop_size)]},
+            )
+            config_lib._set_dotted(
+                config, "arch.population.pbt",
+                {"enabled": True, "interval": 1, "quantile": 0.25,
+                 "perturb_scale": 0.2},
+            )
+        skipped_before = _skipped_updates_base()
+        aggregates = []
+        for _ in range(reps if reps is not None else 1):
+            pop_runner.run_population_experiment(config)
+            steady = float(anakin_runner.LAST_RUN_STATS.get("steady_state_sps") or 0.0)
+            if steady:
+                # steady_state_sps counts PER-MEMBER env steps (the runner's
+                # steps_per_eval is per member); the population executes P of
+                # them simultaneously.
+                aggregates.append(steady * pop_size)
+        stats = dict(pop_runner.LAST_POPULATION_STATS)
+        fitness = [float(f) for f in (stats.get("member_fitness") or [0.0])]
+        member_dispersion = _rep_stats(fitness)
+        member_dispersion["members"] = member_dispersion.pop("reps")
+        payloads.append({
+            "metric": f"population_ppo_identity_game_p{pop_size}_env_steps_per_sec",
+            "value": round(max(aggregates), 1) if aggregates else 0.0,
+            "unit": (
+                f"aggregate env_steps/sec ({pop_size} members, "
+                f"{n_devices} devices, identity_game)"
+                if aggregates else "NO STEADY WINDOW: run ended before eval"
+            ),
+            "vs_baseline": None,
+            **_rep_stats(aggregates if aggregates else [0.0]),
+            "population_size": pop_size,
+            "member_fitness_dispersion": member_dispersion,
+            "pbt_enabled": bool(stats.get("pbt_enabled", False)),
+            "pbt_exploits": int(stats.get("pbt_exploits", 0)),
+            "compile_s": (anakin_runner.LAST_RUN_STATS.get("compile") or {}).get(
+                "compile_s"
+            ),
+            "cache_hits": (anakin_runner.LAST_RUN_STATS.get("compile") or {}).get(
+                "cache_hits", 0
+            ),
+            "resilience": _resilience_selfcheck(config, skipped_before)
+            if not anakin_runner.LAST_RUN_STATS.get("resilience")
+            else dict(anakin_runner.LAST_RUN_STATS.get("resilience")),
+            "integrity": _integrity_report(anakin_runner.LAST_RUN_STATS),
+        })
+    return payloads
+
+
 def _run_sebulba(
     metric: str,
     smoke: bool,
@@ -1260,11 +1361,15 @@ def _run_sebulba(
     # the run), so re-measurement defaults to 1 and scales only on an
     # explicit --reps; `value` stays the best rep, like the Anakin loop.
     steadies = []
+    fps_reps = []
     for _ in range(reps if reps is not None else 1):
         sebulba_ppo.run_experiment(config)
         rep_steady = sebulba_ppo.LAST_RUN_STATS.get("steps_per_sec_steady")
         if rep_steady:
             steadies.append(float(rep_steady))
+        rep_fps = sebulba_ppo.LAST_RUN_STATS.get("fps")
+        if rep_fps:
+            fps_reps.append(float(rep_fps))
     steady = max(steadies) if steadies else None
     after = wait_hist.summary(wait_labels)
     d_count = int(after.get("count", 0)) - int(before.get("count", 0))
@@ -1296,6 +1401,14 @@ def _run_sebulba(
         # none for its sebulba arch); report the raw number.
         "vs_baseline": None,
         **_rep_stats(steadies if steadies else [0.0]),
+        # Whole-run env frames per second, first-class (ROADMAP item-1
+        # leftover): value = best rep, dispersion across reps. Distinct from
+        # `value` (the post-compile steady-state window): fps includes the
+        # first-rollout compile, so it is the fleet-provisioning number.
+        "fps": {
+            "value": round(max(fps_reps), 1) if fps_reps else 0.0,
+            **_rep_stats(fps_reps if fps_reps else [0.0]),
+        },
         # Sebulba pays its compiles inside the run (no separate AOT warmup
         # call to time), so compile_s is not separable here; cache_hits still
         # shows whether arch.compile_cache absorbed them.

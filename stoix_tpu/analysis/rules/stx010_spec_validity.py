@@ -142,6 +142,12 @@ RULE = register(
             "    return jax.make_array_from_single_device_arrays(\n"
             '        (8,), NamedSharding(mesh, P("data", None)), shards\n'
             "    )\n",
+            # A population mesh declares ("pop", "data") — an axis from some
+            # OTHER mesh still cannot ride a spec governed by it.
+            "import numpy as np\nfrom jax.sharding import Mesh, NamedSharding, "
+            "PartitionSpec as P\n\n\ndef place_population(devices, members):\n"
+            '    pop_mesh = Mesh(np.array(devices).reshape(2, -1), ("pop", "data"))\n'
+            '    return NamedSharding(pop_mesh, P("model"))\n',
         ),
         clean_snippets=(
             # Matching mesh-local axis + universe axis through a parameter.
@@ -161,6 +167,12 @@ RULE = register(
             "    return jax.make_array_from_single_device_arrays(\n"
             '        (8, 4), NamedSharding(mesh, P("data", None)), shards\n'
             "    )\n",
+            # The population axis (stoix_tpu/population): "pop" is declared
+            # by configs/arch/population.yaml's mesh block, so a
+            # parameter-mesh spec over it resolves through the repo universe.
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n\n\n"
+            "def population_sharding(mesh):\n"
+            '    return NamedSharding(mesh, P("pop", "data"))\n',
         ),
     )
 )

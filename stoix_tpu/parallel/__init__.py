@@ -16,11 +16,21 @@ from stoix_tpu.parallel.mesh import (
     replicated_sharding,
     shard_leading_axis,
 )
+from stoix_tpu.parallel.roles import (
+    MeshRoles,
+    MeshRolesError,
+    RoleAssignment,
+    resolve_assignments,
+)
 
 __all__ = [
     "is_coordinator",
     "maybe_initialize_distributed",
     "process_allgather",
+    "MeshRoles",
+    "MeshRolesError",
+    "RoleAssignment",
+    "resolve_assignments",
     "assemble_global_array",
     "fetch_global",
     "fetch_global_async",

@@ -1,0 +1,52 @@
+"""stoix_tpu/population — mesh-parallel population training (docs/DESIGN.md
+§2.11): P agents with different hyperparameters trained as ONE jitted program
+on a ("pop", "data") mesh, with on-device PBT exploit/explore.
+
+    hparams.py — lifts designated scalar config leaves (lr, ent_coef, gamma,
+                 clip_eps, seed, ...) into [P]-leading arrays threaded through
+                 a vmapped learner;
+    pbt.py     — truncation selection as pure gather/where over the pop axis
+                 (zero host round-trips), hparam perturbation, per-member
+                 fingerprints + survivor-reseed quarantine;
+    runner.py  — the population setup + experiment entry point, reusing the
+                 pipelined Anakin dispatcher (systems/runner.py) unchanged.
+
+`sweep.py --backend population` maps a grid/TPE batch onto one population
+run through this package.
+"""
+
+from stoix_tpu.population.hparams import (
+    LIFTABLE_HPARAMS,
+    PopulationConfigError,
+    lift_hparams,
+    population_size,
+)
+from stoix_tpu.population.pbt import (
+    PBTSettings,
+    member_fingerprints,
+    quarantine_members,
+    settings_from_config,
+    truncation_selection,
+)
+from stoix_tpu.population.runner import (
+    LAST_POPULATION_STATS,
+    PopulationState,
+    population_setup,
+    run_population_experiment,
+)
+
+__all__ = [
+    "LIFTABLE_HPARAMS",
+    "PopulationConfigError",
+    "lift_hparams",
+    "population_size",
+    "PBTSettings",
+    "member_fingerprints",
+    "quarantine_members",
+    "settings_from_config",
+    "truncation_selection",
+    "LAST_POPULATION_STATS",
+    "PopulationState",
+    "population_setup",
+    "run_population_experiment",
+]

@@ -1,0 +1,217 @@
+"""On-device PBT exploit/explore (docs/DESIGN.md §2.11).
+
+Truncation selection (Jaderberg et al. 2017, arxiv 1711.09846) expressed as
+pure gather/where over the population axis, composed INTO the population's
+one jitted learn program — selection costs zero host round-trips, and under
+a sharded pop axis GSPMD lowers the cross-member gathers to the collectives
+the mesh needs.
+
+Every window the learn program updates each member's fitness (the
+psum-consistent mean completed-episode return of that window); every
+`interval` windows the bottom `quantile` of members copy the top quantile's
+params + optimizer state + observation statistics + hparams EXACTLY, then
+perturb the copied hparams multiplicatively (x(1±perturb_scale), coin per
+member x hparam) and resample the copied members' PRNG streams so clones
+explore instead of replaying their source.
+
+Integrity composition (docs/DESIGN.md §2.9): `member_fingerprints` folds
+each member's params to a uint32 through the SAME position-salted murmur mix
+the PR 12 sentinel uses, and `quarantine_members` re-seeds a corrupt member
+from the fittest healthy survivor — the population's answer to silent
+corruption is a targeted exploit, not a dead run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from stoix_tpu.population.hparams import PERTURBABLE
+from stoix_tpu.resilience.integrity import fingerprint_leaves
+
+
+class PBTSettings(NamedTuple):
+    enabled: bool
+    interval: int  # windows between exploit/explore rounds
+    quantile: float  # fraction exploited (bottom q copies top q)
+    perturb_scale: float  # copied hparams multiply by (1 +- scale)
+
+
+def settings_from_config(config: Any) -> PBTSettings:
+    pop_cfg = (config.get("arch") or {}).get("population") or {}
+    pbt_cfg = pop_cfg.get("pbt") or {}
+    return PBTSettings(
+        enabled=bool(pbt_cfg.get("enabled", False)),
+        interval=max(1, int(pbt_cfg.get("interval", 1) or 1)),
+        quantile=float(pbt_cfg.get("quantile", 0.25)),
+        perturb_scale=float(pbt_cfg.get("perturb_scale", 0.2)),
+    )
+
+
+def truncation_selection(
+    fitness: jax.Array, pop_size: int, quantile: float
+) -> Tuple[jax.Array, jax.Array]:
+    """(src, is_bottom): member i copies from member src[i]; is_bottom marks
+    the exploited (bottom-quantile) members. Non-finite fitness (no completed
+    episode yet, diverged member) ranks below every finite score, so a NaN
+    member is always an exploit TARGET, never a source. Pure gather math —
+    safe inside jit/shard_map."""
+    n = int(pop_size * quantile)
+    n = max(1, n) if pop_size > 1 else 0
+    identity = jnp.arange(pop_size, dtype=jnp.int32)
+    if n == 0:
+        return identity, jnp.zeros((pop_size,), dtype=bool)
+    fit = jnp.where(jnp.isfinite(fitness), fitness, -jnp.inf)
+    order = jnp.argsort(fit)  # ascending: worst first
+    bottom = order[:n]
+    top = order[pop_size - n:]
+    src = identity.at[bottom].set(top.astype(jnp.int32))
+    is_bottom = jnp.zeros((pop_size,), dtype=bool).at[bottom].set(True)
+    return src, is_bottom
+
+
+def _copy_rows(tree: Any, src: jax.Array, do: jax.Array, pop_size: int) -> Any:
+    """where(do, x[src], x) over every [P]-leading leaf of `tree`."""
+
+    def sel(x: jax.Array) -> jax.Array:
+        moved = jnp.take(x, src, axis=0)
+        mask = do.reshape((pop_size,) + (1,) * (x.ndim - 1))
+        return jnp.where(mask, moved, x)
+
+    return jax.tree.map(sel, tree)
+
+
+def _resampled_keys(template: jax.Array, key: jax.Array) -> jax.Array:
+    """Fresh raw uint32 PRNG keys shaped like a member-key leaf [P, S, U, 2]:
+    a cloned member must explore, not replay its source's stream."""
+    flat = int(jnp.size(template) // 2)
+    fresh = jax.random.split(key, flat)
+    return fresh.reshape(template.shape).astype(template.dtype)
+
+
+def perturb_hparams(
+    hparams: Dict[str, jax.Array],
+    src: jax.Array,
+    do: jax.Array,
+    key: jax.Array,
+    scale: float,
+) -> Dict[str, jax.Array]:
+    """Copy each exploited member's hparams from its source, then multiply
+    the perturbable ones by (1 +- scale) — one Bernoulli coin per
+    (member, hparam), keyed deterministically by sorted hparam order so the
+    explore step is replayable (and pinnable) from the pbt key."""
+    pop_size = int(do.shape[0])
+    out: Dict[str, jax.Array] = {}
+    for i, name in enumerate(sorted(hparams)):
+        v = hparams[name]
+        copied = jnp.take(v, src, axis=0)
+        if name in PERTURBABLE:
+            coins = jax.random.bernoulli(
+                jax.random.fold_in(key, i), 0.5, (pop_size,)
+            )
+            factors = jnp.where(coins, 1.0 + scale, 1.0 - scale).astype(v.dtype)
+            copied = copied * factors
+        out[name] = jnp.where(do, copied, v)
+    return out
+
+
+def make_pbt_step(settings: PBTSettings, pop_size: int):
+    """Build the pure exploit/explore transform over a PopulationState.
+
+    Runs EVERY window inside the learn program (uniform collectives — no
+    cond whose branches diverge across shards); `fire` gates the writes with
+    where(), so off-cadence windows are an identity at selection cost only.
+    """
+
+    def pbt_step(state: Any) -> Any:
+        src, is_bottom = truncation_selection(
+            state.fitness, pop_size, settings.quantile
+        )
+        fire = (state.updates_done > 0) & (
+            state.updates_done % settings.interval == 0
+        )
+        do = is_bottom & fire
+
+        key, hp_key, reseed_key = jax.random.split(state.pbt_key, 3)
+        members = state.members
+        members = members._replace(
+            params=_copy_rows(members.params, src, do, pop_size),
+            opt_states=_copy_rows(members.opt_states, src, do, pop_size),
+            obs_stats=_copy_rows(members.obs_stats, src, do, pop_size),
+            kl_beta=_copy_rows(members.kl_beta, src, do, pop_size),
+            key=jnp.where(
+                do.reshape((pop_size,) + (1,) * (members.key.ndim - 1)),
+                _resampled_keys(members.key, reseed_key),
+                members.key,
+            ),
+        )
+        # Exploited members inherit their source's fitness: ranking them by
+        # their own stale (pre-copy) score would re-exploit them every round
+        # until their first episode completes under the new params.
+        fitness = jnp.where(do, jnp.take(state.fitness, src), state.fitness)
+        return state._replace(
+            members=members,
+            hparams=perturb_hparams(
+                state.hparams, src, do, hp_key, settings.perturb_scale
+            ),
+            fitness=fitness,
+            pbt_key=key,
+            exploit_total=state.exploit_total + jnp.sum(do).astype(jnp.int32),
+        )
+
+    return pbt_step
+
+
+# ---------------------------------------------------------------------------
+# Integrity composition (docs/DESIGN.md §2.9)
+
+
+def member_fingerprints(params: Any) -> jax.Array:
+    """[P] uint32 — one fingerprint per member's params, via the sentinel's
+    position-salted murmur fold (resilience/integrity.py). Rides the
+    coalesced metric fetch as observability when
+    arch.population.member_fingerprints is on; a member whose fingerprint
+    diverges from its own history without an update is the silent-corruption
+    signal quarantine_members answers."""
+
+    def one(member_params: Any) -> jax.Array:
+        return fingerprint_leaves(jax.tree.leaves(member_params))
+
+    return jax.vmap(one)(params)
+
+
+def quarantine_members(state: Any, corrupt: jax.Array, pop_size: int) -> Any:
+    """Re-seed corrupt members from the fittest HEALTHY survivor instead of
+    killing the run: params/opt/obs_stats/kl_beta/hparams copy from the
+    survivor exactly, the corrupt members' PRNG streams resample, and their
+    fitness inherits the survivor's. Pure gather/where — jit-safe."""
+    fit = jnp.where(jnp.isfinite(state.fitness), state.fitness, -jnp.inf)
+    healthy_fit = jnp.where(corrupt, -jnp.inf, fit)
+    survivor = jnp.argmax(healthy_fit).astype(jnp.int32)
+    src = jnp.where(corrupt, survivor, jnp.arange(pop_size, dtype=jnp.int32))
+
+    key, reseed_key = jax.random.split(state.pbt_key)
+    members = state.members
+    members = members._replace(
+        params=_copy_rows(members.params, src, corrupt, pop_size),
+        opt_states=_copy_rows(members.opt_states, src, corrupt, pop_size),
+        obs_stats=_copy_rows(members.obs_stats, src, corrupt, pop_size),
+        kl_beta=_copy_rows(members.kl_beta, src, corrupt, pop_size),
+        key=jnp.where(
+            corrupt.reshape((pop_size,) + (1,) * (members.key.ndim - 1)),
+            _resampled_keys(members.key, reseed_key),
+            members.key,
+        ),
+    )
+    hparams = {
+        name: jnp.where(corrupt, jnp.take(v, src, axis=0), v)
+        for name, v in state.hparams.items()
+    }
+    return state._replace(
+        members=members,
+        hparams=hparams,
+        fitness=jnp.where(corrupt, jnp.take(state.fitness, src), state.fitness),
+        pbt_key=key,
+    )

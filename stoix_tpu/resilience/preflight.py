@@ -231,27 +231,39 @@ def validate_config(config: Any, device_count: Optional[int] = None) -> None:
 
     is_sebulba = str(arch.get("architecture_name", "anakin")) == "sebulba"
     if is_sebulba:
-        actor_ids = list((arch.get("actor") or {}).get("device_ids") or [])
-        learner_ids = list((arch.get("learner") or {}).get("device_ids") or [])
-        eval_id = arch.get("evaluator_device_id", 0)
-        if not actor_ids or not learner_ids:
-            findings.append(
-                "arch.actor.device_ids and arch.learner.device_ids must both be non-empty"
-            )
-        if device_count is not None:
-            bad = [i for i in (*actor_ids, *learner_ids, eval_id) if not 0 <= int(i) < device_count]
-            if bad:
-                findings.append(
-                    f"device ids {sorted(set(int(b) for b in bad))} out of range for the "
-                    f"{device_count} probed devices (actor={actor_ids}, "
-                    f"learner={learner_ids}, evaluator={eval_id})"
-                )
+        # The actor/learner/evaluator split validates through the SAME
+        # mesh-role resolution the run itself uses (parallel/roles.py,
+        # docs/DESIGN.md §2.11) — id ranges, non-empty primary roles, and
+        # partial act/learn overlaps all surface here as findings. The
+        # resolution half is jax-free by design, so this stays safe before
+        # any device work; imported lazily because the parallel package
+        # itself pulls in jax.
+        from stoix_tpu.parallel.roles import MeshRolesError, resolve_assignments
+
+        # The env split must be checked against the ACT role's device count —
+        # the run takes actor devices from the resolved roles, so an explicit
+        # arch.roles.act overriding the legacy arch.actor.device_ids must be
+        # honored here too (legacy keys only as a fallback when resolution
+        # itself failed or the all-devices count is unknowable pre-probe).
+        n_actor_devices = None
+        try:
+            assignments = resolve_assignments(config, device_count=device_count)
+            act = assignments.get("act")
+            if act is not None:
+                if act.device_ids is not None:
+                    n_actor_devices = len(act.device_ids)
+                elif device_count is not None:
+                    n_actor_devices = device_count
+        except MeshRolesError as exc:
+            findings.extend(exc.findings)
+        if n_actor_devices is None:
+            n_actor_devices = len(list((arch.get("actor") or {}).get("device_ids") or []))
         actors_per_device = int((arch.get("actor") or {}).get("actor_per_device", 1) or 1)
-        num_actors = max(1, len(actor_ids)) * max(1, actors_per_device)
+        num_actors = max(1, n_actor_devices) * max(1, actors_per_device)
         if total_num_envs is not None and total_num_envs % num_actors != 0:
             findings.append(
                 f"arch.total_num_envs ({total_num_envs}) must be divisible by "
-                f"num_actors ({len(actor_ids)} device(s) x {actors_per_device} "
+                f"num_actors ({n_actor_devices} device(s) x {actors_per_device} "
                 f"actor(s)/device = {num_actors})"
             )
     else:

@@ -37,10 +37,16 @@ class InferenceEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         greedy: bool = True,
         key: Optional[jax.Array] = None,
+        device: Optional[jax.Device] = None,
     ):
         self.buckets = normalize_buckets(buckets)
         self._obs_template = obs_template
-        self._params = jax.device_put(params)
+        # The serving device comes from the mesh-role abstraction
+        # (parallel/roles.py `serve` role) when the server is built from
+        # config; None keeps jax's default device — identical placement,
+        # since the default serve role is device 0.
+        self._device = device
+        self._params = jax.device_put(params, device)
         self._params_version = 0
         self._swap_lock = threading.Lock()
         self._base_key = key if key is not None else jax.random.PRNGKey(0)
@@ -72,7 +78,7 @@ class InferenceEngine:
         """Install fresh params under the in-flight jitted step: device_put
         first (the expensive part, off the request path), then ONE reference
         assignment. Returns the new version number."""
-        local = jax.device_put(params)
+        local = jax.device_put(params, self._device)
         with self._swap_lock:
             self._params = local
             self._params_version += 1
@@ -141,7 +147,7 @@ class InferenceEngine:
             return f"candidate params carry non-finite values at {bad}", None
         bucket = self.buckets[0]
         batched = self.batch_observations([self._obs_template] * bucket, bucket)
-        local = jax.device_put(params)
+        local = jax.device_put(params, self._device)
         try:
             action, extras = self._step(local, batched, self._base_key)
             outputs = jax.tree.map(np.asarray, (action, extras))

@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 from stoix_tpu.observability import get_logger, span
+from stoix_tpu.parallel import MeshRoles
 from stoix_tpu.serve import checkpoint as serve_checkpoint
 from stoix_tpu.serve.batcher import DEFAULT_BUCKETS, DynamicBatcher, PendingRequest
 from stoix_tpu.serve.engine import InferenceEngine
@@ -58,11 +59,13 @@ class PolicyServer:
         hot_swap_poll_s: float = 0.0,
         hot_swap_canary: bool = True,
         compile_deadline_s: float = 600.0,
+        device: Optional[jax.Device] = None,
     ):
         self.telemetry = ServeTelemetry()
         self.obs_template = obs_template
         self._engine = InferenceEngine(
-            apply_fn, params, obs_template, buckets=buckets, greedy=greedy, key=key
+            apply_fn, params, obs_template, buckets=buckets, greedy=greedy, key=key,
+            device=device,
         )
         self._batcher = DynamicBatcher(
             buckets=buckets, max_wait_s=max_wait_s, max_queue=max_queue
@@ -86,11 +89,20 @@ class PolicyServer:
             )
 
     @classmethod
-    def from_config(cls, config: Any) -> "PolicyServer":
+    def from_config(cls, config: Any, roles: Optional[MeshRoles] = None) -> "PolicyServer":
         """Build from a composed serve config (the `default/serve.yaml` root
-        with the configs/arch/serve.yaml block under config.arch.serve)."""
+        with the configs/arch/serve.yaml block under config.arch.serve).
+
+        Device assignment rides the unified mesh-role abstraction
+        (parallel/roles.py, docs/DESIGN.md §2.11): the `serve` role names the
+        device the engine owns (default: device 0 — jax's default device,
+        i.e. the pre-MeshRoles placement). Pass `roles` to share one
+        MeshRoles object across subsystems (e.g. a colocated train+serve
+        deployment)."""
         bundle = serve_checkpoint.load_policy(config)
         serve_cfg = config.arch.serve
+        if roles is None:
+            roles = MeshRoles.from_config(config)
         batching = serve_cfg.batching
         hot_swap = serve_cfg.hot_swap
         seed = int(serve_cfg.get("seed", 0))
@@ -110,6 +122,7 @@ class PolicyServer:
             ),
             hot_swap_canary=bool(hot_swap.get("canary", True)),
             compile_deadline_s=float(serve_cfg.compile_deadline_s),
+            device=roles.device("serve"),
         )
 
     # -- lifecycle ------------------------------------------------------------

@@ -10,6 +10,23 @@ quantile by score) and bad; numeric params get Parzen (Gaussian-kernel)
 densities l(x) over good and g(x) over bad, candidates are drawn from l and
 ranked by l/g; choice params use smoothed count ratios.
 
+Backends (docs/DESIGN.md §2.11):
+    sequential — one compile+train per trial point (the historical shape);
+    population — the whole grid/TPE batch maps onto ONE mesh-parallel
+        population run (stoix_tpu/population): every point becomes a member
+        on the ("pop", "data") mesh, trained in a single jitted program. The
+        results JSON schema is identical; `score` is the member's final
+        fitness (mean completed-episode return of the last eval window on
+        the training envs) and `wall_s` is the shared run wall. Requires
+        every swept key to be a liftable hparam
+        (population.hparams.LIFTABLE_HPARAMS) and the ff_ppo module.
+
+Every trial record carries `wall_s` (per-trial wall-clock seconds) and
+`error` (None, or {type, message} — the typed failure reason; a failed trial
+scores -inf explicitly instead of silently folding into _finite_score, and
+serializes as `"score": null` so the results lines stay strict RFC-8259
+JSON — json.dumps would otherwise print the -Infinity token).
+
 Usage:
     python -m stoix_tpu.sweep --module stoix_tpu.systems.ppo.anakin.ff_ppo \
         --default default/anakin/default_ff_ppo.yaml --trials 8 \
@@ -27,7 +44,8 @@ import importlib
 import itertools
 import json
 import random
-from typing import Any, Dict, List, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 from stoix_tpu.utils import config as config_lib
 
@@ -77,10 +95,14 @@ def sample_point(space: Dict[str, Tuple[str, list]], rng: random.Random) -> Dict
 def _finite_score(r: Dict[str, Any]) -> float:
     """NaN scores (diverged trials) rank BELOW every finite score — a NaN sort
     key would otherwise scramble the good/bad split and could even surface the
-    diverged trial as 'best'."""
+    diverged trial as 'best'. None (the serialized form of a non-finite score,
+    see _trial_record) ranks the same."""
     import math
 
-    s = float(r["score"])
+    s = r["score"]
+    if s is None:
+        return -math.inf
+    s = float(s)
     return s if math.isfinite(s) else -math.inf
 
 
@@ -153,6 +175,30 @@ def grid_points(space: Dict[str, Tuple[str, list]]) -> List[Dict[str, Any]]:
     return [dict(zip(keys, combo)) for combo in itertools.product(*choices)]
 
 
+def _trial_record(
+    trial: int,
+    point: Dict[str, Any],
+    score: float,
+    wall_s: float,
+    error: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """ONE results-JSON schema for both backends: params + score + wall-clock
+    + typed failure reason (None on success). A non-finite score (failed or
+    diverged trial) is recorded as None — json.dumps would otherwise emit the
+    non-RFC-8259 tokens -Infinity/NaN and every strict consumer (jq,
+    JSON.parse) would reject the whole results line."""
+    import math
+
+    score = float(score)
+    return {
+        "trial": trial,
+        "params": point,
+        "score": score if math.isfinite(score) else None,
+        "wall_s": round(float(wall_s), 3),
+        "error": error,
+    }
+
+
 def run_sweep(
     module: str,
     default: str,
@@ -161,9 +207,17 @@ def run_sweep(
     trials: int = 8,
     method: str = "random",
     seed: int = 0,
+    backend: str = "sequential",
 ) -> Dict[str, Any]:
+    if backend == "population":
+        return run_population_sweep(
+            module, default, space, fixed_overrides,
+            trials=trials, method=method, seed=seed,
+        )
+    if backend != "sequential":
+        raise ValueError(f"unknown sweep backend '{backend}' (sequential|population)")
     mod = importlib.import_module(module)
-    rng = random.Random(seed)
+    rng = random.Random(seed)  # noqa: STX005 — stdlib int seed (the population dispatch above returns)
     if method == "grid":
         points: List[Any] = grid_points(space)
     elif method == "tpe":
@@ -180,10 +234,119 @@ def run_sweep(
         # re-parsing via YAML 1.1 would silently turn them into strings).
         for k, v in point.items():
             config_lib._set_dotted(cfg, k, v)
-        score = mod.run_experiment(cfg)
-        results.append({"trial": i, "params": point, "score": float(score)})
+        start = time.perf_counter()
+        try:
+            score = float(mod.run_experiment(cfg))
+            error = None
+        except Exception as exc:  # noqa: BLE001 — one diverged/misconfigured
+            # trial must not kill the sweep; the typed reason rides the
+            # results JSON and the trial scores -inf EXPLICITLY (never a
+            # silent _finite_score fold).
+            score = float("-inf")
+            error = {"type": type(exc).__name__, "message": str(exc)}
+        results.append(
+            _trial_record(i, point, score, time.perf_counter() - start, error)
+        )
         print(json.dumps(results[-1]), flush=True)
 
+    best = max(results, key=_finite_score)
+    print(json.dumps({"best": best}), flush=True)
+    return best
+
+
+POPULATION_MODULES = ("stoix_tpu.systems.ppo.anakin.ff_ppo",)
+
+
+def batch_points(
+    space: Dict[str, Tuple[str, list]], trials: int, method: str, seed: int
+) -> List[Dict[str, Any]]:
+    """The whole batch of trial points, decided UP FRONT (one population run
+    trains them all simultaneously — there is no sequential history for TPE
+    to adapt on, so tpe degenerates to its random-startup phase here)."""
+    rng = random.Random(seed)
+    if method == "grid":
+        return grid_points(space)
+    return [sample_point(space, rng) for _ in range(trials)]
+
+
+def run_population_sweep(
+    module: str,
+    default: str,
+    space: Dict[str, Tuple[str, list]],
+    fixed_overrides: List[str],
+    trials: int = 8,
+    method: str = "random",
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """Map a grid/TPE batch onto ONE mesh-parallel population run
+    (stoix_tpu/population, docs/DESIGN.md §2.11): every trial point becomes a
+    population member; one compile, one train, P scores."""
+    from stoix_tpu.population import (
+        LIFTABLE_HPARAMS,
+        run_population_experiment,
+        LAST_POPULATION_STATS,
+    )
+
+    if module not in POPULATION_MODULES:
+        raise ValueError(
+            f"--backend population supports {', '.join(POPULATION_MODULES)} "
+            f"(got {module}): the population runner threads hparams through "
+            "ff_ppo's vmapped learner"
+        )
+    unliftable = sorted(k for k in space if k not in LIFTABLE_HPARAMS)
+    if unliftable:
+        raise ValueError(
+            f"--backend population cannot lift {', '.join(unliftable)} onto "
+            f"the pop axis; liftable keys: {', '.join(sorted(LIFTABLE_HPARAMS))}"
+        )
+
+    points = batch_points(space, trials, method, seed)
+    cfg = config_lib.compose(
+        config_lib.default_config_dir(), default,
+        ["arch=population", *fixed_overrides],
+    )
+    config_lib._set_dotted(cfg, "arch.population.size", len(points))
+    # Typed per-member value lists, keyed by the dotted path (the same typed
+    # injection discipline as the sequential backend).
+    config_lib._set_dotted(
+        cfg,
+        "arch.population.hparams",
+        {key: [point[key] for point in points] for key in space},
+    )
+
+    start = time.perf_counter()
+    error: Optional[Dict[str, str]] = None
+    fitness: List[float] = []
+    try:
+        run_population_experiment(cfg)
+        fitness = list(LAST_POPULATION_STATS.get("member_fitness") or [])
+    except Exception as exc:  # noqa: BLE001 — the population trains as ONE
+        # program, so a failure is shared: every trial records the same typed
+        # reason (the sequential backend's schema, P times).
+        error = {"type": type(exc).__name__, "message": str(exc)}
+    wall = time.perf_counter() - start
+    if error is None and len(fitness) != len(points):
+        # The run completed but the runner's stats don't cover the members —
+        # a runner contract violation, reported as its own typed reason
+        # rather than masquerading as a training failure (or IndexError-ing
+        # out of the success path).
+        error = {
+            "type": "PopulationStatsError",
+            "message": (
+                f"member_fitness has {len(fitness)} entries for "
+                f"{len(points)} members"
+            ),
+        }
+    results = [
+        _trial_record(
+            i, point,
+            fitness[i] if error is None else float("-inf"),
+            wall, error,
+        )
+        for i, point in enumerate(points)
+    ]
+    for record in results:
+        print(json.dumps(record), flush=True)
     best = max(results, key=_finite_score)
     print(json.dumps({"best": best}), flush=True)
     return best
@@ -196,6 +359,11 @@ def main(argv: List[str] | None = None) -> Dict[str, Any]:
     parser.add_argument("--trials", type=int, default=8)
     parser.add_argument("--method", choices=["random", "grid", "tpe"], default="random")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--backend", choices=["sequential", "population"], default="sequential",
+        help="sequential = one run per trial; population = the whole batch "
+        "as ONE mesh-parallel population run (stoix_tpu/population)",
+    )
     parser.add_argument("--space", nargs="+", required=True)
     parser.add_argument("--set", nargs="*", default=[], dest="overrides",
                         help="fixed key=value overrides")
@@ -208,6 +376,7 @@ def main(argv: List[str] | None = None) -> Dict[str, Any]:
         trials=args.trials,
         method=args.method,
         seed=args.seed,
+        backend=args.backend,
     )
 
 

@@ -81,13 +81,25 @@ def get_learner_fn(
     update_fns: Tuple[Callable, Callable],
     config: Any,
     policy_loss_fn: Callable = None,
+    hparams: Any = None,
 ) -> Callable[[PPOLearnerState], ExperimentOutput]:
     """Build the PER-SHARD learner function (wrapped in shard_map by setup).
 
     policy_loss_fn(dist, action, old_log_prob, gae, config, behavior_dist=...)
         -> (loss, entropy); behavior_dist is the pre-epoch policy re-applied
         on the same observations (analytic-KL penalties anchor to it)
-    overrides the PPO clip objective (penalty/DPO variants)."""
+    overrides the PPO clip objective (penalty/DPO variants).
+
+    `hparams` (stoix_tpu/population, docs/DESIGN.md §2.11): a mapping of
+    hyperparameter name -> scalar that OVERRIDES the config float. The plain
+    path passes None and every value stays a trace-time Python float —
+    byte-identical jaxprs. The population runner calls get_learner_fn inside
+    its vmapped member function with per-member TRACED scalars, so one
+    compiled program trains P members with different lr/ent_coef/gamma/...
+    When `actor_lr`/`critic_lr` are present, `update_fns` must be built
+    WITHOUT a learning rate (clip + scale_by_adam); the lr multiply happens
+    here as `u * (-lr)` — bitwise the same multiply optax's scale(-lr) does.
+    """
 
     actor_apply, critic_apply = apply_fns
     actor_update, critic_update = update_fns
@@ -99,8 +111,15 @@ def get_learner_fn(
             "system.adaptive_kl_beta=true requires a policy loss that consumes "
             "kl_beta (the PPO-penalty loss); the configured loss does not."
         )
-    gamma = float(config.system.gamma)
-    reward_scale = float(config.system.get("reward_scale", 1.0))
+    hp = dict(hparams or {})
+    gamma = hp.get("gamma", float(config.system.gamma))
+    reward_scale = hp.get("reward_scale", float(config.system.get("reward_scale", 1.0)))
+    gae_lambda = hp.get("gae_lambda", float(config.system.gae_lambda))
+    clip_eps = hp.get("clip_eps", float(config.system.clip_eps))
+    ent_coef = hp.get("ent_coef", float(config.system.ent_coef))
+    vf_coef = hp.get("vf_coef", float(config.system.vf_coef))
+    actor_lr = hp.get("actor_lr")  # None = update_fns already bake the lr
+    critic_lr = hp.get("critic_lr")
     normalize_obs = bool(config.system.get("normalize_observations", False))
     guard_mode = guards.resolve_mode(config)
     # Hot-path compute knobs (docs/DESIGN.md §2.7): which scan kernel
@@ -165,22 +184,18 @@ def get_learner_fn(
             )
         else:
             log_prob = actor_policy.log_prob(action)
-            loss_actor = losses.ppo_clip_loss(
-                log_prob, old_log_prob, gae, float(config.system.clip_eps)
-            )
+            loss_actor = losses.ppo_clip_loss(log_prob, old_log_prob, gae, clip_eps)
             entropy = actor_policy.entropy().mean()
-        total = loss_actor - float(config.system.ent_coef) * entropy
+        total = loss_actor - ent_coef * entropy
         return total, (loss_actor, entropy)
 
     def _critic_loss_fn(critic_params, obs, targets, old_value):
         value = critic_apply(critic_params, obs)
         if config.system.get("clip_value", True):
-            value_loss = losses.clipped_value_loss(
-                value, old_value, targets, float(config.system.clip_eps)
-            )
+            value_loss = losses.clipped_value_loss(value, old_value, targets, clip_eps)
         else:
             value_loss = jnp.mean((value - targets) ** 2)
-        return float(config.system.vf_coef) * value_loss, value_loss
+        return vf_coef * value_loss, value_loss
 
     def _fused_loss_fn(
         joint_params, behavior_actor_params, obs, action, old_log_prob, gae,
@@ -251,10 +266,17 @@ def get_learner_fn(
         actor_updates, actor_opt_state = actor_update(
             actor_grads, opt_states.actor_opt_state
         )
+        if actor_lr is not None:
+            # Threaded lr (population path): update_fns end at scale_by_adam,
+            # so the update IS the adam direction; `u * (-lr)` is bitwise the
+            # multiply optax's scale(-lr) performs inside adam(lr).
+            actor_updates = jax.tree.map(lambda u: u * (-actor_lr), actor_updates)
         actor_params = optax.apply_updates(params.actor_params, actor_updates)
         critic_updates, critic_opt_state = critic_update(
             critic_grads, opt_states.critic_opt_state
         )
+        if critic_lr is not None:
+            critic_updates = jax.tree.map(lambda u: u * (-critic_lr), critic_updates)
         critic_params = optax.apply_updates(params.critic_params, critic_updates)
 
         # Divergence guard (resilience/guards.py): select the pre-update
@@ -356,7 +378,7 @@ def get_learner_fn(
         advantages, targets = truncated_generalized_advantage_estimation(
             traj_batch.reward * reward_scale,
             d_t,
-            float(config.system.gae_lambda),
+            gae_lambda,
             v_tm1=traj_batch.value,
             v_t=v_t,
             truncation_t=traj_batch.truncated.astype(jnp.float32),
@@ -429,18 +451,11 @@ def get_learner_fn(
     return learner_fn
 
 
-def learner_setup(
-    env: envs.Environment, config: Any, mesh: Mesh, keys: jax.Array,
-    policy_loss_fn: Callable = None,
-) -> AnakinSetup:
-    """Instantiate networks/optimizers, build the shard_mapped learner, and
-    initialise the (globally sharded) learner state."""
-
+def build_networks(env: envs.Environment, config: Any):
+    """Actor/critic network construction from the network config — shared by
+    learner_setup and the population setup (stoix_tpu/population), which
+    builds ONE network pair for all P members."""
     from stoix_tpu.systems import anakin
-
-    num_actions = env.num_actions
-    config.system.action_dim = num_actions
-
     from stoix_tpu.networks.base import FeedForwardActor, FeedForwardCritic
 
     net_cfg = config.network
@@ -457,6 +472,22 @@ def learner_setup(
         torso=config_lib.instantiate(net_cfg.critic_network.pre_torso),
         input_layer=config_lib.instantiate(net_cfg.critic_network.input_layer),
     )
+    return actor_network, critic_network
+
+
+def learner_setup(
+    env: envs.Environment, config: Any, mesh: Mesh, keys: jax.Array,
+    policy_loss_fn: Callable = None,
+) -> AnakinSetup:
+    """Instantiate networks/optimizers, build the shard_mapped learner, and
+    initialise the (globally sharded) learner state."""
+
+    from stoix_tpu.systems import anakin
+
+    num_actions = env.num_actions
+    config.system.action_dim = num_actions
+
+    actor_network, critic_network = build_networks(env, config)
 
     actor_lr = make_learning_rate(
         float(config.system.actor_lr), config, int(config.system.epochs),

@@ -47,7 +47,7 @@ from stoix_tpu.ops import (
     scan_kernels,
     truncated_generalized_advantage_estimation,
 )
-from stoix_tpu.parallel import assemble_global_array
+from stoix_tpu.parallel import MeshRoles, assemble_global_array
 from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.resilience import (
     PreemptionHandler,
@@ -420,12 +420,18 @@ def run_experiment(
             backoff_max_s=pf.probe_backoff_max_s,
         )
         preflight.validate_config(config, device_count=probe.device_count)
-    devices = jax.devices()
-    actor_devices = [devices[i] for i in config.arch.actor.device_ids]
-    learner_devices = [devices[i] for i in config.arch.learner.device_ids]
-    evaluator_device = devices[int(config.arch.evaluator_device_id)]
-    learner_mesh = Mesh(np.array(learner_devices), ("data",))
-    eval_mesh = Mesh(np.array([evaluator_device]), ("data",))
+    # Device assignment through the unified mesh-role abstraction
+    # (parallel/roles.py, docs/DESIGN.md §2.11): the actor/learner/evaluator
+    # split — historically resolved ad hoc from arch.actor.device_ids /
+    # arch.learner.device_ids / arch.evaluator_device_id — now arrives as one
+    # validated MeshRoles object (the same object the Anakin runner, serve,
+    # and the population runner consume).
+    roles = MeshRoles.from_config(config)
+    actor_devices = roles.role_devices("act")
+    learner_devices = roles.role_devices("learn")
+    evaluator_device = roles.device("evaluate")
+    learner_mesh = roles.learn_mesh()
+    eval_mesh = roles.role_mesh("evaluate")
 
     actors_per_device = int(config.arch.actor.actor_per_device)
     num_actors = len(actor_devices) * actors_per_device
@@ -622,6 +628,8 @@ def run_experiment(
     skipped_base = guards.skipped_counter().value()
     steady_start_time = None  # set after the first eval block (post-compile)
     steady_start_steps = 0
+    run_start_time = time.perf_counter()  # whole-run FPS denominator (incl.
+    # first-rollout compile — the number a fleet scheduler actually gets)
     fleet_window_started = time.perf_counter()
     try:
         for update_idx in range(int(config.arch.num_updates)):
@@ -821,6 +829,19 @@ def run_experiment(
         ).set(steady)
         LAST_RUN_STATS["steps_per_sec_steady"] = steady
         LAST_RUN_STATS["steady_window_steps"] = t_steps - steady_start_steps
+    if t_steps > 0:
+        # Whole-run env frames per second (ROADMAP item-1 leftover): total
+        # env steps over the full learner-loop wall INCLUDING first-rollout
+        # compile — the steady number above excludes it by design; this one
+        # is what a scheduler provisioning actor fleets observes. First-class
+        # in the bench --sebulba payload as `fps` (+ rep dispersion).
+        fps = t_steps / max(steady_end_time - run_start_time, 1e-9)
+        get_registry().gauge(
+            "stoix_tpu_sebulba_fps",
+            "Whole-run env-steps/sec (incl. compile) of the most recent run",
+        ).set(fps)
+        LAST_RUN_STATS["fps"] = fps
+        LAST_RUN_STATS["total_env_steps"] = t_steps
     LAST_RUN_STATS["resilience"] = {
         "update_guard": guard_mode,
         "skipped_updates": guards.skipped_counter().value() - skipped_base,

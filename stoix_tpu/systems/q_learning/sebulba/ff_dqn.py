@@ -44,7 +44,7 @@ from stoix_tpu.base_types import OnlineAndTarget, Transition
 from stoix_tpu.envs.factory import make_factory
 from stoix_tpu.evaluator import get_distribution_act_fn, get_ff_evaluator_fn
 from stoix_tpu.observability import RunStats, get_logger, get_registry, span
-from stoix_tpu.parallel import assemble_global_array
+from stoix_tpu.parallel import MeshRoles, assemble_global_array
 from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.replay import ShardedReplayService, service_from_config
 from stoix_tpu.resilience import (
@@ -319,12 +319,15 @@ def run_experiment(config: Any) -> float:
     guard_mode = guards.resolve_mode(config)
     compilecache.configure(config)
 
-    devices = jax.devices()
-    actor_devices = [devices[i] for i in config.arch.actor.device_ids]
-    learner_devices = [devices[i] for i in config.arch.learner.device_ids]
-    evaluator_device = devices[int(config.arch.evaluator_device_id)]
-    learner_mesh = Mesh(np.array(learner_devices), ("data",))
-    eval_mesh = Mesh(np.array([evaluator_device]), ("data",))
+    # One validated MeshRoles object replaces the ad-hoc device-id split
+    # (parallel/roles.py, docs/DESIGN.md §2.11); the learn mesh it yields is
+    # also what the sharded replay service's data axis lives on below.
+    roles = MeshRoles.from_config(config)
+    actor_devices = roles.role_devices("act")
+    learner_devices = roles.role_devices("learn")
+    evaluator_device = roles.device("evaluate")
+    learner_mesh = roles.learn_mesh()
+    eval_mesh = roles.role_mesh("evaluate")
 
     actors_per_device = int(config.arch.actor.actor_per_device)
     num_actors = len(actor_devices) * actors_per_device

@@ -115,7 +115,7 @@ from stoix_tpu.observability import (
     span,
 )
 from stoix_tpu.parallel import (
-    create_mesh,
+    MeshRoles,
     fetch_global,
     fetch_global_async,
     is_coordinator,
@@ -255,7 +255,13 @@ def run_anakin_experiment(
                 probe.attempts,
             )
     maybe_initialize_distributed(config)
-    mesh = create_mesh(dict(config.arch.get("mesh") or {"data": -1}))
+    # Device assignment goes through the unified mesh-role abstraction
+    # (parallel/roles.py, docs/DESIGN.md §2.11): Anakin's learn role owns the
+    # whole `arch.mesh` (colocated act/learn/evaluate), so this is the same
+    # mesh create_mesh built directly before MeshRoles existed — and the
+    # population runner's ("pop", "data") mesh arrives through the same path.
+    roles = MeshRoles.from_config(config)
+    mesh = roles.learn_mesh()
     # Fleet coordination (docs/DESIGN.md §2.6, arch.fleet): cross-host agreed
     # stop decisions (flags piggybacked on the coalesced metric fetch),
     # heartbeat-based partition detection, straggler skew telemetry, and the

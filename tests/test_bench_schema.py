@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 PHASE_KEYS = ("compile_s", "learn_s", "eval_s", "fetch_s", "ckpt_s")
@@ -172,6 +174,98 @@ def test_bench_serve_payload_schema():
     # Launch-hardening posture fields are universal across workloads.
     assert payload["fallback"] is False
     assert payload["fallback_reason"] is None
+
+
+@pytest.mark.slow
+def test_bench_sebulba_payload_schema():
+    """`bench.py --sebulba`: whole-run env-steps/sec (FPS) is a FIRST-CLASS
+    payload field (ROADMAP item-1 leftover) — value + rep dispersion —
+    alongside the steady-state `value` the workload always carried.
+
+    Slow lane (the PR 14 budget discipline): a whole-experiment subprocess
+    rides outside the 870s tier-1 window; the in-process fps computation is
+    covered not-slow via LAST_RUN_STATS in tests/test_integrity.py's
+    Sebulba eval-boundary run."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--sebulba", "--smoke", "--cpu",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=600,
+        # Strip the conftest 8-virtual-device fan-out: a standalone bench run
+        # sees the real device count, and the smoke Sebulba split (actors on
+        # device 0, learner on the rest) sizes its env chunks for that.
+        env={
+            **{k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+            "JAX_PLATFORMS": "cpu",
+            "STOIX_BENCH_NO_FALLBACK": "1",
+        },
+    )
+    assert proc.returncode == 0, f"bench.py --sebulba failed:\n{proc.stdout}\n{proc.stderr}"
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, f"expected exactly one JSON line:\n{proc.stdout}"
+    payload = json.loads(json_lines[0])
+
+    assert payload["metric"] == "sebulba_ppo_cartpole_env_steps_per_sec"
+    assert payload["value"] > 0 and "steady-state" in payload["unit"]
+    # FPS: total env steps over the FULL learner-loop wall (incl. the
+    # first-rollout compile the steady window excludes) — so fps is always
+    # below the steady rate on a short smoke run, never above it.
+    fps = payload["fps"]
+    assert fps["value"] > 0, payload
+    assert fps["reps"] == payload["reps"] == 1
+    assert fps["min"] <= fps["median"] <= fps["max"]
+    assert fps["rel_spread"] >= 0.0
+    assert fps["value"] <= payload["value"], (fps, payload["value"])
+
+
+@pytest.mark.slow
+def test_bench_population_payload_schema():
+    """`bench.py --population` (docs/DESIGN.md §2.11): TWO payload lines —
+    P=1 (the bit-identity anchor) and P=8 with live PBT — each carrying
+    aggregate env-steps/sec with standard rep dispersion, per-member fitness
+    dispersion, and the PBT exploit count; numeric `value` + `median` +
+    `rel_spread` keep the lines --check-composable."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--population", "--smoke", "--cpu",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "STOIX_BENCH_NO_FALLBACK": "1"},
+    )
+    assert proc.returncode == 0, (
+        f"bench.py --population failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 2, f"expected two JSON lines (P=1, P=8):\n{proc.stdout}"
+    p1, p8 = (json.loads(ln) for ln in json_lines)
+
+    assert p1["metric"] == "population_ppo_identity_game_p1_env_steps_per_sec"
+    assert p8["metric"] == "population_ppo_identity_game_p8_env_steps_per_sec"
+    for payload, pop_size in ((p1, 1), (p8, 8)):
+        assert payload["value"] > 0 and "aggregate env_steps/sec" in payload["unit"]
+        assert payload["population_size"] == pop_size
+        assert payload["reps"] == 1
+        assert payload["min"] <= payload["median"] <= payload["max"]
+        assert payload["rel_spread"] >= 0.0
+        dispersion = payload["member_fitness_dispersion"]
+        assert dispersion["members"] == pop_size
+        assert dispersion["min"] <= dispersion["median"] <= dispersion["max"]
+        assert isinstance(payload["pbt_exploits"], int)
+        assert payload["compile_s"] > 0.0  # AOT warmup is real (not degraded)
+        # Universal posture fields, like every other workload payload.
+        assert "resilience" in payload and "integrity" in payload
+        assert payload["fallback"] is False
+    # P=1 never exploits; P=8 runs live truncation selection every window.
+    assert p1["pbt_enabled"] is False and p1["pbt_exploits"] == 0
+    assert p8["pbt_enabled"] is True and p8["pbt_exploits"] > 0
 
 
 def test_bench_backend_wedge_aborts_typed_within_deadline():

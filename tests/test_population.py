@@ -1,0 +1,498 @@
+"""Population-training contracts (stoix_tpu/population, docs/DESIGN.md §2.11).
+
+The acceptance pins:
+  * a population of P=1 with PBT disabled trains BIT-identically to the
+    plain Anakin ff_ppo run — with and without default-valued hparams lifted
+    onto the pop axis (the threading math itself is bitwise);
+  * truncation selection copies top-quantile members' params+hparams EXACTLY
+    while perturbing the copied hparams at exactly the pinned values, both
+    as the pure transform and observed through a real P=8 CPU training run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams
+from stoix_tpu.population import (
+    LIFTABLE_HPARAMS,
+    PopulationConfigError,
+    lift_hparams,
+    member_fingerprints,
+    quarantine_members,
+    truncation_selection,
+)
+from stoix_tpu.population import pbt as pbt_lib
+from stoix_tpu.population.runner import PopulationState, population_setup
+from stoix_tpu.population.runner import run_population_experiment
+from stoix_tpu.population.runner import LAST_POPULATION_STATS
+from stoix_tpu.systems.ppo.anakin.ff_ppo import PPOLearnerState, learner_setup
+from stoix_tpu.systems.runner import run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+
+BASE_OVERRIDES = [
+    "env=identity_game",
+    "arch.total_num_envs=16",
+    "arch.num_updates=4",
+    "arch.total_timesteps=~",
+    "arch.num_evaluation=2",
+    "arch.num_eval_episodes=8",
+    "arch.absolute_metric=False",
+    "system.rollout_length=4",
+    "system.epochs=1",
+    "system.num_minibatches=2",
+    "logger.use_console=False",
+]
+
+
+def _compose(root, extra=()):
+    return config_lib.compose(
+        config_lib.default_config_dir(), root, BASE_OVERRIDES + list(extra)
+    )
+
+
+def _record_plain():
+    trajectory = []
+    cfg = _compose("default/anakin/default_ff_ppo.yaml")
+
+    def recording_setup(env, config, mesh, key):
+        setup = learner_setup(env, config, mesh, key)
+        inner = setup.learn
+
+        def learn(state):
+            out = inner(state)
+            trajectory.append(jax.tree.map(np.asarray, out.learner_state.params))
+            return out
+
+        return setup._replace(learn=learn)
+
+    run_anakin_experiment(cfg, recording_setup)
+    return trajectory
+
+
+def _record_population(hparams=None, pbt=None, size=1, extra=()):
+    trajectory = []
+    cfg = _compose("default/population/default_ff_ppo.yaml", extra)
+    config_lib._set_dotted(cfg, "arch.population.size", size)
+    if hparams:
+        config_lib._set_dotted(cfg, "arch.population.hparams", hparams)
+    if pbt:
+        config_lib._set_dotted(cfg, "arch.population.pbt", pbt)
+
+    def recording_setup(env, config, mesh, key):
+        setup = population_setup(env, config, mesh, key)
+        inner = setup.learn
+
+        def learn(state):
+            out = inner(state)
+            trajectory.append(
+                {
+                    "params": jax.tree.map(
+                        np.asarray, out.learner_state.members.params
+                    ),
+                    "hparams": jax.tree.map(np.asarray, out.learner_state.hparams),
+                    "exploit_total": int(out.learner_state.exploit_total),
+                }
+            )
+            return out
+
+        return setup._replace(learn=learn)
+
+    run_anakin_experiment(cfg, recording_setup)
+    return trajectory
+
+
+def test_population_of_one_bit_identical_to_plain_ff_ppo(devices):
+    """THE acceptance pin: P=1, PBT off — the population machinery (pop mesh
+    axis, stacked state, fitness tracking, argmax-member eval) costs ZERO
+    trajectory deviation vs the plain Anakin ff_ppo run; and lifting
+    default-valued hparams onto the pop axis (traced scalars instead of
+    jaxpr constants, manual `u * (-lr)` instead of optax scale(-lr)) is
+    bitwise too."""
+    plain = _record_plain()
+    pop = _record_population()
+    pop_lifted = _record_population(
+        hparams={
+            "system.ent_coef": 0.01,
+            "system.actor_lr": 3.0e-4,
+            "system.critic_lr": 3.0e-4,
+            "system.gamma": 0.99,
+            "system.clip_eps": 0.2,
+        }
+    )
+    assert len(plain) == len(pop) == len(pop_lifted) == 2
+    for window, (a, b, c) in enumerate(zip(plain, pop, pop_lifted)):
+        member0 = jax.tree.map(lambda x: x[0], b["params"])
+        member0_lifted = jax.tree.map(lambda x: x[0], c["params"])
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                x, y, err_msg=f"population-of-1 diverged at window {window}"
+            ),
+            a,
+            member0,
+        )
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                x, y,
+                err_msg=f"lifted-hparams population-of-1 diverged at window {window}",
+            ),
+            a,
+            member0_lifted,
+        )
+
+
+# ---------------------------------------------------------------------------
+# PBT: the pure transform, pinned at exact values
+
+
+def _toy_population(pop_size=8, key_leaf_shape=(1, 1, 2)):
+    """A synthetic PopulationState with member-distinct leaves (the member
+    index is readable from every value, so a copy is provable bitwise)."""
+    idx = jnp.arange(pop_size, dtype=jnp.float32)
+    members = PPOLearnerState(
+        params=ActorCriticParams(
+            actor_params={"w": idx[:, None] * jnp.ones((pop_size, 3))},
+            critic_params={"v": 100.0 + idx[:, None] * jnp.ones((pop_size, 2))},
+        ),
+        opt_states=ActorCriticOptStates(
+            actor_opt_state={"mu": 0.5 * idx[:, None] * jnp.ones((pop_size, 3))},
+            critic_opt_state={"nu": 0.25 * idx},
+        ),
+        key=jnp.tile(
+            jnp.arange(pop_size, dtype=jnp.uint32)[:, None, None, None],
+            (1,) + key_leaf_shape,
+        ),
+        env_state={"s": idx},
+        timestep={"t": idx},
+        obs_stats={"mean": idx},
+        kl_beta=idx,
+    )
+    return PopulationState(
+        members=members,
+        hparams={
+            "ent_coef": 0.01 * (idx + 1.0),
+            "actor_lr": 1e-4 * (idx + 1.0),
+        },
+        fitness=jnp.asarray([10.0, 2.0, 8.0, 1.0, 5.0, 7.0, 3.0, 9.0]),
+        updates_done=jnp.asarray(2, dtype=jnp.int32),
+        pbt_key=jax.random.PRNGKey(123),
+        exploit_total=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def test_truncation_selection_indices():
+    src, is_bottom = truncation_selection(
+        jnp.asarray([10.0, 2.0, 8.0, 1.0, 5.0, 7.0, 3.0, 9.0]), 8, 0.25
+    )
+    src, is_bottom = np.asarray(src), np.asarray(is_bottom)
+    # Bottom quantile = fitness 1.0 (member 3) and 2.0 (member 1); top
+    # quantile sources = fitness 9.0 (member 7) and 10.0 (member 0).
+    assert is_bottom.tolist() == [False, True, False, True, False, False, False, False]
+    assert src[3] == 7 and src[1] == 0
+    untouched = [i for i in range(8) if i not in (1, 3)]
+    assert all(src[i] == i for i in untouched)
+    # NaN fitness ranks LAST: it becomes an exploit target, never a source.
+    src2, bottom2 = truncation_selection(
+        jnp.asarray([1.0, jnp.nan, 2.0, 3.0]), 4, 0.25
+    )
+    assert bool(np.asarray(bottom2)[1]) and int(np.asarray(src2)[1]) == 3
+
+
+def test_pbt_exploit_explore_pinned_exact_values():
+    """P=8 truncation selection: the exploited members' params/opt state copy
+    their source EXACTLY (bitwise), hparams copy-then-perturb at EXACTLY the
+    values the pbt key path dictates, and untouched members stay bitwise."""
+    state = _toy_population()
+    settings = pbt_lib.PBTSettings(
+        enabled=True, interval=1, quantile=0.25, perturb_scale=0.2
+    )
+    out = jax.jit(pbt_lib.make_pbt_step(settings, 8))(state)
+
+    # Params + opt state: exploited members 1<-0 and 3<-7, bitwise.
+    for (path_src, path_dst) in (((0,), (1,)), ((7,), (3,))):
+        src_i, dst_i = path_src[0], path_dst[0]
+        jax.tree.map(
+            lambda orig, new: np.testing.assert_array_equal(
+                np.asarray(orig)[src_i], np.asarray(new)[dst_i]
+            ),
+            state.members.params,
+            out.members.params,
+        )
+        jax.tree.map(
+            lambda orig, new: np.testing.assert_array_equal(
+                np.asarray(orig)[src_i], np.asarray(new)[dst_i]
+            ),
+            state.members.opt_states,
+            out.members.opt_states,
+        )
+    # Untouched members bitwise identical (params AND hparams).
+    untouched = [0, 2, 4, 5, 6, 7]
+    jax.tree.map(
+        lambda orig, new: np.testing.assert_array_equal(
+            np.asarray(orig)[untouched], np.asarray(new)[untouched]
+        ),
+        state.members,
+        out.members,
+    )
+
+    # Hparams: EXACT pinned values — replicate the pbt key path.
+    _key, hp_key, _reseed = jax.random.split(state.pbt_key, 3)
+    expected = {}
+    for i, name in enumerate(sorted(state.hparams)):
+        coins = jax.random.bernoulli(jax.random.fold_in(hp_key, i), 0.5, (8,))
+        factors = np.where(np.asarray(coins), np.float32(1.2), np.float32(0.8))
+        vals = np.asarray(state.hparams[name]).copy()
+        vals[1] = np.float32(np.asarray(state.hparams[name])[0]) * factors[1]
+        vals[3] = np.float32(np.asarray(state.hparams[name])[7]) * factors[3]
+        expected[name] = vals
+    for name in state.hparams:
+        np.testing.assert_array_equal(
+            np.asarray(out.hparams[name]), expected[name],
+            err_msg=f"hparam '{name}' not at the pinned perturbed values",
+        )
+
+    # Exploited members' PRNG streams resampled; fitness inherited.
+    assert not np.array_equal(
+        np.asarray(out.members.key)[1], np.asarray(state.members.key)[1]
+    )
+    assert np.asarray(out.fitness)[1] == 10.0 and np.asarray(out.fitness)[3] == 9.0
+    assert int(out.exploit_total) == 2
+    # env_state/timestep are NOT copied: a clone keeps its own envs.
+    np.testing.assert_array_equal(
+        np.asarray(out.members.env_state["s"]), np.asarray(state.members.env_state["s"])
+    )
+
+
+def test_pbt_off_cadence_is_identity():
+    state = _toy_population()
+    settings = pbt_lib.PBTSettings(
+        enabled=True, interval=4, quantile=0.25, perturb_scale=0.2
+    )
+    out = jax.jit(pbt_lib.make_pbt_step(settings, 8))(
+        state._replace(updates_done=jnp.asarray(3, dtype=jnp.int32))
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.members,
+        out.members,
+    )
+    for name in state.hparams:
+        np.testing.assert_array_equal(
+            np.asarray(out.hparams[name]), np.asarray(state.hparams[name])
+        )
+    assert int(out.exploit_total) == 0
+    # Window 0 (no fitness yet) never fires either.
+    out0 = jax.jit(pbt_lib.make_pbt_step(settings, 8))(
+        state._replace(updates_done=jnp.asarray(0, dtype=jnp.int32))
+    )
+    assert int(out0.exploit_total) == 0
+
+
+def test_p8_training_run_selection_observed(devices):
+    """The P=8 CPU run acceptance pin, observed through a REAL training run:
+    at PBT fire windows ≥2 exploited members hold BITWISE copies of their
+    source's params, and every changed hparam equals a survivor's previous
+    value times exactly float32(0.8) or float32(1.2)."""
+    ent = [0.001 * (i + 1) for i in range(8)]
+    traj = _record_population(
+        hparams={"system.ent_coef": ent},
+        pbt={"enabled": True, "interval": 2, "quantile": 0.25, "perturb_scale": 0.2},
+        size=8,
+        extra=["arch.num_updates=4", "arch.num_evaluation=4"],
+    )
+    assert len(traj) == 4  # windows 1..4; PBT fires at 2 and 4
+
+    def dup_pairs(params):
+        leaves = [np.asarray(l) for l in jax.tree.leaves(params)]
+        pairs = set()
+        for i in range(8):
+            for j in range(i + 1, 8):
+                if all(np.array_equal(l[i], l[j]) for l in leaves):
+                    pairs.add((i, j))
+        return pairs
+
+    # Fire windows carry >= 2 bitwise clone pairs (quantile 0.25 of 8);
+    # between fires the clones diverge again (different hparams + fresh key).
+    assert len(dup_pairs(traj[1]["params"])) >= 2, "window 2 fired: clones expected"
+    assert len(dup_pairs(traj[3]["params"])) >= 2, "window 4 fired: clones expected"
+    assert not dup_pairs(traj[0]["params"]), "window 1: no selection yet"
+    assert not dup_pairs(traj[2]["params"]), "window 3: clones must have diverged"
+    assert traj[-1]["exploit_total"] == 4  # 2 fires x 2 exploited members
+
+    # Changed hparams land at EXACT perturbed values of a survivor's previous
+    # value — float32(prev * 1.2) or float32(prev * 0.8), nothing else.
+    for fire_idx in (1, 3):
+        prev = traj[fire_idx - 1]["hparams"]["ent_coef"]
+        new = traj[fire_idx]["hparams"]["ent_coef"]
+        allowed = set(np.float32(prev).tolist())
+        for factor in (np.float32(0.8), np.float32(1.2)):
+            allowed |= set((np.float32(prev) * factor).tolist())
+        changed = [float(v) for v, p in zip(new, prev) if v != p]
+        assert changed, f"fire window {fire_idx + 1} changed no hparams"
+        for v in changed:
+            assert v in allowed, (v, sorted(allowed))
+
+
+# ---------------------------------------------------------------------------
+# Hparam lifting + config validation
+
+
+def test_lift_hparams_validation():
+    good = {
+        "arch": {
+            "population": {
+                "size": 4,
+                "hparams": {"system.ent_coef": [0.0, 0.01, 0.02, 0.03],
+                            "system.actor_lr": 3e-4},
+            }
+        }
+    }
+    size, arrays = lift_hparams(good)
+    assert size == 4
+    assert arrays["ent_coef"].tolist() == pytest.approx([0.0, 0.01, 0.02, 0.03])
+    assert arrays["actor_lr"].shape == (4,)  # scalar broadcast
+
+    with pytest.raises(PopulationConfigError, match="not liftable"):
+        lift_hparams(
+            {"arch": {"population": {"size": 2, "hparams": {"system.epochs": [1, 2]}}}}
+        )
+    with pytest.raises(PopulationConfigError, match="exactly P values"):
+        lift_hparams(
+            {"arch": {"population": {"size": 3,
+                                     "hparams": {"system.ent_coef": [0.0, 0.1]}}}}
+        )
+    assert "system.epochs" not in LIFTABLE_HPARAMS
+
+
+def test_population_refuses_incompatible_config(devices):
+    from stoix_tpu import envs
+    from stoix_tpu.parallel import MeshRoles
+
+    cfg = _compose("default/population/default_ff_ppo.yaml")
+    cfg_bad = _compose("default/anakin/default_ff_ppo.yaml")  # no pop axis
+    roles = MeshRoles.from_config(cfg_bad)
+    mesh = roles.learn_mesh()
+    env, _ = envs.make(cfg_bad)
+    with pytest.raises(PopulationConfigError, match="'pop' mesh axis"):
+        population_setup(env, cfg_bad, mesh, jax.random.PRNGKey(0))
+
+    cfg_int = _compose(
+        "default/population/default_ff_ppo.yaml", ["arch.integrity.enabled=True"]
+    )
+    with pytest.raises(PopulationConfigError, match="integrity"):
+        run_population_experiment(cfg_int)
+
+
+# ---------------------------------------------------------------------------
+# Integrity composition: per-member fingerprints + survivor-reseed quarantine
+
+
+def test_member_fingerprints_and_quarantine():
+    state = _toy_population()
+    prints = np.asarray(member_fingerprints(state.members.params))
+    assert prints.shape == (8,) and prints.dtype == np.uint32
+    assert len(set(prints.tolist())) == 8  # distinct params -> distinct prints
+    # Two members with identical params fingerprint identically.
+    eq_params = jax.tree.map(
+        lambda x: x.at[5].set(x[2]), state.members.params
+    )
+    prints_eq = np.asarray(member_fingerprints(eq_params))
+    assert prints_eq[5] == prints_eq[2]
+
+    # Quarantine member 4: it re-seeds from the fittest healthy survivor
+    # (member 0, fitness 10.0) instead of killing the run.
+    corrupt = jnp.zeros((8,), dtype=bool).at[4].set(True)
+    healed = jax.jit(lambda s: quarantine_members(s, corrupt, 8))(state)
+    jax.tree.map(
+        lambda orig, new: np.testing.assert_array_equal(
+            np.asarray(orig)[0], np.asarray(new)[4]
+        ),
+        state.members.params,
+        healed.members.params,
+    )
+    assert float(np.asarray(healed.fitness)[4]) == 10.0
+    assert not np.array_equal(
+        np.asarray(healed.members.key)[4], np.asarray(state.members.key)[4]
+    )
+    # Healthy members untouched.
+    jax.tree.map(
+        lambda orig, new: np.testing.assert_array_equal(
+            np.asarray(orig)[:4], np.asarray(new)[:4]
+        ),
+        state.members.params,
+        healed.members.params,
+    )
+
+
+# ---------------------------------------------------------------------------
+# sweep.py --backend population: one run, same results-JSON schema
+
+
+def test_population_sweep_matches_sequential_schema(devices, capsys):
+    from stoix_tpu.sweep import parse_space, run_sweep
+
+    space = parse_space(["system.clip_eps=choice:0.1,0.2"])
+    fixed = [
+        "env=identity_game", "arch.total_num_envs=8", "arch.total_timesteps=512",
+        "arch.num_evaluation=1", "arch.num_eval_episodes=8",
+        "system.rollout_length=4", "logger.use_console=False",
+    ]
+    kwargs = dict(
+        module="stoix_tpu.systems.ppo.anakin.ff_ppo",
+        default="default/anakin/default_ff_ppo.yaml",
+        space=space,
+        fixed_overrides=fixed,
+        method="grid",
+        seed=0,
+    )
+    best_seq = run_sweep(backend="sequential", **kwargs)
+    seq_lines = [
+        l for l in capsys.readouterr().out.splitlines() if l.startswith("{")
+    ]
+    best_pop = run_sweep(backend="population", **kwargs)
+    pop_lines = [
+        l for l in capsys.readouterr().out.splitlines() if l.startswith("{")
+    ]
+
+    import json
+
+    seq_records = [json.loads(l) for l in seq_lines]
+    pop_records = [json.loads(l) for l in pop_lines]
+    assert len(seq_records) == len(pop_records) == 3  # 2 trials + best line
+    for s_rec, p_rec in zip(seq_records[:-1], pop_records[:-1]):
+        # SAME results-JSON schema (the acceptance pin), including the
+        # per-trial wall-clock and typed-failure fields.
+        assert set(s_rec) == set(p_rec) == {
+            "trial", "params", "score", "wall_s", "error"
+        }
+        assert p_rec["error"] is None and s_rec["error"] is None
+        assert p_rec["wall_s"] >= 0.0
+        assert np.isfinite(p_rec["score"])
+    assert set(best_seq) == set(best_pop)
+    # LAST_POPULATION_STATS recorded the one-run-many-members shape.
+    assert LAST_POPULATION_STATS["population_size"] == 2
+    assert len(LAST_POPULATION_STATS["member_fitness"]) == 2
+
+
+def test_population_sweep_refuses_unliftable_space():
+    from stoix_tpu.sweep import parse_space, run_sweep
+
+    with pytest.raises(ValueError, match="cannot lift"):
+        run_sweep(
+            module="stoix_tpu.systems.ppo.anakin.ff_ppo",
+            default="default/anakin/default_ff_ppo.yaml",
+            space=parse_space(["system.epochs=choice:1,2"]),
+            fixed_overrides=[],
+            method="grid",
+            backend="population",
+        )
+    with pytest.raises(ValueError, match="supports"):
+        run_sweep(
+            module="stoix_tpu.systems.q_learning.ff_dqn",
+            default="default/anakin/default_ff_dqn.yaml",
+            space=parse_space(["system.ent_coef=choice:0.0,0.1"]),
+            fixed_overrides=[],
+            method="grid",
+            backend="population",
+        )

@@ -1,0 +1,232 @@
+"""MeshRoles contract tests (parallel/roles.py, docs/DESIGN.md §2.11).
+
+The role-partition invariants behind the unified device-assignment path:
+roles cover their device universe exactly once (primary roles colocated or
+disjoint, never partially overlapping), the Sebulba actor/learner split
+round-trips through MeshRoles, and the serve + population consumers read the
+SAME object instead of re-inventing device bookkeeping.
+"""
+
+import numpy as np
+import pytest
+
+from stoix_tpu.parallel import MeshRoles, MeshRolesError, resolve_assignments
+from stoix_tpu.utils import config as config_lib
+
+
+def _compose(root, overrides=()):
+    return config_lib.compose(config_lib.default_config_dir(), root, list(overrides))
+
+
+# ---------------------------------------------------------------------------
+# Derivation per architecture
+
+
+def test_anakin_roles_colocated_on_whole_mesh(devices):
+    cfg = _compose("default/anakin/default_ff_ppo.yaml")
+    roles = MeshRoles.from_config(cfg, devices=devices)
+    # Every primary role owns every device — the colocated Anakin shape.
+    assert roles.role_devices("learn") == list(devices)
+    assert roles.role_devices("act") == list(devices)
+    assert roles.colocated("act", "learn")
+    mesh = roles.learn_mesh()
+    # Bit-for-bit the mesh the runner used to build directly from arch.mesh.
+    from stoix_tpu.parallel import create_mesh
+
+    direct = create_mesh({"data": -1}, devices=devices)
+    assert mesh.axis_names == direct.axis_names == ("data",)
+    assert (mesh.devices == direct.devices).all()
+
+
+def test_sebulba_split_roundtrips_through_meshroles(devices):
+    cfg = _compose(
+        "default/sebulba/default_ff_ppo.yaml",
+        ["arch.actor.device_ids=[0,2]", "arch.learner.device_ids=[1,3]",
+         "arch.evaluator_device_id=2"],
+    )
+    roles = MeshRoles.from_config(cfg, devices=devices)
+    # The legacy keys resolve to exactly the devices the old ad-hoc indexing
+    # picked (the round-trip: config -> MeshRoles -> same device objects).
+    assert roles.role_devices("act") == [devices[0], devices[2]]
+    assert roles.role_devices("learn") == [devices[1], devices[3]]
+    assert roles.device("evaluate") == devices[2]
+    learner_mesh = roles.learn_mesh()
+    assert learner_mesh.axis_names == ("data",)
+    assert list(learner_mesh.devices.flatten()) == [devices[1], devices[3]]
+    eval_mesh = roles.role_mesh("evaluate")
+    assert int(eval_mesh.shape["data"]) == 1
+    assert not roles.colocated("act", "learn")
+
+
+def test_population_learn_mesh_owns_pop_and_data_axes(devices):
+    cfg = _compose(
+        "default/population/default_ff_ppo.yaml", ["arch.mesh.pop=2"]
+    )
+    roles = MeshRoles.from_config(cfg, devices=devices)
+    mesh = roles.learn_mesh()
+    assert set(mesh.axis_names) == {"pop", "data"}
+    assert int(mesh.shape["pop"]) == 2 and int(mesh.shape["data"]) == 4
+
+
+def test_serve_and_population_consume_the_same_object(devices):
+    """One MeshRoles object serves BOTH consumers: the population runner
+    reads learn_mesh(), the serving engine reads device('serve') — no
+    subsystem re-derives device bookkeeping from raw config keys."""
+    cfg = {
+        "arch": {
+            "architecture_name": "population",
+            "mesh": {"pop": 2, "data": -1},
+            "roles": {
+                "learn": {"device_ids": [0, 1, 2, 3]},
+                "act": {"device_ids": [0, 1, 2, 3]},
+                "serve": {"device_ids": [7]},
+            },
+        }
+    }
+    roles = MeshRoles.from_config(cfg, devices=devices)
+    mesh = roles.learn_mesh()
+    assert set(mesh.axis_names) == {"pop", "data"}
+    assert int(mesh.shape["pop"]) == 2 and int(mesh.shape["data"]) == 2
+    assert roles.device("serve") == devices[7]
+    # The serving engine accepts the role's device directly.
+    import jax.numpy as jnp
+
+    from stoix_tpu.serve.engine import InferenceEngine
+
+    class _Dist:
+        def __init__(self, logits):
+            self.logits = logits
+
+        def mode(self):
+            return jnp.argmax(self.logits, axis=-1)
+
+    engine = InferenceEngine(
+        lambda p, obs: _Dist(obs @ p),
+        params=jnp.eye(3, dtype=jnp.float32),
+        obs_template=np.zeros((3,), np.float32),
+        buckets=[1, 2],
+        device=roles.device("serve"),
+    )
+    action, _extras, _bucket = engine.infer([np.ones((3,), np.float32)])
+    assert list(action.devices()) == [devices[7]]
+
+
+def test_serve_config_defaults_to_device_zero(devices):
+    cfg = _compose("default/serve.yaml")
+    roles = MeshRoles.from_config(cfg, devices=devices)
+    assert roles.device("serve") == devices[0]
+
+
+# ---------------------------------------------------------------------------
+# Partition invariants (pure resolution — no jax needed)
+
+
+def test_partial_act_learn_overlap_refused():
+    cfg = {
+        "arch": {
+            "architecture_name": "sebulba",
+            "actor": {"device_ids": [0, 1]},
+            "learner": {"device_ids": [1, 2]},
+            "evaluator_device_id": 0,
+        }
+    }
+    with pytest.raises(MeshRolesError, match="partially overlap"):
+        resolve_assignments(cfg, device_count=4)
+
+
+def test_out_of_range_ids_refused_with_all_findings():
+    cfg = {
+        "arch": {
+            "architecture_name": "sebulba",
+            "actor": {"device_ids": [0]},
+            "learner": {"device_ids": [9]},
+            "evaluator_device_id": 12,
+        }
+    }
+    with pytest.raises(MeshRolesError, match="out of range") as excinfo:
+        resolve_assignments(cfg, device_count=2)
+    # Both bad ids surface in ONE error (the preflight discipline).
+    assert "9" in str(excinfo.value) and "12" in str(excinfo.value)
+
+
+def test_empty_primary_role_refused():
+    cfg = {
+        "arch": {
+            "architecture_name": "sebulba",
+            "actor": {"device_ids": []},
+            "learner": {"device_ids": [1]},
+        }
+    }
+    with pytest.raises(MeshRolesError, match="non-empty"):
+        resolve_assignments(cfg, device_count=2)
+
+
+def test_explicit_roles_must_assign_learn():
+    cfg = {"arch": {"roles": {"act": {"device_ids": [0]}}}}
+    with pytest.raises(MeshRolesError, match="'learn'"):
+        resolve_assignments(cfg, device_count=2)
+
+
+def test_identical_primary_sets_are_colocated_not_overlapping():
+    cfg = {
+        "arch": {
+            "roles": {
+                "act": {"device_ids": [0, 1]},
+                "learn": {"device_ids": [1, 0]},
+            }
+        }
+    }
+    assignments = resolve_assignments(cfg, device_count=2)
+    assert set(assignments["act"].device_ids) == set(assignments["learn"].device_ids)
+
+
+def test_preflight_validation_routes_through_roles():
+    """validate_config's Sebulba split check IS the mesh-role resolution now:
+    a partial overlap — a class the old ad-hoc check never caught — surfaces
+    as a ConfigValidationError finding."""
+    from stoix_tpu.resilience import ConfigValidationError, preflight
+
+    cfg = _compose(
+        "default/sebulba/default_ff_ppo.yaml",
+        ["arch.actor.device_ids=[0,1]", "arch.learner.device_ids=[1,2]"],
+    )
+    with pytest.raises(ConfigValidationError, match="partially overlap"):
+        preflight.validate_config(cfg, device_count=4)
+
+
+def test_all_devices_act_overlapping_subset_learn_refused():
+    """device_ids=None means EVERY device: against a known device count an
+    explicit subset learn role is a partial overlap, not a silent pass (the
+    check resolves the None side instead of skipping the invariant)."""
+    cfg = {"arch": {"roles": {"act": {}, "learn": {"device_ids": [1]}}}}
+    with pytest.raises(MeshRolesError, match="partially overlap"):
+        resolve_assignments(cfg, device_count=4)
+    # With no device count the pairing is unresolvable — tolerated, the
+    # materializing consumer (MeshRoles.from_config) re-validates with one.
+    resolve_assignments(cfg)
+    # ...and an explicit learn role spanning the FULL range is colocated.
+    cfg_ok = {"arch": {"roles": {"act": {}, "learn": {"device_ids": [0, 1, 2, 3]}}}}
+    assignments = resolve_assignments(cfg_ok, device_count=4)
+    assert assignments["act"].resolved_ids(4) == assignments["learn"].device_ids
+
+
+def test_preflight_env_split_honors_explicit_roles():
+    """The env-divisibility preflight counts actor devices from the RESOLVED
+    roles — the same source the run itself uses — so an explicit
+    arch.roles.act overriding stale legacy keys is validated, not the legacy
+    keys: 30 envs over the 2 role-declared actor devices must fail even
+    though the legacy key claims 1 device (30 % 1 == 0 would pass)."""
+    from stoix_tpu.resilience import ConfigValidationError, preflight
+
+    cfg = _compose(
+        "default/sebulba/default_ff_ppo.yaml",
+        [
+            "arch.total_num_envs=30",
+            "arch.actor.device_ids=[0]",
+            "arch.roles.act.device_ids=[0,1]",
+            "arch.roles.learn.device_ids=[2]",
+            "arch.roles.evaluate.device_ids=[3]",
+        ],
+    )
+    with pytest.raises(ConfigValidationError, match="num_actors"):
+        preflight.validate_config(cfg, device_count=4)

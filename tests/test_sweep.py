@@ -86,6 +86,60 @@ def test_tpe_nan_scores_rank_last():
         assert 1e-5 * (1 - 1e-9) <= p["system.lr"] <= 1e-1 * (1 + 1e-9)
 
 
+def test_trial_failure_records_typed_reason_and_wall_clock(capsys):
+    """ISSUE 15 satellite: a raising trial no longer kills the sweep (or
+    silently folds into _finite_score) — the results JSON records per-trial
+    wall-clock seconds and the typed failure reason, the trial scores -inf
+    EXPLICITLY (serialized as null, keeping the line strict JSON), and
+    best-selection skips it."""
+    import json
+    import sys
+    import types
+
+    from stoix_tpu.sweep import run_sweep
+
+    mod = types.ModuleType("_sweep_probe_module")
+
+    def run_experiment(cfg):
+        if float(cfg.system.lr) > 1e-3:
+            raise FloatingPointError("loss diverged to NaN at step 7")
+        return 42.0
+
+    mod.run_experiment = run_experiment
+    sys.modules["_sweep_probe_module"] = mod
+    try:
+        best = run_sweep(
+            module="_sweep_probe_module",
+            default="default/anakin/default_ff_ppo.yaml",
+            space=parse_space(["system.lr=choice:1e-4,1e-2"]),
+            fixed_overrides=["logger.use_console=False"],
+            method="grid",
+            seed=0,
+        )
+    finally:
+        del sys.modules["_sweep_probe_module"]
+
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    records = [json.loads(l) for l in lines[:-1]]
+    assert len(records) == 2
+    ok = next(r for r in records if r["params"]["system.lr"] == 1e-4)
+    failed = next(r for r in records if r["params"]["system.lr"] == 1e-2)
+    # Schema: every record carries wall_s + error (None on success).
+    for r in records:
+        assert set(r) == {"trial", "params", "score", "wall_s", "error"}
+        assert r["wall_s"] >= 0.0
+    assert ok["score"] == 42.0 and ok["error"] is None
+    # json.loads round-trips the failed score as None, never -Infinity — the
+    # printed line parsed under the strict-JSON contract above, proving it.
+    assert failed["score"] is None
+    assert failed["error"] == {
+        "type": "FloatingPointError",
+        "message": "loss diverged to NaN at step 7",
+    }
+    # The failed trial is never "best".
+    assert best["params"]["system.lr"] == 1e-4
+
+
 @pytest.mark.slow
 def test_multirun_sweep_over_real_system(capsys):
     # Multirun-over-configs integration (reference
