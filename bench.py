@@ -13,7 +13,8 @@ and Sebulba hot paths are perf-tracked alongside the PPO path
     sebulba_ppo_cartpole      — actor/learner split over the native C++ pool
 
 Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
-                       [--serve] [--replay] [--cpu] [--reps N] [--integrity]
+                       [--serve] [--replay] [--population] [--gossip] [--cpu]
+                       [--reps N] [--integrity]
        python bench.py --check BASELINE.json --candidate CAND.json
                        [--check-threshold 0.05] [--check-require-all]
   --all       run all five tracked configs, one JSON line each
@@ -40,6 +41,19 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
               sampled_bytes_crossed (the sample psum's payload) — so the
               samples-not-experience claim is a measured number the --check
               gate can hold
+  --population mesh-parallel population training (docs/DESIGN.md §2.11):
+              TWO payload lines, P=1 (bit-identity anchor) and P=8 with
+              live PBT, each carrying aggregate env-steps/sec
+  --gossip    async learner groups (docs/DESIGN.md §2.12): TWO payload
+              lines, G=1 (lockstep — the dense pmean spans every device,
+              zero gossip rounds) and G=2 (ring gossip at window
+              boundaries). Each measures a clean steady-state rate PLUS a
+              twin run under an injected host_stall straggler, and carries
+              throughput_retained = stalled/clean — the headline async
+              claim: gossip groups keep stepping while lockstep waits on
+              the slowest slice. On one host the stall taxes every group
+              equally, so the single-host ratio is a harness check; the
+              field earns its keep on real multi-slice meshes
   --integrity arm the state-integrity sentinel (arch.integrity, docs/
               DESIGN.md §2.9) in the Anakin probe run so the payload's
               first-class `integrity` fields (enabled / fingerprint_checks /
@@ -62,7 +76,15 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
               for CI gates benching every tracked config). Exit 0 = every
               compared metric within band; 1 = regression / posture mismatch
               / failed workload line; 2 = usage or file errors. One JSON
-              verdict line per metric.
+              verdict line per metric. Besides BENCH_r*.json payload lines
+              and BASELINE.json `published` mappings, both sides accept a
+              MULTICHIP_r*.json dry-run record (ok -> 1.0/0.0 median under
+              multichip_dryrun_ok_dN) and a scaling_bench.py summary
+              (`{"scaling": [...]}` -> scaling_ppo_weak_dN_env_steps_per_sec
+              + scaling_ppo_weak_eff_dN per mesh size), so weak-scaling
+              efficiency and the multichip posture ride the SAME gate as
+              throughput — `python scaling_bench.py | python bench.py
+              --check SCALING_BASE.json --candidate -` composes directly.
   --reps N    how many times the steady-state window is re-measured
               (default 3 for the Anakin timed loop; Sebulba re-runs its
               whole experiment per rep, so it defaults to 1 unless --reps is
@@ -103,9 +125,81 @@ def _parse_reps(argv: list) -> int | None:
 # ---------------------------------------------------------------------------
 
 
+def _multichip_payload(obj: dict) -> dict | None:
+    """MULTICHIP_r*.json dry-run record -> a gate-composable payload.
+
+    The fleet harness records `{"n_devices", "rc", "ok", ...}` per dry run;
+    converting ok into a 1.0/0.0 median makes the record ride the SAME gate
+    as every throughput line: a baseline or candidate with ok=false is a
+    zero-median "failed workload" verdict (loud), ok=true vs ok=true passes
+    trivially. A `skipped` record is no measurement at all -> None."""
+    if not isinstance(obj, dict) or "n_devices" not in obj or "ok" not in obj:
+        return None
+    if obj.get("skipped"):
+        return None
+    ok = 1.0 if obj.get("ok") else 0.0
+    return {
+        "metric": "multichip_dryrun_ok_d%d" % int(obj["n_devices"]),
+        "value": ok, "median": ok, "rel_spread": 0.0,
+        "unit": "dry-run success (1.0 = ok)",
+        "rc": obj.get("rc"), "fallback": False,
+    }
+
+
+def _scaling_payloads(obj: dict) -> list | None:
+    """scaling_bench.py summary (`{"scaling": [...]}`) -> per-size payloads.
+
+    Each mesh size contributes a weak-scaling throughput line, and every size
+    past the smallest contributes its efficiency-vs-smallest ratio as its own
+    metric (ROADMAP item 4: >=80% efficiency is a NUMBER the gate can hold a
+    band around, not a prose claim). The smallest size's efficiency is 1.0 by
+    construction, so no line is emitted for it."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("scaling"), list):
+        return None
+    out = []
+    for i, rec in enumerate(obj["scaling"]):
+        if not isinstance(rec, dict) or "devices" not in rec:
+            continue
+        n = int(rec["devices"])
+        sps = float(rec.get("env_steps_per_sec") or 0.0)
+        out.append(
+            {
+                "metric": f"scaling_ppo_weak_d{n}_env_steps_per_sec",
+                "value": sps, "median": sps, "rel_spread": 0.0,
+                "unit": "env_steps/sec (weak scaling)",
+                "devices": n, "fallback": False,
+            }
+        )
+        eff = rec.get("efficiency_vs_smallest")
+        if i > 0 and eff is not None:
+            eff = float(eff)
+            out.append(
+                {
+                    "metric": f"scaling_ppo_weak_eff_d{n}",
+                    "value": eff, "median": eff, "rel_spread": 0.0,
+                    "unit": "per-device efficiency vs smallest mesh",
+                    "devices": n, "fallback": False,
+                }
+            )
+    return out
+
+
 def _parse_payload_lines(text: str) -> list:
-    """Every JSON object line carrying a `metric` field, in file order."""
+    """Every JSON object line carrying a `metric` field, in file order —
+    plus conversions for the two metric-less record shapes the repo's other
+    harnesses emit (a scaling summary line, a multichip dry-run record), so
+    `python scaling_bench.py | python bench.py --check ... --candidate -`
+    composes directly. First occurrence of a metric wins (scaling_bench
+    emits per-size payload lines AND the trailing summary; the summary's
+    conversions must not double-count them)."""
     payloads = []
+    seen = set()
+
+    def _add(obj):
+        if obj and obj.get("metric") and obj["metric"] not in seen:
+            seen.add(obj["metric"])
+            payloads.append(obj)
+
     for line in text.splitlines():
         line = line.strip()
         if not line.startswith("{"):
@@ -114,17 +208,25 @@ def _parse_payload_lines(text: str) -> list:
             obj = json.loads(line)
         except ValueError:
             continue
-        if isinstance(obj, dict) and obj.get("metric"):
-            payloads.append(obj)
+        if not isinstance(obj, dict):
+            continue
+        if obj.get("metric"):
+            _add(obj)
+            continue
+        for converted in _scaling_payloads(obj) or ():
+            _add(converted)
+        _add(_multichip_payload(obj))
     return payloads
 
 
-def _load_baseline_payloads(path: str) -> list:
-    """Baseline payloads from either format: a BENCH_r*.json file (one JSON
-    payload line per tracked metric) or a BASELINE.json whose `published`
-    mapping carries payload dicts keyed by metric name."""
-    with open(path) as f:
-        text = f.read()
+def _payloads_from_text(text: str) -> list:
+    """Payloads from any tracked format: a BENCH_r*.json file (one JSON
+    payload line per tracked metric), a BASELINE.json whose `published`
+    mapping carries payload dicts keyed by metric name, a MULTICHIP_r*.json
+    dry-run record (pretty-printed whole-file JSON — line parsing cannot see
+    it), or a scaling_bench.py `{"scaling": [...]}` summary. Used for BOTH
+    gate sides, so a fresh MULTICHIP record gates directly against a tracked
+    one."""
     try:
         obj = json.loads(text)
     except ValueError:
@@ -137,7 +239,19 @@ def _load_baseline_payloads(path: str) -> list:
         return out
     if isinstance(obj, dict) and obj.get("metric"):
         return [obj]
+    if isinstance(obj, dict):
+        scaling = _scaling_payloads(obj)
+        if scaling is not None:
+            return scaling
+        multichip = _multichip_payload(obj)
+        if multichip is not None:
+            return [multichip]
     return _parse_payload_lines(text)
+
+
+def _load_baseline_payloads(path: str) -> list:
+    with open(path) as f:
+        return _payloads_from_text(f.read())
 
 
 def _median_of(payload: dict) -> float:
@@ -310,10 +424,10 @@ def run_check(argv: list) -> int:
                     )
                 )
                 return 2
-            candidates = _parse_payload_lines(sys.stdin.read())
+            candidates = _payloads_from_text(sys.stdin.read())
         else:
             with open(candidate_path) as f:
-                candidates = _parse_payload_lines(f.read())
+                candidates = _payloads_from_text(f.read())
     except OSError as exc:
         print(json.dumps({"error": f"{type(exc).__name__}: {exc}"}))
         return 2
@@ -360,6 +474,7 @@ def main() -> None:
     serve = "--serve" in sys.argv  # latency frontier: dynamic-batching policy serving
     replay = "--replay" in sys.argv  # sharded replay service microbench
     population = "--population" in sys.argv  # P agents as one jitted program
+    gossip = "--gossip" in sys.argv  # grouped learners + gossip averaging
     # Arm the state-integrity sentinel in the Anakin probe run so the payload's
     # integrity fields carry a MEASURED per-window fingerprint overhead
     # (docs/DESIGN.md §2.9) instead of the disabled zeros.
@@ -388,7 +503,16 @@ def main() -> None:
         # combination — docs/DESIGN.md §2.11), so refuse loudly here too.
         sys.exit("--integrity does not compose with --population "
                  "(use arch.population.member_fingerprints)")
-    if run_all and (large or cartpole or sebulba or pixel or serve or replay or population):
+    if gossip and (large or cartpole or sebulba or pixel or serve or replay or population):
+        sys.exit("--gossip is its own workload family; it does not compose")
+    if gossip and integrity_on:
+        # Replica fingerprints assume ONE replicated state; gossip groups
+        # intentionally diverge between rounds (the grouped learner setup
+        # itself refuses the combination — docs/DESIGN.md §2.12).
+        sys.exit("--integrity does not compose with --gossip "
+                 "(groups diverge between gossip rounds by design)")
+    if run_all and (large or cartpole or sebulba or pixel or serve or replay
+                    or population or gossip):
         sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
     env_tag = "cartpole" if cartpole else "ant"
@@ -404,6 +528,8 @@ def main() -> None:
         metric = "sebulba_ppo_cartpole_env_steps_per_sec"
     elif population:
         metric = "population_ppo_identity_game_env_steps_per_sec"
+    elif gossip:
+        metric = "gossip_ppo_identity_game_env_steps_per_sec"
     else:
         metric = f"anakin_ppo_{env_tag}_env_steps_per_sec" + ("_large_bf16" if large else "")
 
@@ -536,10 +662,11 @@ def main() -> None:
     # THIS process's own backend init, which the probe cannot fully vouch for.
     watchdog.start()
 
-    if replay and "--cpu" in sys.argv:
-        # The replay microbench measures CROSS-SHARD transport: a 1-device
-        # CPU run would measure nothing, so fan the host platform out to 8
-        # virtual devices (the tests/conftest harness) before jax imports.
+    if (replay or gossip) and "--cpu" in sys.argv:
+        # The replay microbench measures CROSS-SHARD transport and the gossip
+        # workload needs a group axis of 2: a 1-device CPU run would measure
+        # nothing, so fan the host platform out to 8 virtual devices (the
+        # tests/conftest harness) before jax imports.
         flags = os.environ.get("XLA_FLAGS", "")
         if "--xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
@@ -658,6 +785,10 @@ def main() -> None:
 
     if population:
         _finish(_run_population(smoke, n_devices, reps=reps))
+        return
+
+    if gossip:
+        _finish(_run_gossip(smoke, n_devices, reps=reps))
         return
 
     if sebulba:
@@ -1288,6 +1419,94 @@ def _run_population(smoke: bool, n_devices: int, reps: int | None = None) -> lis
             if not anakin_runner.LAST_RUN_STATS.get("resilience")
             else dict(anakin_runner.LAST_RUN_STATS.get("resilience")),
             "integrity": _integrity_report(anakin_runner.LAST_RUN_STATS),
+        })
+    return payloads
+
+
+def _run_gossip(smoke: bool, n_devices: int, reps: int | None = None) -> list:
+    """`--gossip` (docs/DESIGN.md §2.12): grouped Anakin PPO on the
+    ("group", "data") mesh (stoix_tpu/parallel/gossip.py). Two payload lines
+    — lockstep (G=1: the bit-identity anchor, gossip machinery at zero
+    groups, no mixing dispatched) and G=2 gossip groups (ring topology,
+    params averaged every window). Each shape is measured CLEAN and again
+    under an injected `host_stall:1` straggler window (faultinject), and
+    `throughput_retained` = stalled/clean steady-state SPS rides along. On
+    one host the stall taxes every group equally — the field exists so
+    multi-slice runs can record how much of the lockstep all-reduce tax the
+    gossip groups remove (the headline: lockstep pays the straggler on every
+    dense window; a group only pays it at its own gossip edges)."""
+    from stoix_tpu.resilience import faultinject
+    from stoix_tpu.systems import runner as anakin_runner
+    from stoix_tpu.utils import config as config_lib
+
+    stall_s = 1
+    payloads = []
+    for num_groups in (1, 2):
+        def _compose_run(fault: bool):
+            overrides = [
+                "arch=gossip",
+                "env=identity_game",
+                "arch.total_num_envs=%d" % (8 if smoke else 64),
+                "arch.num_updates=%d" % (4 if smoke else 32),
+                "arch.total_timesteps=~",
+                "arch.num_evaluation=2",
+                "arch.num_eval_episodes=8",
+                "arch.absolute_metric=False",
+                "system.rollout_length=%d" % (8 if smoke else 16),
+                "logger.use_console=False",
+            ]
+            config = config_lib.compose(
+                config_lib.default_config_dir(), "default/anakin/default_ff_ppo.yaml",
+                overrides,
+            )
+            config_lib._set_dotted(config, "arch.mesh.group", num_groups)
+            if fault:
+                config_lib._set_dotted(
+                    config, "arch.fault_spec", "host_stall:%d" % stall_s
+                )
+            return config
+
+        def _run_once(config) -> float:
+            faultinject.reset()
+            try:
+                from stoix_tpu.systems.ppo.anakin import ff_ppo as anakin_ppo
+
+                anakin_ppo.run_experiment(config)
+            finally:
+                faultinject.reset()
+            return float(anakin_runner.LAST_RUN_STATS.get("steady_state_sps") or 0.0)
+
+        skipped_before = _skipped_updates_base()
+        clean_config = _compose_run(False)
+        clean = [s for s in (_run_once(clean_config) for _ in range(reps or 1)) if s]
+        gossip_stats = dict(anakin_runner.LAST_RUN_STATS.get("gossip") or {})
+        stalled = _run_once(_compose_run(True))
+        resilience = (
+            dict(anakin_runner.LAST_RUN_STATS.get("resilience") or {})
+            or _resilience_selfcheck(clean_config, skipped_before)
+        )
+        tag = "lockstep" if num_groups == 1 else "g%d" % num_groups
+        clean_best = max(clean) if clean else 0.0
+        payloads.append({
+            "metric": f"gossip_ppo_identity_game_{tag}_env_steps_per_sec",
+            "value": round(clean_best, 1),
+            "unit": (
+                f"steady env_steps/sec ({num_groups} group(s), {n_devices} "
+                f"devices, identity_game; stalled twin under host_stall:{stall_s})"
+                if clean_best else "NO STEADY WINDOW: run ended before eval"
+            ),
+            "vs_baseline": None,
+            **_rep_stats(clean if clean else [0.0]),
+            "num_groups": num_groups,
+            "topology": gossip_stats.get("topology"),
+            "gossip_interval": gossip_stats.get("interval"),
+            "gossip_rounds": gossip_stats.get("rounds", 0),
+            "stall_s": stall_s,
+            "stalled_env_steps_per_sec": round(stalled, 1),
+            "throughput_retained": (
+                round(stalled / clean_best, 4) if clean_best and stalled else None
+            ),
+            "resilience": resilience,
         })
     return payloads
 

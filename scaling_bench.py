@@ -95,6 +95,17 @@ def main() -> None:
             base_per_device = per_device
         results.append(
             {
+                # Payload-shaped (bench.py --check contract): metric/value/
+                # median/rel_spread make each per-size line gate-composable,
+                # so `python scaling_bench.py | python bench.py --check
+                # SCALING_BASE.json --candidate -` holds a variance band
+                # around weak-scaling throughput with zero glue.
+                "metric": f"scaling_ppo_weak_d{n}_env_steps_per_sec",
+                "value": round(sps, 1),
+                "median": round(sps, 1),
+                "rel_spread": 0.0,
+                "unit": "env_steps/sec (weak scaling)",
+                "fallback": False,
                 "devices": n,
                 "env_steps_per_sec": round(sps, 1),
                 "per_device": round(per_device, 1),
@@ -102,6 +113,9 @@ def main() -> None:
             }
         )
         print(json.dumps(results[-1]), flush=True)
+    # The trailing summary is itself a --check-loadable baseline: bench.py
+    # converts it into the per-size throughput metrics plus the efficiency
+    # ratios (scaling_ppo_weak_eff_dN) the per-size lines cannot carry.
     print(json.dumps({"scaling": results}))
 
 

@@ -206,6 +206,10 @@ RULE = register(
             "from stoix_tpu.resilience import guards\n\n\ndef step(new, old):\n"
             '    return guards.guard_update("skip", new=new, old=old,\n'
             '                               axis_names=("batch", "dat"))\n',
+            # The gossip-group typo: "groups" is not the learner-group axis
+            # ("group", declared by parallel/gossip.py and arch/gossip.yaml).
+            "import jax\n\n\ndef gossip_round(params):\n"
+            '    return jax.lax.pmean(params, axis_name="groups")\n',
         ),
         clean_snippets=(
             # Mesh axis from parallel/ + vmap-declared in-file axis.
@@ -218,6 +222,13 @@ RULE = register(
             # Axis passed as a VARIABLE is axis-generic library code: skipped.
             "import jax\n\n\ndef reduce_over(x, axis_name):\n"
             "    return jax.lax.psum(x, axis_name)\n",
+            # Near-miss to the "groups" typo above: the real learner-group
+            # axis, reduced within a group then indexed across groups — both
+            # literals resolve against the gossip mesh declarations.
+            "import jax\n\n\ndef grouped_learner(grads):\n"
+            '    grads = jax.lax.pmean(grads, axis_name="data")\n'
+            '    gid = jax.lax.axis_index("group")\n'
+            "    return grads, gid\n",
         ),
     )
 )

@@ -148,6 +148,13 @@ RULE = register(
             "PartitionSpec as P\n\n\ndef place_population(devices, members):\n"
             '    pop_mesh = Mesh(np.array(devices).reshape(2, -1), ("pop", "data"))\n'
             '    return NamedSharding(pop_mesh, P("model"))\n',
+            # The gossip mesh declares ("group", "data") — "pop" belongs to
+            # the population mesh and cannot ride a group-governed spec.
+            "import numpy as np\nfrom jax.sharding import Mesh, NamedSharding, "
+            "PartitionSpec as P\n\n\ndef place_groups(devices, stacks):\n"
+            "    gossip_mesh = Mesh(np.array(devices).reshape(2, -1), "
+            '("group", "data"))\n'
+            '    return NamedSharding(gossip_mesh, P("pop", "data"))\n',
         ),
         clean_snippets=(
             # Matching mesh-local axis + universe axis through a parameter.
@@ -173,6 +180,14 @@ RULE = register(
             "from jax.sharding import NamedSharding, PartitionSpec as P\n\n\n"
             "def population_sharding(mesh):\n"
             '    return NamedSharding(mesh, P("pop", "data"))\n',
+            # Near-miss to the flagged gossip snippet: the SAME ("group",
+            # "data") mesh, now with the spec its axes actually govern —
+            # mesh-local resolution accepts what the universe alone would.
+            "import numpy as np\nfrom jax.sharding import Mesh, NamedSharding, "
+            "PartitionSpec as P\n\n\ndef place_groups(devices, stacks):\n"
+            "    gossip_mesh = Mesh(np.array(devices).reshape(2, -1), "
+            '("group", "data"))\n'
+            '    return NamedSharding(gossip_mesh, P("group", "data"))\n',
         ),
     )
 )

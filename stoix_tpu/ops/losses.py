@@ -54,6 +54,38 @@ def ppo_clip_loss(log_prob: Array, old_log_prob: Array, advantage: Array, epsilo
     return -jnp.mean(jnp.minimum(unclipped, clipped))
 
 
+def impact_loss(
+    log_prob: Array,
+    behavior_log_prob: Array,
+    target_log_prob: Array,
+    advantage: Array,
+    epsilon: float,
+    rho_clip: float,
+) -> Array:
+    """IMPACT surrogate (Luo et al. 2019, arXiv:1912.00167): PPO's clipped
+    objective taken against a slow-moving TARGET policy, importance-weighted
+    from the BEHAVIOR policy that actually collected the (possibly stale)
+    trajectory:
+
+        rho  = min(exp(log pi_target - log pi_behavior), rho_clip)
+        r    = exp(log pi_theta - log pi_target)
+        L    = -E[ min(rho * r * A, rho * clip(r, 1-eps, 1+eps) * A) ]
+
+    `rho` is a stop-gradient-free constant w.r.t. theta (neither policy in it
+    is the online one), so no stop_gradient is needed. When the target and
+    behavior policies coincide (fresh on-policy data, rho_clip >= 1) rho is
+    exactly 1.0 and the expression reduces BITWISE to `ppo_clip_loss` —
+    tests/test_impact.py pins that identity. Both log-ratios reuse the
+    +/-_LOG_RATIO_CLAMP guard (see above) so a sharpened policy meeting a
+    very stale sample cannot overflow the loss.
+    """
+    ratio = _safe_ratio(log_prob, target_log_prob)
+    is_ratio = jnp.minimum(_safe_ratio(target_log_prob, behavior_log_prob), rho_clip)
+    unclipped = is_ratio * ratio * advantage
+    clipped = is_ratio * jnp.clip(ratio, 1.0 - epsilon, 1.0 + epsilon) * advantage
+    return -jnp.mean(jnp.minimum(unclipped, clipped))
+
+
 def ppo_penalty_loss(
     log_prob: Array, old_log_prob: Array, advantage: Array, beta: float, kl_approx: Array
 ) -> Array:

@@ -1,3 +1,10 @@
+from stoix_tpu.parallel.gossip import (
+    GossipError,
+    GossipPlan,
+    GossipSettings,
+    build_gossip_plan,
+    mixing_matrix,
+)
 from stoix_tpu.parallel.distributed import (
     is_coordinator,
     maybe_initialize_distributed,
@@ -24,6 +31,11 @@ from stoix_tpu.parallel.roles import (
 )
 
 __all__ = [
+    "GossipError",
+    "GossipPlan",
+    "GossipSettings",
+    "build_gossip_plan",
+    "mixing_matrix",
     "is_coordinator",
     "maybe_initialize_distributed",
     "process_allgather",

@@ -1,0 +1,242 @@
+"""Gossip-averaged learner groups (docs/DESIGN.md §2.12).
+
+"Gossip-based Actor-Learner Architectures" (arxiv 1906.04585) decouples a
+pod's throughput from its slowest slice: the dense gradient all-reduce runs
+WITHIN a learner group only, and groups exchange parameters through a sparse,
+periodic gossip average instead of a fleet-wide collective. A straggling
+group delays its neighbours by one mixing edge, not the whole pod.
+
+This module is the group-mixing half. The grouped learner itself is plain
+ff_ppo on a ("group", "data") mesh: inside shard_map the learner's
+`pmean(axis_name="data")` reduces within the group automatically, because
+shard_map scopes named-axis collectives to the mesh axes they name — no
+learner change at all. What remains is averaging the per-group parameter
+stacks, and that is ONE mixing-matrix contraction:
+
+    params'[g] = sum_h W[g, h] * params[h]        W: [G, G] doubly stochastic
+
+GSPMD partitions the einsum over the P("group") sharding, inserting exactly
+the cross-group collective the topology implies. Topologies:
+
+  ring         W = (1-w)·I + (w/2)·(R + Rᵀ)       (R = one-step rotation;
+                                                    G == 2 collapses to the
+                                                    single shared edge)
+  all_pairs    W = (1-w)·I + (w/G)·1               (dense average, the
+                                                    synchronous limit)
+  random_peer  W = (1-w)·I + w·R^s,  s ~ U[1, G)   (one random directed edge
+                                                    per group per round; s is
+                                                    derived in-graph from the
+                                                    round index, so EVERY
+                                                    round reuses one compiled
+                                                    program)
+
+All three are doubly stochastic, so the group-mean of the parameters is
+invariant under mixing and repeated rounds contract the groups toward
+consensus at rate governed by W's spectral gap.
+
+Bit-identity contract (pinned, tests/test_gossip.py): with ONE group the
+step is the IDENTITY — returned un-dispatched, not computed — because even
+W = [[1.0]] would evaluate `(1-w)·p + w·p`, which is NOT bitwise `p` under
+float arithmetic. A single-group gossip run is therefore the lockstep path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# The canonical learner-group mesh axis. The P literal below is what
+# registers "group" in the static mesh-axis universe the analysis rules
+# check collectives and sharding specs against (STX007/STX010) — the YAML
+# half of the declaration lives in configs/arch/gossip.yaml's mesh block.
+GROUP_AXIS = "group"
+GROUP_SPEC = P("group")
+
+TOPOLOGIES = ("ring", "all_pairs", "random_peer")
+
+
+class GossipError(ValueError):
+    """Invalid arch.gossip block or grouped-mesh configuration."""
+
+
+class GossipSettings(NamedTuple):
+    """Resolved `arch.gossip` config block (defaults applied)."""
+
+    enabled: bool
+    interval: int  # gossip every N eval windows
+    topology: str  # ring | all_pairs | random_peer
+    mixing_weight: float  # w in (0, 1]: how far toward the neighbours to move
+    average_opt_states: bool  # mix optimizer state alongside params
+    seed: int  # random_peer edge stream seed
+
+
+class GossipPlan(NamedTuple):
+    """What the Anakin runner needs to dispatch gossip: a jitted step (None
+    when the mix is the identity — one group), the window cadence, and the
+    shape facts bench.py reports."""
+
+    step: Optional[Callable[[Any, jax.Array], Any]]
+    interval: int
+    topology: str
+    num_groups: int
+    mixing_weight: float
+    average_opt_states: bool
+
+
+def settings_from_config(config: Any) -> GossipSettings:
+    block = dict((config.get("arch") or {}).get("gossip") or {})
+    settings = GossipSettings(
+        enabled=bool(block.get("enabled", False)),
+        interval=int(block.get("interval", 1)),
+        topology=str(block.get("topology", "ring")),
+        mixing_weight=float(block.get("mixing_weight", 0.5)),
+        average_opt_states=bool(block.get("average_opt_states", False)),
+        seed=int(block.get("seed", 0)),
+    )
+    if settings.interval < 1:
+        raise GossipError(
+            f"arch.gossip.interval must be >= 1 (got {settings.interval})"
+        )
+    if settings.topology not in TOPOLOGIES:
+        raise GossipError(
+            f"arch.gossip.topology must be one of {TOPOLOGIES} "
+            f"(got '{settings.topology}')"
+        )
+    if not (0.0 < settings.mixing_weight <= 1.0):
+        raise GossipError(
+            "arch.gossip.mixing_weight must be in (0, 1] "
+            f"(got {settings.mixing_weight})"
+        )
+    return settings
+
+
+def validate_grouped_config(config: Any, mesh: Mesh) -> GossipSettings:
+    """Cross-checks for a grouped-learner run; returns the resolved settings.
+
+    Raised findings mirror the population runner's refusals: subsystems that
+    assume REPLICATED learner state cannot run over a state sharded on the
+    group axis."""
+    if GROUP_AXIS not in mesh.axis_names:
+        raise GossipError(
+            f"grouped learner training needs a '{GROUP_AXIS}' mesh axis; "
+            f"arch.mesh declares {dict(mesh.shape)} — compose with arch=gossip "
+            "(or add group to arch.mesh)"
+        )
+    settings = settings_from_config(config)
+    num_groups = int(mesh.shape[GROUP_AXIS])
+    if num_groups > 1 and not settings.enabled:
+        raise GossipError(
+            f"arch.mesh declares {num_groups} learner groups but "
+            "arch.gossip.enabled=false: the groups would train forever "
+            "WITHOUT exchanging parameters (set arch.gossip.enabled=true, or "
+            "use group: 1)"
+        )
+    if bool(((config.get("arch") or {}).get("integrity") or {}).get("enabled", False)):
+        raise GossipError(
+            "arch.integrity.enabled=true is not supported under grouped "
+            "training: the sentinel's replica fingerprints assume replicated "
+            "state, but each group owns DIFFERENT params between gossip "
+            "rounds (docs/DESIGN.md §2.12)"
+        )
+    if bool(config.arch.get("fused_eval", False)):
+        raise GossipError(
+            "arch.fused_eval is not supported under grouped training (the "
+            "evaluator serves group 0's slice, selected outside the learn "
+            "program)"
+        )
+    return settings
+
+
+def mixing_matrix(
+    settings: GossipSettings, num_groups: int, round_idx: jax.Array
+) -> jax.Array:
+    """The [G, G] doubly-stochastic mixing matrix for one gossip round.
+
+    `round_idx` may be traced: random_peer derives its shift in-graph
+    (fold_in + randint + dynamic roll), so the topology's randomness never
+    forces a recompile."""
+    w = settings.mixing_weight  # already a host float (settings_from_config)
+    eye = jnp.eye(num_groups, dtype=jnp.float32)
+    if settings.topology == "all_pairs":
+        dense = jnp.full((num_groups, num_groups), 1.0 / num_groups, jnp.float32)
+        return (1.0 - w) * eye + w * dense
+    if settings.topology == "ring":
+        right = jnp.roll(eye, 1, axis=1)
+        if num_groups == 2:
+            # Left and right neighbour are the SAME group: one edge, full w.
+            return (1.0 - w) * eye + w * right
+        left = jnp.roll(eye, -1, axis=1)
+        return (1.0 - w) * eye + (w / 2.0) * (right + left)
+    # random_peer: one directed edge per group, shared shift s in [1, G).
+    edge_key = jax.random.fold_in(jax.random.PRNGKey(settings.seed), round_idx)
+    shift = jax.random.randint(edge_key, (), 1, num_groups)
+    return (1.0 - w) * eye + w * jnp.roll(eye, shift, axis=1)
+
+
+def _mix_leaf(matrix: jax.Array, leaf: jax.Array) -> jax.Array:
+    """Contract the leading [G] axis with the mixing matrix. Integer leaves
+    (optax step counters) pass through — they are identical across groups by
+    construction and averaging them in float would corrupt the dtype."""
+    if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+        return leaf
+    mixed = jnp.tensordot(matrix, leaf.astype(jnp.float32), axes=1)
+    return mixed.astype(leaf.dtype)
+
+
+def build_gossip_plan(
+    config: Any, mesh: Mesh, state_specs: Any = None
+) -> Optional[GossipPlan]:
+    """Build the jitted gossip step for a grouped learner state.
+
+    The state must expose `.params` and `.opt_states` (`PPOLearnerState` and
+    every Anakin learner state do) with a leading [G] axis sharded
+    P("group"). Returns None when gossip is disabled; returns a plan with
+    `step=None` for ONE group (identity — see the module docstring's
+    bit-identity contract)."""
+    settings = settings_from_config(config)
+    if not settings.enabled:
+        return None
+    if GROUP_AXIS not in mesh.axis_names:
+        raise GossipError(
+            f"arch.gossip.enabled=true needs a '{GROUP_AXIS}' mesh axis; "
+            f"arch.mesh declares {dict(mesh.shape)}"
+        )
+    num_groups = int(mesh.shape[GROUP_AXIS])
+    plan_facts = dict(
+        interval=settings.interval,
+        topology=settings.topology,
+        num_groups=num_groups,
+        mixing_weight=settings.mixing_weight,
+        average_opt_states=settings.average_opt_states,
+    )
+    if num_groups == 1:
+        return GossipPlan(step=None, **plan_facts)
+
+    def _gossip(state: Any, round_idx: jax.Array) -> Any:
+        matrix = mixing_matrix(settings, num_groups, round_idx)
+        mix = lambda tree: jax.tree.map(lambda x: _mix_leaf(matrix, x), tree)
+        state = state._replace(params=mix(state.params))
+        if settings.average_opt_states:
+            state = state._replace(opt_states=mix(state.opt_states))
+        return state
+
+    jit_kwargs: dict = {}
+    if state_specs is not None:
+        # Pin the output back onto the grouped specs so the next learn
+        # dispatch consumes it with zero resharding (GSPMD would otherwise be
+        # free to replicate the einsum result).
+        jit_kwargs["out_shardings"] = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            state_specs,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+    if not os.environ.get("STOIX_TPU_NO_DONATE"):
+        # Same donation contract as the learner (systems/anakin.py): the host
+        # loop never reads the pre-gossip state again, and the snapshot the
+        # runner takes afterwards copies the gossip OUTPUT.
+        jit_kwargs["donate_argnums"] = (0,)
+    return GossipPlan(step=jax.jit(_gossip, **jit_kwargs), **plan_facts)

@@ -106,7 +106,8 @@ _PORTABLE_KINDS = frozenset("biufc")
 class FleetStragglerWarning(UserWarning):
     """Typed slow-host warning: one host's window wall-time exceeded
     `skew_warn_ratio` x the fleet's fastest. A persistent straggler is the
-    lockstep-all-reduce tax ROADMAP item 2 (gossip groups) exists to remove;
+    lockstep-all-reduce tax ROADMAP item 1's async learner groups
+    (stoix_tpu/parallel/gossip.py, docs/DESIGN.md §2.12) exist to remove;
     this warning is how it becomes visible before it becomes a timeout."""
 
 

@@ -37,7 +37,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
 
@@ -306,6 +306,19 @@ class OffPolicyPipeline:
                 return drained
 
 
+class VersionedParams(NamedTuple):
+    """Queue entry the ParameterServer feeds actors: the placed params plus
+    the monotone version (distribute_params call count) they came from. The
+    IMPACT stale-reuse path (docs/DESIGN.md §2.12) tags every pushed
+    trajectory with the behavior version so the learner can compute per-batch
+    staleness; the version travels WITH the params through the queue (not as
+    a separate attribute read) so an actor can never pair params vN with
+    version vN+1."""
+
+    version: int
+    params: Any
+
+
 class ParameterServer:
     """Latest-params distribution to actor devices.
 
@@ -313,7 +326,13 @@ class ParameterServer:
     once per actor — actors sharing a device receive the same placed copy
     through their own queues (re-transferring identical bytes for every
     co-located actor scaled the push cost with actors_per_device for no
-    reason). `reprime` reuses the version's placed copy the same way."""
+    reason). `reprime` reuses the version's placed copy the same way.
+
+    Versioning: every distribute_params bumps a monotone version counter;
+    queue entries are VersionedParams. `get_params` strips the version
+    (back-compat contract for the on-policy path); `get_params_versioned`
+    returns (version, params) for actors that must report which policy
+    collected a trajectory (IMPACT, arXiv:1912.00167)."""
 
     def __init__(
         self,
@@ -323,6 +342,7 @@ class ParameterServer:
     ):
         self._devices = [d for d in actor_devices for _ in range(actors_per_device)]
         self._queues: List[queue.Queue] = [queue.Queue(maxsize=1) for _ in self._devices]
+        self._version = 0  # bumped once per distribute_params (learner thread)
         self._latest: Any = None  # last distributed params, for reprime()
         # (params, {device: placed copy}) of the most recently COMPLETED
         # push, identity-tagged so reprime can tell whether the placed
@@ -357,7 +377,15 @@ class ParameterServer:
             placed[device] = local
         return local
 
+    @property
+    def version(self) -> int:
+        """Monotone count of completed/started distribute_params calls — the
+        learner's CURRENT policy version (0 before the first push)."""
+        return self._version
+
     def distribute_params(self, params: Any) -> None:
+        self._version += 1
+        version = self._version
         self._latest = params
         placed: Dict[Any, Any] = {}
         with span("param_push", actors=len(self._queues)):
@@ -373,11 +401,11 @@ class ParameterServer:
                     q.get_nowait()
                 except queue.Empty:
                     pass
-                q.put(local)
+                q.put(VersionedParams(version, local))
                 self._put_wait.observe(time.perf_counter() - start, labels)
                 self._depth.set(q.qsize(), labels)
                 self._pushes.inc(labels={"actor": str(actor_id)})
-        self._placed_entry = (params, placed)
+        self._placed_entry = (params, placed, version)
         self.heartbeats.beat("param-server")
 
     def reprime(self, actor_id: int) -> bool:
@@ -391,9 +419,14 @@ class ParameterServer:
         if latest is None:
             return False
         entry = self._placed_entry
-        placed = entry[1] if entry is not None and entry[0] is latest else {}
+        if entry is not None and entry[0] is latest:
+            placed, version = entry[1], entry[2]
+        else:
+            # Mid-push race: its dict may hold older copies; place fresh and
+            # tag with the in-flight version (the one being distributed).
+            placed, version = {}, self._version
         local = self._place(latest, self._devices[actor_id], placed)
-        _replace_nowait(self._queues[actor_id], local)
+        _replace_nowait(self._queues[actor_id], VersionedParams(version, local))
         return True
 
     def fail(self, failure: ComponentFailure, actor_id: int) -> None:
@@ -408,15 +441,26 @@ class ParameterServer:
     def get_params(self, actor_id: int, timeout: Optional[float] = None) -> Any:
         """Returns fresh params, or None (shutdown sentinel); raises a
         ComponentFailure poison-pill if the learner failed unrecoverably."""
+        got = self.get_params_versioned(actor_id, timeout=timeout)
+        return None if got is None else got.params
+
+    def get_params_versioned(
+        self, actor_id: int, timeout: Optional[float] = None
+    ) -> Optional[VersionedParams]:
+        """Like get_params, but keeps the version the entry was distributed
+        under: (version, params), or None (shutdown sentinel). IMPACT actors
+        use this to tag trajectories with their behavior-policy version."""
         labels = {"queue": "params", "actor": str(actor_id)}
         start = time.perf_counter()
         with span("param_get", actor=actor_id):
-            params = self._queues[actor_id].get(timeout=timeout)
+            entry = self._queues[actor_id].get(timeout=timeout)
         self._get_wait.observe(time.perf_counter() - start, labels)
         self._depth.set(self._queues[actor_id].qsize(), labels)
-        if isinstance(params, ComponentFailure):
-            raise params
-        return params
+        if isinstance(entry, ComponentFailure):
+            raise entry
+        if entry is None:
+            return None
+        return entry
 
     def shutdown(self) -> None:
         for q in self._queues:

@@ -484,6 +484,11 @@ def learner_setup(
 
     from stoix_tpu.systems import anakin
 
+    if "group" in mesh.axis_names:
+        # ("group", "data") mesh: G gossip-averaged learner groups
+        # (parallel/gossip.py, docs/DESIGN.md §2.12).
+        return grouped_learner_setup(env, config, mesh, keys, policy_loss_fn)
+
     num_actions = env.num_actions
     config.system.action_dim = num_actions
 
@@ -582,6 +587,173 @@ def learner_setup(
         eval_params_fn=eval_params_fn,
     )
     return setup
+
+
+def grouped_learner_setup(
+    env: envs.Environment, config: Any, mesh: Mesh, keys: jax.Array,
+    policy_loss_fn: Callable = None,
+) -> AnakinSetup:
+    """G gossip-averaged learner groups on a ("group", "data") mesh
+    (parallel/gossip.py, docs/DESIGN.md §2.12; arxiv 1906.04585).
+
+    Each group is the UNCHANGED per-shard learner: inside shard_map its
+    `pmean(axis_name="data")` reduces within the group's data slice only, so
+    the dense gradient all-reduce never crosses a group boundary. Groups all
+    start from group 0's params/opt state (gossip-SGD averages replicas of
+    ONE model — unlike population members, which are independent agents) but
+    roll out on fold_in-separated env/step key streams, and the runner mixes
+    the per-group parameter stacks with the jitted gossip step every
+    `arch.gossip.interval` windows. Env counts are PER GROUP."""
+
+    import os
+
+    from stoix_tpu.parallel import gossip as gossip_lib
+    from stoix_tpu.parallel.mesh import shard_map
+    from stoix_tpu.systems import anakin
+
+    gossip_lib.validate_grouped_config(config, mesh)
+    num_groups = int(mesh.shape["group"])
+
+    num_actions = env.num_actions
+    config.system.action_dim = num_actions
+
+    actor_network, critic_network = build_networks(env, config)
+
+    actor_lr = make_learning_rate(
+        float(config.system.actor_lr), config, int(config.system.epochs),
+        int(config.system.num_minibatches),
+    )
+    critic_lr = make_learning_rate(
+        float(config.system.critic_lr), config, int(config.system.epochs),
+        int(config.system.num_minibatches),
+    )
+    actor_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(actor_lr, eps=1e-5),
+    )
+    critic_optim = optax.chain(
+        optax.clip_by_global_norm(float(config.system.max_grad_norm)),
+        optax.adam(critic_lr, eps=1e-5),
+    )
+    apply_fns = (actor_network.apply, critic_network.apply)
+    update_fns = (actor_optim.update, critic_optim.update)
+
+    update_batch = int(config.arch.get("update_batch_size", 1))
+    dummy_obs = jax.tree.map(lambda x: x[None], env.observation_value())
+    obs_stats0 = running_statistics.init_state(env.observation_value().agent_view)
+    kl_beta0 = jnp.asarray(float(config.system.get("kl_beta", 3.0)))
+
+    # Group 0's key path is EXACTLY learner_setup's (the single-group
+    # bit-identity pin rides on it); groups g>0 fold_in(g) for their env and
+    # step streams but share group 0's network init.
+    shared_params = None
+    shared_opt = None
+    member_states = []
+    for g in range(num_groups):
+        member_key = keys if g == 0 else jax.random.fold_in(keys, g)
+        key_g, actor_key, critic_key, env_key = jax.random.split(member_key, 4)
+        if g == 0:
+            actor_params = actor_network.init(actor_key, dummy_obs)
+            critic_params = critic_network.init(critic_key, dummy_obs)
+            shared_params = ActorCriticParams(actor_params, critic_params)
+            shared_opt = ActorCriticOptStates(
+                actor_optim.init(actor_params), critic_optim.init(critic_params)
+            )
+        env_state, timestep = anakin.reset_envs_for_anakin(env, config, env_key)
+        member_states.append(
+            PPOLearnerState(
+                params=anakin.broadcast_to_update_batch(shared_params, update_batch),
+                opt_states=anakin.broadcast_to_update_batch(shared_opt, update_batch),
+                key=anakin.make_step_keys(key_g, mesh, config),
+                env_state=env_state,
+                timestep=timestep,
+                obs_stats=anakin.broadcast_to_update_batch(obs_stats0, update_batch),
+                kl_beta=anakin.broadcast_to_update_batch(kl_beta0, update_batch),
+            )
+        )
+    grouped_state = jax.tree.map(lambda *xs: jnp.stack(xs), *member_states)
+
+    grouped_specs = PPOLearnerState(
+        params=P("group"),
+        opt_states=P("group"),
+        key=P("group", "data"),
+        env_state=P("group", None, "data"),
+        timestep=P("group", None, "data"),
+        obs_stats=P("group"),
+        kl_beta=P("group"),
+    )
+    grouped_state = anakin.place_learner_state(grouped_state, mesh, grouped_specs)
+
+    learn_member = get_learner_fn(env, apply_fns, update_fns, config, policy_loss_fn)
+
+    def per_shard_learn(state: PPOLearnerState) -> ExperimentOutput:
+        # The stacked [G] axis is sharded 1:1 over the mesh's group axis, so
+        # the local slice is always ONE group: squeeze -> the unchanged
+        # ff_ppo learner -> unsqueeze. Reshapes only, which is why a single
+        # group trains BIT-identically to plain ff_ppo.
+        local = jax.tree.map(lambda x: x[0], state)
+        out = learn_member(local)
+        return jax.tree.map(lambda x: x[None], out)
+
+    learn_sm = shard_map(
+        per_shard_learn,
+        mesh=mesh,
+        in_specs=(grouped_specs,),
+        out_specs=ExperimentOutput(
+            learner_state=grouped_specs,
+            episode_metrics=P("group", None, None, None, "data"),
+            train_metrics=P("group"),
+        ),
+        # Same Anakin opt-out as systems/anakin.py shardmap_learner: the
+        # in-shard update-batch vmap's pmean trips check_vma's
+        # varying-manual-axes assert.
+        check_vma=False,
+    )
+    donate = {} if os.environ.get("STOIX_TPU_NO_DONATE") else {"donate_argnums": (0,)}
+    learn = jax.jit(learn_sm, **donate)
+
+    gossip_plan = gossip_lib.build_gossip_plan(config, mesh, state_specs=grouped_specs)
+
+    if is_coordinator():
+        n_params = count_parameters(shared_params.actor_params) + count_parameters(
+            shared_params.critic_params
+        )
+        get_logger("stoix_tpu.setup").info(
+            "[setup] %s parameters | mesh %s | %s envs/group | %d groups (%s, "
+            "interval %s)",
+            f"{n_params:,}", dict(mesh.shape), config.arch.total_num_envs,
+            num_groups,
+            gossip_plan.topology if gossip_plan else "lockstep",
+            gossip_plan.interval if gossip_plan else "-",
+        )
+
+    # Evaluation serves group 0's replica-0 slice — the same values the
+    # lockstep path's `x[0]` serves (post-gossip, group 0 already carries its
+    # mixed parameters: the snapshot is taken AFTER the gossip dispatch).
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+    if normalize_obs:
+
+        def eval_apply(bundle, observation):
+            params, stats = bundle
+            observation = running_statistics.normalize_observation(observation, stats)
+            return actor_network.apply(params, observation)
+
+        eval_act_fn = get_distribution_act_fn(config, eval_apply)
+        eval_params_fn = lambda s: (
+            jax.tree.map(lambda x: x[0, 0], s.params.actor_params),
+            jax.tree.map(lambda x: x[0, 0], s.obs_stats),
+        )
+    else:
+        eval_act_fn = get_distribution_act_fn(config, actor_network.apply)
+        eval_params_fn = lambda s: jax.tree.map(lambda x: x[0, 0], s.params.actor_params)
+
+    return AnakinSetup(
+        learn=learn,
+        learner_state=grouped_state,
+        eval_act_fn=eval_act_fn,
+        eval_params_fn=eval_params_fn,
+        gossip=gossip_plan,
+    )
 
 
 def run_experiment(config: Any) -> float:

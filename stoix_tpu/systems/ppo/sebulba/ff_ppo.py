@@ -61,6 +61,7 @@ from stoix_tpu.resilience import (
 from stoix_tpu.resilience.errors import EvaluatorStallError
 from stoix_tpu.sebulba.core import (
     AsyncEvaluator,
+    OffPolicyPipeline,
     OnPolicyPipeline,
     ParameterServer,
     ThreadLifetime,
@@ -84,6 +85,132 @@ class CoreLearnerState(NamedTuple):
     opt_states: ActorCriticOptStates
     key: jax.Array
     obs_stats: Any  # observation running statistics (updates gated by config)
+
+
+class ImpactSettings(NamedTuple):
+    """Validated `system.impact` knobs (IMPACT stale-trajectory reuse,
+    arXiv:1912.00167; docs/DESIGN.md §2.12)."""
+
+    target_update_interval: int
+    rho_clip: float
+    max_staleness: int
+    max_reuse: int
+    buffer_size: int
+
+
+def impact_settings_from_config(config: Any) -> "ImpactSettings | None":
+    """None unless system.impact.enabled — the disabled path constructs the
+    unchanged on-policy objects (OnPolicyPipeline + get_learn_step)."""
+    raw = dict(config.system.get("impact") or {})
+    if not bool(raw.get("enabled", False)):
+        return None
+    settings = ImpactSettings(
+        target_update_interval=int(raw.get("target_update_interval", 4)),
+        rho_clip=float(raw.get("rho_clip", 2.0)),
+        max_staleness=int(raw.get("max_staleness", 4)),
+        max_reuse=int(raw.get("max_reuse", 2)),
+        buffer_size=int(raw.get("buffer_size", 4)),
+    )
+    if settings.target_update_interval < 1:
+        raise ValueError(
+            "system.impact.target_update_interval must be >= 1 "
+            f"(got {settings.target_update_interval})"
+        )
+    if settings.rho_clip < 1.0:
+        raise ValueError(
+            "system.impact.rho_clip must be >= 1.0 — clipping the IS ratio "
+            f"below 1 would down-weight FRESH data (got {settings.rho_clip})"
+        )
+    if settings.max_staleness < 1 or settings.max_reuse < 0 or settings.buffer_size < 1:
+        raise ValueError(
+            "system.impact: max_staleness/buffer_size must be >= 1 and "
+            f"max_reuse >= 0 (got {settings})"
+        )
+    return settings
+
+
+class ImpactBatch(NamedTuple):
+    """One learner step's worth of data on the IMPACT path."""
+
+    batch: Any  # assembled global-array trajectory batch
+    behavior_version: int  # oldest param version that collected it
+    fresh: bool  # False when re-stepping a buffered stale batch
+
+
+class ImpactIngest:
+    """Host-side fresh/stale scheduling for the IMPACT learner
+    (docs/DESIGN.md §2.12).
+
+    The learner prefers a FULL set of fresh payloads (`need` of them — any
+    actor mix, shapes are identical, so one compiled learn step serves both
+    paths). When fresh data is late it re-steps the newest eligible buffered
+    batch instead of blocking in collect; only with an empty buffer does it
+    block in wait_for_data (warmup, or reuse budget exhausted). Buffered
+    entries retire on a reuse budget and are dropped once their version lag
+    exceeds max_staleness."""
+
+    def __init__(self, pipeline: OffPolicyPipeline, need: int, settings: ImpactSettings):
+        import collections
+
+        self._pipeline = pipeline
+        self._need = need
+        self._settings = settings
+        self._pending: List[Any] = []  # (behavior_version, payload) FIFO
+        # [behavior_version, batch, reuse_left]; bounded — an append past
+        # capacity retires the OLDEST (stalest) entry.
+        self._buffer = collections.deque(maxlen=settings.buffer_size)
+        registry = get_registry()
+        self._reused = registry.counter(
+            "stoix_tpu_impact_reused_batches_total",
+            "Learner updates that re-stepped a buffered stale batch because "
+            "fresh rollouts were late",
+        )
+        self._dropped = registry.counter(
+            "stoix_tpu_impact_dropped_batches_total",
+            "Buffered batches retired for exceeding system.impact.max_staleness",
+        )
+
+    def _ingest(self, items: List[Any]) -> None:
+        for _actor_id, (version, payload) in items:
+            self._pending.append((version, payload))
+
+    def _pop_reusable(self, current_version: int) -> "ImpactBatch | None":
+        max_lag = self._settings.max_staleness
+        while self._buffer:
+            # Newest entry first: it has the smallest lag, so if IT is too
+            # stale everything behind it is too.
+            version, batch, reuse_left = self._buffer[-1]
+            if current_version - version > max_lag:
+                self._dropped.inc(len(self._buffer))
+                self._buffer.clear()
+                return None
+            if reuse_left <= 0:
+                self._buffer.pop()
+                continue
+            self._buffer[-1][2] = reuse_left - 1
+            self._reused.inc()
+            return ImpactBatch(batch, version, fresh=False)
+        return None
+
+    def next_batch(
+        self, assemble: Callable[[List[Any]], Any], current_version: int,
+        timeout: float = 180.0,
+    ) -> ImpactBatch:
+        """One update's batch: fresh when a full payload set is available (or
+        arrives while the buffer is empty), else a buffered stale batch."""
+        self._ingest(self._pipeline.poll(max_items=4 * self._need, timeout=0.0))
+        if len(self._pending) < self._need:
+            reusable = self._pop_reusable(current_version)
+            if reusable is not None:
+                return reusable
+            while len(self._pending) < self._need:
+                self._ingest(self._pipeline.wait_for_data(timeout=timeout))
+        take, self._pending = self._pending[: self._need], self._pending[self._need:]
+        version = min(v for v, _ in take)
+        batch = assemble([p for _, p in take])
+        if self._settings.max_reuse > 0:
+            self._buffer.append([version, batch, self._settings.max_reuse])
+        return ImpactBatch(batch, version, fresh=True)
 
 
 def _build_networks(config: Any, num_actions: int, obs_value: Any, env: Any = None):
@@ -247,6 +374,146 @@ def get_learn_step(actor_apply, critic_apply, update_fns, config, mesh: Mesh):
     )
 
 
+def get_impact_learn_step(
+    actor_apply, critic_apply, update_fns, config, mesh: Mesh, rho_clip: float
+):
+    """IMPACT variant of get_learn_step (arXiv:1912.00167, docs/DESIGN.md
+    §2.12): the update takes a THIRD input — the slow-moving target params
+    (replicated; a host-refreshed alias of a recent online version) — and the
+    actor objective becomes losses.impact_loss: the PPO clip taken against
+    the target policy, importance-weighted by the clipped target/behavior
+    ratio. `traj.log_prob` is the BEHAVIOR log-prob recorded by whichever
+    (possibly stale) param version collected the trajectory, which is what
+    makes re-stepping buffered batches sound. Everything else — GAE on the
+    stored values, epoch/minibatch scan, value loss, pmean over "data",
+    guards.guard_update — is the on-policy schedule unchanged."""
+    actor_update, critic_update = update_fns
+    gamma = float(config.system.gamma)
+    normalize_obs = bool(config.system.get("normalize_observations", False))
+    guard_mode = guards.resolve_mode(config)
+
+    def _maybe_normalize(observation, obs_stats):
+        if not normalize_obs:
+            return observation
+        return running_statistics.normalize_observation(observation, obs_stats)
+
+    def per_shard(state: CoreLearnerState, target_params, traj: PPOTransition):
+        obs_stats = state.obs_stats
+        raw_obs = traj.obs
+        traj = traj._replace(
+            obs=_maybe_normalize(raw_obs, obs_stats),
+            next_obs=_maybe_normalize(traj.next_obs, obs_stats),
+        )
+        if normalize_obs:
+            obs_stats = running_statistics.update(
+                obs_stats, raw_obs.agent_view, axis_names=("data",),
+                std_min_value=5e-4, std_max_value=5e4,
+            )
+        v_t = critic_apply(state.params.critic_params, traj.next_obs)
+        d_t = gamma * (1.0 - traj.done.astype(jnp.float32))
+        advantages, targets = truncated_generalized_advantage_estimation(
+            traj.reward, d_t, float(config.system.gae_lambda),
+            v_tm1=traj.value, v_t=v_t,
+            truncation_t=traj.truncated.astype(jnp.float32),
+            standardize_advantages=bool(config.system.get("standardize_advantages", True)),
+            impl=str(config.system.get("multistep_impl", "scan")),
+        )
+
+        @annotate("impact_minibatch")
+        def _minibatch(carry, batch):
+            params, opt_states = carry
+            mb_traj, mb_adv, mb_tgt = batch
+
+            def actor_loss_fn(p):
+                dist = actor_apply(p, mb_traj.obs)
+                log_prob = dist.log_prob(mb_traj.action)
+                # Target policy log-probs on the same (normalized) obs; no
+                # gradient flows into them (target_params is not `p`).
+                target_dist = actor_apply(target_params.actor_params, mb_traj.obs)
+                target_log_prob = target_dist.log_prob(mb_traj.action)
+                loss = losses.impact_loss(
+                    log_prob, mb_traj.log_prob, target_log_prob, mb_adv,
+                    float(config.system.clip_eps), rho_clip,
+                )
+                entropy = dist.entropy().mean()
+                return loss - float(config.system.ent_coef) * entropy, (loss, entropy)
+
+            def critic_loss_fn(p):
+                value = critic_apply(p, mb_traj.obs)
+                loss = losses.clipped_value_loss(
+                    value, mb_traj.value, mb_tgt, float(config.system.clip_eps)
+                )
+                return float(config.system.vf_coef) * loss, loss
+
+            (a_total, (a_loss, entropy)), a_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True
+            )(params.actor_params)
+            (c_total, v_loss), c_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True
+            )(params.critic_params)
+            a_grads, c_grads = jax.lax.pmean((a_grads, c_grads), axis_name="data")
+            a_updates, a_opt = actor_update(a_grads, opt_states.actor_opt_state)
+            c_updates, c_opt = critic_update(c_grads, opt_states.critic_opt_state)
+            new_params = ActorCriticParams(
+                optax.apply_updates(params.actor_params, a_updates),
+                optax.apply_updates(params.critic_params, c_updates),
+            )
+            # Divergence guard stays wired on the stale-reuse path — a
+            # blown-up IS ratio meeting a stale minibatch is exactly the
+            # non-finite-update class system.update_guard exists for.
+            (params, opt_states), guard_metrics = guards.guard_update(
+                guard_mode,
+                new=(new_params, ActorCriticOptStates(a_opt, c_opt)),
+                old=(params, opt_states),
+                loss=a_total + c_total,
+                grads=(a_grads, c_grads),
+                opt_state=opt_states,
+                axis_names=("data",),
+            )
+            return (params, opt_states), {
+                "actor_loss": a_loss, "value_loss": v_loss, "entropy": entropy,
+                **guard_metrics,
+            }
+
+        @annotate("impact_epoch")
+        def _epoch(carry, _):
+            params, opt_states, key = carry
+            key, shuffle_key = jax.random.split(key)
+            batch_size = advantages.shape[0] * advantages.shape[1]
+            perm = jax.random.permutation(shuffle_key, batch_size)
+            flat = jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), (traj, advantages, targets)
+            )
+            shuffled = jax.tree.map(lambda x: jnp.take(x, perm, axis=0), flat)
+            minibatches = jax.tree.map(
+                lambda x: x.reshape(
+                    (int(config.system.num_minibatches), -1) + x.shape[1:]
+                ),
+                shuffled,
+            )
+            (params, opt_states), metrics = jax.lax.scan(
+                _minibatch, (params, opt_states), minibatches
+            )
+            return (params, opt_states, key), metrics
+
+        (params, opt_states, key), metrics = jax.lax.scan(
+            _epoch, (state.params, state.opt_states, state.key), None,
+            int(config.system.epochs),
+        )
+        metrics = jax.lax.pmean(metrics, axis_name="data")
+        return CoreLearnerState(params, opt_states, key, obs_stats), metrics
+
+    return jax.jit(
+        shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(CoreLearnerState(P(), P(), P(), P()), P(), P(None, "data")),
+            out_specs=(CoreLearnerState(P(), P(), P(), P()), P()),
+            check_vma=True,
+        )
+    )
+
+
 def rollout_thread(
     actor_id: int,
     actor_device: jax.Device,
@@ -300,6 +567,10 @@ def _rollout_body(
     timestep = envs.reset(seed=seed)
 
     normalize_obs = bool(config.system.get("normalize_observations", False))
+    # IMPACT path (docs/DESIGN.md §2.12): fetch params WITH their version and
+    # tag every pushed trajectory with it — the learner computes per-batch
+    # staleness (its current version minus this behavior version).
+    impact_on = impact_settings_from_config(config) is not None
 
     @jax.jit
     def act_fn(bundle, observation, key):
@@ -313,7 +584,10 @@ def _rollout_body(
 
     with jax.default_device(actor_device):
         key = jax.random.PRNGKey(seed)
-        params = param_server.get_params(actor_id)
+        versioned = param_server.get_params_versioned(actor_id)
+        if versioned is None:
+            return
+        behavior_version, params = versioned
         rollout_idx = 0
         while not lifetime.should_stop():
             # Chaos injection points (no-ops unless STOIX_TPU_FAULT armed):
@@ -327,10 +601,10 @@ def _rollout_body(
             # run ahead while the learner computes (reference :202-214).
             if rollout_idx > 1:
                 with timer.time("get_params"):
-                    fetched = param_server.get_params(actor_id)
+                    fetched = param_server.get_params_versioned(actor_id)
                     if fetched is None:
                         break
-                    params = fetched
+                    behavior_version, params = fetched
             traj: List[PPOTransition] = []
             with span("actor_rollout", actor=actor_id, idx=rollout_idx), timer.time("rollout"):
                 for _ in range(rollout_length):
@@ -374,7 +648,10 @@ def _rollout_body(
                 )
             with timer.time("queue_put"):
                 try:
-                    pipeline.send_rollout(actor_id, payload, timeout=60.0)
+                    if impact_on:
+                        pipeline.push(actor_id, (behavior_version, payload), timeout=60.0)
+                    else:
+                        pipeline.send_rollout(actor_id, payload, timeout=60.0)
                 except queue.Full:
                     if lifetime.should_stop():
                         break
@@ -492,11 +769,27 @@ def run_experiment(
         NamedSharding(learner_mesh, P()),
     )
 
-    builder = learn_step_builder or get_learn_step
-    learn_step = builder(
-        actor.apply, critic.apply, (actor_optim.update, critic_optim.update),
-        config, learner_mesh,
-    )
+    # IMPACT stale-trajectory reuse (docs/DESIGN.md §2.12): None (the
+    # default) constructs the UNCHANGED on-policy objects below — same
+    # OnPolicyPipeline, same get_learn_step trace.
+    impact = impact_settings_from_config(config)
+    if impact is not None and learn_step_builder is not None:
+        raise ValueError(
+            "system.impact.enabled is incompatible with a custom "
+            "learn_step_builder: the IMPACT update takes (state, "
+            "target_params, batch), not (state, batch)"
+        )
+    if impact is not None:
+        learn_step = get_impact_learn_step(
+            actor.apply, critic.apply, (actor_optim.update, critic_optim.update),
+            config, learner_mesh, rho_clip=impact.rho_clip,
+        )
+    else:
+        builder = learn_step_builder or get_learn_step
+        learn_step = builder(
+            actor.apply, critic.apply, (actor_optim.update, critic_optim.update),
+            config, learner_mesh,
+        )
 
     # State-integrity sentinel (docs/DESIGN.md §2.9, arch.integrity): Sebulba
     # has no coalesced fetch to piggyback fingerprints on, so the learner
@@ -559,7 +852,12 @@ def run_experiment(
     fleet_coord = fleet.fleet_from_config(config)
     if fleet_coord is not None:
         fleet_coord.start()
-    pipeline = OnPolicyPipeline(num_actors, fleet=fleet_coord)
+    if impact is None:
+        pipeline = OnPolicyPipeline(num_actors, fleet=fleet_coord)
+    else:
+        # Push/poll ingestion: a slow actor no longer gates every update —
+        # the learner re-steps buffered stale batches instead (ImpactIngest).
+        pipeline = OffPolicyPipeline(num_actors, fleet=fleet_coord)
     # One heartbeat board for the whole run: actor beats come from the
     # pipeline, param-server and evaluator beats land on the same board so
     # the stall detector sees every component's age.
@@ -624,6 +922,56 @@ def run_experiment(
     preempt = PreemptionHandler().install()
 
     timer = TimingTracker()
+
+    def _assemble_batch(payloads):
+        # Per learner device: concat all payloads' shards, then build one
+        # global array per leaf. The shards are [T, E/n] slices of the ENV
+        # axis, so they tile array_axis=1 — assembling on the leading axis
+        # would stack trajectories along TIME and let GAE bootstrap across
+        # the device seam. (IMPACT note: any num_actors payloads tile to the
+        # same global shape, so fresh and reused batches share one compile.)
+        def to_global(*leaves):
+            per_device = []
+            for d in range(len(learner_devices)):
+                shards = [leaf[d] for leaf in leaves]
+                with jax.default_device(learner_devices[d]):
+                    per_device.append(jnp.concatenate(shards, axis=1))
+            return assemble_global_array(
+                per_device, learner_mesh, axis="data", array_axis=1
+            ) if len(per_device) > 1 else per_device[0]
+
+        # leaves are lists of per-device arrays; traverse manually.
+        flat_payloads = [jax.tree.flatten(p, is_leaf=lambda x: isinstance(x, list))
+                         for p in payloads]
+        treedef = flat_payloads[0][1]
+        merged_leaves = [
+            to_global(*(fp[0][i] for fp in flat_payloads))
+            for i in range(len(flat_payloads[0][0]))
+        ]
+        return jax.tree.unflatten(treedef, merged_leaves)
+
+    impact_ingest = None
+    impact_stats = None
+    target_params = None
+    if impact is not None:
+        impact_ingest = ImpactIngest(pipeline, num_actors, impact)
+        # Target network = device-side alias of a recent online version,
+        # refreshed on the host every target_update_interval updates.
+        target_params = learner_state.params
+        impact_staleness_gauge = get_registry().gauge(
+            "stoix_tpu_impact_batch_staleness",
+            "Param-version lag (learner version minus behavior version) of "
+            "the batch consumed by the most recent IMPACT update",
+        )
+        impact_refreshes = get_registry().counter(
+            "stoix_tpu_impact_target_refreshes_total",
+            "IMPACT target-network refreshes from the online params",
+        )
+        impact_stats = {
+            "updates": 0, "fresh_updates": 0, "reused_updates": 0,
+            "staleness_sum": 0, "max_staleness_seen": 0, "target_refreshes": 0,
+        }
+
     t_steps = 0
     skipped_base = guards.skipped_counter().value()
     steady_start_time = None  # set after the first eval block (post-compile)
@@ -633,41 +981,52 @@ def run_experiment(
     fleet_window_started = time.perf_counter()
     try:
         for update_idx in range(int(config.arch.num_updates)):
-            with timer.time("rollout_get"):
-                payloads = pipeline.collect_rollouts()
-            with span("learner_assemble", update=update_idx), timer.time("assemble"):
-                # Per learner device: concat all actors' shards, then build one
-                # global array per leaf. The shards are [T, E/n] slices of the
-                # ENV axis, so they tile array_axis=1 — assembling on the
-                # leading axis would stack devices' trajectories along TIME
-                # and let GAE bootstrap across the device seam.
-                def to_global(*leaves):
-                    per_device = []
-                    for d in range(len(learner_devices)):
-                        shards = [leaf[d] for leaf in leaves]
-                        with jax.default_device(learner_devices[d]):
-                            per_device.append(jnp.concatenate(shards, axis=1))
-                    return assemble_global_array(
-                        per_device, learner_mesh, axis="data", array_axis=1
-                    ) if len(per_device) > 1 else per_device[0]
-
-                # leaves are lists of per-device arrays; traverse manually.
-                flat_payloads = [jax.tree.flatten(p, is_leaf=lambda x: isinstance(x, list))
-                                 for p in payloads]
-                treedef = flat_payloads[0][1]
-                merged_leaves = [
-                    to_global(*(fp[0][i] for fp in flat_payloads))
-                    for i in range(len(flat_payloads[0][0]))
-                ]
-                batch = jax.tree.unflatten(treedef, merged_leaves)
+            fresh = True
+            if impact_ingest is None:
+                with timer.time("rollout_get"):
+                    payloads = pipeline.collect_rollouts()
+                with span("learner_assemble", update=update_idx), timer.time("assemble"):
+                    batch = _assemble_batch(payloads)
+            else:
+                with span("impact_next_batch", update=update_idx), timer.time("rollout_get"):
+                    got = impact_ingest.next_batch(
+                        _assemble_batch, param_server.version
+                    )
+                batch, fresh = got.batch, got.fresh
+                # First-class staleness: the learner's current version (=
+                # completed distributes, i.e. the params it just trained)
+                # minus the OLDEST behavior version in the batch; grows on
+                # every re-step of the same buffered batch.
+                staleness = param_server.version - got.behavior_version
+                impact_staleness_gauge.set(staleness)
+                impact_stats["updates"] += 1
+                impact_stats["fresh_updates" if fresh else "reused_updates"] += 1
+                impact_stats["staleness_sum"] += staleness
+                impact_stats["max_staleness_seen"] = max(
+                    impact_stats["max_staleness_seen"], staleness
+                )
 
             with span("learner_update", update=update_idx), timer.time("learn"):
-                learner_state, train_metrics = learn_step(learner_state, batch)
+                if impact_ingest is None:
+                    learner_state, train_metrics = learn_step(learner_state, batch)
+                else:
+                    learner_state, train_metrics = learn_step(
+                        learner_state, target_params, batch
+                    )
                 jax.block_until_ready(train_metrics)
             param_server.distribute_params(
                 (learner_state.params, learner_state.obs_stats)
             )
-            t_steps += steps_per_update
+            if impact_ingest is not None:
+                if impact_stats["updates"] % impact.target_update_interval == 0:
+                    target_params = learner_state.params
+                    impact_stats["target_refreshes"] += 1
+                    impact_refreshes.inc()
+            if fresh:
+                # Re-stepping a buffered batch consumes no NEW env frames:
+                # t_steps stays an env-frame count (fps denominators, eval
+                # t axis) rather than a gradient-step count.
+                t_steps += steps_per_update
             # Divergence guard, host half: count skipped updates; halt mode
             # raises DivergenceError here (metrics are already materialized
             # by the block_until_ready above — no extra sync).
@@ -842,6 +1201,22 @@ def run_experiment(
         ).set(fps)
         LAST_RUN_STATS["fps"] = fps
         LAST_RUN_STATS["total_env_steps"] = t_steps
+    # None when disabled (the pin tests/test_impact.py asserts): the default
+    # config must report the untouched on-policy path, not a zeroed dict.
+    LAST_RUN_STATS["impact"] = None if impact is None else {
+        "rho_clip": impact.rho_clip,
+        "target_update_interval": impact.target_update_interval,
+        "max_staleness": impact.max_staleness,
+        "max_reuse": impact.max_reuse,
+        "updates": impact_stats["updates"],
+        "fresh_updates": impact_stats["fresh_updates"],
+        "reused_updates": impact_stats["reused_updates"],
+        "mean_staleness": (
+            impact_stats["staleness_sum"] / max(1, impact_stats["updates"])
+        ),
+        "max_staleness_seen": impact_stats["max_staleness_seen"],
+        "target_refreshes": impact_stats["target_refreshes"],
+    }
     LAST_RUN_STATS["resilience"] = {
         "update_guard": guard_mode,
         "skipped_updates": guards.skipped_counter().value() - skipped_base,

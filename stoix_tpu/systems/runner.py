@@ -146,7 +146,7 @@ from stoix_tpu.utils.timestep_checker import check_total_timesteps
 # dict-compatible view at run end.
 LAST_RUN_STATS = RunStats()
 
-_PHASE_NAMES = ("compile_s", "learn_s", "eval_s", "fetch_s", "ckpt_s")
+_PHASE_NAMES = ("compile_s", "learn_s", "gossip_s", "eval_s", "fetch_s", "ckpt_s")
 
 
 class _PhaseClock:
@@ -162,14 +162,20 @@ class _PhaseClock:
         self._base = {
             name: self._counter.value({"phase": name}) for name in _PHASE_NAMES
         }
+        self._touched: set = set()
 
     def add(self, name: str, seconds: float) -> None:
+        self._touched.add(name)
         self._counter.inc(seconds, {"phase": name})
 
     def breakdown(self) -> dict:
+        # gossip_s appears only in runs that actually dispatched a gossip step;
+        # lockstep runs keep the original five-key schema bench.py and the
+        # observability contract tests pin.
         return {
             name: self._counter.value({"phase": name}) - self._base[name]
             for name in _PHASE_NAMES
+            if name != "gossip_s" or name in self._touched
         }
 
 
@@ -180,6 +186,11 @@ class AnakinSetup(NamedTuple):
     learner_state: Any
     eval_act_fn: Callable[..., Any]  # act_fn for the evaluator
     eval_params_fn: Callable[[Any], Any]  # learner_state -> params for eval
+    # Optional GossipPlan (parallel/gossip.py, docs/DESIGN.md §2.12): when its
+    # step is set, the runner dispatches it every plan.interval windows right
+    # after the learn dispatch. None (the default) = lockstep — the field
+    # defaults keep older setups (and _replace-based wrappers) source-compatible.
+    gossip: Any = None
 
 
 SetupFn = Callable[[envs.Environment, Any, Any, jax.Array], AnakinSetup]
@@ -362,6 +373,24 @@ def run_anakin_experiment(
         pipelined = False
 
     learn = setup.learn
+    # Gossip groups (parallel/gossip.py, docs/DESIGN.md §2.12): the mixing
+    # step the grouped setup returned, dispatched through this same pipelined
+    # stream every `interval` windows so it overlaps the next window's host
+    # work like any other device program. step=None covers both lockstep
+    # setups and the single-group identity short-circuit that keeps group:1
+    # bitwise-lockstep.
+    gossip_plan = getattr(setup, "gossip", None)
+    gossip_step = gossip_plan.step if gossip_plan is not None else None
+    gossip_interval = gossip_plan.interval if gossip_plan is not None else 0
+    gossip_rounds = 0
+    gossip_counter = (
+        get_registry().counter(
+            "stoix_tpu_gossip_rounds_total",
+            "Cross-group parameter mixing rounds dispatched",
+        )
+        if gossip_step is not None
+        else None
+    )
     phases = _PhaseClock()
     compile_counter = get_registry().counter(
         "stoix_tpu_runner_compile_seconds_total",
@@ -410,6 +439,12 @@ def run_anakin_experiment(
                     learn, (learner_state,), export_dir,
                     name=config.system.system_name,
                 )
+            if gossip_step is not None:
+                # The mixing program's compile is paid here too, so the first
+                # gossip window's wall time is dispatch cost like every other.
+                gossip_step = aot_warmup(
+                    gossip_step, learner_state, jnp.asarray(0, jnp.int32)
+                )
     compile_s = time.perf_counter() - t0
     phases.add("compile_s", compile_s)
     compile_counter.inc(compile_s)
@@ -450,7 +485,7 @@ def run_anakin_experiment(
     def dispatch_window(eval_idx: int) -> _Window:
         """Enqueue one full eval window on the device stream; never blocks on
         device results (post-compile, each call is dispatch cost only)."""
-        nonlocal learner_state, key, last_save_t
+        nonlocal learner_state, key, last_save_t, gossip_rounds
         key, eval_key = jax.random.split(key)
         ts = time.perf_counter()
         # device_annotation: names this dispatch in the jax.profiler device
@@ -464,6 +499,21 @@ def run_anakin_experiment(
                 output = learn(learner_state)
         phases.add("learn_s", time.perf_counter() - ts)
         learner_state = output.learner_state
+        if gossip_step is not None and (eval_idx + 1) % gossip_interval == 0:
+            # Mix BEFORE the snapshot below: eval, best-params tracking, and
+            # checkpoints all observe the POST-gossip parameters. The round
+            # index seeds random_peer's edge draw deterministically, and the
+            # step donates the learn output it consumes (nothing else reads
+            # the pre-gossip state).
+            ts = time.perf_counter()
+            with span("gossip_dispatch", window=eval_idx), \
+                    device_annotation("gossip_dispatch"):
+                learner_state = gossip_step(
+                    learner_state, jnp.asarray(eval_idx, jnp.int32)
+                )
+            phases.add("gossip_s", time.perf_counter() - ts)
+            gossip_rounds += 1
+            gossip_counter.inc()
         t = start_step + (eval_idx + 1) * steps_per_eval
 
         # On-device snapshots, enqueued BEFORE the next learn dispatch ever
@@ -833,6 +883,18 @@ def run_anakin_experiment(
             "integrity": (
                 sentinel.stats() if sentinel is not None
                 else integrity.disabled_stats()
+            ),
+            "gossip": (
+                {
+                    "num_groups": gossip_plan.num_groups,
+                    "interval": gossip_plan.interval,
+                    "topology": gossip_plan.topology,
+                    "mixing_weight": gossip_plan.mixing_weight,
+                    "average_opt_states": gossip_plan.average_opt_states,
+                    "rounds": gossip_rounds,
+                }
+                if gossip_plan is not None
+                else None
             ),
         }
     )

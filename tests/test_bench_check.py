@@ -224,6 +224,133 @@ def test_baseline_json_published_mapping_format(tmp_path):
     ]
 
 
+# ---- scaling_bench / MULTICHIP wiring (ROADMAP item 4 slice) -----------------
+
+
+def _scaling_summary(effs=(1.0, 0.92), sps0=10000.0):
+    records = []
+    for i, eff in enumerate(effs):
+        n = 2**i
+        records.append(
+            {
+                "devices": n,
+                "env_steps_per_sec": round(sps0 * n * eff, 1),
+                "per_device": round(sps0 * eff, 1),
+                "efficiency_vs_smallest": eff,
+            }
+        )
+    return {"scaling": records}
+
+
+def test_scaling_summary_loads_as_baseline_payloads(tmp_path):
+    """A scaling_bench.py summary is a first-class --check baseline: per-size
+    throughput metrics plus efficiency-vs-smallest as its OWN metric for
+    every size past the smallest (the >=80% weak-scaling efficiency claim
+    becomes a number the gate holds a band around)."""
+    bench = _bench()
+    path = tmp_path / "SCALING.json"
+    path.write_text(json.dumps(_scaling_summary()))
+    payloads = bench._load_baseline_payloads(str(path))
+    metrics = [p["metric"] for p in payloads]
+    assert metrics == [
+        "scaling_ppo_weak_d1_env_steps_per_sec",
+        "scaling_ppo_weak_d2_env_steps_per_sec",
+        "scaling_ppo_weak_eff_d2",
+    ]
+    eff = payloads[-1]
+    assert eff["median"] == 0.92 and eff["rel_spread"] == 0.0
+    # Every converted line is immediately gate-composable.
+    code, verdicts = bench.check_payloads(payloads, payloads)
+    assert code == 0, verdicts
+
+
+def test_scaling_efficiency_regression_fails_the_gate(tmp_path):
+    """An efficiency collapse (0.92 -> 0.60 at d2) is a regression verdict on
+    the eff metric even though absolute throughput grew — the exact failure
+    mode a raw steps/sec comparison would wave through."""
+    bench = _bench()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_scaling_summary(effs=(1.0, 0.92))))
+    baselines = bench._load_baseline_payloads(str(base))
+    cand_text = json.dumps(_scaling_summary(effs=(1.0, 0.60), sps0=20000.0))
+    code, verdicts = bench.check_payloads(
+        baselines, bench._parse_payload_lines(cand_text)
+    )
+    assert code == 1
+    by_metric = {v["metric"]: v for v in verdicts}
+    assert by_metric["scaling_ppo_weak_eff_d2"]["status"] == "fail"
+    assert "regression" in by_metric["scaling_ppo_weak_eff_d2"]["reason"]
+    # Throughput itself improved and passes.
+    assert by_metric["scaling_ppo_weak_d2_env_steps_per_sec"]["status"] == "pass"
+
+
+def test_scaling_stdout_pipes_as_candidate_without_double_counting():
+    """scaling_bench stdout = payload-shaped per-size lines + the trailing
+    summary. The line parser must keep ONE payload per metric (first wins)
+    and still pick up the eff metrics only the summary carries."""
+    bench = _bench()
+    summary = _scaling_summary()
+    lines = [json.dumps({**rec, "metric": f"scaling_ppo_weak_d{rec['devices']}_env_steps_per_sec", "value": rec["env_steps_per_sec"], "median": rec["env_steps_per_sec"], "rel_spread": 0.0}) for rec in summary["scaling"]]
+    lines.append(json.dumps(summary))
+    payloads = bench._parse_payload_lines("\n".join(lines))
+    metrics = [p["metric"] for p in payloads]
+    assert len(metrics) == len(set(metrics)) == 3, metrics
+    assert "scaling_ppo_weak_eff_d2" in metrics
+
+
+def test_multichip_record_converts_and_gates(tmp_path):
+    """MULTICHIP_r*.json rides the same gate: ok -> 1.0 median (passes
+    against an ok baseline), ok=false -> 0.0 median -> the failed-workload
+    verdict; a skipped record is no measurement at all."""
+    bench = _bench()
+    ok_path = tmp_path / "MULTICHIP_ok.json"
+    ok_path.write_text(
+        json.dumps({"n_devices": 8, "rc": 0, "ok": True, "skipped": False})
+    )
+    baselines = bench._load_baseline_payloads(str(ok_path))
+    assert baselines == [
+        {
+            "metric": "multichip_dryrun_ok_d8", "value": 1.0, "median": 1.0,
+            "rel_spread": 0.0, "unit": "dry-run success (1.0 = ok)",
+            "rc": 0, "fallback": False,
+        }
+    ]
+    # ok vs ok: pass.
+    code, verdicts = bench.check_payloads(baselines, baselines)
+    assert code == 0, verdicts
+    # A broken dry run (the repo's own MULTICHIP_r01 shape: rc=124 timeout)
+    # is a zero-median candidate -> loud failed-workload verdict.
+    broken = bench._parse_payload_lines(
+        json.dumps({"n_devices": 8, "rc": 124, "ok": False, "skipped": False})
+    )
+    code, verdicts = bench.check_payloads(baselines, broken)
+    assert code == 1 and "failed workload" in verdicts[0]["reason"]
+    # skipped -> no payload.
+    assert bench._parse_payload_lines(
+        json.dumps({"n_devices": 16, "rc": 0, "ok": False, "skipped": True})
+    ) == []
+
+
+def test_multichip_cli_end_to_end(tmp_path):
+    """Subprocess contract: the real-file shapes flow through run_check with
+    no jax import (same prolog guarantee as every other --check path)."""
+    base = tmp_path / "MULTICHIP_base.json"
+    cand = tmp_path / "MULTICHIP_cand.json"
+    base.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True}))
+    cand.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True}))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--check", str(base), "--candidate", str(cand),
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[0])
+    assert verdict["metric"] == "multichip_dryrun_ok_d8"
+    assert verdict["status"] == "pass"
+
+
 # ---- CLI contract (subprocess; no jax import on this path) -------------------
 
 

@@ -268,6 +268,55 @@ def test_bench_population_payload_schema():
     assert p8["pbt_enabled"] is True and p8["pbt_exploits"] > 0
 
 
+@pytest.mark.slow
+def test_bench_gossip_payload_schema():
+    """`bench.py --gossip` (docs/DESIGN.md §2.12): TWO payload lines —
+    G=1 (lockstep, the bit-identity anchor: zero gossip rounds) and G=2
+    (ring gossip) — each measuring a clean steady-state rate PLUS a twin
+    run under an injected `host_stall` straggler, with the retained-
+    throughput ratio riding the payload; numeric `value` + `median` +
+    `rel_spread` keep the lines --check-composable."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--gossip", "--smoke", "--cpu",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "STOIX_BENCH_NO_FALLBACK": "1"},
+    )
+    assert proc.returncode == 0, (
+        f"bench.py --gossip failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 2, f"expected two JSON lines (G=1, G=2):\n{proc.stdout}"
+    g1, g2 = (json.loads(ln) for ln in json_lines)
+
+    assert g1["metric"] == "gossip_ppo_identity_game_lockstep_env_steps_per_sec"
+    assert g2["metric"] == "gossip_ppo_identity_game_g2_env_steps_per_sec"
+    for payload, num_groups in ((g1, 1), (g2, 2)):
+        assert payload["value"] > 0 and "env_steps/sec" in payload["unit"]
+        assert payload["num_groups"] == num_groups
+        assert payload["topology"] == "ring"
+        assert payload["gossip_interval"] >= 1
+        assert payload["min"] <= payload["median"] <= payload["max"]
+        assert payload["rel_spread"] >= 0.0
+        # The straggler twin: an injected host_stall ran to completion and
+        # produced a comparable rate; retained = stalled / clean best.
+        assert payload["stall_s"] >= 1
+        assert payload["stalled_env_steps_per_sec"] > 0, payload
+        assert 0.0 < payload["throughput_retained"], payload
+        # Universal posture fields, like every other workload payload.
+        assert "resilience" in payload
+        assert payload["fallback"] is False
+    # G=1 is lockstep: the dense pmean spans every device, no gossip ever
+    # fires. G=2 averaged across groups at each window boundary.
+    assert g1["gossip_rounds"] == 0
+    assert g2["gossip_rounds"] > 0
+
+
 def test_bench_backend_wedge_aborts_typed_within_deadline():
     # Acceptance pin (docs/DESIGN.md §2.4): with the probe subprocess wedged
     # (backend_wedge chaos fault — the child sleeps before touching jax),

@@ -264,6 +264,51 @@ def test_skew_warns_and_exports_gauges():
         assert coord.observe_window_wall(4, 1.0) == pytest.approx(1.2)
 
 
+def test_host_stall_fault_drives_skew_warning():
+    """Satellite pin (ISSUE 16): the `host_stall:S` chaos fault IS the
+    straggler drill — a wall-time measured around the injected stall, fed
+    through the same skew transport the host loop uses, pushes
+    stoix_tpu_fleet_window_skew_ratio past skew_warn_ratio and emits the
+    typed FleetStragglerWarning (the signal bench.py --gossip's
+    throughput_retained headline exists to answer)."""
+    from stoix_tpu.observability import get_registry
+
+    faultinject.configure("host_stall:1")
+    injected = get_registry().counter("stoix_tpu_resilience_faults_injected_total")
+    before = injected.value({"fault": "host_stall"})
+    # The stalled host's window wall, measured exactly as a host loop wraps
+    # the fault hook: the one-shot sleep lands at window 1.
+    t0 = time.perf_counter()
+    faultinject.maybe_host_stall(1)
+    stalled_wall = time.perf_counter() - t0
+    assert stalled_wall >= 1.0
+    assert injected.value({"fault": "host_stall"}) - before == 1.0
+    # One-shot: the healthy twin of the same window does not stall.
+    t0 = time.perf_counter()
+    faultinject.maybe_host_stall(1)
+    healthy_wall = time.perf_counter() - t0
+    assert healthy_wall < 0.5
+    # Floor the fast host's wall so the ratio is deterministic, never 1/~0.
+    fast_wall = max(healthy_wall, 0.05)
+
+    store = fleet.FakeFleetStore(2)
+    coord = fleet.FleetCoordinator(
+        _settings(skew_warn_ratio=2.0),
+        backend=store.view(1),
+        allgather_fn=lambda x: np.asarray([[fast_wall], x.reshape(-1)[:1]]),
+        interrupt_on_partition=False,
+    )
+    with pytest.warns(fleet.FleetStragglerWarning, match="process 1 is a straggler"):
+        ratio = coord.observe_window_wall(1, stalled_wall)
+    assert ratio == pytest.approx(stalled_wall / fast_wall)
+    assert ratio > 2.0
+    gauge = get_registry().gauge("stoix_tpu_fleet_window_wall_seconds")
+    assert gauge.value({"process": "1"}) == pytest.approx(stalled_wall)
+    assert get_registry().gauge(
+        "stoix_tpu_fleet_window_skew_ratio"
+    ).value() == pytest.approx(ratio)
+
+
 def test_skew_single_process_skips_allgather():
     cfg = config_lib.compose(
         config_lib.default_config_dir(),

@@ -1,0 +1,319 @@
+"""Gossip-grouped training contracts (parallel/gossip.py, docs/DESIGN.md §2.12).
+
+The acceptance pins:
+  * a SINGLE group with gossip.interval=1 trains BIT-identically to the plain
+    lockstep Anakin ff_ppo run (the identity short-circuit, not arithmetic);
+  * every topology's mixing matrix is doubly stochastic (the group-mean of
+    the parameters is invariant under mixing), observed both as the pure
+    matrix and through a real 2-group CPU training run;
+  * a 2-group run under `faultinject host_stall` completes without stalling
+    and still mixes every window.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.parallel import gossip
+from stoix_tpu.resilience import faultinject
+from stoix_tpu.systems.ppo.anakin import ff_ppo
+from stoix_tpu.systems.runner import LAST_RUN_STATS, run_anakin_experiment
+from stoix_tpu.utils import config as config_lib
+
+BASE_OVERRIDES = [
+    "env=identity_game",
+    "arch.total_num_envs=16",
+    "arch.num_updates=4",
+    "arch.total_timesteps=~",
+    "arch.num_evaluation=2",
+    "arch.num_eval_episodes=8",
+    "arch.absolute_metric=False",
+    "system.rollout_length=4",
+    "system.epochs=1",
+    "system.num_minibatches=2",
+    "logger.use_console=False",
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _compose(root, extra=()):
+    return config_lib.compose(
+        config_lib.default_config_dir(), root, BASE_OVERRIDES + list(extra)
+    )
+
+
+def _record_run(root, extra=(), squeeze_group=False):
+    """Run ff_ppo recording the learn output's params per window (pre-gossip)
+    and, when a gossip step exists, the post-mix params per round."""
+    learn_traj, gossip_traj = [], []
+    cfg = _compose(root, extra)
+
+    def recording_setup(env, config, mesh, key):
+        setup = ff_ppo.learner_setup(env, config, mesh, key)
+        inner = setup.learn
+
+        def learn(state):
+            out = inner(state)
+            params = out.learner_state.params
+            if squeeze_group:
+                params = jax.tree.map(lambda x: x[0], params)
+            learn_traj.append(jax.tree.map(np.asarray, params))
+            return out
+
+        plan = setup.gossip
+        if plan is not None and plan.step is not None:
+            inner_step = plan.step
+
+            def gossip_step(state, round_idx):
+                mixed = inner_step(state, round_idx)
+                gossip_traj.append(jax.tree.map(np.asarray, mixed.params))
+                return mixed
+
+            plan = plan._replace(step=gossip_step)
+        return setup._replace(learn=learn, gossip=plan)
+
+    run_anakin_experiment(cfg, recording_setup)
+    return learn_traj, gossip_traj
+
+
+# ---------------------------------------------------------------------------
+# THE bit-identity pin
+
+
+def test_single_group_bit_identical_to_lockstep(devices):
+    """arch=gossip with group:1 (interval 1, gossip enabled) must be BITWISE
+    the plain Anakin run: the mixing step is never dispatched for one group —
+    even W=[[1.0]] arithmetic would break this, so the pin guards the
+    short-circuit itself."""
+    plain, _ = _record_run("default/anakin/default_ff_ppo.yaml")
+    grouped, gossip_rounds = _record_run(
+        "default/gossip/default_ff_ppo.yaml", squeeze_group=True
+    )
+    assert not gossip_rounds, "single group must never dispatch a mixing step"
+    assert LAST_RUN_STATS["gossip"] == {
+        "num_groups": 1, "interval": 1, "topology": "ring",
+        "mixing_weight": 0.5, "average_opt_states": False, "rounds": 0,
+    }
+    assert len(plain) == len(grouped) == 2
+    for window, (a, b) in enumerate(zip(plain, grouped)):
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                x, y, err_msg=f"single-group gossip diverged at window {window}"
+            ),
+            a,
+            b,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Mixing matrices: the pure math
+
+
+@pytest.mark.parametrize("topology", gossip.TOPOLOGIES)
+@pytest.mark.parametrize("num_groups", [2, 3, 5])
+def test_mixing_matrix_doubly_stochastic(topology, num_groups):
+    settings = gossip.GossipSettings(
+        enabled=True, interval=1, topology=topology,
+        mixing_weight=0.4, average_opt_states=False, seed=0,
+    )
+    matrix = np.asarray(
+        gossip.mixing_matrix(settings, num_groups, jnp.asarray(3, jnp.int32))
+    )
+    assert matrix.shape == (num_groups, num_groups)
+    np.testing.assert_allclose(matrix.sum(axis=0), 1.0, atol=1e-6)
+    np.testing.assert_allclose(matrix.sum(axis=1), 1.0, atol=1e-6)
+    assert (matrix >= 0.0).all()
+    # Self-weight on the diagonal: 1-w for the sparse topologies; all_pairs
+    # folds the group's own 1/G share of the dense average back in.
+    expected_diag = 0.6 + (0.4 / num_groups if topology == "all_pairs" else 0.0)
+    np.testing.assert_allclose(np.diag(matrix), expected_diag, atol=1e-6)
+
+
+def test_ring_two_groups_single_edge():
+    """G=2: left and right neighbour coincide — the edge carries FULL w, not
+    w/2 twice (which would silently halve the mixing rate)."""
+    settings = gossip.GossipSettings(
+        enabled=True, interval=1, topology="ring",
+        mixing_weight=0.5, average_opt_states=False, seed=0,
+    )
+    matrix = np.asarray(gossip.mixing_matrix(settings, 2, jnp.asarray(0, jnp.int32)))
+    np.testing.assert_allclose(matrix, [[0.5, 0.5], [0.5, 0.5]], atol=1e-7)
+
+
+def test_random_peer_edge_varies_with_round_but_not_rerun():
+    settings = gossip.GossipSettings(
+        enabled=True, interval=1, topology="random_peer",
+        mixing_weight=0.5, average_opt_states=False, seed=7,
+    )
+    rounds = [
+        np.asarray(gossip.mixing_matrix(settings, 5, jnp.asarray(r, jnp.int32)))
+        for r in range(8)
+    ]
+    # Deterministic per round index...
+    np.testing.assert_array_equal(
+        rounds[3],
+        np.asarray(gossip.mixing_matrix(settings, 5, jnp.asarray(3, jnp.int32))),
+    )
+    # ...but the drawn edge changes across rounds (4 possible shifts over 8
+    # rounds: at least two distinct matrices, overwhelmingly).
+    assert any(not np.array_equal(rounds[0], m) for m in rounds[1:])
+    # And the shift works under jit with a TRACED round index (no recompile
+    # per round is the whole point).
+    jitted = jax.jit(lambda r: gossip.mixing_matrix(settings, 5, r))
+    np.testing.assert_array_equal(np.asarray(jitted(jnp.asarray(3))), rounds[3])
+
+
+def test_mix_leaf_passes_integers_through():
+    matrix = jnp.full((2, 2), 0.5, jnp.float32)
+    count = jnp.asarray([[3], [3]], jnp.int32)
+    out = gossip._mix_leaf(matrix, count)
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(count))
+    floats = jnp.asarray([[2.0], [4.0]], jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(gossip._mix_leaf(matrix, floats)), [[3.0], [3.0]]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Config validation refusals
+
+
+def _cfg_with_gossip(**gossip_over):
+    cfg = _compose("default/gossip/default_ff_ppo.yaml")
+    for k, v in gossip_over.items():
+        config_lib._set_dotted(cfg, f"arch.gossip.{k}", v)
+    return cfg
+
+
+def test_settings_refusals():
+    with pytest.raises(gossip.GossipError, match="interval"):
+        gossip.settings_from_config(_cfg_with_gossip(interval=0))
+    with pytest.raises(gossip.GossipError, match="topology"):
+        gossip.settings_from_config(_cfg_with_gossip(topology="star"))
+    with pytest.raises(gossip.GossipError, match="mixing_weight"):
+        gossip.settings_from_config(_cfg_with_gossip(mixing_weight=0.0))
+    with pytest.raises(gossip.GossipError, match="mixing_weight"):
+        gossip.settings_from_config(_cfg_with_gossip(mixing_weight=1.5))
+
+
+def test_grouped_config_refusals(devices):
+    from stoix_tpu import envs
+    from stoix_tpu.parallel import MeshRoles
+
+    # No group axis on the mesh: the grouped setup is never entered, but
+    # enabling gossip on a plain mesh must refuse loudly.
+    cfg_plain = _compose("default/anakin/default_ff_ppo.yaml")
+    config_lib._set_dotted(cfg_plain, "arch.gossip", {"enabled": True})
+    mesh_plain = MeshRoles.from_config(cfg_plain).learn_mesh()
+    with pytest.raises(gossip.GossipError, match="'group' mesh axis"):
+        gossip.build_gossip_plan(cfg_plain, mesh_plain)
+
+    # Multi-group mesh with gossip disabled: groups would never communicate.
+    cfg_off = _compose(
+        "default/gossip/default_ff_ppo.yaml",
+        ["arch.mesh.group=2", "arch.gossip.enabled=false"],
+    )
+    mesh_off = MeshRoles.from_config(cfg_off).learn_mesh()
+    env, _ = envs.make(cfg_off)
+    with pytest.raises(gossip.GossipError, match="WITHOUT exchanging"):
+        ff_ppo.learner_setup(env, cfg_off, mesh_off, jax.random.PRNGKey(0))
+
+    # Integrity sentinel + fused_eval assume replicated state / in-program
+    # eval params: both refused, mirroring the population runner.
+    for override, match in (
+        ("arch.integrity.enabled=True", "integrity"),
+        ("arch.fused_eval=True", "fused_eval"),
+    ):
+        cfg_bad = _compose("default/gossip/default_ff_ppo.yaml", [override])
+        mesh_bad = MeshRoles.from_config(cfg_bad).learn_mesh()
+        env_bad, _ = envs.make(cfg_bad)
+        with pytest.raises(gossip.GossipError, match=match):
+            ff_ppo.learner_setup(env_bad, cfg_bad, mesh_bad, jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# Real 2-group runs
+
+
+def test_two_group_run_mixes_and_preserves_group_mean(devices):
+    """2 groups, ring, w=0.5: the groups roll out on different env streams so
+    their pre-mix params differ; each gossip round is dispatched every window
+    and preserves the group-mean of the parameters (double stochasticity,
+    observed through the real run)."""
+    learn_traj, gossip_traj = _record_run(
+        "default/gossip/default_ff_ppo.yaml", ["arch.mesh.group=2"]
+    )
+    assert len(learn_traj) == 2 and len(gossip_traj) == 2
+    assert LAST_RUN_STATS["gossip"]["rounds"] == 2
+    assert LAST_RUN_STATS["gossip"]["num_groups"] == 2
+    assert LAST_RUN_STATS["phase_breakdown"]["gossip_s"] > 0.0
+    for window, (pre, post) in enumerate(zip(learn_traj, gossip_traj)):
+        pre_leaves = jax.tree.leaves(pre)
+        post_leaves = jax.tree.leaves(post)
+        # Different env streams -> the groups genuinely diverged before the mix.
+        assert any(
+            not np.array_equal(l[0], l[1]) for l in pre_leaves
+        ), f"groups identical before mix at window {window}"
+        # W=[[.5,.5],[.5,.5]]... no — ring G=2 w=0.5 mixes half-way; the mean
+        # across groups must be preserved leaf-wise.
+        for a, b in zip(pre_leaves, post_leaves):
+            np.testing.assert_allclose(
+                a.mean(axis=0), b.mean(axis=0), rtol=1e-5, atol=1e-6,
+                err_msg=f"group-mean not preserved at window {window}",
+            )
+
+
+def test_all_pairs_full_weight_reaches_consensus(devices):
+    """all_pairs with w=1.0 IS the synchronous average: after every round all
+    groups hold identical parameters."""
+    _, gossip_traj = _record_run(
+        "default/gossip/default_ff_ppo.yaml",
+        [
+            "arch.mesh.group=2",
+            "arch.gossip.topology=all_pairs",
+            "arch.gossip.mixing_weight=1.0",
+        ],
+    )
+    assert len(gossip_traj) == 2
+    for window, post in enumerate(gossip_traj):
+        for leaf in jax.tree.leaves(post):
+            np.testing.assert_allclose(
+                leaf[0], leaf[1], rtol=1e-6, atol=1e-7,
+                err_msg=f"groups not at consensus after all-pairs w=1 round "
+                        f"{window}",
+            )
+
+
+def test_two_group_run_survives_host_stall(devices):
+    """THE straggler drill: a 2-group run under `faultinject host_stall`
+    completes end-to-end (the stall is a delay, never a deadlock) and still
+    dispatches every gossip round; the injection is visible on the fault
+    counter."""
+    from stoix_tpu.observability import get_registry
+
+    counter = get_registry().counter(
+        "stoix_tpu_resilience_faults_injected_total",
+        "Faults fired by the injection harness, by fault name",
+    )
+    base = counter.value({"fault": "host_stall"})
+    learn_traj, gossip_traj = _record_run(
+        "default/gossip/default_ff_ppo.yaml",
+        ["arch.mesh.group=2", "arch.fault_spec=host_stall:1"],
+    )
+    assert len(learn_traj) == 2 and len(gossip_traj) == 2
+    assert counter.value({"fault": "host_stall"}) == base + 1
+    assert LAST_RUN_STATS["gossip"]["rounds"] == 2
+    assert LAST_RUN_STATS["resilience"]["preempted"] is False
+
+
+def test_lockstep_run_reports_no_gossip(devices):
+    _record_run("default/anakin/default_ff_ppo.yaml")
+    assert LAST_RUN_STATS["gossip"] is None

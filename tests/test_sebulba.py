@@ -42,6 +42,9 @@ def test_sebulba_ppo_multi_device_split(devices):
     )
     ret = ff_ppo.run_experiment(cfg)
     assert np.isfinite(ret)
+    # IMPACT disabled-path pin (docs/DESIGN.md §2.12): the default config
+    # runs the untouched on-policy pipeline and reports no impact stats.
+    assert ff_ppo.LAST_RUN_STATS["impact"] is None
 
 
 @pytest.mark.slow
