@@ -847,6 +847,26 @@ def _integrity_report(stats_source) -> dict:
     }
 
 
+def _goodput_report(stats_source) -> dict:
+    """First-class goodput ledger fields for a bench payload (docs/DESIGN.md
+    §2.13): the compute fraction of wall time plus the badput components that
+    taxed it (stall/recovery seconds) and the full per-phase fraction map,
+    whose values sum to 1 (tests/test_bench_schema.py pins the shape). Runs
+    that never opened a ledger report the schema-complete zero shape."""
+    from stoix_tpu.observability import goodput as goodput_mod
+
+    report = dict((stats_source or {}).get("goodput") or {})
+    if not report:
+        report = goodput_mod.disabled_report()
+    return {
+        "wall_s": round(float(report.get("wall_s", 0.0)), 6),
+        "fraction": round(float(report.get("fraction", 0.0)), 6),
+        "stall_s": round(float(report.get("stall_s", 0.0)), 6),
+        "recovery_s": round(float(report.get("recovery_s", 0.0)), 6),
+        "fractions": dict(report.get("fractions") or {}),
+    }
+
+
 def _timed_anakin_run(config, learner_setup, smoke: bool, reps: int | None = None):
     """Shared timed-loop core: compose -> setup -> warmup -> N timed reps of
     the steady-state window (`--reps`, default 3). Returns
@@ -1072,6 +1092,8 @@ def _run_anakin_ppo(
         # Sentinel posture of the probe run (the probe exercises the real
         # runner, fingerprints included when --integrity arms them).
         "integrity": _integrity_report(anakin_runner.LAST_RUN_STATS),
+        # Goodput ledger of the probe run (same source as phase_breakdown).
+        "goodput": _goodput_report(anakin_runner.LAST_RUN_STATS),
     }
 
 
@@ -1172,6 +1194,7 @@ def _run_replay(metric, smoke, n_devices, reps=None) -> dict:
         # The microbench drives the service directly (no runner, no
         # sentinel): disabled shape, never a missing key.
         "integrity": _integrity_report(None),
+        "goodput": _goodput_report(None),
     }
 
 
@@ -1274,6 +1297,7 @@ def _run_serve(metric, smoke, n_devices, reps=None) -> dict:
             # Serving's integrity story is the hot-swap canary; the training
             # sentinel never runs here — disabled shape, never a missing key.
             "integrity": _integrity_report(None),
+            "goodput": _goodput_report(None),
         }
     finally:
         os.chdir(cwd)
@@ -1334,6 +1358,7 @@ def _run_anakin_generic(
         # sentinel): the integrity fields still ride with the disabled
         # shape, so consumers never see a missing key.
         "integrity": _integrity_report(None),
+        "goodput": _goodput_report(None),
     }
 
 
@@ -1419,6 +1444,7 @@ def _run_population(smoke: bool, n_devices: int, reps: int | None = None) -> lis
             if not anakin_runner.LAST_RUN_STATS.get("resilience")
             else dict(anakin_runner.LAST_RUN_STATS.get("resilience")),
             "integrity": _integrity_report(anakin_runner.LAST_RUN_STATS),
+            "goodput": _goodput_report(anakin_runner.LAST_RUN_STATS),
         })
     return payloads
 
@@ -1636,6 +1662,7 @@ def _run_sebulba(
         "telemetry": telemetry,
         "resilience": resilience,
         "integrity": _integrity_report(sebulba_ppo.LAST_RUN_STATS),
+        "goodput": _goodput_report(sebulba_ppo.LAST_RUN_STATS),
     }
 
 

@@ -243,6 +243,12 @@ def run_supervised(
     relaunches = 0
     extra: List[str] = []
     while True:
+        # Each relaunch is a FRESH subprocess, and within any process the
+        # run start calls observability.configure(), which resets the
+        # process-wide HealthMonitor and flight recorder — so a relaunched
+        # incarnation never inherits stale heartbeat state that would read
+        # as an instant stall (docs/DESIGN.md §2.13; pinned by
+        # tests/test_opsplane.py).
         rc = subprocess.run(cmd + extra, env=env).returncode
         if rc not in (EXIT_CODE_FLEET_PARTITION, EXIT_CODE_STATE_CORRUPTION):
             if relaunches:
@@ -333,8 +339,17 @@ def serve_main(argv: List[str]) -> int:
     # the hot-swap canary (docs/DESIGN.md §2.9) when serving standalone.
     faultinject.configure((config.get("arch") or {}).get("fault_spec"))
     log = get_logger("stoix_tpu.launcher")
-    server = PolicyServer.from_config(config)
     serve_cfg = config.arch.serve
+    # Ops plane (docs/DESIGN.md §2.13): start the endpoints BEFORE warmup so
+    # /healthz and /statusz answer during the first compile. The serve config
+    # has no `logger` block, so the switch lives at `arch.serve.http`.
+    from stoix_tpu.observability import get_status_board, server_from_config
+
+    ops_server = server_from_config(dict(serve_cfg.get("http") or {}))
+    get_status_board().update(
+        {"run_id": "serve", "architecture": "serve", "system": "policy-server"}
+    )
+    server = PolicyServer.from_config(config)
     stop_requested = {"flag": False}
 
     def _request_stop(_signum: int, _frame: Any) -> None:
@@ -379,6 +394,8 @@ def serve_main(argv: List[str]) -> int:
                 path = server.telemetry.export(str(telemetry_dir))
                 log.info("[serve] SLO metrics exported to %s", path)
     finally:
+        if ops_server is not None:
+            ops_server.close()
         for signum, handler in previous_handlers.items():
             signal.signal(signum, handler)
     return 0

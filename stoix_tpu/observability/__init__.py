@@ -35,10 +35,32 @@ from stoix_tpu.observability.exporters import (  # noqa: F401 — public API
     to_prometheus_text,
     write_prometheus,
 )
+from stoix_tpu.observability.aggregate import (  # noqa: F401
+    FleetMetricsAggregator,
+    aggregator_from_fleet,
+)
+from stoix_tpu.observability.flightrec import (  # noqa: F401
+    FlightRecorder,
+    dump_flight_record,
+    get_flight_recorder,
+    validate_flight_record,
+)
+from stoix_tpu.observability.goodput import (  # noqa: F401
+    GoodputLedger,
+)
 from stoix_tpu.observability.health import (  # noqa: F401
     ActorStarvationError,
+    HealthMonitor,
     HeartbeatBoard,
     StallDetector,
+    get_health_monitor,
+)
+from stoix_tpu.observability.httpz import (  # noqa: F401
+    OpsServer,
+    StatusBoard,
+    get_status_board,
+    render_statusz,
+    server_from_config,
 )
 from stoix_tpu.observability.introspect import (  # noqa: F401
     DeviceTelemetryPoller,
@@ -69,6 +91,7 @@ from stoix_tpu.observability.trace_export import (  # noqa: F401
 
 _lock = threading.Lock()
 _poller: Optional[DeviceTelemetryPoller] = None
+_http_server: Optional[OpsServer] = None
 
 
 def get_logger(name: str = "stoix_tpu") -> logging.Logger:
@@ -96,16 +119,33 @@ def get_logger(name: str = "stoix_tpu") -> logging.Logger:
 def configure(telemetry_cfg: Any = None) -> bool:
     """Apply a `logger.telemetry` config block (a plain/Config dict or None).
     Returns whether telemetry is enabled. Idempotent: reconfiguring replaces
-    the poller; disabling stops it and turns span recording off. Output
-    paths are the TelemetrySink's concern (utils/logger.py wires them)."""
+    the poller (and the ops HTTP server); disabling stops them and turns
+    span recording off. Output paths are the TelemetrySink's concern
+    (utils/logger.py wires them).
+
+    This is also the per-run reset seam for the ops plane (docs/DESIGN.md
+    §2.13): every run start — supervised relaunch included — gets a fresh
+    HealthMonitor (no stale heartbeat boards from the previous incarnation
+    can trip an instant 503/stall verdict) and a fresh flight-recorder ring
+    (a crash dump covers THIS run's windows, not the last run's). Both are
+    host-memory resets: no device work, bit-identity untouched."""
     cfg = telemetry_cfg or {}
     enabled = bool(cfg.get("enabled", False))
-    global _poller
+    global _poller, _http_server
     with _lock:
         set_enabled(enabled)
         if _poller is not None:
             _poller.stop()
             _poller = None
+        if _http_server is not None:
+            _http_server.close()
+            _http_server = None
+        get_health_monitor().reset()
+        get_flight_recorder().clear()
+        # `logger.telemetry.http` is its own switch: the endpoints serve the
+        # registry/health state that exists regardless of whether span/file
+        # telemetry is on. Off by default = no socket, no thread.
+        _http_server = server_from_config(cfg.get("http"))
         if enabled:
             # Fresh span buffer per enabled run: without this, a second
             # telemetry run in the same process would export the previous
@@ -123,11 +163,24 @@ def configure(telemetry_cfg: Any = None) -> bool:
 
 
 def shutdown() -> None:
-    """Stop the poller and disable span recording (buffer/registry contents
-    are kept — the caller may still export them)."""
-    global _poller
+    """Stop the poller and the ops HTTP server, and disable span recording
+    (buffer/registry contents are kept — the caller may still export
+    them)."""
+    global _poller, _http_server
     with _lock:
         if _poller is not None:
             _poller.stop()
             _poller = None
+        if _http_server is not None:
+            _http_server.close()
+            _http_server = None
         set_enabled(False)
+
+
+def get_ops_server() -> Optional[OpsServer]:
+    """The live OpsServer started by configure(), or None when
+    `logger.telemetry.http.enabled` is off. Tests and the runner read the
+    ephemeral port (`get_ops_server().port`) from here; the runner also
+    attaches the fleet aggregator through it."""
+    with _lock:
+        return _http_server

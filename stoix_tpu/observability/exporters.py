@@ -20,10 +20,18 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import time
 from typing import Any, Dict, Optional
 
 from stoix_tpu.observability.registry import MetricsRegistry, get_registry
+
+# Prometheus exposition-format identifier grammar (text format 0.0.4):
+# metric names additionally allow ':' (recording-rule convention).
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_METRIC_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_]")
 
 
 def _fmt_value(v: float) -> str:
@@ -34,6 +42,45 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
+def sanitize_metric_name(name: str) -> str:
+    """Spec-valid metric name: invalid characters collapse to '_' (and a
+    leading digit gets a '_' prefix) rather than raising — an exporter must
+    render whatever the process registered, not crash the scrape."""
+    name = str(name)
+    if _METRIC_NAME_RE.match(name):
+        return name
+    name = _METRIC_BAD_CHARS.sub("_", name)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def sanitize_label_name(name: str) -> str:
+    name = str(name)
+    if _LABEL_NAME_RE.match(name):
+        return name
+    name = _LABEL_BAD_CHARS.sub("_", name)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _escape_label_value(value: str) -> str:
+    # Escaping order matters: backslash first, then quote and newline —
+    # the three characters the spec requires escaped in label values.
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    # HELP text escapes backslash and newline only (quotes are legal there).
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> str:
     merged = dict(labels)
     if extra:
@@ -41,7 +88,7 @@ def _fmt_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) 
     if not merged:
         return ""
     inner = ",".join(
-        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        '%s="%s"' % (sanitize_label_name(k), _escape_label_value(v))
         for k, v in sorted(merged.items())
     )
     return "{%s}" % inner
@@ -50,9 +97,12 @@ def _fmt_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) 
 def to_prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     registry = registry or get_registry()
     lines = []
-    for name, family in sorted(registry.snapshot().items()):
+    for raw_name, family in sorted(registry.snapshot().items()):
+        name = sanitize_metric_name(raw_name)
+        # HELP then TYPE, emitted exactly once per family — every labeled
+        # child series of the family renders below the single header pair.
         if family["help"]:
-            lines.append(f"# HELP {name} {family['help']}")
+            lines.append(f"# HELP {name} {_escape_help(family['help'])}")
         lines.append(f"# TYPE {name} {family['kind']}")
         for series in family["series"]:
             labels = series["labels"]

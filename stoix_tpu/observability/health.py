@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from stoix_tpu.observability.registry import MetricsRegistry, get_registry
 
@@ -65,6 +65,15 @@ class HeartbeatBoard:
         for component, age in self.ages().items():
             gauge.set(age, {"component": component})
 
+    def reset(self) -> None:
+        """Forget all last-beat timestamps. A supervised relaunch (or a
+        second run in the same process) must start from a board with NO
+        history: stale beats from the previous incarnation would otherwise
+        read as an instant stall verdict (docs/DESIGN.md §2.13)."""
+        with self._lock:
+            self._beats.clear()
+            self._counts.clear()
+
 
 def describe_age(age: Optional[float]) -> str:
     return "never beat" if age is None else f"last beat {age:.1f}s ago"
@@ -108,6 +117,110 @@ class StallDetector:
             return "all components beating within threshold"
         worst = max(stalled, key=lambda k: stalled[k])
         return f"{worst} stalled ({describe_age(stalled[worst])})"
+
+
+class HealthMonitor:
+    """Process-wide aggregation of liveness sources for `/healthz`
+    (docs/DESIGN.md §2.13): heartbeat boards (runner window loop, Sebulba
+    pipelines) judged through StallDetector thresholds, arbitrary check
+    callables (serve worker liveness), and the watchdog stage verdict (any
+    `stoix_tpu_watchdog_stalls_total` increment since the run started).
+
+    `reset()` is the supervised-relaunch seam: `observability.configure()`
+    calls it on every run start, so a fresh incarnation begins with no
+    boards, no checks, and a re-based watchdog counter — stale state from
+    the previous run can never trip an instant 503."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._registry = registry or get_registry()
+        self._lock = threading.Lock()
+        self._boards: Dict[str, Tuple[HeartbeatBoard, float]] = {}
+        self._checks: Dict[str, Callable[[], Optional[str]]] = {}
+        self._stall_base = self._watchdog_stalls()
+
+    def _watchdog_stalls(self) -> float:
+        counter = self._registry.counter(
+            "stoix_tpu_watchdog_stalls_total",
+            "Watchdog deadline expirations, by stage",
+        )
+        return float(sum(value for _, value in counter.labels_and_values()))
+
+    def register_board(
+        self, name: str, board: HeartbeatBoard, stale_after_s: float = 60.0
+    ) -> None:
+        with self._lock:
+            self._boards[name] = (board, float(stale_after_s))
+
+    def register_check(
+        self, name: str, check: Callable[[], Optional[str]]
+    ) -> None:
+        """`check()` returns None when healthy, else a one-line problem."""
+        with self._lock:
+            self._checks[name] = check
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._boards.pop(name, None)
+            self._checks.pop(name, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._boards.clear()
+            self._checks.clear()
+        self._stall_base = self._watchdog_stalls()
+
+    def verdict(self) -> Tuple[bool, str]:
+        """(healthy, one-page detail). Unhealthy when any registered board
+        has a component older than its threshold, any check reports a
+        problem, or a watchdog stage blew its deadline this run. A component
+        that never beat is NOT unhealthy — compile/warmup precedes the first
+        beat and must not read as a stall."""
+        with self._lock:
+            boards = dict(self._boards)
+            checks = dict(self._checks)
+        problems: List[str] = []
+        lines: List[str] = []
+        for name, (board, stale_after_s) in sorted(boards.items()):
+            detector = StallDetector(board, stale_after_s=stale_after_s)
+            ages = board.ages()
+            stalled = sorted(
+                component
+                for component, age in ages.items()
+                if age is not None and age > stale_after_s
+            )
+            if stalled:
+                problems.append(f"{name}: {detector.diagnose()}")
+            summary = ", ".join(
+                f"{component}={describe_age(age)}"
+                for component, age in sorted(ages.items())
+            )
+            lines.append(f"{name}: {summary or 'no beats yet'}")
+        for name, check in sorted(checks.items()):
+            problem = check()
+            if problem is not None:
+                problems.append(f"{name}: {problem}")
+            lines.append(f"{name}: {problem or 'ok'}")
+        stalls = self._watchdog_stalls() - self._stall_base
+        if stalls > 0:
+            problems.append(
+                f"watchdog: {int(stalls)} stage deadline(s) blown this run"
+            )
+        if problems:
+            return False, "\n".join(problems)
+        return True, "ok\n" + "\n".join(lines) if lines else "ok"
+
+
+_monitor_lock = threading.Lock()
+_monitor: Optional[HealthMonitor] = None
+
+
+def get_health_monitor() -> HealthMonitor:
+    """Process-wide monitor serving `/healthz` (httpz.py)."""
+    global _monitor
+    with _monitor_lock:
+        if _monitor is None:
+            _monitor = HealthMonitor()
+        return _monitor
 
 
 class ActorStarvationError(RuntimeError):

@@ -83,7 +83,7 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from stoix_tpu.observability import get_logger, get_registry
+from stoix_tpu.observability import flightrec, get_logger, get_registry, goodput
 from stoix_tpu.resilience.errors import InjectedFault
 from stoix_tpu.resilience.exit_codes import EXIT_CODE_FAILURE
 
@@ -233,11 +233,20 @@ def maybe_stall_queue(
     get_logger("stoix_tpu.resilience").warning(
         "[faultinject] actor-%d wedged at rollout %d", actor_id, rollout_idx
     )
+    flightrec.get_flight_recorder().record(
+        "fault", fault="queue_stall", actor=actor_id, rollout=rollout_idx
+    )
+    wedge_started = time.monotonic()
     deadline = time.monotonic() + max_stall_s
-    while time.monotonic() < deadline:
-        if should_abort is not None and should_abort():
-            return
-        time.sleep(0.05)
+    try:
+        while time.monotonic() < deadline:
+            if should_abort is not None and should_abort():
+                return
+            time.sleep(0.05)
+    finally:
+        # However the wedge ends (deadline or shutdown abort), the seconds
+        # actually spent wedged are stall badput, not queue_wait.
+        goodput.note_stall(time.monotonic() - wedge_started)
 
 
 def maybe_sigterm(window_idx: int) -> None:
@@ -313,7 +322,13 @@ def maybe_host_stall(window_idx: int) -> None:
     get_logger("stoix_tpu.resilience").warning(
         "[faultinject] host stalling %ds at window %d", secs, window_idx
     )
+    flightrec.get_flight_recorder().record(
+        "fault", fault="host_stall", window=window_idx, seconds=float(secs)
+    )
     time.sleep(secs)
+    # The sleep is pure badput: charge it to the active run's goodput ledger
+    # as stall so it cannot masquerade as compute residual.
+    goodput.note_stall(float(secs))
 
 
 def maybe_barrier_wedge(barrier: str, max_wedge_s: float = 3600.0) -> None:

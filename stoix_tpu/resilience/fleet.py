@@ -66,7 +66,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from stoix_tpu.observability import get_logger, get_registry
+from stoix_tpu.observability import flightrec, get_logger, get_registry
 from stoix_tpu.resilience import faultinject
 from stoix_tpu.resilience.errors import (
     FleetBarrierTimeout,
@@ -623,6 +623,10 @@ class FleetCoordinator:
                 "[fleet] %s: %s",
                 type(self._partition_error).__name__, self._partition_error,
             )
+            flightrec.get_flight_recorder().record(
+                "fleet_partition", missing=list(missing), deadline_s=float(deadline_s),
+                detail=detail,
+            )
         return self._partition_error
 
     def _on_partition(self, stale: List[int]) -> None:
@@ -649,11 +653,25 @@ class FleetCoordinator:
 
             _thread.interrupt_main()
 
+    def _dump_flight_record(self, reason: str) -> None:
+        """rc-87 flight record, next to the emergency rescue artifacts. Only
+        the paths where the PROCESS actually dies with the fleet code dump
+        (excepthook and hard exit) — a declared-but-handled partition in a
+        unit test must not litter files (docs/DESIGN.md §2.13)."""
+        flightrec.dump_flight_record(
+            self.settings.emergency_dir,
+            reason=reason,
+            exit_code=EXIT_CODE_FLEET_PARTITION,
+        )
+
     def _hard_exit(self) -> None:
         self._log.error(
             "[fleet] main thread still wedged %.0fs after the partition was "
             "declared (dead collective is uninterruptible) — hard exit %d",
             self.settings.exit_grace_s, EXIT_CODE_FLEET_PARTITION,
+        )
+        self._dump_flight_record(
+            f"fleet partition hard exit: {self._partition_error}"
         )
         sys.stderr.flush()
         os._exit(EXIT_CODE_FLEET_PARTITION)
@@ -678,6 +696,7 @@ class FleetCoordinator:
         def hook(exc_type, exc, tb):
             prev(exc_type, exc, tb)
             if isinstance(exc, FleetError):
+                self._dump_flight_record(f"fleet partition: {exc}")
                 sys.stderr.flush()
                 os._exit(EXIT_CODE_FLEET_PARTITION)
 

@@ -60,7 +60,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tu
 
 import numpy as np
 
-from stoix_tpu.observability import get_logger, get_registry
+from stoix_tpu.observability import flightrec, get_logger, get_registry
 from stoix_tpu.resilience.errors import StateCorruptionError
 
 # Exit code of the corruption path: distinct from the watchdog's 86 and the
@@ -420,6 +420,18 @@ class StateIntegritySentinel:
                 "[integrity] could not write quarantine record to %s: %s",
                 path, exc,
             )
+        # rc-88 flight record, next to the quarantine file (dumped even when
+        # the quarantine write itself failed — the ring is all evidence then).
+        recorder = flightrec.get_flight_recorder()
+        recorder.record(
+            "quarantine", corruption=err.kind, window=err.window, step=err.step,
+            processes=list(err.processes), devices=list(err.devices),
+        )
+        flightrec.dump_flight_record(
+            os.path.dirname(os.path.abspath(path)),
+            reason=f"state corruption: {err.kind} at window {err.window}",
+            exit_code=EXIT_CODE_STATE_CORRUPTION,
+        )
 
     # -- fingerprints ---------------------------------------------------------
     @property

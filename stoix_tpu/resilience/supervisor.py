@@ -31,7 +31,13 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from stoix_tpu.observability import HeartbeatBoard, get_logger, get_registry
+from stoix_tpu.observability import (
+    HeartbeatBoard,
+    flightrec,
+    get_logger,
+    get_registry,
+    goodput,
+)
 from stoix_tpu.resilience.errors import ComponentFailure
 
 ThreadFactory = Callable[[], threading.Thread]
@@ -128,6 +134,10 @@ class ActorSupervisor:
             )
             return
         delay = min(self.backoff_base_s * (2.0 ** attempt), self.backoff_max_s)
+        flightrec.get_flight_recorder().record(
+            "actor_crash", actor=actor_id, error=f"{type(exc).__name__}: {exc}",
+            attempt=attempt + 1, backoff_s=delay,
+        )
         self._log.warning(
             "[supervisor] actor-%d crashed (%s: %s) — restarting in %.2fs "
             "(attempt %d/%d)",
@@ -187,6 +197,9 @@ class ActorSupervisor:
             self._threads[actor_id] = thread
             self._spawned_at[actor_id] = time.monotonic()
         thread.start()
+        # The backoff+respawn wall time is recovery in the goodput ledger:
+        # the fleet was degraded (one actor down) for exactly this span.
+        goodput.note_recovery(delay)
         self._restart_counter.inc(labels={"actor": str(actor_id)})
         self._log.warning(
             "[supervisor] actor-%d restarted (fresh env instance, re-primed params)",
@@ -195,6 +208,9 @@ class ActorSupervisor:
 
     def _propagate(self, actor_id: int, failure: ComponentFailure) -> None:
         self._failure_counter.inc(labels={"component": failure.component})
+        flightrec.get_flight_recorder().record(
+            "component_failure", component=failure.component, detail=str(failure)
+        )
         self._log.error("[supervisor] %s", failure)
         # Learner side: poison the rollout hand-off so collect_rollouts
         # raises instead of burning its timeout.

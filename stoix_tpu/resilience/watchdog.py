@@ -37,7 +37,7 @@ import time
 import traceback
 from typing import Callable, Optional
 
-from stoix_tpu.observability import HeartbeatBoard, get_logger, get_registry
+from stoix_tpu.observability import HeartbeatBoard, flightrec, get_logger, get_registry
 from stoix_tpu.resilience.errors import CompileStallError
 
 # Exit code for the hard-exit path: distinct from Python's 1 and SIGKILL's
@@ -156,6 +156,9 @@ class Watchdog:
             "stoix_tpu_watchdog_stalls_total",
             "Watchdog deadlines blown, by stage",
         ).inc(labels={"stage": self.stage})
+        flightrec.get_flight_recorder().record(
+            "watchdog_stall", stage=self.stage, deadline_s=self.deadline_s
+        )
         if self.hard_exit_grace_s > 0:
             self._hard_timer = threading.Timer(self.hard_exit_grace_s, self._hard_exit)
             self._hard_timer.daemon = True
@@ -171,6 +174,13 @@ class Watchdog:
             "[watchdog] main thread still wedged %.0fs after the '%s' stall "
             "dump (native call uninterruptible) — hard exit %d",
             self.hard_exit_grace_s, self.stage, self._exit_code,
+        )
+        # The rc-86 flight record: dumped from the watchdog thread because
+        # os._exit skips atexit/finally — this is the last Python that runs.
+        flightrec.dump_flight_record(
+            None,
+            reason=f"watchdog stall in stage '{self.stage}'",
+            exit_code=self._exit_code,
         )
         # Flush what we can: logging handlers buffer, and this process is done.
         sys.stderr.flush()

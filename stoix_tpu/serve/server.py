@@ -25,7 +25,12 @@ from typing import Any, Dict, List, NamedTuple, Optional
 import jax
 import numpy as np
 
-from stoix_tpu.observability import get_logger, span
+from stoix_tpu.observability import (
+    get_health_monitor,
+    get_logger,
+    get_status_board,
+    span,
+)
 from stoix_tpu.parallel import MeshRoles
 from stoix_tpu.serve import checkpoint as serve_checkpoint
 from stoix_tpu.serve.batcher import DEFAULT_BUCKETS, DynamicBatcher, PendingRequest
@@ -144,10 +149,22 @@ class PolicyServer:
         self._worker.start()
         if self.watcher is not None:
             self.watcher.start()
+        # Ops plane (docs/DESIGN.md §2.13): /statusz renders the SLO ladder
+        # live (the provider is called at render time, not snapshotted here)
+        # and /healthz turns 503 if the batch worker thread dies.
+        get_status_board().register_provider(
+            "serve_slo", self.telemetry.slo_snapshot
+        )
+        get_health_monitor().register_check(
+            "serve-worker",
+            lambda: None if self._worker.is_alive() else "serve worker thread dead",
+        )
         self._started = True
         return self
 
     def close(self, join_timeout: float = 10.0) -> None:
+        get_status_board().unregister_provider("serve_slo")
+        get_health_monitor().unregister("serve-worker")
         if self.watcher is not None:
             self.watcher.stop()
         self._stop.set()

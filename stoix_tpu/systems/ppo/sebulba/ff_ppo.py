@@ -40,7 +40,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from stoix_tpu.base_types import ActorCriticOptStates, ActorCriticParams, PPOTransition
 from stoix_tpu.envs.factory import make_factory
 from stoix_tpu.evaluator import get_distribution_act_fn, get_ff_evaluator_fn
-from stoix_tpu.observability import RunStats, annotate, get_logger, get_registry, span
+from stoix_tpu.observability import (
+    RunStats,
+    annotate,
+    flightrec,
+    get_health_monitor,
+    get_logger,
+    get_registry,
+    get_status_board,
+    goodput,
+    span,
+)
 from stoix_tpu.ops import (
     losses,
     running_statistics,
@@ -843,6 +853,28 @@ def run_experiment(
         )
 
     logger = StoixLogger(config)
+    # Ops plane (docs/DESIGN.md §2.13): StoixLogger's configure() just reset
+    # the health monitor and flight recorder — and started the ops HTTP
+    # server if `logger.telemetry.http.enabled` — so register THIS run's
+    # identity, goodput ledger, and heartbeat board on the fresh instances.
+    http_cfg = dict(dict(config.logger.get("telemetry") or {}).get("http") or {})
+    ledger = goodput.GoodputLedger().start()
+    goodput.set_active(ledger)
+    recorder = flightrec.get_flight_recorder()
+    recorder.set_context(
+        architecture="sebulba",
+        system=str(config.system.system_name),
+        seed=int(config.arch.seed),
+    )
+    status = get_status_board()
+    status.update(
+        {
+            "run_id": f"{config.system.system_name}_seed{config.arch.seed}",
+            "architecture": "sebulba",
+            "system": str(config.system.system_name),
+            "step": 0,
+        }
+    )
     lifetime = ThreadLifetime()
     # Fleet coordination (docs/DESIGN.md §2.6, arch.fleet): in a multi-host
     # Sebulba deployment the learner loop exchanges window-indexed stop votes
@@ -860,7 +892,14 @@ def run_experiment(
         pipeline = OffPolicyPipeline(num_actors, fleet=fleet_coord)
     # One heartbeat board for the whole run: actor beats come from the
     # pipeline, param-server and evaluator beats land on the same board so
-    # the stall detector sees every component's age.
+    # the stall detector sees every component's age — and /healthz reads the
+    # same board through the process-wide health monitor.
+    monitor = get_health_monitor()
+    monitor.register_board(
+        "sebulba-pipeline",
+        pipeline.heartbeats,
+        stale_after_s=float(http_cfg.get("stale_after_s", 60.0) or 60.0),
+    )
     param_server = ParameterServer(
         actor_devices, actors_per_device, heartbeats=pipeline.heartbeats
     )
@@ -985,13 +1024,24 @@ def run_experiment(
             if impact_ingest is None:
                 with timer.time("rollout_get"):
                     payloads = pipeline.collect_rollouts()
+                ledger.note(
+                    goodput.SEBULBA_PHASE_MAP["rollout_get"],
+                    timer.latest("rollout_get"),
+                )
                 with span("learner_assemble", update=update_idx), timer.time("assemble"):
                     batch = _assemble_batch(payloads)
+                ledger.note(
+                    goodput.SEBULBA_PHASE_MAP["assemble"], timer.latest("assemble")
+                )
             else:
                 with span("impact_next_batch", update=update_idx), timer.time("rollout_get"):
                     got = impact_ingest.next_batch(
                         _assemble_batch, param_server.version
                     )
+                ledger.note(
+                    goodput.SEBULBA_PHASE_MAP["rollout_get"],
+                    timer.latest("rollout_get"),
+                )
                 batch, fresh = got.batch, got.fresh
                 # First-class staleness: the learner's current version (=
                 # completed distributes, i.e. the params it just trained)
@@ -1014,6 +1064,7 @@ def run_experiment(
                         learner_state, target_params, batch
                     )
                 jax.block_until_ready(train_metrics)
+            ledger.note(goodput.SEBULBA_PHASE_MAP["learn"], timer.latest("learn"))
             param_server.distribute_params(
                 (learner_state.params, learner_state.obs_stats)
             )
@@ -1088,6 +1139,13 @@ def run_experiment(
                     steady_start_time = time.perf_counter()
                     steady_start_steps = t_steps
                 window_idx = (update_idx + 1) // int(config.arch.num_updates_per_eval)
+                status.update({"window": window_idx, "step": t_steps})
+                recorder.record(
+                    "window", window=window_idx, step=t_steps,
+                    updates=update_idx + 1,
+                    queue_wait_s=round(timer.mean("rollout_get"), 6),
+                    learn_s=round(timer.mean("learn"), 6),
+                )
                 corruption = None
                 if sentinel is not None:
                     # Integrity check at the eval boundary (docs/DESIGN.md
@@ -1143,6 +1201,8 @@ def run_experiment(
         raise
     finally:
         preempt.uninstall()
+        goodput.set_active(None)
+        monitor.unregister("sebulba-pipeline")
         if sentinel is not None:
             # BEFORE fleet stop: the excepthook chain unwinds in reverse
             # install order. Keeps the hook across a propagating corruption
@@ -1201,6 +1261,11 @@ def run_experiment(
         ).set(fps)
         LAST_RUN_STATS["fps"] = fps
         LAST_RUN_STATS["total_env_steps"] = t_steps
+    # Goodput close-out (docs/DESIGN.md §2.13): queue_wait/compute were noted
+    # per update; finalize() attributes the residual learner-loop wall (host
+    # work concurrent with actor rollouts, teardown joins) to compute per the
+    # pipelined-residual rule, so the fractions sum to 1.
+    LAST_RUN_STATS["goodput"] = ledger.finalize()
     # None when disabled (the pin tests/test_impact.py asserts): the default
     # config must report the untouched on-policy path, not a zeroed dict.
     LAST_RUN_STATS["impact"] = None if impact is None else {

@@ -43,7 +43,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from stoix_tpu.base_types import OnlineAndTarget, Transition
 from stoix_tpu.envs.factory import make_factory
 from stoix_tpu.evaluator import get_distribution_act_fn, get_ff_evaluator_fn
-from stoix_tpu.observability import RunStats, get_logger, get_registry, span
+from stoix_tpu.observability import (
+    RunStats,
+    flightrec,
+    get_health_monitor,
+    get_logger,
+    get_registry,
+    get_status_board,
+    goodput,
+    span,
+)
 from stoix_tpu.parallel import MeshRoles, assemble_global_array
 from stoix_tpu.parallel.mesh import shard_map
 from stoix_tpu.replay import ShardedReplayService, service_from_config
@@ -426,8 +435,34 @@ def run_experiment(config: Any) -> float:
         )
 
     logger = StoixLogger(config)
+    # Ops plane (docs/DESIGN.md §2.13): register this run's identity, goodput
+    # ledger, and heartbeat board on the instances configure() just reset.
+    http_cfg = dict(dict(config.logger.get("telemetry") or {}).get("http") or {})
+    ledger = goodput.GoodputLedger().start()
+    goodput.set_active(ledger)
+    recorder = flightrec.get_flight_recorder()
+    recorder.set_context(
+        architecture="sebulba",
+        system=str(config.system.system_name),
+        seed=int(config.arch.seed),
+    )
+    status = get_status_board()
+    status.update(
+        {
+            "run_id": f"{config.system.system_name}_seed{config.arch.seed}",
+            "architecture": "sebulba",
+            "system": str(config.system.system_name),
+            "step": 0,
+        }
+    )
     lifetime = ThreadLifetime()
     pipeline = OffPolicyPipeline(num_actors)
+    monitor = get_health_monitor()
+    monitor.register_board(
+        "sebulba-pipeline",
+        pipeline.heartbeats,
+        stale_after_s=float(http_cfg.get("stale_after_s", 60.0) or 60.0),
+    )
     param_server = ParameterServer(
         actor_devices, actors_per_device, heartbeats=pipeline.heartbeats
     )
@@ -535,6 +570,9 @@ def run_experiment(config: Any) -> float:
                     # dead actor fleet raises typed starvation here).
                     _ingest(pipeline.wait_for_data(timeout=180.0))
                 replay_warmed = True
+            ledger.note(
+                goodput.SEBULBA_PHASE_MAP["ingest"], timer.latest("ingest")
+            )
             with span("learner_update", update=update_idx), timer.time("learn"):
                 learner_state, new_replay, train_metrics = learn_step(
                     learner_state, service.state
@@ -542,6 +580,7 @@ def run_experiment(config: Any) -> float:
                 service.commit(new_replay)
                 service.note_embedded_samples(int(config.system.epochs))
                 jax.block_until_ready(train_metrics)
+            ledger.note(goodput.SEBULBA_PHASE_MAP["learn"], timer.latest("learn"))
             if (update_idx + 1) % param_sync == 0:
                 param_server.distribute_params(learner_state.params.online)
             t_steps = ingested_items()
@@ -576,12 +615,22 @@ def run_experiment(config: Any) -> float:
                     evaluator_device,
                 )
                 async_evaluator.submit(eval_params, ek, t_steps)
+                window_idx = (update_idx + 1) // int(config.arch.num_updates_per_eval)
+                status.update({"window": window_idx, "step": t_steps})
+                recorder.record(
+                    "window", window=window_idx, step=t_steps,
+                    updates=update_idx + 1,
+                    queue_wait_s=round(timer.mean("ingest"), 6),
+                    learn_s=round(timer.mean("learn"), 6),
+                )
                 if steady_start_time is None:
                     steady_start_time = time.perf_counter()
                     steady_start_items = ingested_items()
         steady_end_time = time.perf_counter()
     finally:
         preempt.uninstall()
+        goodput.set_active(None)
+        monitor.unregister("sebulba-pipeline")
         lifetime.stop()
         param_server.shutdown()
         for _ in range(2):
@@ -621,6 +670,7 @@ def run_experiment(config: Any) -> float:
     LAST_RUN_STATS["replay"] = {
         k: replay_stats[k] - replay_base[k] for k in replay_stats
     }
+    LAST_RUN_STATS["goodput"] = ledger.finalize()
     LAST_RUN_STATS["resilience"] = {
         "update_guard": guard_mode,
         "skipped_updates": guards.skipped_counter().value() - skipped_base,

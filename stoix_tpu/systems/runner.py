@@ -108,12 +108,19 @@ import jax.numpy as jnp
 from stoix_tpu import envs
 from stoix_tpu.evaluator import evaluator_setup, get_rnn_evaluator_fn
 from stoix_tpu.observability import (
+    HeartbeatBoard,
     RunStats,
     device_annotation,
+    flightrec,
+    get_health_monitor,
     get_logger,
+    get_ops_server,
     get_registry,
+    get_status_board,
+    goodput,
     span,
 )
+from stoix_tpu.observability import aggregate as fleet_metrics
 from stoix_tpu.parallel import (
     MeshRoles,
     fetch_global,
@@ -240,6 +247,12 @@ def run_anakin_experiment(
     # divergence-guard mode for the host-side checks below.
     faultinject.configure(config.arch.get("fault_spec"))
     guard_mode = guards.resolve_mode(config)
+    # Goodput ledger (docs/DESIGN.md §2.13): opened before any setup work so
+    # restore/compile/stall seconds are all inside the attributed wall. Pure
+    # host arithmetic — always on, bit-identity untouched. set_active lets
+    # out-of-loop sites (faultinject stalls, watchdog) charge their seconds.
+    ledger = goodput.GoodputLedger().start()
+    goodput.set_active(ledger)
     # Compile economy (docs/DESIGN.md §2.7): the persistent-cache knobs must
     # land before the FIRST compile this process does (network init included),
     # and the multistep scan-kernel default before the learner is traced —
@@ -304,6 +317,8 @@ def run_anakin_experiment(
     ckpt_cfg = config.logger.checkpointing
     start_step = 0
     restore_skipped = 0
+    restore_report: list = []
+    t_restore = time.perf_counter()
     if ckpt_cfg.get("load_model", False):
         load_args = ckpt_cfg.get("load_args") or {}
         load_path = load_args.get("load_path")
@@ -331,6 +346,10 @@ def run_anakin_experiment(
             # rejected (with typed reasons — structure / non_finite /
             # digest), surfaced in LAST_RUN_STATS.resilience below.
             restore_skipped = len(loader.last_restore_report)
+            restore_report = list(loader.last_restore_report)
+        # Restore wall time is recovery, not compute: a relaunch spending
+        # minutes re-reading checkpoints must show up in the badput ledger.
+        ledger.note("recovery", time.perf_counter() - t_restore)
         if is_coordinator():
             get_logger("stoix_tpu.checkpoint").info(
                 "[checkpoint] restored state from step %d%s", start_step,
@@ -342,6 +361,58 @@ def run_anakin_experiment(
     evaluator, absolute_evaluator = make_evaluators(eval_env, setup.eval_act_fn, config, mesh)
     logger = StoixLogger(config)
     checkpointer = checkpointer_from_config(config, config.system.system_name)
+
+    # Ops plane (docs/DESIGN.md §2.13), wired AFTER StoixLogger: its
+    # observability.configure() call is the per-run reset (fresh
+    # HealthMonitor + flight-recorder ring) and starts the /metrics·/healthz
+    # ·/statusz·/varz server when logger.telemetry.http.enabled. Everything
+    # below is host-memory bookkeeping — always on, bit-identity untouched.
+    telemetry_cfg = dict(config.logger.get("telemetry") or {})
+    http_cfg = dict(telemetry_cfg.get("http") or {})
+    recorder = flightrec.get_flight_recorder()
+    recorder.set_context(
+        architecture="anakin",
+        system=str(config.system.system_name),
+        seed=int(config.arch.seed),
+    )
+    status = get_status_board()
+    status.update(
+        {
+            "run_id": f"{config.system.system_name}_seed{int(config.arch.seed)}",
+            "architecture": "anakin",
+            "system": str(config.system.system_name),
+            "step": start_step,
+            "restore_skipped": restore_skipped,
+            "last_restore_report": restore_report,
+            "quarantine_file": dict(config.arch.get("integrity") or {}).get(
+                "quarantine_file", "checkpoints/quarantine.json"
+            ),
+        }
+    )
+    # /healthz source: the host loop beats once per window; an injected
+    # host_stall (or a genuinely wedged loop) lets the age cross
+    # stale_after_s and the endpoint flips to 503. Registered fresh each run
+    # — configure() above already dropped any previous incarnation's board.
+    monitor = get_health_monitor()
+    loop_beats = HeartbeatBoard()
+    monitor.register_board(
+        "anakin-host-loop",
+        loop_beats,
+        stale_after_s=float(http_cfg.get("stale_after_s", 60.0) or 60.0),
+    )
+    ops_server = get_ops_server()
+    aggregator = None
+    if ops_server is not None and fleet_coord is not None:
+        # Host-level metric federation over the fleet KV store: publish this
+        # host's snapshots off the hot path; /metrics/fleet folds every
+        # host's newest blob with per-host labels (aggregate.py).
+        aggregator = fleet_metrics.aggregator_from_fleet(
+            fleet_coord,
+            interval_s=float(http_cfg.get("aggregate_interval_s", 10.0) or 10.0),
+        )
+        if aggregator is not None:
+            aggregator.start()
+            ops_server.set_aggregator(aggregator)
 
     if sentinel is not None:
         # Bind AFTER restore: the fingerprint program is built once for this
@@ -602,6 +673,14 @@ def run_anakin_experiment(
             integrity_payload = fetched.pop("integrity")
             corruption = sentinel.verify(integrity_payload, window.eval_idx, window.t)
             if corruption is not None:
+                # Last ring entry before the rc-88 path unwinds: the dumped
+                # flight record ends with the verdict itself.
+                recorder.record(
+                    "integrity_verdict",
+                    window=window.eval_idx,
+                    step=window.t,
+                    detail=str(corruption),
+                )
                 if fleet_coord is not None:
                     fleet_coord.request_stop(fleet.FLAG_CORRUPT, note=str(corruption))
                 raise corruption
@@ -636,6 +715,24 @@ def run_anakin_experiment(
             "stoix_tpu_runner_steps_per_second",
             "Env-steps/sec over the most recent eval window",
         ).set(sps)
+        # Ops plane: /statusz freshness + one flight-recorder ring entry per
+        # completed window (the last N of these are what an rc-86/87/88 dump
+        # hands the post-mortem).
+        status.update(
+            {"window": window.eval_idx, "step": window.t,
+             "steps_per_second": round(sps, 3)}
+        )
+        recorder.record(
+            "window",
+            window=window.eval_idx,
+            step=window.t,
+            wall_s=round(wall, 6),
+            steps_per_second=round(sps, 3),
+            phases={k: round(v, 6) for k, v in phases.breakdown().items()},
+            fleet=fleet_coord is not None,
+            fleet_stop=agreed_stop.describe() if agreed_stop is not None else None,
+            integrity=sentinel is not None,
+        )
         if is_coordinator():
             with span("log", window=window.eval_idx):
                 logger.log(
@@ -695,6 +792,10 @@ def run_anakin_experiment(
         sentinel.capture_probe_input(_tree_copy(learner_state))
     try:
         for eval_idx in range(num_evaluation):
+            # One beat per window top: an injected host_stall (next line) or
+            # a wedged dispatch stops the beats and /healthz goes 503 once
+            # the age crosses the stale threshold.
+            loop_beats.beat("window")
             faultinject.maybe_host_stall(eval_idx)
             # Chaos: `bitflip:N` rebuilds the replicated state with ONE
             # mantissa bit flipped in one device's copy going INTO window N
@@ -837,6 +938,12 @@ def run_anakin_experiment(
         raise
     finally:
         preempt.uninstall()
+        goodput.set_active(None)
+        monitor.unregister("anakin-host-loop")
+        if aggregator is not None:
+            aggregator.close()
+            if ops_server is not None:
+                ops_server.set_aggregator(None)
         if sentinel is not None:
             # BEFORE fleet stop, so the excepthook chain unwinds in reverse
             # install order. Restores the hook UNLESS a corruption verdict
@@ -860,10 +967,17 @@ def run_anakin_experiment(
         "stoix_tpu_runner_steady_state_sps",
         "Post-first-window env-steps/sec of the most recent Anakin run",
     ).set(steady)
+    # Close the goodput books: attribute this run's phase-clock deltas, then
+    # assign the residual wall (host idle while the device computes, in the
+    # pipelined loop) to compute. Fractions sum to 1 by construction
+    # (tests/test_opsplane.py pins it on a real pipelined run).
+    ledger.note_phases(phases.breakdown())
+    goodput_report = ledger.finalize()
     LAST_RUN_STATS.clear()
     LAST_RUN_STATS.update(
         {
             "phase_breakdown": {k: round(v, 6) for k, v in phases.breakdown().items()},
+            "goodput": goodput_report,
             "steady_state_sps": steady,
             "pipelined": pipelined,
             "fused_eval": fused,

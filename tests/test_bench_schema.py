@@ -17,6 +17,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 PHASE_KEYS = ("compile_s", "learn_s", "eval_s", "fetch_s", "ckpt_s")
 
+GOODPUT_KEYS = ("wall_s", "fraction", "stall_s", "recovery_s", "fractions")
+GOODPUT_PHASES = {
+    "compute", "eval", "checkpoint", "fetch_wait", "queue_wait",
+    "gossip", "compile", "stall", "recovery",
+}
+
+
+def _assert_goodput_shape(payload, live: bool):
+    """Goodput ledger fields (docs/DESIGN.md §2.13): first-class on every
+    payload. Training probes report a live ledger whose fractions sum to 1;
+    workloads that never run a ledger report the zeroed shape — the same
+    keys either way, never a missing one."""
+    goodput = payload["goodput"]
+    assert set(goodput) == set(GOODPUT_KEYS), goodput
+    assert set(goodput["fractions"]) == GOODPUT_PHASES, goodput
+    assert goodput["stall_s"] >= 0.0 and goodput["recovery_s"] >= 0.0
+    if live:
+        assert goodput["wall_s"] > 0.0, goodput
+        assert 0.0 <= goodput["fraction"] <= 1.0, goodput
+        assert abs(sum(goodput["fractions"].values()) - 1.0) < 1e-6, goodput
+    else:
+        assert goodput["wall_s"] == 0.0 and goodput["fraction"] == 0.0
+        assert all(v == 0.0 for v in goodput["fractions"].values()), goodput
+
 
 def test_bench_smoke_payload_schema():
     proc = subprocess.run(
@@ -94,6 +118,11 @@ def test_bench_smoke_payload_schema():
     assert payload["fallback"] is False, payload
     assert payload["fallback_reason"] is None, payload
     assert payload["probe_attempts"] == 0, payload
+
+    # Goodput ledger of the probe run (docs/DESIGN.md §2.13): the fractions
+    # partition the probe's wall clock, and an AOT compile really happened.
+    _assert_goodput_shape(payload, live=True)
+    assert payload["goodput"]["fractions"]["compile"] > 0.0, payload["goodput"]
 
 
 def _load_bench_module():
@@ -174,6 +203,8 @@ def test_bench_serve_payload_schema():
     # Launch-hardening posture fields are universal across workloads.
     assert payload["fallback"] is False
     assert payload["fallback_reason"] is None
+    # Serving never opens a training ledger: zeroed shape, never missing.
+    _assert_goodput_shape(payload, live=False)
 
 
 @pytest.mark.slow
@@ -220,6 +251,8 @@ def test_bench_sebulba_payload_schema():
     assert fps["min"] <= fps["median"] <= fps["max"]
     assert fps["rel_spread"] >= 0.0
     assert fps["value"] <= payload["value"], (fps, payload["value"])
+    # The Sebulba learner loop runs a live ledger (queue_wait vs compute).
+    _assert_goodput_shape(payload, live=True)
 
 
 @pytest.mark.slow
@@ -409,3 +442,5 @@ def test_bench_replay_payload_schema():
     assert payload["fallback_reason"] is None
     integrity = payload["integrity"]
     assert integrity["enabled"] is False
+    # The replay microbench drives the service directly — no run ledger.
+    _assert_goodput_shape(payload, live=False)
