@@ -54,6 +54,17 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
               the slowest slice. On one host the stall taxes every group
               equally, so the single-host ratio is a harness check; the
               field earns its keep on real multi-slice meshes
+  --elastic   the elastic-relaunch recovery frontier (docs/DESIGN.md §2.14):
+              drive fault-injected shrink->grow resize cycles through
+              `launcher.run_supervised --elastic` semantics (scripts/soak.py
+              legs on the forced-CPU backend) and report the emergency-
+              restore recovery wall per relaunch. The payload carries
+              direction=lower_is_better (the --check gate inverts its
+              comparison), recovery_wall_s dispersion over the relaunch reps
+              (reps/median/min/max/rel_spread), and cycles_survived — how
+              many full cycles upheld the §2.14 contract (consumed request,
+              schema-valid flight record, digest-identical survivors,
+              recovery-phase attribution)
   --integrity arm the state-integrity sentinel (arch.integrity, docs/
               DESIGN.md §2.9) in the Anakin probe run so the payload's
               first-class `integrity` fields (enabled / fingerprint_checks /
@@ -475,6 +486,7 @@ def main() -> None:
     replay = "--replay" in sys.argv  # sharded replay service microbench
     population = "--population" in sys.argv  # P agents as one jitted program
     gossip = "--gossip" in sys.argv  # grouped learners + gossip averaging
+    elastic = "--elastic" in sys.argv  # fault-injected resize recovery wall
     # Arm the state-integrity sentinel in the Anakin probe run so the payload's
     # integrity fields carry a MEASURED per-window fingerprint overhead
     # (docs/DESIGN.md §2.9) instead of the disabled zeros.
@@ -511,8 +523,13 @@ def main() -> None:
         # itself refuses the combination — docs/DESIGN.md §2.12).
         sys.exit("--integrity does not compose with --gossip "
                  "(groups diverge between gossip rounds by design)")
-    if run_all and (large or cartpole or sebulba or pixel or serve or replay
+    if elastic and (large or cartpole or sebulba or pixel or serve or replay
                     or population or gossip):
+        sys.exit("--elastic is its own (recovery-shaped) workload; it does not compose")
+    if elastic and integrity_on:
+        sys.exit("--integrity arms the TRAINING sentinel; it does not compose with --elastic")
+    if run_all and (large or cartpole or sebulba or pixel or serve or replay
+                    or population or gossip or elastic):
         sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
     env_tag = "cartpole" if cartpole else "ant"
@@ -530,6 +547,8 @@ def main() -> None:
         metric = "population_ppo_identity_game_env_steps_per_sec"
     elif gossip:
         metric = "gossip_ppo_identity_game_env_steps_per_sec"
+    elif elastic:
+        metric = "elastic_recovery_wall_s"
     else:
         metric = f"anakin_ppo_{env_tag}_env_steps_per_sec" + ("_large_bf16" if large else "")
 
@@ -789,6 +808,10 @@ def main() -> None:
 
     if gossip:
         _finish(_run_gossip(smoke, n_devices, reps=reps))
+        return
+
+    if elastic:
+        _finish([_run_elastic(metric, smoke, reps=reps)])
         return
 
     if sebulba:
@@ -1301,6 +1324,107 @@ def _run_serve(metric, smoke, n_devices, reps=None) -> dict:
         }
     finally:
         os.chdir(cwd)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run_elastic(metric, smoke, reps=None) -> dict:
+    """Recovery-shaped workload (docs/DESIGN.md §2.14): fault-injected
+    shrink->grow resize cycles through the elastic supervision path
+    (scripts/soak.py legs, forced-CPU children — the resize REQUIRES fresh
+    processes, so the backend this parent probed is irrelevant to the
+    measurement). The headline is the emergency-restore recovery wall per
+    elastic relaunch — the seconds a resized incarnation spends re-reading
+    and re-placing the rescue snapshot, exactly what the goodput ledger's
+    recovery phase charges — with direction=lower_is_better so the --check
+    gate compares it the right way up. cycles_survived counts cycles that
+    upheld the full §2.14 contract, making a fast-but-broken relaunch
+    (consumed nothing, restored nothing) impossible to publish as a win."""
+    import importlib.util
+    import os
+    import shutil
+    import tempfile
+
+    from stoix_tpu.resilience import fleet as fleet_lib
+
+    spec = importlib.util.spec_from_file_location(
+        "stoix_tpu_soak",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts", "soak.py"),
+    )
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+
+    cycles = reps if reps is not None else (1 if smoke else 2)
+    windows = 2 if smoke else 3
+    devices = 8
+    tmp = tempfile.mkdtemp(prefix="stoix_elastic_bench_")
+    walls: list = []
+    legs: list = []
+    cycles_survived = 0
+    last_stats = None
+    try:
+        for cycle in range(cycles):
+            workdir = os.path.join(tmp, f"cycle{cycle}")
+            cycle_problems: list = []
+            start = devices
+            for action in ("shrink", "grow"):
+                leg = soak.run_leg(
+                    workdir, action=action, devices=start, windows=windows
+                )
+                cycle_problems.extend(leg["problems"])
+                report = fleet_lib.read_restore_report(
+                    os.path.join(workdir, "fleet_emergency")
+                )
+                wall = float((report or {}).get("recovery_wall_s") or 0.0)
+                if wall > 0.0:
+                    walls.append(wall)
+                legs.append(
+                    {
+                        "action": action,
+                        "from_devices": start,
+                        "to_devices": leg["target"],
+                        "rc": leg["rc"],
+                        "leg_wall_s": round(leg["wall_s"], 3),
+                        "recovery_wall_s": round(wall, 6),
+                        "problems": leg["problems"],
+                    }
+                )
+                last_stats = leg["stats"] or last_stats
+                start = leg["target"]
+            if not cycle_problems:
+                cycles_survived += 1
+        if not walls:
+            raise RuntimeError(
+                "no elastic relaunch produced a restore report — no recovery "
+                f"wall to report (legs: {legs})"
+            )
+        import statistics
+
+        med = float(statistics.median(walls))
+        lo, hi = float(min(walls)), float(max(walls))
+        return {
+            "metric": metric,
+            "value": round(lo, 6),  # best rep (mirror of latency payloads)
+            "unit": (
+                f"s emergency-restore recovery wall per elastic relaunch "
+                f"({devices}-device CPU shrink->grow cycles, identity_game "
+                f"ff_ppo)"
+            ),
+            "vs_baseline": None,
+            "direction": "lower_is_better",
+            # recovery walls sit well under _rep_stats' 0.1s rounding grain,
+            # so the dispersion fields are computed here at full precision.
+            "reps": len(walls),
+            "median": round(med, 6),
+            "min": round(lo, 6),
+            "max": round(hi, 6),
+            "rel_spread": round((hi - lo) / med, 4) if med > 0 else 0.0,
+            "cycles": cycles,
+            "cycles_survived": cycles_survived,
+            "legs": legs,
+            "integrity": _integrity_report(None),
+            "goodput": _goodput_report(last_stats),
+        }
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 

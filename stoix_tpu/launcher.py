@@ -207,20 +207,49 @@ def run_preflight_only(jobs: List[dict], changed_only: bool = False) -> int:
     return 0 if report.ok else 1
 
 
+def _elastic_child_env(
+    env: Optional[dict],
+    platform: Optional[str] = None,
+    device_count: Optional[int] = None,
+) -> dict:
+    """Child environment for an elastic relaunch: the armed fault is consumed
+    (a `shrink:1` that re-fired every incarnation would relaunch-loop the
+    budget away), and on the cpu backend the virtual device count is forced
+    to the target so the relaunch actually RUNS the smaller/larger topology
+    (the fault-injected soak's mechanism; real TPU backends ignore it)."""
+    child = dict(env if env is not None else os.environ)
+    child.pop("STOIX_TPU_FAULT", None)
+    if platform == "cpu" and device_count:
+        flags = [
+            flag
+            for flag in child.get("XLA_FLAGS", "").split()
+            if not flag.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={int(device_count)}")
+        child["XLA_FLAGS"] = " ".join(flags)
+    return child
+
+
 def run_supervised(
     cmd: List[str],
     env: Optional[dict],
     max_relaunches: int,
     resume_overrides: List[str],
     quarantine_file: Optional[str] = None,
+    elastic: bool = False,
+    fleet_resume_path: Optional[str] = None,
+    job_overrides: Optional[List[str]] = None,
 ) -> int:
-    """Supervision loop for one job (docs/DESIGN.md §2.6 + §2.9). Two exit
-    codes mean "the run is healthy, relaunch-and-resume":
+    """Supervision loop for one job (docs/DESIGN.md §2.6 + §2.9 + §2.14).
+    Two exit codes mean "the run is healthy, relaunch-and-resume":
 
       * 87 (fleet partition, resilience/fleet.py) — a peer died and the
         survivors secured a local-shard emergency checkpoint; relaunch with
         `resume_overrides` so topology-elastic restore resumes at whatever
-        topology survived.
+        topology survived. With `elastic`, the backend is RE-PROBED first
+        and the mesh re-derived for the devices actually present
+        (resilience/elastic.survivor_overrides) instead of replaying the
+        dead topology.
       * 88 (state corruption, resilience/integrity.py) — the integrity
         sentinel proved silent corruption (replica fingerprint mismatch or a
         failed determinism probe) and recorded the offending host(s) in the
@@ -229,9 +258,19 @@ def run_supervised(
         The quarantine file is the scheduler/operator's drain list — this
         loop cannot evict a host from its own allocation, but it names the
         offender with proof and keeps the job moving.
+      * 89 (elastic resize, resilience/elastic.py) — ONLY with `elastic`: the
+        run deliberately vacated for a different topology, leaving a
+        `resize_request.json` next to the emergency store naming the target
+        device count and the relaunch overrides (re-derived mesh + population
+        re-placement). The request is consumed one-shot and the relaunch
+        restores through the emergency path at the requested topology.
+        Without `elastic`, 89 is final — fixed-topology supervision is
+        bit-identical to what it was before this flag existed.
 
     Every OTHER exit code (clean 0, watchdog 86, crash 1) is final. Returns
     the final exit code."""
+    from stoix_tpu.resilience import elastic as elastic_lib
+    from stoix_tpu.resilience.exit_codes import EXIT_CODE_ELASTIC_RESIZE
     from stoix_tpu.resilience.fleet import EXIT_CODE_FLEET_PARTITION
     from stoix_tpu.resilience.integrity import (
         EXIT_CODE_STATE_CORRUPTION,
@@ -240,8 +279,12 @@ def run_supervised(
     )
 
     log = get_logger("stoix_tpu.launcher")
+    handled = {EXIT_CODE_FLEET_PARTITION, EXIT_CODE_STATE_CORRUPTION}
+    if elastic:
+        handled.add(EXIT_CODE_ELASTIC_RESIZE)
     relaunches = 0
     extra: List[str] = []
+    child_env = env
     while True:
         # Each relaunch is a FRESH subprocess, and within any process the
         # run start calls observability.configure(), which resets the
@@ -249,18 +292,19 @@ def run_supervised(
         # incarnation never inherits stale heartbeat state that would read
         # as an instant stall (docs/DESIGN.md §2.13; pinned by
         # tests/test_opsplane.py).
-        rc = subprocess.run(cmd + extra, env=env).returncode
-        if rc not in (EXIT_CODE_FLEET_PARTITION, EXIT_CODE_STATE_CORRUPTION):
+        rc = subprocess.run(cmd + extra, env=child_env).returncode
+        if rc not in handled:
             if relaunches:
                 log.info(
                     "[launcher] job finished (rc %d) after %d supervised "
                     "relaunch(es)", rc, relaunches,
                 )
             return rc
-        reason = (
-            "fleet partition" if rc == EXIT_CODE_FLEET_PARTITION
-            else "state corruption"
-        )
+        reason = {
+            EXIT_CODE_FLEET_PARTITION: "fleet partition",
+            EXIT_CODE_STATE_CORRUPTION: "state corruption",
+            EXIT_CODE_ELASTIC_RESIZE: "elastic resize",
+        }[rc]
         if relaunches >= max_relaunches:
             log.error(
                 "[launcher] %s exit (rc %d) with the relaunch budget (%d) "
@@ -268,8 +312,59 @@ def run_supervised(
             )
             return rc
         relaunches += 1
-        if rc == EXIT_CODE_FLEET_PARTITION:
+        if rc == EXIT_CODE_ELASTIC_RESIZE:
+            request = elastic_lib.consume_resize_request(
+                fleet_resume_path or ""
+            )
+            if request is None:
+                log.error(
+                    "[launcher] elastic resize exit (rc %d) but no "
+                    "%s under %s — giving up (the dying incarnation failed "
+                    "before the hand-off was written)",
+                    rc, elastic_lib.RESIZE_REQUEST_NAME, fleet_resume_path,
+                )
+                return rc
+            target = int(request.get("target_devices") or 0)
+            # The armed fault was consumed by this exit; `arch.fault_spec=~`
+            # outranks any job-override spec so the relaunch trains instead
+            # of re-firing the same resize every incarnation.
+            extra = [
+                *resume_overrides,
+                *[str(o) for o in request.get("overrides") or []],
+                "arch.fault_spec=~",
+            ]
+            child_env = _elastic_child_env(
+                env, platform=request.get("platform"), device_count=target
+            )
+            log.warning(
+                "[launcher] elastic %s: relaunching at %d device(s) "
+                "(from %s, window %s)",
+                request.get("action"), target,
+                request.get("from_devices"), request.get("window"),
+            )
+        elif rc == EXIT_CODE_FLEET_PARTITION:
             extra = list(resume_overrides)
+            if elastic:
+                # Re-probe what actually survived the partition and re-derive
+                # the mesh for it — never replay the dead topology.
+                from stoix_tpu.resilience import preflight
+
+                try:
+                    probe = preflight.probe_backend()
+                    extra = extra + elastic_lib.survivor_overrides(
+                        probe.device_count, list(job_overrides or [])
+                    )
+                    child_env = _elastic_child_env(env)
+                    log.warning(
+                        "[launcher] elastic partition recovery: %d %s "
+                        "device(s) survived; relaunching with re-derived mesh",
+                        probe.device_count, probe.platform,
+                    )
+                except Exception as exc:  # noqa: STX003 — a failed re-probe degrades to the fixed-topology relaunch rather than killing a recoverable job
+                    log.error(
+                        "[launcher] elastic re-probe failed (%s); relaunching "
+                        "at the configured topology", exc,
+                    )
         else:
             quarantined = read_quarantine(quarantine_file or "").get("quarantined") or []
             if quarantined:
@@ -452,6 +547,19 @@ def main(argv: List[str] | None = None) -> None:
         "disables supervision.",
     )
     parser.add_argument(
+        "--elastic",
+        action="store_true",
+        help="with --supervise: topology-elastic relaunch policy "
+        "(stoix_tpu/resilience/elastic.py, docs/DESIGN.md §2.14). An "
+        "elastic-resize exit (rc 89) consumes the run's resize_request.json "
+        "and relaunches at the REQUESTED device count with re-derived mesh "
+        "axes + population re-placement overrides; a fleet-partition exit "
+        "(rc 87) re-probes the backend and relaunches at whatever topology "
+        "actually survived instead of replaying the dead one. Off (default): "
+        "rc 89 is final and supervision is bit-identical to fixed-topology "
+        "behavior.",
+    )
+    parser.add_argument(
         "--fleet-resume-path",
         default=os.path.join("checkpoints", "fleet_emergency"),
         help="emergency-store path the supervised relaunch resumes from "
@@ -509,6 +617,10 @@ def main(argv: List[str] | None = None) -> None:
         # Silently ignoring the flag would let a user believe their --submit
         # was gated on a changed-file lint that never ran.
         parser.error("--changed-only requires --preflight-only")
+    if args.elastic and args.supervise <= 0:
+        # An elastic policy with nothing supervising it would silently never
+        # relaunch — exactly the surprise this pairing check prevents.
+        parser.error("--elastic requires --supervise N (N > 0)")
     if args.aot_export and not args.compile_cache:
         # The export store exists to be shared alongside the cache dir; an
         # export-only launch silently paying full per-job XLA compiles is
@@ -554,6 +666,9 @@ def main(argv: List[str] | None = None) -> None:
                 rc = run_supervised(
                     cmd, env, args.supervise, resume_overrides,
                     quarantine_file=args.quarantine_file,
+                    elastic=args.elastic,
+                    fleet_resume_path=args.fleet_resume_path,
+                    job_overrides=list(job["overrides"]),
                 )
                 if rc != 0:
                     sys.exit(rc)

@@ -202,6 +202,51 @@ def resolve_assignments(
     return assignments
 
 
+def elastic_mesh_axes(
+    axes: Optional[Dict[str, int]], device_count: int
+) -> Dict[str, int]:
+    """Re-derive a mesh axis spec for a DIFFERENT device count (the elastic
+    relaunch path, docs/DESIGN.md §2.14). Pure host logic — no jax — so the
+    supervising launcher can compute the survivor topology before spawning.
+
+    A `-1` axis already absorbs whatever count the child probes, so the spec
+    passes through untouched. When every axis is pinned, the `data` axis is
+    rescaled to fit (the population shape: `{pop: P, data: -1→fixed}`); a
+    count the fixed axes cannot divide is refused rather than silently
+    truncated — the caller must shrink the other axes (e.g. the population)
+    first.
+    """
+    if device_count < 1:
+        raise MeshRolesError(
+            [f"cannot derive a mesh for {device_count} devices"]
+        )
+    axes = dict(axes or {"data": -1})
+    if any(size == -1 for size in axes.values()):
+        return axes
+    fixed = 1
+    for name, size in axes.items():
+        if name != "data":
+            fixed *= int(size)
+    if "data" not in axes:
+        raise MeshRolesError(
+            [
+                f"mesh axes {axes} have no -1 axis and no 'data' axis to "
+                f"rescale for {device_count} devices"
+            ]
+        )
+    if fixed < 1 or device_count % fixed != 0:
+        raise MeshRolesError(
+            [
+                f"mesh axes {axes} cannot be rescaled to {device_count} "
+                f"devices: the non-data axes multiply to {fixed}, which does "
+                f"not divide {device_count}"
+            ]
+        )
+    rescaled = dict(axes)
+    rescaled["data"] = device_count // fixed
+    return rescaled
+
+
 class MeshRoles:
     """Materialized role → devices/mesh mapping for this process's job.
 

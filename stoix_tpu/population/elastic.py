@@ -1,0 +1,335 @@
+"""Population shrink/grow across a different P (docs/DESIGN.md §2.14).
+
+The state half of the elastic resize protocol (resilience/elastic.py): when
+the supervisor relaunches a population run at a different topology, the PBT
+state saved by the OLD incarnation must be re-placed onto the NEW population
+size. The rules are PBT's own (population/pbt.py, arxiv 1711.09846), applied
+across incarnations instead of across windows:
+
+  * **Shrink** keeps the fittest `new_size` members by the fitness the store
+    RECORDED (truncation selection over the same scores
+    `LAST_POPULATION_STATS` reported; non-finite ranks below every finite
+    score). Surviving members' params / optimizer state / obs statistics /
+    hparams / fitness move bit-identically — the shrink is a gather, never a
+    recompute — pinned via leaf digests in tests/test_elastic.py.
+  * **Grow** keeps every existing member bit-identical and fills the new
+    slots with clones of the fittest members (cyclically), perturbing each
+    clone's perturbable hparams by x(1 +- perturb_scale) with the PR 15
+    explore coins and resampling the clone's PRNG stream `fold_in`-fresh
+    from the stored pbt key — a clone explores, it never replays its source.
+  * **Refusals**: a resize below one member, or past the configured
+    `arch.population.max_size`, raises the typed ElasticResizeError — an
+    impossible population must refuse before the relaunch loop burns its
+    budget on it.
+
+Wired into the restore path as `AnakinSetup.restore_transform`: the
+population setup installs `raw_resize_transform(config)`, and
+`fleet.restore_emergency` applies it to the digest-verified host arrays
+BEFORE tree-path placement — the resize happens while the values are plain
+host numpy, so it composes with any mesh the new incarnation builds.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from stoix_tpu.observability import get_logger
+from stoix_tpu.population import hparams as hparams_lib
+from stoix_tpu.population import pbt as pbt_lib
+from stoix_tpu.resilience.elastic import ElasticResizeError
+
+# Raw-store keys (slash-joined tree paths of PopulationState) that carry a
+# leading [P] population axis. Scalars (updates_done, exploit_total) and the
+# replicated pbt_key are NOT population leaves.
+_POP_PREFIXES = ("members/", "hparams/")
+_POP_EXACT = frozenset({"fitness"})
+_FITNESS_KEY = "fitness"
+_MEMBER_KEY_LEAF = "members/key"
+_PBT_KEY_LEAF = "pbt_key"
+
+
+def is_population_leaf(key: str) -> bool:
+    return key in _POP_EXACT or any(key.startswith(p) for p in _POP_PREFIXES)
+
+
+def max_population_size(config: Any) -> Optional[int]:
+    """`arch.population.max_size` — the configured grow ceiling (None/~ =
+    uncapped)."""
+    pop_cfg = (config.get("arch") or {}).get("population") or {}
+    raw = pop_cfg.get("max_size")
+    if raw in (None, ""):
+        return None
+    value = int(raw)
+    if value < 1:
+        raise hparams_lib.PopulationConfigError(
+            f"arch.population.max_size must be positive, got {value}"
+        )
+    return value
+
+
+def validate_resize(
+    old_size: int, new_size: int, max_size: Optional[int] = None
+) -> None:
+    """The refusal rules: never below one member, never past the configured
+    max. Raises the typed error so the supervisor logs a refusal instead of
+    relaunch-looping an impossible population."""
+    if new_size < 1:
+        raise ElasticResizeError(
+            f"cannot shrink the population below one member "
+            f"(requested {new_size}, currently {old_size})"
+        )
+    if max_size is not None and new_size > max_size:
+        raise ElasticResizeError(
+            f"cannot grow the population to {new_size} members: "
+            f"arch.population.max_size caps it at {max_size}"
+        )
+
+
+def select_survivors(fitness: Any, new_size: int) -> np.ndarray:
+    """Indices of the fittest `new_size` members by recorded fitness, in
+    their ORIGINAL member order (a shrink re-indexes, it never reshuffles).
+    Non-finite fitness (no completed episode, diverged member) ranks below
+    every finite score — exactly truncation_selection's rule."""
+    fitness = np.asarray(fitness, dtype=np.float64).reshape(-1)
+    old_size = int(fitness.shape[0])
+    validate_resize(old_size, new_size)
+    if new_size > old_size:
+        raise ElasticResizeError(
+            f"select_survivors is a shrink: requested {new_size} of "
+            f"{old_size} members"
+        )
+    fit = np.where(np.isfinite(fitness), fitness, -np.inf)
+    order = np.argsort(fit, kind="stable")  # ascending: worst first
+    return np.sort(order[old_size - new_size:])
+
+
+def clone_sources(fitness: Any, old_size: int, new_size: int) -> np.ndarray:
+    """Per-slot source index for a grow: existing slots are identities (the
+    bit-identity half), new slots clone the fittest members cyclically —
+    fittest first, by the same recorded-fitness ranking a shrink uses."""
+    fitness = np.asarray(fitness, dtype=np.float64).reshape(-1)
+    fit = np.where(np.isfinite(fitness), fitness, -np.inf)
+    ranked = np.argsort(fit, kind="stable")[::-1]  # fittest first
+    src = np.arange(new_size, dtype=np.int64)
+    for i, slot in enumerate(range(old_size, new_size)):
+        src[slot] = ranked[i % old_size]
+    return src
+
+
+def _fold_in(key: Any, data: int) -> Any:
+    import jax
+
+    return jax.random.fold_in(jax.numpy.asarray(key), data)
+
+
+def _fresh_member_keys(template_row: np.ndarray, key: Any, slot: int) -> np.ndarray:
+    """A fold_in-fresh raw-uint32 key block shaped like ONE member's key leaf
+    [S, U, 2] — the cross-incarnation analogue of pbt._resampled_keys."""
+    import jax
+
+    fresh = jax.random.split(_fold_in(key, slot), int(template_row.size // 2))
+    return np.asarray(fresh).reshape(template_row.shape).astype(template_row.dtype)
+
+
+def resize_arrays(
+    raw: Dict[str, np.ndarray],
+    new_size: int,
+    *,
+    perturb_scale: float = 0.2,
+    max_size: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """Resize every population leaf of a raw emergency-store dict to
+    `new_size` members. Identity (the SAME dict) when the store is not a
+    population store or already the right size — the transform is safe to
+    install unconditionally."""
+    fitness = raw.get(_FITNESS_KEY)
+    if fitness is None:
+        return raw
+    old_size = int(np.asarray(fitness).shape[0])
+    if old_size == new_size:
+        return raw
+    validate_resize(old_size, new_size, max_size)
+    log = get_logger("stoix_tpu.population")
+    out = dict(raw)
+    if new_size < old_size:
+        keep = select_survivors(fitness, new_size)
+        for key, value in raw.items():
+            if is_population_leaf(key):
+                out[key] = np.ascontiguousarray(np.asarray(value)[keep])
+        log.warning(
+            "[elastic] population shrink %d -> %d: keeping members %s "
+            "(fittest by recorded fitness)",
+            old_size, new_size, keep.tolist(),
+        )
+        return out
+
+    src = clone_sources(fitness, old_size, new_size)
+    clone_slots = list(range(old_size, new_size))
+    pbt_key = raw.get(_PBT_KEY_LEAF)
+    if pbt_key is None:
+        # A store without the PBT key still grows deterministically: derive
+        # the explore stream from the recorded step-invariant fitness size.
+        import jax
+
+        pbt_key = np.asarray(jax.random.PRNGKey(old_size))
+    explore_key = _fold_in(pbt_key, 0x9E37)
+    for key, value in raw.items():
+        if not is_population_leaf(key):
+            continue
+        value = np.asarray(value)
+        copied = np.ascontiguousarray(value[src])
+        if key == _MEMBER_KEY_LEAF:
+            # A clone explores — resample its PRNG stream instead of
+            # replaying the source member's.
+            for slot in clone_slots:
+                copied[slot] = _fresh_member_keys(copied[slot], explore_key, slot)
+        elif key.startswith("hparams/"):
+            name = key.split("/", 1)[1]
+            if name in hparams_lib.PERTURBABLE:
+                # The PR 15 explore move, keyed deterministically by sorted
+                # hparam order (pbt.perturb_hparams's convention) so a grow
+                # is replayable from the stored pbt key.
+                import jax
+
+                index = sorted(
+                    k.split("/", 1)[1] for k in raw if k.startswith("hparams/")
+                ).index(name)
+                coins = np.asarray(
+                    jax.random.bernoulli(
+                        _fold_in(explore_key, index), 0.5, (new_size,)
+                    )
+                )
+                factors = np.where(
+                    coins, 1.0 + perturb_scale, 1.0 - perturb_scale
+                ).astype(copied.dtype)
+                for slot in clone_slots:
+                    copied[slot] = copied[slot] * factors[slot]
+        out[key] = copied
+    # Advance the stored pbt key: the explore randomness above is consumed.
+    if _PBT_KEY_LEAF in out:
+        out[_PBT_KEY_LEAF] = np.asarray(explore_key).astype(
+            np.asarray(raw[_PBT_KEY_LEAF]).dtype
+        )
+    log.warning(
+        "[elastic] population grow %d -> %d: clone sources %s "
+        "(fittest first, hparams perturbed x(1±%.3g), fresh PRNG streams)",
+        old_size, new_size, [int(src[s]) for s in clone_slots], perturb_scale,
+    )
+    return out
+
+
+def resize_population_state(
+    state: Any, new_size: int, *, perturb_scale: float = 0.2,
+    max_size: Optional[int] = None,
+) -> Any:
+    """The in-process form of the resize: a PopulationState pytree in, a
+    PopulationState with `new_size` members out, through exactly the raw
+    transform the restore path applies (one code path, one set of pins)."""
+    import jax
+    import jax.numpy as jnp
+
+    from stoix_tpu.utils.checkpointing import _path_key
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    raw = {"/".join(_path_key(p)): np.asarray(leaf) for p, leaf in flat}
+    resized = resize_arrays(
+        raw, new_size, perturb_scale=perturb_scale, max_size=max_size
+    )
+    return jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(resized["/".join(_path_key(p))]) for p, _ in flat]
+    )
+
+
+def raw_resize_transform(config: Any) -> Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]:
+    """The restore-time transform the population setup installs as
+    `AnakinSetup.restore_transform`: re-places a restored store's members
+    onto THIS config's population size (identity when they already agree)."""
+    target = hparams_lib.population_size(config)
+    scale = pbt_lib.settings_from_config(config).perturb_scale
+    cap = max_population_size(config)
+
+    def transform(raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return resize_arrays(raw, target, perturb_scale=scale, max_size=cap)
+
+    return transform
+
+
+def plan_population_size(
+    config: Any, target_devices: int, from_devices: int
+) -> int:
+    """The population size a relaunch at `target_devices` should run:
+    scaled with the device ratio (the soak's pop-per-device shape), floored
+    at one member and CLAMPED at `arch.population.max_size` — the override
+    computation clamps so a grow fault past the cap degrades to the cap
+    instead of crashing the resize exit; the transforms themselves refuse."""
+    size = hparams_lib.population_size(config)
+    if from_devices < 1 or target_devices < 1:
+        raise ElasticResizeError(
+            f"cannot plan a population resize {from_devices} -> "
+            f"{target_devices} device(s)"
+        )
+    new_size = max(1, (size * target_devices) // from_devices)
+    cap = max_population_size(config)
+    if cap is not None and new_size > cap:
+        get_logger("stoix_tpu.population").warning(
+            "[elastic] grow to %d members clamped at arch.population."
+            "max_size=%d", new_size, cap,
+        )
+        new_size = cap
+    return new_size
+
+
+def _resized_hparam_values(
+    values: List[Any], fitness: Optional[List[float]], new_size: int
+) -> List[Any]:
+    """A per-member hparam list re-shaped for the new population: shrink
+    slices to the recorded-fitness survivors, grow extends by cloning the
+    fittest cyclically. Template values only — a successful restore
+    overwrites them with the (perturbed) stored leaf."""
+    old_size = len(values)
+    fit = (
+        np.asarray(fitness, dtype=np.float64)
+        if fitness is not None and len(fitness) == old_size
+        else np.zeros((old_size,), dtype=np.float64)
+    )
+    if new_size <= old_size:
+        return [values[i] for i in select_survivors(fit, new_size)]
+    src = clone_sources(fit, old_size, new_size)
+    return [values[int(i)] for i in src]
+
+
+def population_resize_overrides(
+    config: Any,
+    *,
+    target_devices: int,
+    from_devices: Optional[int] = None,
+    stats: Optional[Dict[str, Any]] = None,
+) -> List[str]:
+    """Config overrides re-deriving `arch.population` for a relaunch at
+    `target_devices` (docs/DESIGN.md §2.14): the scaled `size`, plus
+    re-shaped values for any per-member hparams LIST (a length-P list
+    composed against a different P is a PopulationConfigError before the
+    restore ever runs). `stats` defaults to LAST_POPULATION_STATS so the
+    list re-shaping follows the same recorded fitness the restore's
+    truncation will."""
+    if from_devices is None:
+        import jax
+
+        from_devices = jax.device_count()
+    new_size = plan_population_size(config, target_devices, from_devices)
+    overrides = [f"arch.population.size={new_size}"]
+    if stats is None:
+        from stoix_tpu.population.runner import LAST_POPULATION_STATS
+
+        stats = dict(LAST_POPULATION_STATS)
+    fitness = stats.get("member_fitness")
+    pop_cfg = (config.get("arch") or {}).get("population") or {}
+    for dotted, values in dict(pop_cfg.get("hparams") or {}).items():
+        if isinstance(values, (int, float)):
+            continue  # scalars broadcast to any size
+        resized = _resized_hparam_values(list(values), fitness, new_size)
+        rendered = ",".join(repr(float(v)) for v in resized)
+        overrides.append(f"arch.population.hparams.{dotted}=[{rendered}]")
+    return overrides

@@ -348,11 +348,17 @@ def population_setup(
             "on" if settings.enabled else "off",
         )
 
+    from stoix_tpu.population import elastic as elastic_lib
+
     return AnakinSetup(
         learn=learn,
         learner_state=pop_state,
         eval_act_fn=eval_act_fn,
         eval_params_fn=eval_params_fn,
+        # Elastic restore (docs/DESIGN.md §2.14): an emergency store saved by
+        # a DIFFERENT population size is re-placed onto this one before tree
+        # placement — identity when the sizes already agree.
+        restore_transform=elastic_lib.raw_resize_transform(config),
     )
 
 

@@ -56,7 +56,7 @@ ops, the signal handler only reacts to signals, and supervision only acts on
 failures (tests/test_resilience.py pins the trajectory equality).
 """
 
-from stoix_tpu.resilience import exit_codes, faultinject, fleet, guards, integrity, preflight  # noqa: F401 — public API
+from stoix_tpu.resilience import elastic, exit_codes, faultinject, fleet, guards, integrity, preflight  # noqa: F401 — public API
 from stoix_tpu.resilience.exit_codes import (  # noqa: F401
     EXIT_CODE_FAILURE,
     EXIT_CODE_OK,

@@ -1,0 +1,285 @@
+"""Topology-elastic resize protocol (docs/DESIGN.md §2.14).
+
+Production TPU allocations shrink and grow under preemption; before this
+module a partition meant rescue + relaunch at a FIXED topology — the one
+failure mode the Podracer layout assumes away. This module is the protocol
+half of "resize instead of die":
+
+  * **The resize request** (`resize_request.json`, written next to the fleet
+    emergency store): a deliberate hand-off from a dying incarnation to the
+    supervising launcher, naming the action (shrink/grow), the device counts
+    on both sides, and the exact config overrides the relaunch needs
+    (re-derived mesh axes + population re-placement). Written by
+    `resize_exit` together with the emergency snapshot and a schema-valid
+    flight record, then the process hard-exits `EXIT_CODE_ELASTIC_RESIZE`
+    (89) — distinguishable from a partition (87) in supervisor logs.
+  * **Topology re-derivation** (`topology_overrides` / `survivor_overrides`):
+    `arch.mesh` axes are re-derived for the devices actually present via
+    `roles.elastic_mesh_axes` and validated through
+    `roles.resolve_assignments` — never replayed from the dead topology.
+    Explicit `arch.roles` device ids that no longer fit fall back to
+    role re-derivation (`arch.roles=~`). Pure host logic, no jax import:
+    the supervising launcher computes the survivor topology before spawning.
+  * **The relaunch policy** lives in `launcher.py --supervise --elastic`:
+    rc 89 consumes the resize request and relaunches at the requested
+    topology with the emergency restore overrides; rc 87 re-probes the
+    backend and relaunches at whatever survived. `--elastic` off is pinned
+    bit-identical to the fixed-topology supervision this replaces.
+
+The state half — re-placing PBT members across a different P — is
+`stoix_tpu/population/elastic.py`, wired through `AnakinSetup
+.restore_transform` into `fleet.restore_emergency`'s raw-transform seam.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from stoix_tpu.observability import flightrec, get_logger
+from stoix_tpu.parallel import roles as roles_lib
+from stoix_tpu.resilience.exit_codes import EXIT_CODE_ELASTIC_RESIZE
+
+RESIZE_REQUEST_NAME = "resize_request.json"
+
+RESIZE_ACTIONS = ("shrink", "grow")
+
+
+class ElasticResizeError(ValueError):
+    """A resize that cannot be satisfied (below one device, bad action,
+    un-rescalable mesh axes)."""
+
+
+def plan_resize(action: str, device_count: int) -> int:
+    """The target device count for a resize fault: shrink halves, grow
+    doubles — the preemption granularity of slice-sized allocations. Refuses
+    a shrink below one device with the typed error (the run should die as a
+    plain failure, not loop relaunching an impossible topology)."""
+    if action not in RESIZE_ACTIONS:
+        raise ElasticResizeError(
+            f"unknown resize action {action!r}; known: {', '.join(RESIZE_ACTIONS)}"
+        )
+    if device_count < 1:
+        raise ElasticResizeError(
+            f"cannot resize from {device_count} device(s)"
+        )
+    if action == "shrink":
+        target = device_count // 2
+        if target < 1:
+            raise ElasticResizeError(
+                f"cannot shrink below one device (currently {device_count})"
+            )
+        return target
+    return device_count * 2
+
+
+def topology_overrides(config: Any, device_count: int) -> List[str]:
+    """Config overrides that re-derive the mesh for `device_count` devices
+    via `roles.elastic_mesh_axes`, validated through
+    `roles.resolve_assignments` against the new count. When explicit
+    `arch.roles` device ids no longer fit the survivors, the roles block is
+    dropped (`arch.roles=~`) so assignment re-derives from the architecture
+    name instead of replaying the dead topology. Jax-free host logic."""
+    arch = dict((config.get("arch") if config is not None else None) or {})
+    axes = roles_lib.elastic_mesh_axes(
+        dict(arch.get("mesh") or {"data": -1}), device_count
+    )
+    candidate: Dict[str, Any] = {
+        "arch": {
+            "architecture_name": arch.get("architecture_name", "anakin"),
+            "mesh": dict(axes),
+            "roles": arch.get("roles"),
+        }
+    }
+    overrides: List[str] = []
+    try:
+        roles_lib.resolve_assignments(candidate, device_count=device_count)
+    except roles_lib.MeshRolesError:
+        # Explicit role assignments pin device ids from the old topology;
+        # re-derive instead. If even the derived assignment cannot fit, the
+        # error propagates — an impossible topology must refuse, not relaunch.
+        candidate["arch"]["roles"] = None
+        roles_lib.resolve_assignments(candidate, device_count=device_count)
+        overrides.append("arch.roles=~")
+    overrides.extend(f"arch.mesh.{name}={size}" for name, size in axes.items())
+    return overrides
+
+
+def survivor_overrides(
+    device_count: int, overrides: Optional[List[str]] = None
+) -> List[str]:
+    """The rc-87 elastic path's topology re-derivation, for the supervising
+    launcher (which holds no composed config — only the job's override list).
+    Any `arch.mesh.*=` / `arch.roles=` overrides already on the job are
+    parsed into a minimal config so the re-derivation starts from what the
+    dead incarnation actually ran with."""
+    axes: Dict[str, int] = {}
+    explicit_roles = False
+    for entry in overrides or []:
+        key, _, value = str(entry).partition("=")
+        if key.startswith("arch.mesh."):
+            try:
+                axes[key[len("arch.mesh."):]] = int(value)
+            except ValueError:
+                continue
+        elif key == "arch.roles" and value not in ("~", "null", ""):
+            explicit_roles = True
+    config = {"arch": {"mesh": axes or None, "roles": None}}
+    derived = topology_overrides(config, device_count)
+    if explicit_roles and "arch.roles=~" not in derived:
+        derived.insert(0, "arch.roles=~")
+    return derived
+
+
+def write_resize_request(
+    directory: str,
+    *,
+    action: str,
+    from_devices: int,
+    target_devices: int,
+    window: int,
+    step: int,
+    platform: str,
+    overrides: Optional[List[str]] = None,
+) -> str:
+    """Atomically write the resize hand-off next to the emergency store;
+    returns the request path."""
+    os.makedirs(directory, exist_ok=True)
+    request = {
+        "format": 1,
+        "action": str(action),
+        "from_devices": int(from_devices),
+        "target_devices": int(target_devices),
+        "window": int(window),
+        "step": int(step),
+        "platform": str(platform),
+        "overrides": list(overrides or []),
+        "unix_time": time.time(),
+    }
+    path = os.path.join(directory, RESIZE_REQUEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(request, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def read_resize_request(directory: str) -> Optional[Dict[str, Any]]:
+    """The pending resize request under `directory`, or None."""
+    try:
+        with open(os.path.join(str(directory), RESIZE_REQUEST_NAME)) as f:
+            request = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return request if isinstance(request, dict) else None
+
+
+def consume_resize_request(directory: str) -> Optional[Dict[str, Any]]:
+    """One-shot read for the supervising launcher: the request is removed so
+    a LATER rc-89 (the grow leg of a soak cycle) is always answered by ITS
+    OWN request, never a stale one."""
+    request = read_resize_request(directory)
+    if request is not None:
+        try:
+            os.remove(os.path.join(str(directory), RESIZE_REQUEST_NAME))
+        except OSError:
+            pass
+    return request
+
+
+def resize_overrides(config: Any, target_devices: int) -> List[str]:
+    """Everything a relaunch at `target_devices` needs beyond the restore
+    overrides: re-derived mesh axes, plus — for population runs — the
+    population re-placement overrides (`arch.population.size` scaled with
+    the device ratio, docs/DESIGN.md §2.14)."""
+    overrides = topology_overrides(config, target_devices)
+    arch = dict((config.get("arch") if config is not None else None) or {})
+    pop_cfg = dict(arch.get("population") or {})
+    if int(pop_cfg.get("size", 1) or 1) > 1:
+        # Lazy import: population code pulls jax; the protocol half must stay
+        # importable from a supervisor/CI process without an accelerator.
+        from stoix_tpu.population import elastic as population_elastic
+
+        overrides.extend(
+            population_elastic.population_resize_overrides(
+                config, target_devices=target_devices
+            )
+        )
+    return overrides
+
+
+def resize_exit(
+    action: str,
+    *,
+    config: Any,
+    window_idx: int,
+    step: int,
+    fleet_coord: Any = None,
+) -> None:
+    """The rc-89 exit protocol (never returns): secure the emergency
+    snapshot, write the resize request naming the target topology + relaunch
+    overrides, dump a schema-valid flight record, hard-exit 89. Ordering
+    matters — the snapshot and both artifacts must be on disk before the
+    exit, because `os._exit` runs no finally blocks."""
+    import jax
+
+    log = get_logger("stoix_tpu.resilience")
+    from_devices = jax.device_count()
+    target_devices = plan_resize(action, from_devices)
+    overrides = resize_overrides(config, target_devices)
+    emergency_dir = str(
+        dict(dict(config.get("arch") or {}).get("fleet") or {}).get(
+            "emergency_dir", os.path.join("checkpoints", "fleet_emergency")
+        )
+    )
+    if fleet_coord is not None:
+        try:
+            saved = fleet_coord.emergency_save()
+        except Exception as exc:  # noqa: STX003 — the resize hand-off must still be written when the rescue save fails; the relaunch then restores the newest digest-verified orbax store instead
+            saved = None
+            log.error("[elastic] emergency save failed: %s", exc)
+        if saved is None:
+            log.warning(
+                "[elastic] no rescue snapshot secured — the relaunch will "
+                "restore the newest digest-verified checkpoint instead"
+            )
+    else:
+        log.warning(
+            "[elastic] resize without a fleet coordinator (arch.fleet."
+            "enabled=false): no emergency snapshot — the relaunch restores "
+            "the newest digest-verified checkpoint"
+        )
+    request_path = write_resize_request(
+        emergency_dir,
+        action=action,
+        from_devices=from_devices,
+        target_devices=target_devices,
+        window=window_idx,
+        step=step,
+        platform=str(jax.default_backend()),
+        overrides=overrides,
+    )
+    reason = (
+        f"elastic {action}: {from_devices} -> {target_devices} device(s) "
+        f"at window {window_idx} (step {step})"
+    )
+    log.warning(
+        "[elastic] %s — request at %s, exiting %d for the elastic supervisor",
+        reason, request_path, EXIT_CODE_ELASTIC_RESIZE,
+    )
+    flightrec.get_flight_recorder().record(
+        "elastic_resize",
+        action=action,
+        window=window_idx,
+        step=step,
+        from_devices=from_devices,
+        target_devices=target_devices,
+    )
+    flightrec.dump_flight_record(
+        emergency_dir, reason=reason, exit_code=EXIT_CODE_ELASTIC_RESIZE
+    )
+    sys.stderr.flush()
+    os._exit(EXIT_CODE_ELASTIC_RESIZE)

@@ -49,6 +49,13 @@ EXIT_CODE_FLEET_PARTITION = 87
 # offender in the quarantine file (resilience/integrity.py, §2.9).
 # `--supervise N` relaunches with the quarantine record's resume overrides.
 EXIT_CODE_STATE_CORRUPTION = 88
+# A deliberate topology resize (resilience/elastic.py, §2.14): the run
+# secured an emergency snapshot and wrote a `resize_request.json` naming the
+# target device count. Distinct from 87 so supervisor logs and flight
+# records can tell "we chose to resize" from "a peer died under us".
+# `--supervise N --elastic` relaunches at the requested topology with the
+# emergency restore overrides; without `--elastic` it is final.
+EXIT_CODE_ELASTIC_RESIZE = 89
 
 
 class ExitCode(NamedTuple):
@@ -100,6 +107,14 @@ _RECORDS: "tuple[ExitCode, ...]" = (
         "recorded in the quarantine file (§2.9)",
         "`--supervise N`: relaunch with the quarantine record's resume "
         "overrides, restoring the newest digest-verified checkpoint",
+    ),
+    ExitCode(
+        EXIT_CODE_ELASTIC_RESIZE,
+        "EXIT_CODE_ELASTIC_RESIZE",
+        "deliberate topology resize: emergency snapshot secured and "
+        "`resize_request.json` names the target device count (§2.14)",
+        "`--supervise N --elastic`: relaunch at the requested topology with "
+        "the emergency restore overrides; without `--elastic` it is final",
     ),
 )
 

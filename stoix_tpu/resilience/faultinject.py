@@ -65,6 +65,17 @@ Spec grammar: comma-separated `name[:arg]` entries (a mapping
                   a NaN written into its first float leaf (one-shot) —
                   drives the hot-swap canary's reject-and-keep-serving path
                   (serve/hotswap.py) deterministically
+  shrink:N        after dispatching eval window N the run vacates for a
+                  SMALLER topology (one-shot): emergency snapshot, a
+                  `resize_request.json` naming half the current device
+                  count, schema-valid flight record, hard exit 89
+                  (resilience/elastic.py, docs/DESIGN.md §2.14) — a
+                  preemption that takes half the allocation. The elastic
+                  supervisor relaunches at the requested count.
+  grow:N          same protocol, but the resize request names DOUBLE the
+                  current device count (one-shot) — preempted capacity
+                  coming back. The elastic supervisor relaunches larger,
+                  restoring from the newest digest-verified store.
 
 All injection points are no-ops (a single None check) when no plan is armed,
 and `configure()` is called once per experiment so one-shot state never leaks
@@ -102,6 +113,8 @@ _KNOWN = (
     "barrier_wedge",
     "bitflip",
     "swap_poison",
+    "shrink",
+    "grow",
 )
 
 
@@ -306,6 +319,31 @@ def maybe_host_loss(window_idx: int) -> None:
         # Only reachable if something SIGCONTs the frozen process: the host
         # is still "lost" — finish the job.
         os._exit(EXIT_CODE_FAILURE)
+
+
+def maybe_resize(window_idx: int) -> Optional[str]:
+    """Return "shrink"/"grow" when a `shrink:N`/`grow:N` resize fault fires
+    after eval window N (one-shot each), else None. This hook only DECIDES —
+    the runner owns the exit protocol (secure the emergency snapshot, write
+    `resize_request.json`, dump the flight record, exit 89) via
+    resilience/elastic.py, because only the runner holds the fleet
+    coordinator and the live step count."""
+    plan = get_plan()
+    if plan is None:
+        return None
+    for action in ("shrink", "grow"):
+        at = plan.arg(action)
+        if at is not None and window_idx == at and plan.consume(action):
+            _injected_counter().inc(labels={"fault": action})
+            get_logger("stoix_tpu.resilience").warning(
+                "[faultinject] %s resize requested at window %d",
+                action, window_idx,
+            )
+            flightrec.get_flight_recorder().record(
+                "fault", fault=action, window=window_idx
+            )
+            return action
+    return None
 
 
 def maybe_host_stall(window_idx: int) -> None:

@@ -1024,17 +1024,52 @@ def read_emergency_raw(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, str]
     return raw, dict(manifest.get("casts") or {}), step
 
 
-def restore_emergency(template: Any, path: str) -> Tuple[Any, int]:
+RESTORE_REPORT_NAME = "restore_report.json"
+
+
+def read_restore_report(path: str) -> Optional[Dict[str, Any]]:
+    """The report the most recent `restore_emergency` over `path` left behind
+    (None when no restore has run, or the report is unreadable)."""
+    try:
+        with open(os.path.join(str(path), RESTORE_REPORT_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def restore_emergency(
+    template: Any,
+    path: str,
+    raw_transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+) -> Tuple[Any, int]:
     """Restore a local-shard emergency store into `template`'s shardings via
     the same tree-path matching + placement as topology-elastic restore
     (utils/checkpointing.place_host_leaves): matched leaves round-trip
     through the host bit-identical; manifest-recorded partial leaves (and
-    shape-mismatched topology-bound leaves) keep the template's fresh value."""
+    shape-mismatched topology-bound leaves) keep the template's fresh value.
+
+    `raw_transform` is the elastic seam (docs/DESIGN.md §2.14): it rewrites
+    the digest-verified host arrays BEFORE placement — the population
+    shrink/grow transform re-places PBT member axes across a different P
+    here, where the values are still plain host numpy. The restore leaves a
+    `restore_report.json` next to the store recording the step, the sha256
+    of every leaf actually placed (post-transform, so an elastic-off restore
+    reports exactly the manifest digests), what was reinitialized, and the
+    restore's own wall clock — the artifact the resize soak asserts
+    digest-identity and recovery wall against from OUTSIDE the process."""
     import jax
 
+    from stoix_tpu.resilience import integrity
     from stoix_tpu.utils.checkpointing import place_host_leaves
 
+    t_start = time.perf_counter()
     raw, casts, step = read_emergency_raw(path)
+    if raw_transform is not None:
+        raw = dict(raw_transform(dict(raw)))
+    # Digests of what is actually being placed, BEFORE the storage-width
+    # cast-back (so with no transform they equal the manifest's digests,
+    # which were computed over the stored widened arrays).
+    placed_digests = integrity.digest_arrays(raw)
     # Cast storage-widened leaves back to the template's dtype (bfloat16 was
     # stored as float32 — lossless to round-trip through the wider float).
     template_dtypes = {
@@ -1054,6 +1089,28 @@ def restore_emergency(template: Any, path: str) -> Tuple[Any, int]:
         step, path, matched, len(reinitialized),
         f" ({'; '.join(reinitialized)})" if reinitialized else "",
     )
+    report = {
+        "format": 1,
+        "step": int(step),
+        "source": str(path),
+        "transformed": raw_transform is not None,
+        "matched": int(matched),
+        "reinitialized": list(reinitialized),
+        "digests": placed_digests,
+        "recovery_wall_s": time.perf_counter() - t_start,
+        "unix_time": time.time(),
+    }
+    try:
+        tmp = os.path.join(str(path), RESTORE_REPORT_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, os.path.join(str(path), RESTORE_REPORT_NAME))
+    except OSError:
+        # The report is a soak/bench observability artifact; a read-only
+        # store must not fail the restore that just succeeded.
+        get_logger("stoix_tpu.checkpoint").warning(
+            "[fleet] could not write %s next to %s", RESTORE_REPORT_NAME, path
+        )
     return restored, step
 
 

@@ -132,6 +132,7 @@ from stoix_tpu.parallel import (
 from stoix_tpu.resilience import (
     PreemptionHandler,
     Watchdog,
+    elastic,
     faultinject,
     fleet,
     guards,
@@ -198,6 +199,11 @@ class AnakinSetup(NamedTuple):
     # after the learn dispatch. None (the default) = lockstep — the field
     # defaults keep older setups (and _replace-based wrappers) source-compatible.
     gossip: Any = None
+    # Optional elastic-restore seam (docs/DESIGN.md §2.14): a transform over
+    # the emergency store's digest-verified host arrays, applied BEFORE
+    # tree-path placement. The population setup installs its shrink/grow
+    # member re-placement here; None = restore the store as saved.
+    restore_transform: Any = None
 
 
 SetupFn = Callable[[envs.Environment, Any, Any, jax.Array], AnakinSetup]
@@ -328,7 +334,8 @@ def run_anakin_experiment(
             # tree-path placement as the topology-elastic path — params
             # round-trip bit-identical onto the (possibly shrunk) new mesh.
             learner_state, start_step = fleet.restore_emergency(
-                learner_state, load_path
+                learner_state, load_path,
+                raw_transform=getattr(setup, "restore_transform", None),
             )
         else:
             from stoix_tpu.utils.checkpointing import Checkpointer
@@ -836,6 +843,19 @@ def run_anakin_experiment(
                 pending = window
             else:
                 process_window(window)
+            # Chaos: `shrink:N`/`grow:N` vacate for a different topology
+            # (docs/DESIGN.md §2.14). AFTER process_window so the newest
+            # CONFIRMED rescue candidate exists — the resize exit's emergency
+            # snapshot is what the relaunch restores digest-identically.
+            resize_action = faultinject.maybe_resize(eval_idx)
+            if resize_action is not None:
+                elastic.resize_exit(
+                    resize_action,
+                    config=config,
+                    window_idx=eval_idx,
+                    step=dispatched_t,
+                    fleet_coord=fleet_coord,
+                )
             if fleet_coord is None:
                 if preempt.stop_requested():
                     preempted = True

@@ -1,0 +1,150 @@
+"""Shared two-process Gloo harness support for the multi-process CPU tests
+(tests/test_multihost.py, tests/test_fleet_e2e.py).
+
+Two distinct "can't test this here" conditions, both SKIPS rather than
+failures — neither is a product defect:
+
+  * **Capability precheck** (`require_two_process_jax`): the platform cannot
+    run a 2-process `jax.distributed` job at all (no spawn, no Gloo, no
+    loopback coordination). Probed ONCE per pytest session with a real
+    cross-process allgather — `jax.device_count()` alone proves only the
+    coordination service.
+  * **Transport flake** (`skip_if_gloo_flake` / `is_gloo_flake`): the Gloo
+    TCP transport pairs collective ops strictly in-order per connection, and
+    orbax's async multi-process machinery can execute its sync collectives
+    concurrently with in-flight XLA collectives — on the CPU backend this
+    occasionally misorders the op stream and aborts with
+    `gloo::EnforceNotMet op.preamble.length <= op.nbytes` (observed ~1/3 of
+    checkpointing runs; real TPU streams serialize launches and do not have
+    this failure mode). Tests retry a bounded number of times; when EVERY
+    attempt dies with a transport signature, the run skips with a typed
+    one-line reason naming the signature — an infra flake red-lining CI
+    teaches people to ignore red, which is worse than the lost coverage.
+    Genuine protocol failures (wrong window, missing manifest, wrong exit
+    code) carry no transport signature and still fail loudly.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from typing import Optional
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Transport-abort signatures that mark an attempt as infrastructure, not
+# product: the Gloo op-stream misorder, and jax's distributed service
+# fatal-propagating a peer's transport death.
+GLOO_FLAKE_SIGNATURES = (
+    "gloo::EnforceNotMet",
+    "Terminating process because the JAX distributed service detected fatal errors",
+)
+
+_PRECHECK = textwrap.dedent(
+    """
+    import os, sys
+    proc_id = int(sys.argv[1]); port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jax: gloo is the implicit default
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=proc_id
+    )
+    assert jax.device_count() == 4
+    # Collectives must actually WORK (device_count alone proves only the
+    # coordination service): a cross-process allgather is the real precheck.
+    import numpy as np
+    from jax.experimental import multihost_utils
+    out = multihost_utils.process_allgather(np.asarray([proc_id], np.float64))
+    assert out.reshape(-1).tolist() == [0.0, 1.0], out
+    print("PRECHECK_OK", flush=True)
+    """
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def clean_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO  # drop site hooks that pre-initialise jax
+    env.pop("STOIX_TPU_FAULT", None)
+    return env
+
+
+_precheck_result: Optional[bool] = None
+
+
+def require_two_process_jax(tmp_path_factory) -> None:
+    """Skip cleanly when this platform cannot run a 2-process jax.distributed
+    job at all (no spawn, no Gloo, no loopback coordination). The verdict is
+    cached for the session — one spawn pair vouches for every caller."""
+    global _precheck_result
+    if _precheck_result is None:
+        tmp = tmp_path_factory.mktemp("gloo_precheck")
+        script = tmp / "precheck.py"
+        script.write_text(_PRECHECK)
+        port = free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(i), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=clean_env(), text=True,
+            )
+            for i in range(2)
+        ]
+        try:
+            outs = [p.communicate(timeout=120)[0] for p in procs]
+            _precheck_result = all(
+                p.returncode == 0 and "PRECHECK_OK" in o
+                for p, o in zip(procs, outs)
+            )
+        except subprocess.TimeoutExpired:
+            _precheck_result = False
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+    if not _precheck_result:
+        pytest.skip("platform cannot run a 2-process jax.distributed job")
+
+
+def matched_signature(*outputs: str) -> Optional[str]:
+    """The first transport-flake signature present in any output, or None."""
+    for out in outputs:
+        for sig in GLOO_FLAKE_SIGNATURES:
+            if sig in (out or ""):
+                return sig
+    return None
+
+
+def is_gloo_flake(*outputs: str) -> bool:
+    return matched_signature(*outputs) is not None
+
+
+def skip_if_gloo_flake(*outputs: str, attempts: int) -> None:
+    """Every attempt died with a Gloo transport signature: SKIP with a typed
+    one-line reason naming the signature (never fail — infra, not product).
+    Callers reach this only after their bounded retry loop is exhausted, so
+    a genuine protocol failure (no signature in the output) never lands
+    here — it fails on its own assertions instead."""
+    signature = matched_signature(*outputs)
+    pytest.skip(
+        f"gloo-flake[{signature or 'transport-abort'}]: 2-process gloo "
+        f"transport aborted all {attempts} attempt(s) — CPU-backend op-stream "
+        f"misorder (infra, not product; tests/gloo_precheck.py)"
+    )

@@ -350,6 +350,65 @@ def test_bench_gossip_payload_schema():
     assert g2["gossip_rounds"] > 0
 
 
+@pytest.mark.slow
+def test_bench_elastic_payload_schema():
+    """`bench.py --elastic` (docs/DESIGN.md §2.14): the recovery-shaped
+    payload is schema-complete — direction=lower_is_better (so --check
+    inverts its comparison), value = the BEST (minimum) recovery-wall rep,
+    recovery_wall_s dispersion over the relaunch reps, and the
+    cycles_survived contract counter that keeps a fast-but-broken relaunch
+    from publishing as a win. Slow lane: each cycle is four real training
+    subprocesses (two incarnations per leg)."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--elastic", "--smoke", "--cpu",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "STOIX_BENCH_NO_FALLBACK": "1"},
+    )
+    assert proc.returncode == 0, f"bench.py --elastic failed:\n{proc.stdout}\n{proc.stderr}"
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, f"expected exactly one JSON line:\n{proc.stdout}"
+    payload = json.loads(json_lines[0])
+
+    assert payload["metric"] == "elastic_recovery_wall_s"
+    assert payload["direction"] == "lower_is_better"
+    assert isinstance(payload["value"], (int, float)) and payload["value"] > 0
+    assert "recovery wall" in payload["unit"]
+    assert payload["vs_baseline"] is None  # no recovery baseline tracked yet
+
+    # Rep dispersion with best-rep semantics MIRRORED for a lower-is-better
+    # metric: value is the fastest (minimum) recovery wall.
+    assert payload["reps"] >= 2  # one cycle = shrink + grow relaunches
+    assert payload["min"] <= payload["median"] <= payload["max"]
+    assert payload["value"] == payload["min"], payload
+    assert payload["rel_spread"] >= 0.0
+
+    # The contract counter: every cycle upheld §2.14 (consumed request,
+    # schema-valid flight record, digest-identical survivors, recovery-phase
+    # attribution) — a failing cycle must be visible next to the number.
+    assert payload["cycles"] == 1
+    assert payload["cycles_survived"] == 1, payload
+    legs = payload["legs"]
+    assert [leg["action"] for leg in legs] == ["shrink", "grow"], legs
+    for leg in legs:
+        assert leg["rc"] == 0 and leg["problems"] == [], leg
+        assert leg["recovery_wall_s"] > 0.0, leg
+    assert legs[0]["from_devices"] == legs[1]["to_devices"] == 8
+    assert legs[0]["to_devices"] == legs[1]["from_devices"] == 4
+
+    # Universal posture fields; the goodput is the completing incarnation's
+    # live ledger (its recovery phase is what the headline measures).
+    assert payload["fallback"] is False
+    assert payload["fallback_reason"] is None
+    _assert_goodput_shape(payload, live=True)
+    assert payload["goodput"]["recovery_s"] > 0.0, payload["goodput"]
+
+
 def test_bench_backend_wedge_aborts_typed_within_deadline():
     # Acceptance pin (docs/DESIGN.md §2.4): with the probe subprocess wedged
     # (backend_wedge chaos fault — the child sleeps before touching jax),

@@ -1,0 +1,464 @@
+"""Elastic fleet contracts (docs/DESIGN.md §2.14): population shrink/grow
+re-placement, topology re-derivation, the resize-request hand-off, and the
+`--supervise --elastic` relaunch policy.
+
+The not-slow lane pins the pure protocol pieces (transforms over hand-built
+raw stores, override derivation, request IO, the supervision loop against
+tiny stub children — no jax in any child). The slow lane runs one full
+fault-injected preempt -> shrink -> resume -> grow cycle end-to-end on the
+CPU backend through scripts/soak.py.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from stoix_tpu.population import elastic as pop_elastic
+from stoix_tpu.resilience import elastic as res_elastic
+from stoix_tpu.resilience.elastic import ElasticResizeError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Population shrink: truncation over recorded fitness, bit-identical gathers
+# ---------------------------------------------------------------------------
+
+
+def _store_8() -> dict:
+    """A hand-built raw emergency store for an 8-member population run:
+    population leaves carry a leading [8] axis, plus the scalars and a
+    non-population params leaf that a resize must never touch."""
+    rng = np.random.default_rng(0)
+    return {
+        "members/w": rng.standard_normal((8, 3)).astype(np.float32),
+        "hparams/actor_lr": (np.arange(8, dtype=np.float32) + 1.0) * 1e-3,
+        "fitness": np.array(
+            [3.0, np.nan, 7.0, 1.0, 9.0, 2.0, 5.0, -np.inf], np.float32
+        ),
+        "updates_done": np.asarray(12, np.int64),
+        "params/actor": rng.standard_normal((4,)).astype(np.float32),
+    }
+
+
+def test_select_survivors_keeps_fittest_in_original_order():
+    fitness = [3.0, np.nan, 7.0, 1.0, 9.0, 2.0, 5.0, -np.inf]
+    # Fittest four: 9.0 (4), 7.0 (2), 5.0 (6), 3.0 (0) — returned in member
+    # order, and the non-finite members rank below every finite score.
+    assert pop_elastic.select_survivors(fitness, 4).tolist() == [0, 2, 4, 6]
+    assert pop_elastic.select_survivors(fitness, 8).tolist() == list(range(8))
+
+
+def test_shrink_8_to_4_keeps_fittest_members_bitwise():
+    raw = _store_8()
+    out = pop_elastic.resize_arrays(dict(raw), 4)
+    keep = [0, 2, 4, 6]
+    for key in ("members/w", "hparams/actor_lr", "fitness"):
+        # A shrink is a gather, never a recompute: bit-identical survivors.
+        assert out[key].tobytes() == raw[key][keep].tobytes(), key
+        assert out[key].shape[0] == 4
+    # Scalars and non-population leaves pass through untouched.
+    assert out["updates_done"] is raw["updates_done"]
+    assert out["params/actor"] is raw["params/actor"]
+
+
+def test_resize_arrays_is_identity_off_population_stores():
+    # No fitness leaf (a plain single-agent store) or an already-right size:
+    # the transform returns the SAME dict, so installing it unconditionally
+    # as AnakinSetup.restore_transform is safe.
+    plain = {"params/actor": np.ones((3,), np.float32)}
+    assert pop_elastic.resize_arrays(plain, 4) is plain
+    sized = _store_8()
+    assert pop_elastic.resize_arrays(sized, 8) is sized
+
+
+# ---------------------------------------------------------------------------
+# Population grow: fittest-first clones, perturbed hparams, fresh PRNG keys
+# ---------------------------------------------------------------------------
+
+
+def _store_4() -> dict:
+    import jax
+
+    rng = np.random.default_rng(1)
+    member_keys = np.stack(
+        [np.asarray(jax.random.split(jax.random.PRNGKey(i), 6)) for i in range(4)]
+    ).reshape(4, 2, 3, 2)
+    return {
+        "members/w": rng.standard_normal((4, 3)).astype(np.float32),
+        "members/key": member_keys.astype(np.uint32),
+        "hparams/actor_lr": np.array([1e-3, 2e-3, 3e-3, 4e-3], np.float32),
+        "hparams/seed": np.array([10, 11, 12, 13], np.int32),
+        "fitness": np.array([1.0, 9.0, 5.0, 7.0], np.float32),
+        "pbt_key": np.asarray(jax.random.PRNGKey(42)).astype(np.uint32),
+        "updates_done": np.asarray(3, np.int64),
+    }
+
+
+def test_grow_4_to_8_clones_fittest_with_perturbed_hparams_and_fresh_keys():
+    raw = _store_4()
+    out = pop_elastic.resize_arrays(dict(raw), 8, perturb_scale=0.2)
+    # Existing members survive bit-identical — the grow half of the pin.
+    for key in ("members/w", "members/key", "hparams/actor_lr",
+                "hparams/seed", "fitness"):
+        assert out[key][:4].tobytes() == raw[key].tobytes(), key
+        assert out[key].shape[0] == 8
+    # New slots clone the fittest cyclically: fitness [1, 9, 5, 7] ranks
+    # members [1, 3, 2, 0], so slots 4..7 source from exactly that order.
+    src = [1, 3, 2, 0]
+    assert out["fitness"][4:].tolist() == [raw["fitness"][s] for s in src]
+    assert out["members/w"][4:].tobytes() == raw["members/w"][src].tobytes()
+    # Perturbable hparams explore x(1 +- scale); seed is never perturbed.
+    for slot, s in zip(range(4, 8), src):
+        source = float(raw["hparams/actor_lr"][s])
+        cloned = float(out["hparams/actor_lr"][slot])
+        assert min(abs(cloned - source * 1.2), abs(cloned - source * 0.8)) < 1e-9, slot
+    assert out["hparams/seed"][4:].tolist() == [
+        int(raw["hparams/seed"][s]) for s in src
+    ]
+    # A clone explores, it never replays its source: fresh, pairwise-distinct
+    # PRNG streams for every new slot.
+    clone_keys = [out["members/key"][slot].tobytes() for slot in range(4, 8)]
+    assert len(set(clone_keys)) == 4
+    for slot, s in zip(range(4, 8), src):
+        assert out["members/key"][slot].tobytes() != raw["members/key"][s].tobytes()
+        assert out["members/key"][slot].dtype == raw["members/key"].dtype
+    # The explore randomness is consumed: the stored pbt key advances.
+    assert out["pbt_key"].tobytes() != raw["pbt_key"].tobytes()
+
+
+def test_resize_is_deterministic():
+    # The same store resized twice must produce bit-identical results — the
+    # soak's digest-identity checks depend on it.
+    for new_size in (2, 8):
+        first = pop_elastic.resize_arrays(dict(_store_4()), new_size)
+        second = pop_elastic.resize_arrays(dict(_store_4()), new_size)
+        assert sorted(first) == sorted(second)
+        for key in first:
+            assert np.asarray(first[key]).tobytes() == np.asarray(
+                second[key]
+            ).tobytes(), key
+
+
+def test_raw_resize_transform_follows_config_size():
+    config = {"arch": {"population": {"size": 4, "max_size": 8}}}
+    transform = pop_elastic.raw_resize_transform(config)
+    out = transform(dict(_store_8()))
+    assert out["fitness"].shape[0] == 4
+    # Identity when the store already matches the config.
+    sized = _store_4()
+    assert transform(sized) is sized
+
+
+# ---------------------------------------------------------------------------
+# Refusals: below one member, past max_size, impossible device plans
+# ---------------------------------------------------------------------------
+
+
+def test_resize_refusals_are_typed():
+    with pytest.raises(ElasticResizeError, match="below one member"):
+        pop_elastic.validate_resize(4, 0)
+    with pytest.raises(ElasticResizeError, match="max_size caps it at 6"):
+        pop_elastic.resize_arrays(dict(_store_4()), 8, max_size=6)
+    with pytest.raises(ElasticResizeError, match="is a shrink"):
+        pop_elastic.select_survivors([1.0, 2.0], 3)
+    with pytest.raises(ElasticResizeError, match="below one device"):
+        res_elastic.plan_resize("shrink", 1)
+    with pytest.raises(ElasticResizeError, match="unknown resize action"):
+        res_elastic.plan_resize("sideways", 8)
+    with pytest.raises(ElasticResizeError, match="cannot plan"):
+        pop_elastic.plan_population_size(
+            {"arch": {"population": {"size": 4}}}, 4, 0
+        )
+
+
+def test_plan_population_size_scales_and_clamps():
+    config = {"arch": {"population": {"size": 8, "max_size": 6}}}
+    assert pop_elastic.plan_population_size(config, 4, 8) == 4
+    # A grow past the cap degrades to the cap in the override computation
+    # (the transforms refuse; the relaunch plan clamps).
+    assert pop_elastic.plan_population_size(config, 16, 8) == 6
+    # Scaling never plans below one member.
+    assert pop_elastic.plan_population_size(
+        {"arch": {"population": {"size": 2}}}, 1, 8
+    ) == 1
+
+
+def test_population_resize_overrides_reshape_hparam_lists():
+    config = {
+        "arch": {
+            "population": {
+                "size": 4,
+                "hparams": {
+                    "system.actor_lr": [1e-3, 2e-3, 3e-3, 4e-3],
+                    "system.seed": 7,  # scalars broadcast: no override
+                },
+            }
+        }
+    }
+    stats = {"member_fitness": [1.0, 9.0, 5.0, 7.0]}
+    shrunk = pop_elastic.population_resize_overrides(
+        config, target_devices=4, from_devices=8, stats=stats
+    )
+    # Survivors of a 4 -> 2 shrink are the fittest members 1 and 3: the
+    # per-member list must re-shape to THEIR values or composing the length-4
+    # list against P=2 refuses before the restore ever runs.
+    assert shrunk == [
+        "arch.population.size=2",
+        "arch.population.hparams.system.actor_lr=[0.002,0.004]",
+    ]
+    grown = pop_elastic.population_resize_overrides(
+        config, target_devices=16, from_devices=8, stats=stats
+    )
+    assert grown[0] == "arch.population.size=8"
+    # Clone sources (fittest first, cyclic): [0,1,2,3] + [1,3,2,0].
+    assert grown[1] == (
+        "arch.population.hparams.system.actor_lr="
+        "[0.001,0.002,0.003,0.004,0.002,0.004,0.003,0.001]"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Topology re-derivation + the resize-request hand-off (jax-free host logic)
+# ---------------------------------------------------------------------------
+
+
+def test_survivor_overrides_rederive_mesh_from_job_overrides():
+    # A pinned data axis is rescaled for the survivors...
+    assert res_elastic.survivor_overrides(4, ["arch.mesh.data=8"]) == [
+        "arch.mesh.data=4"
+    ]
+    # ...a -1 axis already absorbs whatever the child probes...
+    assert res_elastic.survivor_overrides(4, []) == ["arch.mesh.data=-1"]
+    # ...and explicit role assignments pin device ids from the dead topology,
+    # so they are dropped and re-derived.
+    assert res_elastic.survivor_overrides(
+        4, ["arch.roles={learner: [0]}"]
+    ) == ["arch.roles=~", "arch.mesh.data=-1"]
+
+
+def test_resize_request_roundtrip_and_one_shot_consume(tmp_path):
+    directory = str(tmp_path / "emergency")
+    path = res_elastic.write_resize_request(
+        directory,
+        action="shrink",
+        from_devices=8,
+        target_devices=4,
+        window=1,
+        step=128,
+        platform="cpu",
+        overrides=["arch.mesh.data=-1", "arch.population.size=2"],
+    )
+    assert os.path.basename(path) == res_elastic.RESIZE_REQUEST_NAME
+    request = res_elastic.read_resize_request(directory)
+    assert request["format"] == 1
+    assert request["action"] == "shrink"
+    assert (request["from_devices"], request["target_devices"]) == (8, 4)
+    assert (request["window"], request["step"]) == (1, 128)
+    assert request["overrides"] == ["arch.mesh.data=-1", "arch.population.size=2"]
+    # One-shot: the consume removes the request so a later rc-89 (the grow
+    # leg of a soak cycle) is answered by ITS OWN request, never a stale one.
+    assert res_elastic.consume_resize_request(directory) == request
+    assert res_elastic.read_resize_request(directory) is None
+    assert res_elastic.consume_resize_request(directory) is None
+    assert res_elastic.read_resize_request(str(tmp_path / "missing")) is None
+
+
+# ---------------------------------------------------------------------------
+# The --elastic relaunch policy (stub children: no jax in any subprocess)
+# ---------------------------------------------------------------------------
+
+# Logs every invocation's extra argv + the env the launcher handed it, exits
+# 89 on the first run and 0 on the relaunch.
+_CHILD_89 = r"""
+import json, os, sys
+state = sys.argv[1]
+with open(os.path.join(state, "invocations.jsonl"), "a") as f:
+    f.write(json.dumps({
+        "argv": sys.argv[2:],
+        "xla": os.environ.get("XLA_FLAGS", ""),
+        "fault": "STOIX_TPU_FAULT" in os.environ,
+    }) + "\n")
+marker = os.path.join(state, "died")
+if os.path.exists(marker):
+    sys.exit(0)
+open(marker, "w").close()
+sys.exit(89)
+"""
+
+_CHILD_87 = _CHILD_89.replace("sys.exit(89)", "sys.exit(87)")
+
+
+def _invocations(state: str) -> list:
+    with open(os.path.join(state, "invocations.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+def _elastic_env() -> dict:
+    env = dict(os.environ)
+    env["STOIX_TPU_FAULT"] = "shrink:1"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8 --xla_cpu_x=y"
+    return env
+
+
+def test_run_supervised_elastic_relaunches_from_resize_request(tmp_path):
+    from stoix_tpu.launcher import run_supervised
+
+    state = str(tmp_path)
+    res_elastic.write_resize_request(
+        state,
+        action="shrink",
+        from_devices=8,
+        target_devices=4,
+        window=1,
+        step=128,
+        platform="cpu",
+        overrides=["arch.mesh.data=-1", "arch.population.size=2"],
+    )
+    resume = ["logger.checkpointing.load_model=true"]
+    rc = run_supervised(
+        [sys.executable, "-c", _CHILD_89, state],
+        env=_elastic_env(),
+        max_relaunches=2,
+        resume_overrides=resume,
+        elastic=True,
+        fleet_resume_path=state,
+    )
+    assert rc == 0
+    first, second = _invocations(state)
+    assert first["argv"] == [] and first["fault"]
+    # The relaunch carries the restore overrides, the request's re-derived
+    # topology, and the fault disarm — in exactly that precedence order.
+    assert second["argv"] == [
+        "logger.checkpointing.load_model=true",
+        "arch.mesh.data=-1",
+        "arch.population.size=2",
+        "arch.fault_spec=~",
+    ]
+    # The armed fault is consumed and the cpu device count forced to the
+    # target; unrelated XLA flags survive.
+    assert not second["fault"]
+    assert "--xla_force_host_platform_device_count=4" in second["xla"].split()
+    assert "--xla_cpu_x=y" in second["xla"].split()
+    # One-shot: the request is gone.
+    assert res_elastic.read_resize_request(state) is None
+
+
+def test_run_supervised_without_elastic_is_bit_identical_to_fixed(tmp_path):
+    # The acceptance pin: with --elastic off, rc 89 is FINAL — one
+    # invocation, no relaunch, and the request stays untouched on disk.
+    from stoix_tpu.launcher import run_supervised
+
+    state = str(tmp_path)
+    res_elastic.write_resize_request(
+        state, action="shrink", from_devices=8, target_devices=4,
+        window=1, step=128, platform="cpu", overrides=[],
+    )
+    rc = run_supervised(
+        [sys.executable, "-c", _CHILD_89, state],
+        env=_elastic_env(),
+        max_relaunches=2,
+        resume_overrides=["logger.checkpointing.load_model=true"],
+        fleet_resume_path=state,
+    )
+    assert rc == 89
+    assert len(_invocations(state)) == 1
+    assert res_elastic.read_resize_request(state) is not None
+
+
+def test_run_supervised_elastic_without_request_gives_up(tmp_path):
+    # rc 89 with no hand-off on disk means the dying incarnation failed
+    # before the request was written: final, not a relaunch loop.
+    from stoix_tpu.launcher import run_supervised
+
+    state = str(tmp_path)
+    rc = run_supervised(
+        [sys.executable, "-c", _CHILD_89, state],
+        env=_elastic_env(),
+        max_relaunches=2,
+        resume_overrides=[],
+        elastic=True,
+        fleet_resume_path=state,
+    )
+    assert rc == 89
+    assert len(_invocations(state)) == 1
+
+
+def test_run_supervised_elastic_partition_reprobes_survivors(tmp_path, monkeypatch):
+    # rc 87 with --elastic: the mesh is re-derived from the devices the
+    # re-probe actually finds, never replayed from the dead topology.
+    from stoix_tpu import launcher
+    from stoix_tpu.resilience import preflight
+
+    monkeypatch.setattr(
+        preflight, "probe_backend",
+        lambda: types.SimpleNamespace(device_count=4, platform="cpu", attempts=1),
+    )
+    state = str(tmp_path)
+    rc = launcher.run_supervised(
+        [sys.executable, "-c", _CHILD_87, state],
+        env=_elastic_env(),
+        max_relaunches=2,
+        resume_overrides=["logger.checkpointing.load_model=true"],
+        elastic=True,
+        fleet_resume_path=state,
+        job_overrides=["arch.mesh.data=8"],
+    )
+    assert rc == 0
+    first, second = _invocations(state)
+    assert first["argv"] == []
+    assert second["argv"] == [
+        "logger.checkpointing.load_model=true",
+        "arch.mesh.data=4",
+    ]
+    assert not second["fault"]  # _elastic_child_env strips the armed fault
+
+
+def test_run_supervised_elastic_probe_failure_degrades_to_fixed(tmp_path, monkeypatch):
+    from stoix_tpu import launcher
+    from stoix_tpu.resilience import preflight
+
+    def _boom():
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(preflight, "probe_backend", _boom)
+    state = str(tmp_path)
+    rc = launcher.run_supervised(
+        [sys.executable, "-c", _CHILD_87, state],
+        env=_elastic_env(),
+        max_relaunches=2,
+        resume_overrides=["logger.checkpointing.load_model=true"],
+        elastic=True,
+        fleet_resume_path=state,
+        job_overrides=["arch.mesh.data=8"],
+    )
+    assert rc == 0
+    _, second = _invocations(state)
+    # A failed re-probe degrades to the fixed-topology relaunch.
+    assert second["argv"] == ["logger.checkpointing.load_model=true"]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: one fault-injected preempt -> shrink -> resume -> grow cycle
+# ---------------------------------------------------------------------------
+
+
+def _load_soak():
+    spec = importlib.util.spec_from_file_location(
+        "stoix_tpu_soak_under_test", os.path.join(REPO, "scripts", "soak.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.mark.slow
+def test_soak_cycle_shrink_then_grow_end_to_end(tmp_path):
+    soak = _load_soak()
+    problems = soak.run_cycle(str(tmp_path), devices=8, windows=3)
+    assert problems == [], "\n".join(problems)

@@ -3,15 +3,21 @@ virtual CPU devices, one GLOBAL 8-device mesh over Gloo collectives — the full
 PPO training loop (learn, metrics fetch, evaluation, coordinator gating) must
 run and learn. This is the capability the reference explicitly lacks
 (reference README.md:57, sebulba/ff_ppo.py:808-810).
+
+Shares tests/gloo_precheck.py's harness support: the session-cached
+two-process spawn precheck (skip when the platform cannot run jax.distributed
+at all), and the bounded retry + typed gloo-flake SKIP for the CPU backend's
+known transport misorder — infra aborts never red-line this suite.
 """
 
 import os
-import socket
 import subprocess
 import sys
 import textwrap
 
 import pytest
+
+import gloo_precheck
 
 WORKER = textwrap.dedent(
     """
@@ -55,51 +61,55 @@ WORKER = textwrap.dedent(
 )
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 @pytest.mark.slow
-def test_two_process_global_mesh_training(tmp_path):
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+def test_two_process_global_mesh_training(tmp_path, tmp_path_factory):
+    gloo_precheck.require_two_process_jax(tmp_path_factory)
+    repo_root = gloo_precheck.REPO
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER.format(repo_root=repo_root))
-    port = _free_port()
+    env = gloo_precheck.clean_env()
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo_root  # drop site hooks that pre-initialise jax
-    ckpt_dir = tmp_path / "shared"
-    ckpt_dir.mkdir()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), str(i), str(port), str(ckpt_dir)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            env=env,
-            text=True,
-        )
-        for i in range(2)
-    ]
-    try:
-        outputs = [p.communicate(timeout=600)[0] for p in procs]
-    except subprocess.TimeoutExpired:
-        # A collective deadlock leaves the peer blocked: kill, then harvest the
-        # partial output (the only evidence of where the hang occurred).
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        outputs = [p.communicate()[0] for p in procs]
-        raise AssertionError(
-            "multi-process run deadlocked; partial outputs:\n"
-            + "\n---\n".join(o[-2000:] for o in outputs)
-        )
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+    attempts = 3
+    outputs: list = []
+    for attempt in range(attempts):
+        port = gloo_precheck.free_port()
+        ckpt_dir = tmp_path / f"shared{attempt}"
+        ckpt_dir.mkdir()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(worker), str(i), str(port), str(ckpt_dir)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                env=env,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        try:
+            outputs = [p.communicate(timeout=600)[0] for p in procs]
+        except subprocess.TimeoutExpired:
+            # A collective deadlock leaves the peer blocked: kill, then harvest
+            # the partial output (the only evidence of where the hang occurred).
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            outputs = [p.communicate()[0] for p in procs]
+            raise AssertionError(
+                "multi-process run deadlocked; partial outputs:\n"
+                + "\n---\n".join(o[-2000:] for o in outputs)
+            )
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        if any(p.returncode != 0 for p in procs) and gloo_precheck.is_gloo_flake(*outputs):
+            continue  # transport abort, not product: retry on a fresh port
+        break
+    else:
+        # Infra, not product: every attempt died in the transport — skip with
+        # the typed gloo-flake reason instead of red-lining CI.
+        gloo_precheck.skip_if_gloo_flake(*outputs, attempts=attempts)
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert "RESULT" in out
