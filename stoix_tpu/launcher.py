@@ -389,6 +389,68 @@ def run_supervised(
         )
 
 
+def loop_main(argv: List[str]) -> int:
+    """`launcher.py loop` (docs/DESIGN.md §2.15): run the closed
+    train→serve→experience loop from a composed loop config and print ONE
+    JSON report line. Returns the process exit code."""
+    import json
+
+    from stoix_tpu.utils import config as config_lib
+
+    parser = argparse.ArgumentParser(
+        prog="stoix_tpu.launcher loop",
+        description="closed train→serve→experience loop (stoix_tpu/loop)",
+    )
+    parser.add_argument(
+        "--config",
+        default="default/loop.yaml",
+        help="loop root yaml under stoix_tpu/configs (default: default/loop.yaml)",
+    )
+    parser.add_argument(
+        "--frozen",
+        action="store_true",
+        help="control arm: identical traffic and ingest, learner never "
+        "updates and nothing is published (the bench --loop baseline)",
+    )
+    parser.add_argument(
+        "--duration",
+        type=float,
+        default=None,
+        metavar="S",
+        help="override arch.loop.traffic.duration_s",
+    )
+    parser.add_argument("overrides", nargs="*", help="key=value overrides")
+    args = parser.parse_args(argv)
+
+    overrides = list(args.overrides)
+    if args.duration is not None:
+        overrides.append(f"arch.loop.traffic.duration_s={args.duration}")
+    config = config_lib.compose(
+        config_lib.default_config_dir(), args.config, overrides
+    )
+    from stoix_tpu.loop import run_loop
+    from stoix_tpu.resilience import faultinject
+
+    # Arm the chaos plan exactly like the serve/train entry points (env var
+    # wins over arch.fault_spec): the §2.15 drill arms
+    # `replica_kill:N,replica_slow:S,feedback_stall:S` here.
+    faultinject.configure((config.get("arch") or {}).get("fault_spec"))
+    from stoix_tpu.observability import get_status_board, server_from_config
+
+    ops_server = server_from_config(dict(config.arch.serve.get("http") or {}))
+    get_status_board().update(
+        {"run_id": "loop", "architecture": "loop", "system": "closed-loop"}
+    )
+    try:
+        report = run_loop(config, frozen=args.frozen)
+        # The JSON line IS this mode's output contract, like serve --loadgen.
+        print(json.dumps(report), flush=True)  # noqa: STX002 — loop stdout contract
+    finally:
+        if ops_server is not None:
+            ops_server.close()
+    return 1 if report.get("silent_drops") else 0
+
+
 def serve_main(argv: List[str]) -> int:
     """`launcher.py serve` (docs/DESIGN.md §2.8): run the policy server from
     a composed serve config. Returns the process exit code."""
@@ -511,6 +573,10 @@ def main(argv: List[str] | None = None) -> None:
         # Subcommand dispatch: `launcher.py serve [...]` is the serving entry
         # point (docs/DESIGN.md §2.8); the batch-launch surface is unchanged.
         sys.exit(serve_main(argv[1:]))
+    if argv and argv[0] == "loop":
+        # `launcher.py loop [...]`: the closed train→serve→experience loop
+        # (docs/DESIGN.md §2.15).
+        sys.exit(loop_main(argv[1:]))
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--systems", nargs="+", required=True, help="module paths")
     parser.add_argument("--envs", nargs="+", required=True, help="env group names")

@@ -1,0 +1,36 @@
+"""stoix_tpu.loop — the self-healing closed production loop
+(docs/DESIGN.md §2.15): train → serve → experience → train, behind a
+health-checked serve-fleet router.
+
+  * router.py     — FleetRouter / DirectRouter: health-checked routing,
+                    shed backoff, failover, optional tail hedging, typed
+                    degraded modes.
+  * recorder.py   — ExperienceRecorder: non-blocking transition capture
+                    with drop-oldest backpressure into OffPolicyPipeline.
+  * learner.py    — LoopLearner: continuous REINFORCE updates on live
+                    experience from the sharded replay service.
+  * publisher.py  — FleetPublisher: canary-gated fleet-wide parameter
+                    pushes with all-or-nothing rollback.
+  * runner.py     — run_loop(): the composition root + traffic driver.
+  * errors.py     — LoopError / FleetUnavailableError.
+"""
+
+from stoix_tpu.loop.errors import FleetUnavailableError, LoopError
+from stoix_tpu.loop.learner import LoopLearner
+from stoix_tpu.loop.publisher import FleetPublisher
+from stoix_tpu.loop.recorder import ExperienceRecorder
+from stoix_tpu.loop.router import DirectRouter, FleetRouter, ReplicaHandle, RouterFuture
+from stoix_tpu.loop.runner import run_loop
+
+__all__ = [
+    "DirectRouter",
+    "ExperienceRecorder",
+    "FleetPublisher",
+    "FleetRouter",
+    "FleetUnavailableError",
+    "LoopError",
+    "LoopLearner",
+    "ReplicaHandle",
+    "RouterFuture",
+    "run_loop",
+]

@@ -1,0 +1,30 @@
+"""Typed closed-loop failures (docs/DESIGN.md §2.15).
+
+The degraded-mode contract: every way a request can fail to be answered has
+a NAMED error — callers (and the zero-silent-drop accounting in the loop
+runner) distinguish "the fleet is gone" from "my retry budget ran out" from
+"my batch failed" without string matching.
+"""
+
+from __future__ import annotations
+
+from stoix_tpu.serve.errors import ServeError
+
+
+class LoopError(ServeError):
+    """Base class for closed-loop (stoix_tpu/loop) failures."""
+
+
+class FleetUnavailableError(LoopError):
+    """Every replica is ejected/dead: the all-replicas-down degraded mode.
+    The router fails FAST with this instead of burning retry budgets against
+    a fleet that cannot answer — callers decide whether to wait for
+    re-admission or surface the outage."""
+
+    def __init__(self, total: int, ejected: int):
+        self.total = int(total)
+        self.ejected = int(ejected)
+        super().__init__(
+            f"no healthy serve replicas: {ejected}/{total} ejected — "
+            f"fleet unavailable (fail-fast; replicas re-admit on recovery)"
+        )

@@ -1,0 +1,139 @@
+"""LoopLearner: continuous policy-gradient training on live experience
+(docs/DESIGN.md §2.15).
+
+The Sebulba learner role inside the closed loop: poll the OffPolicyPipeline
+for recorder batches, ingest them into the sharded replay service, and run a
+jitted REINFORCE-with-mean-baseline update on samples — the actions in the
+buffer were SAMPLED by the serve fleet (the loop config serves with
+greedy=false precisely so live traffic carries exploration), so the
+log-prob-weighted advantage estimator is on-policy-correct modulo replay
+staleness, which the mean baseline and small buffer keep benign.
+
+The learner owns the params; the runner snapshots `params` on its publish
+cadence, writes a checkpoint step, and the FleetPublisher pushes it through
+the canary path. `frozen=True` (the bench control arm) ingests but never
+updates — matched ingest load, zero learning, so the return delta isolates
+the policy improvement.
+
+One jit, built at construction (STX012); the sampled batch is fetched to
+host before the update so the program runs on the learner's default device
+regardless of how many replay shards the mesh spans.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from stoix_tpu.observability import get_logger, get_registry
+
+
+class LoopLearner:
+    def __init__(
+        self,
+        apply_fn: Any,
+        params: Any,
+        service: Any,  # replay.ShardedReplayService
+        pipeline: Any,  # sebulba.core.OffPolicyPipeline
+        learning_rate: float = 3e-3,
+        frozen: bool = False,
+        seed: int = 0,
+    ):
+        self._service = service
+        self._pipeline = pipeline
+        self.frozen = bool(frozen)
+        self.params = params
+        self._optimizer = optax.adam(float(learning_rate))
+        self._opt_state = self._optimizer.init(params)
+        self._key = jax.random.PRNGKey(int(seed))
+        self._sharding = NamedSharding(service.mesh, P(service.axis))
+        # One lock covers the whole learner step and the stats reads: the
+        # update path normally runs only on the learner thread, but tests
+        # drive step_once() directly and the runner reads progress counters
+        # concurrently.
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="loop-learner", daemon=True
+        )
+        self._log = get_logger("stoix_tpu.loop")
+        self._m_updates = get_registry().counter(
+            "stoix_tpu_loop_learner_updates_total",
+            "Policy-gradient updates applied by the loop learner",
+        )
+        self.n_updates = 0
+        self.n_ingested = 0
+        self.last_loss = float("nan")
+
+        def _update(params: Any, opt_state: Any, batch: Any):
+            def loss_fn(p: Any) -> jax.Array:
+                logits = apply_fn(p, batch.obs).logits
+                logp = jax.nn.log_softmax(logits)
+                action = jnp.asarray(batch.action, jnp.int32)
+                chosen = jnp.take_along_axis(logp, action[:, None], axis=-1)[:, 0]
+                reward = jnp.asarray(batch.reward, jnp.float32)
+                advantage = reward - jnp.mean(reward)
+                return -jnp.mean(chosen * advantage)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, new_opt_state = self._optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt_state, loss
+
+        self._update = jax.jit(_update)
+
+    def step_once(self, poll_timeout_s: float = 0.05) -> int:
+        """One learner tick: ingest whatever arrived — each recorder batch
+        (leading dim = flush_batch, divisible by the shard count, enforced at
+        build) is placed as a P(axis)-sharded global array — then (unless
+        frozen) one update if the buffer can sample. Returns updates applied
+        (0/1). Exposed for deterministic tests; `_run` just loops it."""
+        payloads = self._pipeline.poll(timeout=poll_timeout_s)
+        with self._lock:
+            for _actor_id, payload in payloads:
+                self._service.add(jax.device_put(payload, self._sharding))
+                self.n_ingested += int(jax.tree.leaves(payload)[0].shape[0])
+            if self.frozen or not self._service.can_sample():
+                return 0
+            self._key, sample_key = jax.random.split(self._key)
+            sample = self._service.sample(sample_key)
+            # Host fetch: the update runs on the default device; the sampled
+            # minibatch is tiny next to the ring it was drawn from.
+            batch = jax.tree.map(np.asarray, sample.experience)
+            self.params, self._opt_state, loss = self._update(
+                self.params, self._opt_state, batch
+            )
+            self.last_loss = float(loss)
+            self.n_updates += 1
+        self._m_updates.inc()
+        return 1
+
+    # -- lifecycle ------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.step_once()
+
+    def start(self) -> "LoopLearner":
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=join_timeout)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "frozen": self.frozen,
+                "updates": self.n_updates,
+                "transitions_ingested": self.n_ingested,
+                "last_loss": (
+                    None if np.isnan(self.last_loss) else round(self.last_loss, 6)
+                ),
+            }

@@ -1,0 +1,141 @@
+"""FleetPublisher: one checkpoint push, N replicas, zero torn fleets
+(docs/DESIGN.md §2.15).
+
+Each replica gets its own ParameterWatcher (the EXISTING poll → load →
+canary → atomic-swap path, serve/hotswap.py) but the watchers' threads are
+never started — the publisher drives `check_now()` on every replica
+synchronously, which is what makes the fleet-wide transaction possible:
+
+  1. snapshot every replica's (step, device params reference) — cheap, the
+     engine hands back the installed reference;
+  2. drive each replica's check_now(). Each one independently loads,
+     canary-validates, and swaps — `swap_poison` and any per-replica load
+     failure fire INSIDE this existing path;
+  3. if the outcomes TORE the fleet (some replicas accepted the step, at
+     least one rejected it), roll every swapped replica back to its
+     snapshot: engine.set_params(old reference) + watcher.current_step
+     reset. The whole fleet serves the OLD params bitwise — a canary
+     rejection is fleet-wide, never per-replica.
+
+A push every replica rejects needs no rollback (nothing swapped); a push
+every replica accepts commits. Rollbacks are counted in
+`stoix_tpu_loop_canary_rollbacks_total` and the poisoned step is retried by
+the next publish (the poison fault is one-shot; a genuinely bad checkpoint
+keeps being rejected fleet-wide, which is the correct steady state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from stoix_tpu.observability import get_logger, get_registry
+from stoix_tpu.serve.hotswap import ParameterWatcher
+
+
+class FleetPublisher:
+    def __init__(self, servers: Sequence[Any], source: Any, initial_step: int, canary: bool = True):
+        # One UNSTARTED watcher per replica: check_now() is the only driver,
+        # so a publish is always a deliberate, fleet-scoped event.
+        self.watchers: List[ParameterWatcher] = [
+            ParameterWatcher(
+                source,
+                server.engine,
+                server.telemetry,
+                current_step=int(initial_step),
+                canary=canary,
+            )
+            for server in servers
+        ]
+        self._servers = list(servers)
+        self._source = source
+        self._canary = bool(canary)
+        self._log = get_logger("stoix_tpu.loop")
+        self._m_publishes = get_registry().counter(
+            "stoix_tpu_loop_publishes_total",
+            "Fleet-wide parameter pushes attempted",
+        )
+        self._m_rollbacks = get_registry().counter(
+            "stoix_tpu_loop_canary_rollbacks_total",
+            "Fleet-wide rollbacks after a partially-rejected push",
+        )
+        self.n_publishes = 0
+        self.n_swaps = 0
+        self.n_rollbacks = 0
+
+    @property
+    def current_step(self) -> int:
+        """The fleet's serving step (identical across replicas by
+        construction: every publish either commits or rolls back all)."""
+        return self.watchers[0].current_step
+
+    def rebind(self, ordinal: int, server: Any) -> None:
+        """Point one ordinal at a RESTARTED server (the runner's self-healing
+        path): fresh watcher bound to the new engine, synced to the fleet's
+        serving step so the next publish treats the newcomer like everyone
+        else."""
+        self._servers[ordinal] = server
+        self.watchers[ordinal] = ParameterWatcher(
+            self._source,
+            server.engine,
+            server.telemetry,
+            current_step=self.current_step,
+            canary=self._canary,
+        )
+
+    def publish(self) -> Optional[int]:
+        """One fleet-wide push attempt. Returns the newly-serving step when
+        the whole fleet committed, None when there was nothing new or the
+        push was rejected (and, if needed, rolled back)."""
+        latest = self._source.latest_step()
+        if latest is None or latest <= self.current_step:
+            return None
+        self.n_publishes += 1
+        self._m_publishes.inc()
+        snapshots = [
+            (watcher.current_step, server.engine.get_params())
+            for watcher, server in zip(self.watchers, self._servers)
+        ]
+        # Pin every replica to the step the gate resolved: independent
+        # latest_step() scans can disagree while the learner's async save is
+        # landing, and a disagreement here reads as a torn push (spurious
+        # fleet-wide rollback) when no replica actually rejected anything.
+        outcomes = [watcher.check_now(target_step=latest) for watcher in self.watchers]
+        accepted = [step for step in outcomes if step is not None]
+        if len(accepted) == len(outcomes):
+            self.n_swaps += 1
+            return accepted[0]
+        if not accepted:
+            # Unanimous rejection: nothing swapped, nothing to roll back —
+            # the fleet already agrees on the old step.
+            self._log.warning(
+                "[loop] publish of step %d rejected by all %d replica(s) — "
+                "fleet keeps serving step %d",
+                latest, len(outcomes), self.current_step,
+            )
+            return None
+        # Torn outcome: roll the swapped replicas back to their snapshots.
+        rolled = 0
+        for (old_step, old_params), outcome, watcher, server in zip(
+            snapshots, outcomes, self.watchers, self._servers
+        ):
+            if outcome is None:
+                continue
+            server.engine.set_params(old_params)
+            watcher.current_step = old_step
+            rolled += 1
+        self.n_rollbacks += 1
+        self._m_rollbacks.inc()
+        self._log.warning(
+            "[loop] publish of step %d TORN (%d/%d accepted) — rolled %d "
+            "replica(s) back to step %d; fleet-wide canary rollback",
+            latest, len(accepted), len(outcomes), rolled, self.current_step,
+        )
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "step": self.current_step,
+            "publishes": self.n_publishes,
+            "commits": self.n_swaps,
+            "rollbacks": self.n_rollbacks,
+        }

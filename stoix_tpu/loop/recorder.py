@@ -1,0 +1,163 @@
+"""ExperienceRecorder: request/response/reward streams → replay transitions
+(docs/DESIGN.md §2.15).
+
+The serve path and the replay path run at different speeds and MUST stay
+decoupled: `record()` is a lock-guarded deque append — never a blocking
+queue put — so a stalled replay ingest can never add latency to a live
+response. Backpressure is explicit drop-oldest: when the bounded buffer is
+full the OLDEST unfed transition is discarded and counted
+(`stoix_tpu_loop_experience_dropped_total`); fresh experience is worth more
+than stale experience, and wedging the serve path is never an option.
+
+A feeder thread batches `flush_batch` transitions (host-stacked once, off
+the serve path) and pushes them into the Sebulba OffPolicyPipeline with a
+SHORT timeout — a full pipeline (learner stalled) bounces the batch back
+into the buffer rather than blocking the feeder forever. The
+`feedback_stall:S` fault injects exactly that wedge into the feeder
+(resilience/faultinject.py), and tests/test_loop.py pins that serving
+latency is unaffected while it holds.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, List, Optional
+
+import jax
+import numpy as np
+
+from stoix_tpu.observability import get_logger, get_registry
+from stoix_tpu.resilience import faultinject
+
+
+class ExperienceRecorder:
+    """Bounded, drop-oldest transition capture feeding OffPolicyPipeline."""
+
+    def __init__(
+        self,
+        pipeline: Any,  # sebulba.core.OffPolicyPipeline
+        flush_batch: int = 64,
+        capacity: int = 4096,
+        actor_id: int = 0,
+        push_timeout_s: float = 0.2,
+    ):
+        if capacity < flush_batch:
+            raise ValueError(
+                f"recorder capacity {capacity} < flush_batch {flush_batch}"
+            )
+        self._pipeline = pipeline
+        self.flush_batch = int(flush_batch)
+        self.capacity = int(capacity)
+        self.actor_id = int(actor_id)
+        self.push_timeout_s = float(push_timeout_s)
+        self._buf: Deque[Any] = deque()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._feed_loop, name="loop-recorder", daemon=True
+        )
+        self._log = get_logger("stoix_tpu.loop")
+        registry = get_registry()
+        self._m_recorded = registry.counter(
+            "stoix_tpu_loop_experience_recorded_total",
+            "Transitions captured from the serve path",
+        )
+        self._m_dropped = registry.counter(
+            "stoix_tpu_loop_experience_dropped_total",
+            "Transitions dropped oldest-first under replay backpressure",
+        )
+        self._m_fed = registry.counter(
+            "stoix_tpu_loop_experience_fed_total",
+            "Transitions handed to the off-policy pipeline",
+        )
+        self.n_recorded = 0
+        self.n_dropped = 0
+        self.n_fed = 0
+        self.n_push_timeouts = 0
+
+    # -- serve-path side (non-blocking, any thread) ---------------------------
+    def record(self, transition: Any) -> None:
+        """Append one transition (host pytree). NEVER blocks: a full buffer
+        drops its oldest entry, counted."""
+        with self._lock:
+            if len(self._buf) >= self.capacity:
+                self._buf.popleft()
+                self.n_dropped += 1
+                self._m_dropped.inc()
+            self._buf.append(transition)
+            self.n_recorded += 1
+        self._m_recorded.inc()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    # -- feeder side ----------------------------------------------------------
+    def _take_batch(self) -> Optional[List[Any]]:
+        with self._lock:
+            if len(self._buf) < self.flush_batch:
+                return None
+            return [self._buf.popleft() for _ in range(self.flush_batch)]
+
+    def _requeue_front(self, batch: List[Any]) -> None:
+        """Return a bounced batch to the FRONT of the buffer (it holds the
+        oldest transitions); anything the capacity cannot take back is
+        dropped-oldest, counted."""
+        with self._lock:
+            for transition in reversed(batch):
+                self._buf.appendleft(transition)
+            while len(self._buf) > self.capacity:
+                self._buf.popleft()
+                self.n_dropped += 1
+                self._m_dropped.inc()
+
+    def _feed_loop(self) -> None:
+        while not self._stop.is_set():
+            # Chaos (`feedback_stall:S`): wedge THIS thread — the bounded
+            # buffer and the serve path must ride it out.
+            faultinject.maybe_stall_feedback(should_abort=self._stop.is_set)
+            batch = self._take_batch()
+            if batch is None:
+                time.sleep(0.005)
+                continue
+            stacked = jax.tree.map(
+                lambda *leaves: np.stack([np.asarray(leaf) for leaf in leaves]),
+                *batch,
+            )
+            try:
+                self._pipeline.push(
+                    self.actor_id, stacked, timeout=self.push_timeout_s
+                )
+                with self._lock:
+                    self.n_fed += len(batch)
+                self._m_fed.inc(len(batch))
+            except queue.Full:
+                # Learner stalled: bounce the batch back under the bound and
+                # keep serving — backpressure becomes drops, not wedges.
+                with self._lock:
+                    self.n_push_timeouts += 1
+                self._requeue_front(batch)
+                time.sleep(0.01)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "ExperienceRecorder":
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=join_timeout)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "recorded": self.n_recorded,
+                "dropped": self.n_dropped,
+                "fed": self.n_fed,
+                "push_timeouts": self.n_push_timeouts,
+                "depth": len(self._buf),
+            }

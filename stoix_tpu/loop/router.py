@@ -1,0 +1,452 @@
+"""FleetRouter: shared-nothing routing over N PolicyServer replicas
+(docs/DESIGN.md §2.15).
+
+Each replica is a complete, independent PolicyServer (own batcher, own
+engine, own telemetry — shared-nothing); the router is pure host-side
+dispatch. Failure handling is the design axis:
+
+  * **health-checked routing** — a replica whose worker died, or whose
+    submit raised ServerClosedError, is EJECTED from the rotation (the same
+    liveness predicate its per-replica `<name>-worker` HealthMonitor check
+    serves on /healthz, so the router and the ops plane never disagree);
+    ejected replicas are probed again after `readmit_cooldown_s` and
+    re-admitted the moment they are healthy — the self-healing half.
+  * **shed backoff** — ServerOverloadError retries ride the serve/client.py
+    bounded-exponential + full-jitter schedule against the NEXT replica in
+    rotation (shed-aware rebalance: round-robin advances past the shedding
+    replica); a spent budget raises the typed RetryBudgetExhaustedError.
+  * **failover** — a request whose replica dies AFTER acceptance (its
+    future completes with ServerClosedError) is re-dispatched to a
+    surviving replica: accepted requests are never silently dropped.
+  * **tail hedging** (optional) — a request still unanswered past
+    `hedge_after_s` is duplicated to a second replica; FIRST answer wins
+    through a settle-once guard (no double-completion), the loser is
+    discarded.
+  * **degraded modes** — all replicas down ⇒ typed FleetUnavailableError
+    fail-fast; partial fleet ⇒ the rotation simply shrinks.
+
+Everything is counted in the `stoix_tpu_loop_*` metric family and rendered
+live on /statusz via the `loop_fleet` status provider.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+from stoix_tpu.loop.errors import FleetUnavailableError
+from stoix_tpu.observability import get_logger, get_registry, get_status_board
+from stoix_tpu.serve.client import (
+    BackoffPolicy,
+    RetryBudgetExhaustedError,
+    backoff_delay,
+)
+from stoix_tpu.serve.errors import (
+    RequestTimeoutError,
+    ServerClosedError,
+    ServerOverloadError,
+)
+
+# A hedge must not sleep through a backoff schedule — it exists to cut tail
+# latency. One attempt; a shed simply means no hedge this time.
+_HEDGE_RETRY = BackoffPolicy(max_attempts=1, deadline_s=0.0)
+
+
+class ReplicaHandle:
+    """One replica's routing state: the live server plus ejection bookkeeping.
+    `server` is replaced in place when the loop runner restarts a killed
+    replica (the handle's ordinal is the stable identity)."""
+
+    def __init__(self, ordinal: int, server: Any):
+        self.ordinal = int(ordinal)
+        self.server = server
+        self.ejected_at: Optional[float] = None
+        self.ejected_reason: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return getattr(self.server, "name", f"replica{self.ordinal}")
+
+    def healthy(self) -> bool:
+        return self.server is not None and self.server.healthy()
+
+
+class _Leg(NamedTuple):
+    """One in-flight attempt of a routed request."""
+
+    handle: ReplicaHandle
+    request: Any  # serve.batcher.PendingRequest
+    kind: str  # "primary" | "failover" | "hedge"
+
+
+class RouterFuture:
+    """One routed request: wraps the accepted per-replica future(s) and
+    settles EXACTLY ONCE — when retries/hedges put two legs in flight, the
+    first completed answer wins and later completions are ignored (pinned in
+    tests/test_loop.py)."""
+
+    def __init__(self, router: "FleetRouter", observation: Any, leg: _Leg):
+        self._router = router
+        self.observation = observation
+        self.submitted_at = time.monotonic()
+        self.legs: List[_Leg] = [leg]
+        self.hedge_attempted = False
+        self._lock = threading.Lock()
+        self._winner: Optional[_Leg] = None
+
+    def settle(self, leg: _Leg) -> bool:
+        """First-answer-wins gate: True for the one leg that settles this
+        future, False for every later completion."""
+        with self._lock:
+            if self._winner is not None:
+                return False
+            self._winner = leg
+            return True
+
+    @property
+    def winner(self) -> Optional[_Leg]:
+        with self._lock:
+            return self._winner
+
+    @property
+    def latency_s(self) -> float:
+        leg = self.winner
+        return leg.request.latency_s if leg is not None else 0.0
+
+    def done(self) -> bool:
+        return self.winner is not None or any(leg.request.done() for leg in self.legs)
+
+    def result(self, timeout: float = 30.0) -> Any:
+        return self._router.await_result(self, timeout=timeout)
+
+
+class DirectRouter:
+    """router.enabled=false: the pinned pass-through. Submits go straight to
+    the single replica — no retry, no hedging, no failover — so the
+    router-off path serves bit-identically to today's `launcher serve`
+    single PolicyServer (tests/test_loop.py pins the logits)."""
+
+    def __init__(self, server: Any):
+        self.server = server
+
+    def submit(self, observation: Any) -> Any:
+        return self.server.submit(observation)
+
+    def stats(self) -> dict:
+        return {"mode": "direct", "replicas": 1}
+
+    def tick(self) -> None:  # interface parity with FleetRouter
+        return None
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        servers: Sequence[Any],
+        retry: Optional[BackoffPolicy] = None,
+        hedge_after_s: Optional[float] = None,
+        readmit_cooldown_s: float = 0.5,
+        max_failovers: int = 4,
+        rng: Optional[random.Random] = None,
+        sleep: Any = time.sleep,
+    ):
+        if not servers:
+            raise ValueError("FleetRouter needs at least one replica")
+        self._replicas = [ReplicaHandle(i, s) for i, s in enumerate(servers)]
+        self.retry = retry or BackoffPolicy()
+        self.hedge_after_s = None if hedge_after_s is None else float(hedge_after_s)
+        self.readmit_cooldown_s = float(readmit_cooldown_s)
+        self.max_failovers = int(max_failovers)
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        self._lock = threading.Lock()  # rotation index + ejection state
+        self._rr = 0
+        self._log = get_logger("stoix_tpu.loop")
+        registry = get_registry()
+        self._m_requests = registry.counter(
+            "stoix_tpu_loop_requests_total", "Requests accepted through the fleet router"
+        )
+        self._m_sheds = registry.counter(
+            "stoix_tpu_loop_sheds_total", "Per-replica sheds seen by the router"
+        )
+        self._m_retries = registry.counter(
+            "stoix_tpu_loop_retries_total", "Backoff retries after a shed"
+        )
+        self._m_failovers = registry.counter(
+            "stoix_tpu_loop_failovers_total",
+            "Accepted requests re-dispatched after their replica died",
+        )
+        self._m_hedges = registry.counter(
+            "stoix_tpu_loop_hedges_total", "Tail hedges fired"
+        )
+        self._m_hedge_wins = registry.counter(
+            "stoix_tpu_loop_hedge_wins_total", "Requests settled by the hedge leg"
+        )
+        self._m_ejections = registry.counter(
+            "stoix_tpu_loop_ejections_total", "Replica ejections, by reason"
+        )
+        self._m_readmissions = registry.counter(
+            "stoix_tpu_loop_readmissions_total", "Replicas re-admitted after recovery"
+        )
+        self._m_unavailable = registry.counter(
+            "stoix_tpu_loop_unavailable_total",
+            "Submits failed fast because every replica was down",
+        )
+        # Host-side mirrors (ServeTelemetry discipline: tests and the runner
+        # report read these without scraping the registry).
+        self.n_requests = 0
+        self.n_sheds = 0
+        self.n_retries = 0
+        self.n_failovers = 0
+        self.n_hedges = 0
+        self.n_hedge_wins = 0
+        self.n_ejections = 0
+        self.n_readmissions = 0
+        self.n_unavailable = 0
+
+    # -- fleet membership -----------------------------------------------------
+    @property
+    def replicas(self) -> Tuple[ReplicaHandle, ...]:
+        return tuple(self._replicas)
+
+    def replace(self, ordinal: int, server: Any) -> None:
+        """Install a restarted server under an existing ordinal (the loop
+        runner's self-healing path). The handle stays EJECTED until the
+        cooldown-gated probe sees it healthy — restart and re-admission are
+        separate, counted events."""
+        with self._lock:
+            self._replicas[ordinal].server = server
+
+    def eject(self, handle: ReplicaHandle, reason: str) -> None:
+        with self._lock:
+            self._eject_locked(handle, reason)
+
+    def _eject_locked(self, handle: ReplicaHandle, reason: str) -> None:
+        if handle.ejected_at is not None:
+            return
+        handle.ejected_at = time.monotonic()
+        handle.ejected_reason = reason
+        self.n_ejections += 1
+        self._m_ejections.inc(labels={"reason": reason})
+        self._log.warning(
+            "[loop] ejected replica %s (%s) — %d/%d in rotation",
+            handle.name, reason,
+            sum(1 for h in self._replicas if h.ejected_at is None),
+            len(self._replicas),
+        )
+
+    def _sweep_locked(self) -> None:
+        """Eject newly-unhealthy replicas; re-admit recovered ones past the
+        cooldown. Runs under the rotation lock on every pick and on tick()."""
+        now = time.monotonic()
+        for handle in self._replicas:
+            if handle.ejected_at is None:
+                if not handle.healthy():
+                    self._eject_locked(handle, "unhealthy")
+            elif now - handle.ejected_at >= self.readmit_cooldown_s and handle.healthy():
+                handle.ejected_at = None
+                handle.ejected_reason = None
+                self.n_readmissions += 1
+                self._m_readmissions.inc()
+                self._log.info("[loop] re-admitted replica %s", handle.name)
+
+    def tick(self) -> None:
+        """Periodic health sweep (the runner calls this between traffic
+        rounds so recovery does not wait for the next submit)."""
+        with self._lock:
+            self._sweep_locked()
+
+    def _pick(self, exclude: Tuple[ReplicaHandle, ...] = ()) -> ReplicaHandle:
+        with self._lock:
+            self._sweep_locked()
+            candidates = [
+                h for h in self._replicas
+                if h.ejected_at is None and h not in exclude
+            ]
+            if not candidates:
+                ejected = sum(1 for h in self._replicas if h.ejected_at is not None)
+                if not exclude:
+                    # exclude non-empty = hedge placement probing for a SECOND
+                    # replica — finding none is not an outage, so only the
+                    # bare-pick case counts as all-replicas-down.
+                    self.n_unavailable += 1
+                    self._m_unavailable.inc()
+                raise FleetUnavailableError(len(self._replicas), ejected)
+            self._rr += 1
+            return candidates[self._rr % len(candidates)]
+
+    # -- submission -----------------------------------------------------------
+    def submit(self, observation: Any) -> RouterFuture:
+        """Route one observation; returns the routed future. Raises
+        FleetUnavailableError (all down), RetryBudgetExhaustedError (shed
+        past the budget), or ServerClosedError only via result()-side legs."""
+        leg = self._dispatch(observation, kind="primary")
+        self.n_requests += 1
+        self._m_requests.inc()
+        return RouterFuture(self, observation, leg)
+
+    def _dispatch(
+        self,
+        observation: Any,
+        kind: str,
+        exclude: Tuple[ReplicaHandle, ...] = (),
+        retry: Optional[BackoffPolicy] = None,
+    ) -> _Leg:
+        policy = retry or self.retry
+        attempts = 0
+        start = time.monotonic()
+        while True:
+            handle = self._pick(exclude)
+            try:
+                return _Leg(handle, handle.server.submit(observation), kind)
+            except ServerClosedError:
+                # Dead replica: eject and move on — consumes no retry budget
+                # (the request was never accepted anywhere).
+                self.eject(handle, "closed")
+            except ServerOverloadError:
+                self.n_sheds += 1
+                self._m_sheds.inc()
+                attempts += 1
+                elapsed = time.monotonic() - start
+                delay = backoff_delay(policy, attempts - 1, self._rng)
+                if attempts >= policy.max_attempts or elapsed + delay > policy.deadline_s:
+                    raise RetryBudgetExhaustedError(
+                        attempts, policy.deadline_s, elapsed
+                    ) from None
+                self.n_retries += 1
+                self._m_retries.inc()
+                self._sleep(delay)
+
+    # -- completion -----------------------------------------------------------
+    def await_result(self, fut: RouterFuture, timeout: float = 30.0) -> Any:
+        """Wait for the first winning leg; failover legs replaced in place on
+        post-accept replica death; hedge fired once past hedge_after_s."""
+        deadline = time.monotonic() + timeout
+        while True:
+            won = fut.winner
+            if won is not None:
+                return won.request.result(timeout=0.0)
+            now = time.monotonic()
+            if now >= deadline:
+                raise RequestTimeoutError(timeout)
+            if (
+                self.hedge_after_s is not None
+                and not fut.hedge_attempted
+                and now - fut.submitted_at >= self.hedge_after_s
+            ):
+                self._fire_hedge(fut)
+            settled = self._collect(fut)
+            if settled is not None:
+                return settled.request.result(timeout=0.0)
+            self._wait_slice(fut, deadline)
+
+    def _wait_slice(self, fut: RouterFuture, deadline: float) -> None:
+        now = time.monotonic()
+        remaining = max(0.0, deadline - now)
+        if self.hedge_after_s is not None and not fut.hedge_attempted:
+            # Wake in time to fire the hedge.
+            slice_s = min(
+                remaining, max(0.0, fut.submitted_at + self.hedge_after_s - now)
+            )
+        elif len(fut.legs) > 1:
+            slice_s = min(remaining, 0.002)  # alternate between live legs
+        else:
+            slice_s = remaining
+        if fut.legs:
+            fut.legs[0].request.wait(timeout=max(slice_s, 0.0005))
+
+    def _collect(self, fut: RouterFuture) -> Optional[_Leg]:
+        """Reap completed legs: settle the first OK answer; replace legs
+        killed by replica death (counted failover); raise the typed error
+        when NO leg can still answer."""
+        last_error: Optional[BaseException] = None
+        for leg in list(fut.legs):
+            if not leg.request.done():
+                continue
+            if leg.request.ok:
+                if fut.settle(leg):
+                    if leg.kind == "hedge":
+                        self.n_hedge_wins += 1
+                        self._m_hedge_wins.inc()
+                    return leg
+                # Settle lost the race — a slower duplicate; discard.
+                fut.legs.remove(leg)
+                continue
+            try:
+                leg.request.result(timeout=0.0)
+            except ServerClosedError as exc:
+                fut.legs.remove(leg)
+                self.eject(leg.handle, "closed")
+                n_failovers = sum(1 for item in fut.legs if item.kind == "failover")
+                if n_failovers >= self.max_failovers:
+                    last_error = exc
+                    continue
+                # Failover: the accepted request is re-dispatched, never
+                # silently dropped. _dispatch raising (fleet down / budget)
+                # is itself a typed, counted outcome for the caller.
+                fut.legs.append(
+                    self._dispatch(fut.observation, kind="failover")
+                )
+                self.n_failovers += 1
+                self._m_failovers.inc()
+            except Exception as exc:  # noqa: BLE001 — typed batch failure:
+                # keep any other in-flight leg alive; raise only when this
+                # was the last one.
+                fut.legs.remove(leg)
+                last_error = exc
+        if not fut.legs and fut.winner is None:
+            raise last_error if last_error is not None else ServerClosedError(
+                "all request legs failed"
+            )
+        return None
+
+    def _fire_hedge(self, fut: RouterFuture) -> None:
+        fut.hedge_attempted = True
+        exclude = tuple(leg.handle for leg in fut.legs)
+        try:
+            leg = self._dispatch(
+                fut.observation, kind="hedge", exclude=exclude, retry=_HEDGE_RETRY
+            )
+        except (FleetUnavailableError, RetryBudgetExhaustedError):
+            return  # no spare capacity — the primary keeps its slot
+        fut.legs.append(leg)
+        self.n_hedges += 1
+        self._m_hedges.inc()
+
+    # -- observability --------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            fleet = [
+                {
+                    "replica": handle.name,
+                    "healthy": handle.healthy(),
+                    "ejected": handle.ejected_at is not None,
+                    "reason": handle.ejected_reason,
+                }
+                for handle in self._replicas
+            ]
+        return {
+            "mode": "fleet",
+            "replicas": len(self._replicas),
+            "in_rotation": sum(1 for f in fleet if not f["ejected"]),
+            "fleet": fleet,
+            "requests": self.n_requests,
+            "sheds": self.n_sheds,
+            "retries": self.n_retries,
+            "failovers": self.n_failovers,
+            "hedges": self.n_hedges,
+            "hedge_wins": self.n_hedge_wins,
+            "ejections": self.n_ejections,
+            "readmissions": self.n_readmissions,
+            "unavailable": self.n_unavailable,
+        }
+
+    def register_status(self) -> "FleetRouter":
+        """Publish the fleet table on /statusz (render-time snapshot)."""
+        get_status_board().register_provider("loop_fleet", self.stats)
+        return self
+
+    def unregister_status(self) -> None:
+        get_status_board().unregister_provider("loop_fleet")

@@ -76,6 +76,22 @@ Spec grammar: comma-separated `name[:arg]` entries (a mapping
                   current device count (one-shot) — preempted capacity
                   coming back. The elastic supervisor relaunches larger,
                   restoring from the newest digest-verified store.
+  replica_kill:N  the closed-loop runner hard-closes serve-fleet replica N
+                  mid-traffic (one-shot): in-flight requests on that replica
+                  complete with ServerClosedError and the FleetRouter must
+                  fail them over to a surviving replica with zero silent
+                  drops (stoix_tpu/loop, docs/DESIGN.md §2.15). The runner
+                  restarts the replica after the router's re-admission
+                  cooldown — the self-healing path.
+  replica_slow:S  serve-fleet replica 0's batch worker sleeps S milliseconds
+                  before EVERY batch (sustained, counted once) — a straggler
+                  replica, alive and answering but slow. Drives the router's
+                  tail-latency/hedging surface, which a kill cannot.
+  feedback_stall:S the experience recorder's replay feeder thread wedges S
+                  seconds (one-shot, sliced sleep) — a stalled replay
+                  ingest. The recorder's bounded queue must absorb it by
+                  dropping oldest (counted), never by blocking the serve
+                  path.
 
 All injection points are no-ops (a single None check) when no plan is armed,
 and `configure()` is called once per experiment so one-shot state never leaks
@@ -115,6 +131,9 @@ _KNOWN = (
     "swap_poison",
     "shrink",
     "grow",
+    "replica_kill",
+    "replica_slow",
+    "feedback_stall",
 )
 
 
@@ -512,6 +531,76 @@ def maybe_poison_swap(params: Any) -> Any:
             )
             return treedef.unflatten(leaves)
     return params
+
+
+def consume_replica_kill() -> Optional[int]:
+    """The serve-fleet replica ordinal to hard-close mid-traffic when
+    `replica_kill:N` is armed (one-shot), else None. The loop runner polls
+    this from its traffic thread and closes the named replica — in-flight
+    requests complete with ServerClosedError and the router's failover path
+    must re-dispatch them (docs/DESIGN.md §2.15)."""
+    plan = get_plan()
+    if plan is None:
+        return None
+    at = plan.arg("replica_kill")
+    if at is None or not plan.consume("replica_kill"):
+        return None
+    _injected_counter().inc(labels={"fault": "replica_kill"})
+    get_logger("stoix_tpu.resilience").warning(
+        "[faultinject] killing serve replica %d mid-traffic", at
+    )
+    flightrec.get_flight_recorder().record(
+        "fault", fault="replica_kill", replica=int(at)
+    )
+    return at
+
+
+def maybe_slow_replica(replica_id: int) -> None:
+    """Sleep `replica_slow:S` MILLISECONDS before each batch on serve-fleet
+    replica 0 (sustained — a straggler replica keeps straggling; counted and
+    logged once). Other replicas, and the plain single-server path (which
+    passes no replica id), are untouched."""
+    plan = get_plan()
+    if plan is None:
+        return
+    ms = plan.arg("replica_slow")
+    if ms is None or replica_id != 0:
+        return
+    if plan.consume("replica_slow"):
+        _injected_counter().inc(labels={"fault": "replica_slow"})
+        get_logger("stoix_tpu.resilience").warning(
+            "[faultinject] replica 0 straggling: +%dms per batch", ms
+        )
+        flightrec.get_flight_recorder().record(
+            "fault", fault="replica_slow", ms=int(ms)
+        )
+    time.sleep(ms / 1000.0)
+
+
+def maybe_stall_feedback(should_abort: Optional[Callable[[], bool]] = None) -> None:
+    """Wedge the experience recorder's replay feeder `feedback_stall:S`
+    seconds (one-shot) — a stalled replay ingest. Sliced sleep so shutdown
+    (`should_abort`) cuts it short; the stall is charged to the goodput
+    ledger as badput either way."""
+    plan = get_plan()
+    if plan is None:
+        return
+    secs = plan.arg("feedback_stall")
+    if secs is None or not plan.consume("feedback_stall"):
+        return
+    _injected_counter().inc(labels={"fault": "feedback_stall"})
+    get_logger("stoix_tpu.resilience").warning(
+        "[faultinject] stalling experience feedback for %ds", secs
+    )
+    flightrec.get_flight_recorder().record(
+        "fault", fault="feedback_stall", seconds=float(secs)
+    )
+    deadline = time.monotonic() + float(secs)
+    while time.monotonic() < deadline:
+        if should_abort is not None and should_abort():
+            break
+        time.sleep(0.05)
+    goodput.note_stall(float(secs))
 
 
 def backend_wedge_armed() -> bool:

@@ -17,6 +17,9 @@ LATENCY-shaped traffic class:
   * `ServeTelemetry` — `stoix_tpu_serve_*` SLO metrics (p50/p95/p99).
   * `run_loadgen` — open-loop latency-shaped load generation (bench.py
     --serve).
+  * `ServeClient` — the shed-retry client (bounded exponential backoff +
+    full jitter + a retry budget) shared by the load generator and the
+    closed-loop FleetRouter (stoix_tpu/loop, docs/DESIGN.md §2.15).
 """
 
 from stoix_tpu.serve.batcher import (  # noqa: F401 — public API
@@ -24,10 +27,16 @@ from stoix_tpu.serve.batcher import (  # noqa: F401 — public API
     DynamicBatcher,
     PendingRequest,
 )
-from stoix_tpu.serve.checkpoint import (  # noqa: F401
+from stoix_tpu.serve.checkpoint import (  # noqa: F401 — public API
     PolicyBundle,
     PolicySource,
     load_policy,
+)
+from stoix_tpu.serve.client import (  # noqa: F401
+    BackoffPolicy,
+    RetryBudgetExhaustedError,
+    ServeClient,
+    backoff_delay,
 )
 from stoix_tpu.serve.engine import InferenceEngine  # noqa: F401
 from stoix_tpu.serve.errors import (  # noqa: F401

@@ -1,0 +1,132 @@
+"""Shed-aware submission client: bounded exponential backoff + full jitter
+(docs/DESIGN.md §2.15).
+
+`ServerOverloadError` has always told callers to "retry with backoff"; this
+module is that retry, implemented once so every caller (the open-loop load
+generator, the FleetRouter's per-replica submits) shares one schedule:
+
+  * exponential growth `base * multiplier**attempt`, capped at `max_delay`;
+  * FULL jitter — the actual sleep is uniform on [0, bounded] (decorrelated
+    retries; synchronized clients re-colliding at the same instant is the
+    classic thundering-herd failure the jitter exists to break);
+  * a retry BUDGET — both an attempt cap and a wall-clock deadline. A caller
+    that cannot get in within the budget receives the typed
+    `RetryBudgetExhaustedError` naming both, with the final shed error
+    chained as __cause__.
+
+The sleep and RNG are injectable so the schedule itself is unit-testable
+without wall-clock time (tests/test_loop.py pins the bounded-exponential
+envelope and the budget exhaustion).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, NamedTuple, Optional
+
+from stoix_tpu.serve.errors import ServeError, ServerOverloadError
+
+
+class BackoffPolicy(NamedTuple):
+    """Bounded-exponential-backoff schedule + retry budget."""
+
+    base_s: float = 0.002
+    max_s: float = 0.100
+    multiplier: float = 2.0
+    max_attempts: int = 5
+    deadline_s: float = 1.0
+
+    def bound(self, attempt: int) -> float:
+        """The jitter-free upper envelope for retry number `attempt` (0-based):
+        min(max_s, base_s * multiplier**attempt)."""
+        return min(float(self.max_s), float(self.base_s) * float(self.multiplier) ** attempt)
+
+
+class RetryBudgetExhaustedError(ServeError):
+    """Every attempt in the retry budget was shed. Names the budget that was
+    spent (attempts + deadline) so operators can tell "server briefly busy"
+    from "budget too small" at a glance."""
+
+    def __init__(self, attempts: int, deadline_s: float, elapsed_s: float):
+        self.attempts = int(attempts)
+        self.deadline_s = float(deadline_s)
+        self.elapsed_s = float(elapsed_s)
+        super().__init__(
+            f"retry budget exhausted: {attempts} attempt(s) all shed within "
+            f"{elapsed_s:.3f}s (budget: {attempts} attempts / {deadline_s:.3f}s "
+            f"deadline)"
+        )
+
+
+def backoff_delay(
+    policy: BackoffPolicy, attempt: int, rng: random.Random
+) -> float:
+    """One full-jitter sample for retry number `attempt` (0-based): uniform
+    on [0, policy.bound(attempt)]."""
+    return rng.uniform(0.0, policy.bound(attempt))
+
+
+class ServeClient:
+    """Retrying wrapper around one submit target.
+
+    `submit_fn` is anything with PolicyServer.submit semantics (raises
+    ServerOverloadError on shed); `submit()` retries sheds per the policy and
+    returns the accepted request future. All other errors (ServerClosedError
+    included) pass straight through — a closed server is not a transient."""
+
+    def __init__(
+        self,
+        submit_fn: Callable[[Any], Any],
+        policy: Optional[BackoffPolicy] = None,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self._submit = submit_fn
+        self.policy = policy or BackoffPolicy()
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        # Host-side mirrors (telemetry-style): total sheds seen vs retries
+        # that eventually got in vs budgets exhausted.
+        self.n_sheds = 0
+        self.n_retried_ok = 0
+        self.n_budget_exhausted = 0
+
+    def submit(self, observation: Any) -> Any:
+        start = time.monotonic()
+        attempts = 0
+        while True:
+            try:
+                request = self._submit(observation)
+                if attempts:
+                    self.n_retried_ok += 1
+                return request
+            except ServerOverloadError as exc:
+                self.n_sheds += 1
+                attempts += 1
+                elapsed = time.monotonic() - start
+                delay = backoff_delay(self.policy, attempts - 1, self._rng)
+                if (
+                    attempts >= self.policy.max_attempts
+                    or elapsed + delay > self.policy.deadline_s
+                ):
+                    self.n_budget_exhausted += 1
+                    raise RetryBudgetExhaustedError(
+                        attempts, self.policy.deadline_s, elapsed
+                    ) from exc
+                self._sleep(delay)
+
+
+def policy_from_config(retry_cfg: Any) -> BackoffPolicy:
+    """Build a BackoffPolicy from a `retry:` config block (ms-denominated
+    keys, matching the serve config's latency-unit convention); None/empty
+    yields the defaults."""
+    cfg = dict(retry_cfg or {})
+    defaults = BackoffPolicy()
+    return BackoffPolicy(
+        base_s=float(cfg.get("base_ms", defaults.base_s * 1000.0)) / 1000.0,
+        max_s=float(cfg.get("max_ms", defaults.max_s * 1000.0)) / 1000.0,
+        multiplier=float(cfg.get("multiplier", defaults.multiplier)),
+        max_attempts=int(cfg.get("max_attempts", defaults.max_attempts)),
+        deadline_s=float(cfg.get("deadline_ms", defaults.deadline_s * 1000.0)) / 1000.0,
+    )

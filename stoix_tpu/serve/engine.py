@@ -74,6 +74,15 @@ class InferenceEngine:
     def params_version(self) -> int:
         return self._params_version
 
+    def get_params(self) -> Any:
+        """The currently-installed (device-resident) params reference. The
+        fleet publisher captures this before a push so a canary-rejected
+        rollout can roll every replica back to the exact pre-push bytes
+        (docs/DESIGN.md §2.15) — read under the swap lock so a capture racing
+        a swap still returns one coherent version."""
+        with self._swap_lock:
+            return self._params
+
     def set_params(self, params: Any) -> int:
         """Install fresh params under the in-flight jitted step: device_put
         first (the expensive part, off the request path), then ONE reference

@@ -75,12 +75,20 @@ class ParameterWatcher:
         if self._thread.is_alive():
             self._thread.join(timeout=join_timeout)
 
-    def check_now(self) -> Optional[int]:
+    def check_now(self, target_step: Optional[int] = None) -> Optional[int]:
         """One synchronous poll (tests and deterministic swap points): swap
         if the store advanced AND the candidate passes the canary; returns
-        the new step, or None for no-op/rejected/error."""
+        the new step, or None for no-op/rejected/error.
+
+        `target_step` pins the candidate instead of re-resolving the store's
+        latest — the FleetPublisher passes the step it gated on, so every
+        replica in one fleet push loads the SAME step even while the learner
+        is concurrently saving a newer one (two latest_step() scans racing a
+        save can disagree, which would tear the fleet for no real fault)."""
         try:
-            latest = self._source.latest_step()
+            latest = (
+                self._source.latest_step() if target_step is None else int(target_step)
+            )
             if latest is None or latest <= self.current_step:
                 return None
             params, step = self._source.load(latest)

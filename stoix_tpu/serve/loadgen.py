@@ -18,8 +18,17 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from stoix_tpu.serve.batcher import PendingRequest
-from stoix_tpu.serve.errors import ServerOverloadError
+from stoix_tpu.serve.client import BackoffPolicy, RetryBudgetExhaustedError, ServeClient
 from stoix_tpu.utils.timing import TimingTracker
+
+# The generator's default retry budget is deliberately TIGHT: an open-loop
+# injector that sleeps a long backoff stops being open-loop (subsequent
+# requests queue behind the sleep and then burst). Three quick jittered
+# retries recover transient sheds; anything longer is counted shed and the
+# schedule moves on.
+DEFAULT_LOADGEN_RETRY = BackoffPolicy(
+    base_s=0.002, max_s=0.020, multiplier=2.0, max_attempts=3, deadline_s=0.050
+)
 
 
 def run_loadgen(
@@ -28,10 +37,13 @@ def run_loadgen(
     duration_s: float,
     observation_fn: Optional[Callable[[int], Any]] = None,
     result_timeout_s: float = 30.0,
+    retry_policy: Optional[BackoffPolicy] = None,
 ) -> Dict[str, Any]:
     """Drive `server` at `offered_qps` for `duration_s`; returns the latency
     report dict. `observation_fn(i)` supplies the i-th request's observation
-    (default: the server's observation template every time)."""
+    (default: the server's observation template every time). Sheds are
+    retried through the backoff client (serve/client.py); a request is
+    counted `shed` only once its whole retry budget is exhausted."""
     if offered_qps <= 0 or duration_s <= 0:
         raise ValueError("offered_qps and duration_s must be positive")
     if observation_fn is None:
@@ -39,6 +51,7 @@ def run_loadgen(
 
     swaps_before = server.telemetry.n_hot_swaps
     batches_before = server.telemetry.n_batches
+    client = ServeClient(server.submit, policy=retry_policy or DEFAULT_LOADGEN_RETRY)
     interval = 1.0 / float(offered_qps)
     requests: List[PendingRequest] = []
     shed = 0
@@ -53,8 +66,8 @@ def run_loadgen(
             time.sleep(min(target - now, 0.010))
             continue
         try:
-            requests.append(server.submit(observation_fn(i)))
-        except ServerOverloadError:
+            requests.append(client.submit(observation_fn(i)))
+        except RetryBudgetExhaustedError:
             shed += 1
         i += 1
     offered = i  # attempted submissions, shed included
@@ -89,6 +102,7 @@ def run_loadgen(
         "requests": offered,
         "completed": completed,
         "shed": shed,
+        "retries": client.n_sheds - client.n_budget_exhausted,
         "errors": errors,
         "timed_out": timed_out,
         "latency_ms": {

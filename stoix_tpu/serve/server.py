@@ -32,6 +32,7 @@ from stoix_tpu.observability import (
     span,
 )
 from stoix_tpu.parallel import MeshRoles
+from stoix_tpu.resilience import faultinject
 from stoix_tpu.serve import checkpoint as serve_checkpoint
 from stoix_tpu.serve.batcher import DEFAULT_BUCKETS, DynamicBatcher, PendingRequest
 from stoix_tpu.serve.engine import InferenceEngine
@@ -65,7 +66,17 @@ class PolicyServer:
         hot_swap_canary: bool = True,
         compile_deadline_s: float = 600.0,
         device: Optional[jax.Device] = None,
+        name: str = "serve",
+        replica_id: Optional[int] = None,
     ):
+        # `name` namespaces the global status/health registrations so N
+        # replicas can coexist in one process (the loop fleet,
+        # docs/DESIGN.md §2.15). The default reproduces the original keys
+        # ("serve_slo" / "serve-worker") exactly, so the single-server path
+        # registers bit-identically to before. `replica_id` is the fleet
+        # ordinal — only the replica_slow fault injection reads it.
+        self.name = str(name)
+        self._replica_id = replica_id
         self.telemetry = ServeTelemetry()
         self.obs_template = obs_template
         self._engine = InferenceEngine(
@@ -77,8 +88,9 @@ class PolicyServer:
         )
         self._compile_deadline_s = float(compile_deadline_s)
         self._stop = threading.Event()
+        self._killed = threading.Event()
         self._worker = threading.Thread(
-            target=self._worker_loop, name="serve-worker", daemon=True
+            target=self._worker_loop, name=self._worker_name(), daemon=True
         )
         self._started = False
         self._log = get_logger("stoix_tpu.serve")
@@ -130,6 +142,15 @@ class PolicyServer:
             device=roles.device("serve"),
         )
 
+    # -- naming ---------------------------------------------------------------
+    def _worker_name(self) -> str:
+        # "serve" -> "serve-worker" (the historical thread/check name);
+        # "loop_replica0" -> "loop_replica0-worker".
+        return f"{self.name}-worker"
+
+    def _status_key(self) -> str:
+        return f"{self.name}_slo"
+
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> "PolicyServer":
         """Warm every bucket under a first-compile watchdog (a wedged backend
@@ -153,18 +174,18 @@ class PolicyServer:
         # live (the provider is called at render time, not snapshotted here)
         # and /healthz turns 503 if the batch worker thread dies.
         get_status_board().register_provider(
-            "serve_slo", self.telemetry.slo_snapshot
+            self._status_key(), self.telemetry.slo_snapshot
         )
         get_health_monitor().register_check(
-            "serve-worker",
+            self._worker_name(),
             lambda: None if self._worker.is_alive() else "serve worker thread dead",
         )
         self._started = True
         return self
 
     def close(self, join_timeout: float = 10.0) -> None:
-        get_status_board().unregister_provider("serve_slo")
-        get_health_monitor().unregister("serve-worker")
+        get_status_board().unregister_provider(self._status_key())
+        get_health_monitor().unregister(self._worker_name())
         if self.watcher is not None:
             self.watcher.stop()
         self._stop.set()
@@ -177,6 +198,16 @@ class PolicyServer:
                 "(completed with ServerClosedError)", dropped,
             )
 
+    def kill(self, join_timeout: float = 10.0) -> None:
+        """Crash-style shutdown (the `replica_kill` chaos drill,
+        docs/DESIGN.md §2.15). Unlike close()'s graceful drain, the worker
+        dies WITHOUT completing its current batch: every queued and in-batch
+        request completes with ServerClosedError — exactly what a powered-off
+        replica looks like to the FleetRouter, whose failover path must
+        re-dispatch the accepted requests."""
+        self._killed.set()
+        self.close(join_timeout=join_timeout)
+
     def __enter__(self) -> "PolicyServer":
         return self.start()
 
@@ -184,6 +215,17 @@ class PolicyServer:
         self.close()
 
     # -- request path ---------------------------------------------------------
+    @property
+    def engine(self) -> InferenceEngine:
+        """The replica's engine — the fleet publisher drives check_now /
+        rollback against it (docs/DESIGN.md §2.15)."""
+        return self._engine
+
+    def healthy(self) -> bool:
+        """Liveness probe the FleetRouter polls for ejection/re-admission:
+        started, not closing, and the batch worker thread still running."""
+        return self._started and not self._stop.is_set() and self._worker.is_alive()
+
     @property
     def compile_count(self) -> int:
         return self._engine.compile_count
@@ -230,11 +272,17 @@ class PolicyServer:
             if not batch:
                 continue
             try:
+                if self._replica_id is not None:
+                    faultinject.maybe_slow_replica(self._replica_id)
                 with span("serve_batch", n=len(batch)):
                     start = time.perf_counter()
                     action, extras, bucket = self._engine.infer(
                         [request.observation for request in batch]
                     )
+                    if self._killed.is_set():
+                        # Crash-style kill(): the batch dies WITH the worker
+                        # — callers see ServerClosedError and fail over.
+                        raise ServerClosedError(f"{self.name} killed mid-batch")
                     self._complete(batch, action, extras)
                 self.telemetry.batch_done(
                     len(batch), bucket, time.perf_counter() - start

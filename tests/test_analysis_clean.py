@@ -24,6 +24,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -94,7 +96,11 @@ def test_concurrency_rules_clean_json():
     assert proc.returncode == 0 and findings == [], findings
 
 
+@pytest.mark.slow
 def test_shim_gate_clean_text():
+    # Slow lane (tier-1 budget, PR 19): a second full-repo scan through the
+    # shim (~21s) duplicating test_repo_lints_clean_json's coverage; the
+    # shim's byte-parity contract is pinned in test_lint.py.
     # The historical invocation (CI, docs, muscle memory) — via the shim.
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "lint.py")],

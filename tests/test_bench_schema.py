@@ -42,7 +42,12 @@ def _assert_goodput_shape(payload, live: bool):
         assert all(v == 0.0 for v in goodput["fractions"].values()), goodput
 
 
+@pytest.mark.slow
 def test_bench_smoke_payload_schema():
+    # Slow lane (tier-1 budget, PR 19): a whole bench subprocess incl. a
+    # training probe (~23s); the serve payload schema below keeps a
+    # not-slow subprocess pin, and --check gate semantics are covered
+    # in-process by tests/test_bench_check.py.
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--smoke", "--cpu"],
         capture_output=True,
@@ -443,6 +448,89 @@ def test_bench_backend_wedge_aborts_typed_within_deadline():
     assert "BACKEND UNAVAILABLE" in payload["unit"], payload
     assert payload["probe_attempts"] == 2, payload
     assert payload["fallback"] is False, payload
+
+
+def test_bench_loop_refuses_composition():
+    """`--loop` is its own closed-loop workload (docs/DESIGN.md §2.15): it
+    already CONTAINS serving and replay, so composing it with --serve /
+    --replay / --integrity / --all must refuse fast with a clear message
+    (argument validation, no training run)."""
+    for extra in ("--serve", "--replay", "--integrity", "--all"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--loop", extra],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode != 0, f"--loop {extra} must refuse"
+        out = proc.stdout + proc.stderr
+        assert "does not compose" in out, out
+
+
+@pytest.mark.slow
+def test_bench_loop_payload_schema():
+    """`bench.py --loop` (docs/DESIGN.md §2.15): the policy-improvement
+    payload is schema-complete — end-return delta (live chaos-drill arm vs
+    frozen control, higher_is_better) plus the full resilience ledger. The
+    workload itself HARD-FAILS on silent drops, a drill with no failover, or
+    no canary rollback, so a passing run proves the self-healing contract.
+    Slow lane: two closed-loop arms plus a training run in a subprocess."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--loop", "--smoke", "--cpu",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "STOIX_BENCH_NO_FALLBACK": "1"},
+    )
+    assert proc.returncode == 0, f"bench.py --loop failed:\n{proc.stdout}\n{proc.stderr}"
+    json_lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1, f"expected exactly one JSON line:\n{proc.stdout}"
+    payload = json.loads(json_lines[0])
+
+    assert payload["metric"] == "loop_policy_improvement_return_delta"
+    assert payload["direction"] == "higher_is_better"
+    assert isinstance(payload["value"], (int, float))
+    assert "end-return delta" in payload["unit"]
+    assert payload["vs_baseline"] is None
+
+    # Dispersion fields are inline full-precision (return deltas live on an
+    # ~O(1) scale; _rep_stats' 0.1 rounding would crush them).
+    assert payload["reps"] >= 1
+    assert payload["min"] <= payload["median"] <= payload["max"]
+    assert payload["value"] == payload["max"], payload  # best-delta rep
+
+    # The live-vs-frozen pair behind the delta.
+    assert payload["live_return"] is not None
+    assert payload["frozen_return"] is not None
+    assert round(
+        payload["live_return"] - payload["frozen_return"], 4
+    ) == payload["value"], payload
+
+    # The resilience ledger: the drill really ran and the contract held.
+    assert payload["fault_spec"] == "replica_kill:1,replica_slow:2,feedback_stall:3,swap_poison"
+    assert payload["silent_drops"] == 0
+    assert payload["accepted"] == payload["completed"] + payload["typed_failures"]
+    assert payload["failovers"] >= 1
+    assert payload["ejections"] >= 1
+    assert payload["replica_kills"] == 1
+    assert payload["replica_restarts"] >= 1
+    assert payload["canary_rollbacks"] >= 1
+    assert payload["publishes"] >= 1
+    assert payload["learner_updates"] > 0
+    assert payload["episodes"] > 0
+    assert payload["p99_latency_ms"] > 0
+    assert payload["experience_dropped"] >= 0
+
+    # Universal posture fields: no training sentinel, no run ledger.
+    integrity = payload["integrity"]
+    assert integrity["enabled"] is False
+    _assert_goodput_shape(payload, live=False)
 
 
 def test_bench_replay_payload_schema():

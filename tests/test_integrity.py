@@ -393,7 +393,11 @@ def test_bitflip_detected_within_one_window_and_never_checkpointed(
     assert np.isfinite(final)
 
 
+@pytest.mark.slow
 def test_bitflip_exit_code_and_quarantined_relaunch(tmp_path):
+    # Slow lane (tier-1 budget, PR 19): two full training SUBPROCESSES
+    # (~28s); the in-process detect→rc-88→quarantine path stays not-slow
+    # via the sentinel tests above.
     # The acceptance path as PROCESSES: run 1 (bitflip armed) must die with
     # EXIT_CODE_STATE_CORRUPTION via the sentinel's excepthook and leave a
     # quarantine record; run 2, launched with the record's resume overrides

@@ -989,7 +989,10 @@ def test_cli_changed_only_with_selected_tree_rule_exits_2(monkeypatch, capsys):
     assert "STX009" in out.err and "tree-scoped" in out.err
 
 
+@pytest.mark.slow
 def test_cli_changed_only_clean_tree_falls_back_to_full_scan(monkeypatch, capsys):
+    # Slow lane (tier-1 budget, PR 19): a full-repo analysis scan (~6s);
+    # the changed-only fast path and its refusals stay not-slow above.
     # The CI/prolog case: the bad change is already COMMITTED, so the
     # changed set is empty — a vacuous 0-file pass would be a fake gate.
     from stoix_tpu.analysis import __main__ as cli
@@ -1014,7 +1017,11 @@ def test_cli_ignore_unknown_rule_exits_2():
     assert proc.returncode == 2
 
 
+@pytest.mark.slow
 def test_shim_output_is_byte_identical():
+    # Slow lane (tier-1 budget, PR 19): two analysis subprocesses (~10s);
+    # the shim's exit-code parity is also covered by
+    # test_analysis_clean.py's not-slow module-CLI gate.
     # scripts/lint.py must keep every existing invocation working: same
     # stdout, same exit code as the module CLI (here on a small subtree).
     args = ["stoix_tpu/analysis", "--skip-external"]
@@ -1041,7 +1048,11 @@ def test_list_rules_catalog():
 # grows a static-analysis section, exit semantics unchanged otherwise.
 
 
+@pytest.mark.slow
 def test_launcher_preflight_includes_static_analysis_section(monkeypatch, capsys):
+    # Slow lane (tier-1 budget, PR 19): the preflight report embeds a
+    # full-repo analysis scan (~28s); the preflight report shape itself is
+    # pinned not-slow in test_threadmodel.py's empty-model preflight test.
     from stoix_tpu import launcher
     from stoix_tpu.resilience import preflight
 

@@ -1,0 +1,570 @@
+"""Closed-loop subsystem (stoix_tpu/loop, docs/DESIGN.md §2.15).
+
+Covers the ISSUE-19 acceptance surface on CPU:
+  * backoff client — bounded-exponential envelope, full jitter, typed
+    budget exhaustion (injected RNG/sleep: no wall-clock in the units);
+  * FleetRouter — health-checked ejection and cooldown re-admission,
+    shed-aware retry against the next replica, post-accept failover (an
+    accepted request is NEVER silently dropped), all-down typed fail-fast,
+    tail hedging with a first-answer-wins settle (no double completion);
+  * ExperienceRecorder — drop-oldest under pressure, record() never blocks,
+    a wedged pipeline bounces batches instead of wedging the feeder;
+  * FleetPublisher — fleet-wide canary rollback pinned BITWISE: one poisoned
+    replica rolls the whole fleet back to the old params;
+  * router-off — DirectRouter over a real checkpoint serves logits
+    bit-identical to the direct jitted apply (the `launcher serve` pin);
+  * chaos e2e — run_loop under `replica_kill` + `feedback_stall`: zero
+    silent drops, at least one failover, and a self-healed restart.
+"""
+
+import os
+import queue
+import random
+import shutil
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stoix_tpu.loop import (
+    DirectRouter,
+    ExperienceRecorder,
+    FleetPublisher,
+    FleetRouter,
+    FleetUnavailableError,
+)
+from stoix_tpu.serve import PolicyServer, ServerClosedError, ServerOverloadError
+from stoix_tpu.serve.client import (
+    BackoffPolicy,
+    RetryBudgetExhaustedError,
+    ServeClient,
+    backoff_delay,
+)
+from stoix_tpu.serve.errors import ServeError
+
+
+# ---------------------------------------------------------------------------
+# Fakes: controllable replicas so router semantics need no real servers.
+# ---------------------------------------------------------------------------
+
+
+class _FakeRequest:
+    """PendingRequest-shaped future with scripted completion."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self.latency_s = 0.0
+
+    def complete(self, result):
+        self._result = result
+        self._event.set()
+
+    def fail(self, error):
+        self._error = error
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def wait(self, timeout=30.0):
+        return self._event.wait(timeout=timeout)
+
+    @property
+    def ok(self):
+        return self._event.is_set() and self._error is None
+
+    def result(self, timeout=30.0):
+        self._event.wait(timeout=timeout)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _FakeServer:
+    """Scripted replica: `mode` picks the submit behaviour."""
+
+    def __init__(self, name, mode="ok"):
+        self.name = name
+        self.mode = mode
+        self.alive = True
+        self.n_submits = 0
+        self.pending = []
+
+    def healthy(self):
+        return self.alive
+
+    def submit(self, observation):
+        self.n_submits += 1
+        if self.mode == "shed":
+            raise ServerOverloadError(64, 64)
+        if self.mode == "closed":
+            raise ServerClosedError(f"{self.name} is closed")
+        request = _FakeRequest()
+        if self.mode == "ok":
+            request.complete((self.name, observation))
+        elif self.mode == "die_after_accept":
+            request.fail(ServerClosedError(f"{self.name} killed mid-batch"))
+        elif self.mode == "hang":
+            self.pending.append(request)
+        return request
+
+
+def _no_sleep(_s):
+    return None
+
+
+class _TopRng:
+    """random.Random stand-in whose uniform() returns the upper bound, so
+    backoff sleeps equal the jitter-free envelope exactly."""
+
+    def uniform(self, _lo, hi):
+        return hi
+
+
+# ---------------------------------------------------------------------------
+# Backoff client: schedule + budget (injected RNG and sleep)
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_bounded_exponential_envelope_pinned():
+    """With jitter pinned to its upper bound the sleeps are exactly
+    base * multiplier**attempt, capped at max_s."""
+    policy = BackoffPolicy(
+        base_s=0.002, max_s=0.008, multiplier=2.0, max_attempts=10, deadline_s=60.0
+    )
+    sheds_left = [5]
+    sleeps = []
+
+    def submit_fn(obs):
+        if sheds_left[0] > 0:
+            sheds_left[0] -= 1
+            raise ServerOverloadError(1, 1)
+        return "accepted"
+
+    client = ServeClient(
+        submit_fn, policy=policy, rng=_TopRng(), sleep=sleeps.append
+    )
+    assert client.submit("obs") == "accepted"
+    assert sleeps == [0.002, 0.004, 0.008, 0.008, 0.008]  # capped at max_s
+    assert client.n_sheds == 5
+    assert client.n_retried_ok == 1
+    assert client.n_budget_exhausted == 0
+
+
+def test_backoff_full_jitter_stays_within_envelope():
+    policy = BackoffPolicy(base_s=0.004, max_s=0.064, multiplier=2.0)
+    rng = random.Random(7)
+    for attempt in range(8):
+        for _ in range(50):
+            delay = backoff_delay(policy, attempt, rng)
+            assert 0.0 <= delay <= policy.bound(attempt)
+
+
+def test_backoff_budget_exhaustion_is_typed_and_chained():
+    policy = BackoffPolicy(max_attempts=3, deadline_s=60.0)
+
+    def submit_fn(obs):
+        raise ServerOverloadError(9, 9)
+
+    client = ServeClient(submit_fn, policy=policy, rng=_TopRng(), sleep=_no_sleep)
+    with pytest.raises(RetryBudgetExhaustedError) as excinfo:
+        client.submit("obs")
+    assert isinstance(excinfo.value, ServeError)  # callers catch one base
+    assert excinfo.value.attempts == 3
+    assert isinstance(excinfo.value.__cause__, ServerOverloadError)
+    assert client.n_budget_exhausted == 1
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter: ejection / re-admission / retry / failover / hedging
+# ---------------------------------------------------------------------------
+
+
+def _router(servers, **kwargs):
+    defaults = dict(
+        retry=BackoffPolicy(max_attempts=4, deadline_s=60.0),
+        readmit_cooldown_s=0.0,
+        rng=_TopRng(),
+        sleep=_no_sleep,
+    )
+    defaults.update(kwargs)
+    return FleetRouter(servers, **defaults)
+
+
+def test_router_ejects_dead_replica_and_readmits_after_recovery():
+    alive, dead = _FakeServer("a"), _FakeServer("b")
+    dead.alive = False
+    router = _router([alive, dead])
+    for _ in range(4):
+        assert router.submit("obs").result(timeout=1.0)[0] == "a"
+    assert dead.n_submits == 0  # never routed to the ejected replica
+    stats = router.stats()
+    assert stats["ejections"] == 1 and stats["in_rotation"] == 1
+    # Recovery: cooldown is 0 so the next sweep re-admits it.
+    dead.alive = True
+    router.tick()
+    stats = router.stats()
+    assert stats["readmissions"] == 1 and stats["in_rotation"] == 2
+    names = {router.submit("obs").result(timeout=1.0)[0] for _ in range(4)}
+    assert names == {"a", "b"}  # back in rotation
+
+
+def test_router_retries_shed_against_next_replica():
+    shedder, server = _FakeServer("shed", mode="shed"), _FakeServer("ok")
+    router = _router([shedder, server])
+    for _ in range(6):
+        assert router.submit("obs").result(timeout=1.0)[0] == "ok"
+    # The shedding replica was genuinely tried and shed-retried past.
+    assert shedder.n_submits >= 1
+    assert router.n_sheds == shedder.n_submits
+    assert router.n_retries == router.n_sheds  # every shed got its retry
+
+
+def test_router_all_shedding_exhausts_retry_budget_typed():
+    router = _router(
+        [_FakeServer("s0", mode="shed"), _FakeServer("s1", mode="shed")],
+        retry=BackoffPolicy(max_attempts=3, deadline_s=60.0),
+    )
+    with pytest.raises(RetryBudgetExhaustedError):
+        router.submit("obs")
+    assert router.n_sheds == 3
+    assert router.n_unavailable == 0  # shedding replicas are alive, not down
+
+
+def test_router_all_replicas_down_fails_fast_typed():
+    a, b = _FakeServer("a"), _FakeServer("b")
+    a.alive = b.alive = False
+    router = _router([a, b])
+    with pytest.raises(FleetUnavailableError) as excinfo:
+        router.submit("obs")
+    assert excinfo.value.total == 2 and excinfo.value.ejected == 2
+    assert isinstance(excinfo.value, ServeError)
+    assert router.n_unavailable == 1
+    assert a.n_submits == 0 and b.n_submits == 0  # fail-fast: no dispatch
+
+
+def test_router_fails_over_accepted_request_after_replica_death():
+    """The zero-silent-drop property at the unit level: a request ACCEPTED by
+    a replica that then dies mid-batch is re-dispatched, and the caller gets
+    an answer — plus the dead replica is ejected."""
+    first_dies = {"armed": True}
+
+    class _DieOnFirst(_FakeServer):
+        def submit(self, observation):
+            if first_dies["armed"]:
+                first_dies["armed"] = False
+                self.mode = "die_after_accept"
+            else:
+                self.mode = "ok"
+            return super().submit(observation)
+
+    servers = [_DieOnFirst("r0"), _DieOnFirst("r1")]
+    router = _router(servers)
+    result = router.submit("obs").result(timeout=2.0)
+    assert result[0] in {"r0", "r1"}
+    assert router.n_failovers == 1
+    assert router.n_ejections == 1
+
+
+def test_router_hedge_first_answer_wins_without_double_completion():
+    fast, slow = _FakeServer("fast"), _FakeServer("slow", mode="hang")
+    # Rotation detail this test leans on: the first _pick lands on index 1
+    # (the hanging replica), so the hedge must go to `fast` to answer.
+    router = _router([fast, slow], hedge_after_s=0.0)
+    fut = router.submit("obs")
+    assert slow.pending, "primary leg should be parked on the slow replica"
+    result = fut.result(timeout=2.0)
+    assert result[0] == "fast"
+    assert router.n_hedges == 1 and router.n_hedge_wins == 1
+    # The slow leg completing LATE must not re-settle the future.
+    winner = fut.winner
+    slow.pending[0].complete(("slow", "obs"))
+    assert fut.settle(fut.legs[0] if fut.legs else winner) is False
+    assert fut.winner is winner
+    assert fut.result(timeout=1.0)[0] == "fast"
+
+
+def test_router_replaced_replica_stays_ejected_until_probe():
+    """replace() is restart, not re-admission: the new server joins the
+    rotation only after the cooldown-gated health probe (so the runner's
+    self-healing path and the router's counters stay separate events)."""
+    a, b = _FakeServer("a"), _FakeServer("b")
+    router = _router([a, b], readmit_cooldown_s=0.05)
+    b.alive = False
+    router.tick()
+    assert router.stats()["in_rotation"] == 1
+    replacement = _FakeServer("b2")
+    router.replace(1, replacement)
+    router.tick()  # cooldown not yet elapsed
+    assert router.stats()["in_rotation"] == 1
+    time.sleep(0.06)
+    router.tick()
+    stats = router.stats()
+    assert stats["in_rotation"] == 2 and stats["readmissions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ExperienceRecorder: drop-oldest, never-block, bounce-not-wedge
+# ---------------------------------------------------------------------------
+
+
+class _FakePipeline:
+    def __init__(self, full=False):
+        self.full = full
+        self.batches = []
+
+    def push(self, actor_id, stacked, timeout=None):
+        if self.full:
+            raise queue.Full()
+        self.batches.append(stacked)
+
+
+def test_recorder_drop_oldest_and_never_blocks():
+    recorder = ExperienceRecorder(_FakePipeline(), flush_batch=4, capacity=8)
+    start = time.perf_counter()
+    for i in range(20):
+        recorder.record({"i": np.int32(i)})
+    assert time.perf_counter() - start < 0.5  # no blocking path exists
+    stats = recorder.stats()
+    assert stats["recorded"] == 20
+    assert stats["dropped"] == 12
+    assert stats["depth"] == 8
+    # Drop-OLDEST: the survivors are the 8 freshest transitions.
+    assert [int(t["i"]) for t in recorder._buf] == list(range(12, 20))
+
+
+def test_recorder_wedged_pipeline_bounces_batches_not_feeder():
+    pipeline = _FakePipeline(full=True)
+    recorder = ExperienceRecorder(
+        pipeline, flush_batch=4, capacity=16, push_timeout_s=0.01
+    ).start()
+    try:
+        for i in range(4):
+            recorder.record({"i": np.int32(i)})
+        deadline = time.time() + 2.0
+        while recorder.stats()["push_timeouts"] < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        stats = recorder.stats()
+        assert stats["push_timeouts"] >= 2  # kept trying, never wedged
+        assert stats["fed"] == 0
+        assert stats["dropped"] == 0  # the bounce is lossless under capacity
+        # Un-wedge: the same batch now feeds through.
+        pipeline.full = False
+        deadline = time.time() + 2.0
+        while recorder.stats()["fed"] < 4 and time.time() < deadline:
+            time.sleep(0.01)
+        assert recorder.stats()["fed"] == 4
+        assert pipeline.batches[0]["i"].shape == (4,)  # host-stacked batch
+    finally:
+        recorder.stop()
+
+
+# ---------------------------------------------------------------------------
+# FleetPublisher: fleet-wide canary rollback, pinned bitwise
+# ---------------------------------------------------------------------------
+
+_OBS_DIM, _N_ACT = 6, 4
+_OBS_TEMPLATE = np.zeros((_OBS_DIM,), np.float32)
+
+
+class _LinearDist:
+    def __init__(self, logits):
+        self.logits = logits
+
+    def mode(self):
+        return jnp.argmax(self.logits, axis=-1)
+
+    def sample(self, *, seed):
+        return jax.random.categorical(seed, self.logits, axis=-1)
+
+
+def _linear_apply(params, observation):
+    return _LinearDist(observation @ params)
+
+
+def _linear_server(name):
+    params = jnp.asarray(
+        np.random.default_rng(0).normal(size=(_OBS_DIM, _N_ACT)).astype(np.float32)
+    )
+    return PolicyServer(
+        apply_fn=_linear_apply,
+        params=params,
+        obs_template=_OBS_TEMPLATE,
+        buckets=[1, 2],
+        max_wait_s=0.002,
+        max_queue=64,
+        greedy=True,
+        name=name,
+    )
+
+
+class _FakeSource:
+    """PolicySource-shaped step feed for the publisher."""
+
+    def __init__(self):
+        self.step = None
+        self.params = None
+
+    def latest_step(self):
+        return self.step
+
+    def load(self, step):
+        assert step == self.step
+        return self.params, step
+
+
+def test_fleet_publisher_poisoned_push_rolls_whole_fleet_back_bitwise():
+    """One replica's canary rejects a poisoned candidate → the publish is
+    TORN → every replica that swapped is rolled back: the fleet serves the
+    OLD params bit-for-bit, at the old step, on every replica."""
+    from stoix_tpu.resilience import faultinject
+
+    servers = [_linear_server("pub0"), _linear_server("pub1")]
+    source = _FakeSource()
+    base = np.asarray(servers[0].engine.get_params())
+    publisher = FleetPublisher(servers, source, initial_step=0, canary=True)
+    try:
+        # A clean push commits fleet-wide.
+        source.step, source.params = 1, jnp.asarray(base + 1.0)
+        assert publisher.publish() == 1
+        assert publisher.current_step == 1
+        committed = np.asarray(base + 1.0)
+        for server in servers:
+            np.testing.assert_array_equal(
+                np.asarray(server.engine.get_params()), committed
+            )
+        # A poisoned push: swap_poison NaNs the FIRST loaded candidate
+        # (one-shot), so replica 0 rejects while replica 1 accepts — torn.
+        faultinject.configure("swap_poison")
+        source.step, source.params = 2, jnp.asarray(base + 2.0)
+        assert publisher.publish() is None
+        assert publisher.n_rollbacks == 1
+        assert publisher.current_step == 1  # fleet step did NOT advance
+        for server, watcher in zip(servers, publisher.watchers):
+            assert watcher.current_step == 1
+            np.testing.assert_array_equal(
+                np.asarray(server.engine.get_params()), committed
+            )
+        # The next (clean) push of the same step commits everywhere.
+        assert publisher.publish() == 2
+        for server in servers:
+            np.testing.assert_array_equal(
+                np.asarray(server.engine.get_params()), np.asarray(base + 2.0)
+            )
+        assert publisher.stats() == {
+            "step": 2, "publishes": 3, "commits": 2, "rollbacks": 1,
+        }
+    finally:
+        faultinject.reset()
+        for server in servers:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-backed paths: router-off bitwise pin + chaos e2e
+# ---------------------------------------------------------------------------
+
+_UID = "loop-test"
+
+
+@pytest.fixture(scope="module")
+def loop_store(shared_identity_checkpoint, tmp_path_factory):
+    """Module-private COPY of the session-shared checkpoint (the loop
+    learner PUBLISHES new steps into its store, which must stay local)."""
+    shared_store, _ = shared_identity_checkpoint
+    root = tmp_path_factory.mktemp("loop_ckpt")
+    store = os.path.join(str(root), "checkpoints", _UID, "ff_ppo")
+    shutil.copytree(shared_store, store)
+    return store
+
+
+def _loop_config(store, extra=()):
+    from stoix_tpu.utils import config as config_lib
+
+    return config_lib.compose(
+        config_lib.default_config_dir(),
+        "default/loop.yaml",
+        [f"arch.serve.checkpoint.path={store}", *extra],
+    )
+
+
+def test_router_off_direct_path_serves_bit_identical_logits(loop_store):
+    """arch.loop.fleet.router.enabled=false is the pinned pass-through: the
+    DirectRouter-wrapped single replica serves logits bit-identical to the
+    direct jitted apply — the same reference `launcher serve` is pinned
+    against in test_serve.py, so the two paths are transitively identical."""
+    from stoix_tpu.serve import load_policy
+
+    config = _loop_config(loop_store, ["arch.serve.greedy=true"])
+    bundle = load_policy(config)
+    observations = [
+        jax.tree.map(
+            lambda x, i=i: (x + i).astype(np.asarray(x).dtype)
+            if np.issubdtype(np.asarray(x).dtype, np.floating)
+            else x,
+            bundle.obs_template,
+        )
+        for i in range(4)
+    ]
+    batched = jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *observations
+    )
+    direct = np.asarray(
+        jax.jit(lambda p, o: bundle.apply_fn(p, o).logits)(bundle.params, batched)
+    )
+
+    from stoix_tpu.loop.runner import _build_replica
+
+    server = _build_replica(bundle, config.arch.serve, 0, seed=0)
+    router = DirectRouter(server)
+    with server:
+        futures = [router.submit(obs) for obs in observations]
+        for i, future in enumerate(futures):
+            np.testing.assert_array_equal(
+                future.result(timeout=30.0).extras["logits"], direct[i]
+            )
+    assert router.stats() == {"mode": "direct", "replicas": 1}
+
+
+def test_loop_chaos_e2e_zero_silent_drops_with_failover_and_selfheal(loop_store):
+    """run_loop under the chaos drill: hard-kill a replica mid-round (its
+    in-flight requests must fail over, not vanish), wedge the experience
+    feeder — and the accounting must still balance to zero silent drops,
+    with the killed replica restarted (self-healed) inside the window."""
+    from stoix_tpu.loop import run_loop
+    from stoix_tpu.resilience import faultinject
+
+    config = _loop_config(
+        loop_store,
+        [
+            "arch.loop.traffic.duration_s=3.0",
+            "arch.loop.traffic.offered_qps=80.0",
+            "arch.loop.learner.publish_interval_s=0.5",
+            "arch.loop.fleet.restart_cooldown_s=0.3",
+        ],
+    )
+    faultinject.configure("replica_kill:1,feedback_stall:1")
+    try:
+        report = run_loop(config)
+    finally:
+        faultinject.reset()
+
+    assert report["silent_drops"] == 0
+    assert (
+        report["accepted"]
+        == report["completed"] + report["typed_failures"]
+    )
+    assert report["completed"] > 0
+    assert report["replica_kills"] == 1
+    assert report["replica_restarts"] == 1  # self-healed inside the window
+    assert report["router_stats"]["failovers"] >= 1
+    assert report["router_stats"]["ejections"] >= 1
+    # The serve path rode out the feeder stall: experience was recorded and
+    # nothing wedged (drops are allowed — silent drops are not).
+    assert report["recorder"]["recorded"] > 0
+    assert report["episodes"] > 0

@@ -429,7 +429,11 @@ def test_member_fingerprints_and_quarantine():
 # sweep.py --backend population: one run, same results-JSON schema
 
 
+@pytest.mark.slow
 def test_population_sweep_matches_sequential_schema(devices, capsys):
+    # Slow lane (tier-1 budget, PR 19): two full sweep runs back to back
+    # (~29s); the population-backend results schema is also pinned by the
+    # not-slow population trainer tests and test_sweep.py's schema suite.
     from stoix_tpu.sweep import parse_space, run_sweep
 
     space = parse_space(["system.clip_eps=choice:0.1,0.2"])

@@ -256,39 +256,18 @@ _UID = "serve-test"
 
 
 @pytest.fixture(scope="module")
-def trained_store(tmp_path_factory):
-    """One tiny ff_ppo training run with checkpointing on; yields
-    (store_dir, train_root_dir)."""
-    from stoix_tpu.systems.ppo.anakin import ff_ppo
-    from stoix_tpu.utils import config as config_lib
+def trained_store(shared_identity_checkpoint, tmp_path_factory):
+    """Module-private COPY of the session-shared trained checkpoint
+    (tests/conftest.py `shared_identity_checkpoint` — ONE tiny ff_ppo train
+    for the whole session instead of one per module). The copy matters: the
+    hot-swap test below writes a step-2048 checkpoint into this store, which
+    must never leak into other modules reading "latest"."""
+    import shutil
 
+    shared_store, _shared_root = shared_identity_checkpoint
     root = tmp_path_factory.mktemp("serve_ckpt")
-    config = config_lib.compose(
-        config_lib.default_config_dir(),
-        "default/anakin/default_ff_ppo.yaml",
-        [
-            "env=identity_game",
-            "arch.total_num_envs=16",
-            "arch.total_timesteps=1024",
-            "arch.num_evaluation=1",
-            "arch.num_eval_episodes=8",
-            "arch.absolute_metric=False",
-            "system.rollout_length=8",
-            "system.num_minibatches=2",
-            "logger.use_console=False",
-            f"logger.base_exp_path={root}/results",
-            "logger.checkpointing.save_model=True",
-            f"logger.checkpointing.save_args.checkpoint_uid={_UID}",
-        ],
-    )
-    cwd = os.getcwd()
-    os.chdir(root)
-    try:
-        ff_ppo.run_experiment(config)
-    finally:
-        os.chdir(cwd)
     store = os.path.join(str(root), "checkpoints", _UID, "ff_ppo")
-    assert os.path.isdir(store)
+    shutil.copytree(shared_store, store)
     return store, str(root)
 
 

@@ -604,7 +604,11 @@ def test_cli_github_format_for_seeded_stx018(tmp_path):
     assert "file=stoix_tpu/_stx18_scratch_probe.py,line=5" in annotations[0]
 
 
+@pytest.mark.slow
 def test_preflight_reports_concurrency_model_row(monkeypatch, capsys):
+    # Slow lane (tier-1 budget, PR 19): embeds a full-repo thread-model
+    # scan (~31s); the non-vacuity contract (empty model FAILS preflight)
+    # keeps its own not-slow test below — that is the load-bearing gate.
     from stoix_tpu import launcher
     from stoix_tpu.resilience import preflight
 
