@@ -112,7 +112,7 @@ def print_statistics(
     CI log shows at a glance whether a quiet gate is quiet because the code
     is clean, because every finding is noqa'd away, or because a refactor
     silently emptied the model a rule family depends on."""
-    from stoix_tpu.analysis import meshmodel, threadmodel
+    from stoix_tpu.analysis import meshmodel, opsmodel, threadmodel
 
     by_rule: dict = {}
     for f in findings:
@@ -156,6 +156,15 @@ def print_statistics(
         f"root(s), {t['locks']} lock(s), {t['shared']} shared binding(s), "
         f"{t['obligations']} completion obligation(s) across {t['files']} "
         f"file(s)",
+        file=err,
+    )
+    o = opsmodel.repo_summary(paths)
+    print(
+        f"[stats] opsmodel: {o['series']} metric series "
+        f"({o['metric_sites']} creation / {o['observe_sites']} observe "
+        f"site(s)), {o['kv_writes']} KV write(s) / {o['kv_reads']} read(s), "
+        f"{o['exit_sites']} hard-exit site(s), {o['fault_sites']} fault-spec "
+        f"site(s) across {o['files']} file(s)",
         file=err,
     )
 

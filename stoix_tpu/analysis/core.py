@@ -148,7 +148,10 @@ class Rule:
     def run_on_source(
         self, source: str, rel: Optional[str] = None, repo: str = REPO
     ) -> List[Finding]:
-        """Run this rule alone against an in-memory snippet (fixture tests)."""
+        """Run this rule alone against an in-memory snippet (fixture tests).
+        Tree-scoped checks run too, over a one-file tree — so rules whose
+        contract is inherently cross-module (STX019/020/022/023) still ship
+        replayable in-module fixtures."""
         rel = rel or self.fixture_rel
         ctx = FileContext(
             repo=repo,
@@ -158,7 +161,10 @@ class Rule:
             lines=source.splitlines(),
             tree=ast.parse(source),
         )
-        return list(self.check_file(self, ctx)) if self.check_file else []
+        findings = list(self.check_file(self, ctx)) if self.check_file else []
+        if self.check_tree is not None:
+            findings.extend(self.check_tree(self, TreeContext(repo, [ctx])))
+        return findings
 
 
 # ---------------------------------------------------------------------------

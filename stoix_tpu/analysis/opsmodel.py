@@ -1,0 +1,714 @@
+"""Ops-contract static model (ISSUE 20): metrics, fleet-KV keyspace,
+hard-exit paths, and fault-spec vocabulary — the distributed-runtime
+contract surface the STX019-STX023 rule family checks.
+
+The repo's cross-process coordination fabric is held together by *names*:
+~82 hand-named `stoix_tpu_*` metric series, fleet-KV key patterns
+(`hb/<pid>`, `vote/<window>/<pid>`, `ometrics/<pid>`, `flags`) written in
+one module and read in another, `EXIT_CODE_*` symbols that must each dump a
+flight record before `os._exit`, and the fault-spec vocabulary
+(`faultinject._KNOWN`) that tests/bench/soak arm by string. None of those
+names are checked by the type system; all of them have drifted by hand at
+least once. This module builds a per-module, `FileContext`-memoized model
+of the four surfaces (the same architecture as `meshmodel`/`threadmodel`:
+pure AST, no jax import, shared across rules via `ctx.memo`):
+
+  * **Metric sites** — every `<registry>.counter/gauge/histogram(name, ...)`
+    creation with its name normalized to a *pattern* (f-string holes and
+    `%`-conversions become `{}`; module-level string constants are resolved,
+    so `registry.counter(_EVENTS_METRIC, ...)` stays lintable), plus every
+    `inc/set/dec/observe` call whose receiver *binds* to a creation site
+    (same binding-key discipline as threadmodel: `self._m` is matched
+    class-wide, a module name module-wide, a local within its function),
+    carrying the label-key set used at that call.
+  * **Fleet-KV keyspace** — every `put/try_get/get_blocking/barrier` whose
+    key normalizes to a pattern, split into writer (`put`) and reader
+    (`try_get`/`get_blocking`) sides. Generic transport wrappers whose key
+    is a bare parameter normalize to ``None`` and are recorded but not
+    contract-checked.
+  * **Hard-exit sites** — `os._exit(...)`/`sys.exit(...)` calls carrying an
+    `EXIT_CODE_*` symbol or int literal, with the enclosing function and a
+    statically-preceding-call index so a rule can ask "is a flight-record
+    dump reachable before this exit, in this function or its callees?".
+  * **Fault-spec sites** — every spec string armed via
+    `faultinject.configure(...)`, `STOIX_TPU_FAULT` env plumbing
+    (setenv / dict literal / subscript assignment), or an
+    `arch.fault_spec=<spec>` override literal, parsed into spec names.
+
+Documented blind spots (docs/DESIGN.md §2.5): metric receivers are matched
+by *method name* (`.counter(`/`.gauge(`/`.histogram(`) — a non-registry
+object exposing those method names with a string first argument would be
+modeled as a metric; KV `put` receivers are matched by a name hint
+(store/backend/kv/fleet) so `queue.put(item)` is not misread as a KV write,
+which means a KV store bound to an unrelated name is *missed*, not
+misattributed; observe-site binding resolution is one assignment deep
+(a metric handle passed across functions as an argument is not followed);
+exit-code reachability follows module-local and self-method callees to a
+fixed depth, not across modules; f-string holes match greedily, so two
+patterns differing only inside holes unify.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from stoix_tpu.analysis.threadmodel import dotted
+
+_METRIC_CTOR_ATTRS = ("counter", "gauge", "histogram")
+_OBSERVE_ATTRS = ("inc", "dec", "set", "observe")
+# Methods whose *names* are distinctive enough to attribute to the fleet-KV
+# protocol on any receiver; `put` additionally needs a receiver name hint
+# (queue.Queue.put(item) carries payloads, not keys).
+_KV_READ_ATTRS = ("try_get", "get_blocking")
+_KV_WRITE_ATTRS = ("put",)
+_KV_RECEIVER_HINTS = ("store", "backend", "kv", "fleet")
+
+_PCT_CONVERSION = re.compile(r"%[-#0-9 +.]*[a-zA-Z]")
+_SPEC_NAME = re.compile(r"^[a-z_][a-z0-9_]*$")
+_SPEC_ITEM = re.compile(r"^([a-z_{][a-z0-9_{}]*)(?::.+)?$")
+_FAULT_ENV_VAR = "STOIX_TPU_FAULT"
+_FAULT_OVERRIDE = re.compile(r"fault_spec=([^\s'\"]*)")
+
+
+# ---------------------------------------------------------------------------
+# Sites
+
+
+@dataclass
+class MetricSite:
+    """One `registry.counter/gauge/histogram(name, ...)` creation."""
+
+    pattern: Optional[str]  # normalized name; None = not normalizable
+    kind: str  # "counter" | "gauge" | "histogram"
+    lineno: int
+
+
+@dataclass
+class ObserveSite:
+    """One `inc/set/dec/observe` call resolved to a metric series."""
+
+    pattern: Optional[str]
+    kind: str
+    method: str
+    label_keys: Optional[Tuple[str, ...]]  # sorted; None = dynamic/unknown
+    lineno: int
+
+
+@dataclass
+class KVSite:
+    """One fleet-KV protocol call."""
+
+    op: str  # "put" | "try_get" | "get_blocking" | "barrier"
+    side: str  # "write" | "read" | "barrier"
+    pattern: Optional[str]  # normalized key; None = generic wrapper
+    lineno: int
+
+
+@dataclass
+class ExitSite:
+    """One os._exit / sys.exit call site."""
+
+    via: str  # "os._exit" | "sys.exit"
+    code_name: Optional[str]  # EXIT_CODE_* symbol at the call, if any
+    code_value: Optional[int]  # int literal at the call, if any
+    lineno: int
+    fn_id: Optional[int]  # id() of the enclosing function node
+
+
+@dataclass
+class FaultSpecSite:
+    """One armed fault-spec string (configure / env / override literal)."""
+
+    names: Tuple[str, ...]  # parsed spec names ("" entries dropped)
+    raw: str
+    lineno: int
+    complete: bool  # False when part of the spec was dynamic
+
+
+# ---------------------------------------------------------------------------
+# Pattern helpers (shared with the rules and their tests)
+
+
+def normalize_name(
+    node: ast.AST, constants: Optional[Dict[str, str]] = None
+) -> Optional[str]:
+    """Normalize a name expression to a pattern: literal parts verbatim,
+    dynamic holes as `{}`, module-level string constants resolved. Returns
+    None when no literal skeleton survives (a bare unresolved name, a call,
+    arbitrary arithmetic)."""
+    constants = constants or {}
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return constants.get(node.id)
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for value in node.values:
+            if isinstance(value, ast.Constant) and isinstance(value.value, str):
+                parts.append(value.value)
+            elif isinstance(value, ast.FormattedValue):
+                inner = normalize_name(value.value, constants)
+                parts.append(inner if inner is not None else "{}")
+            else:
+                return None
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        left = normalize_name(node.left, constants)
+        if left is None:
+            return None
+        return _PCT_CONVERSION.sub("{}", left)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = normalize_name(node.left, constants)
+        right = normalize_name(node.right, constants)
+        if left is None or right is None:
+            return None
+        return left + right
+    return None
+
+
+def _pattern_regex(pattern: str) -> "re.Pattern[str]":
+    parts = [re.escape(piece) for piece in pattern.split("{}")]
+    return re.compile("(?s:" + ".+".join(parts) + ")\\Z")
+
+
+def patterns_match(a: str, b: str) -> bool:
+    """Whether two normalized key patterns can name the same KV entry:
+    `hb/{}` matches `hb/{}` and the literal `hb/3`; `flags` only matches
+    `flags`. Holes match greedily in either direction (documented blind
+    spot: patterns differing only inside holes unify)."""
+    if a == b:
+        return True
+    return bool(
+        _pattern_regex(a).match(b.replace("{}", "\x00"))
+        or _pattern_regex(b).match(a.replace("{}", "\x00"))
+    )
+
+
+def parse_fault_spec(raw: str) -> Tuple[Tuple[str, ...], bool]:
+    """Parse a fault-spec string into (names, complete). The null form `~`
+    and the empty string carry no names; a `{}` hole from normalization
+    marks the site incomplete (dynamic name part) without inventing names."""
+    raw = raw.strip()
+    if raw in ("", "~"):
+        return (), True
+    names: List[str] = []
+    complete = True
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        match = _SPEC_ITEM.match(item)
+        if match is None:
+            complete = False
+            continue
+        name = match.group(1)
+        if _SPEC_NAME.match(name):
+            names.append(name)
+        else:
+            complete = False  # a `{}` hole or malformed name part
+    return tuple(names), complete
+
+
+def module_string_constants(tree: ast.AST) -> Dict[str, str]:
+    """Module-level `NAME = "literal"` bindings (incl. annotated), the
+    resolution table for constant-named metrics/keys/specs."""
+    constants: Dict[str, str] = {}
+    for node in getattr(tree, "body", []):
+        target: Optional[ast.AST] = None
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target, value = node.target, node.value
+        if (
+            isinstance(target, ast.Name)
+            and isinstance(value, ast.Constant)
+            and isinstance(value.value, str)
+        ):
+            constants[target.id] = value.value
+    return constants
+
+
+def module_int_constants(tree: ast.AST) -> Dict[str, int]:
+    """Module-level `NAME = <int>` bindings (the EXIT_CODE_* fallback for
+    fixtures that define their own codes)."""
+    constants: Dict[str, int] = {}
+    for node in getattr(tree, "body", []):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+            if (
+                isinstance(target, ast.Name)
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, int)
+                and not isinstance(value.value, bool)
+            ):
+                constants[target.id] = value.value
+    return constants
+
+
+def known_fault_specs(tree: ast.AST) -> Tuple[str, ...]:
+    """The `_KNOWN = (...)` vocabulary tuple, if this module defines one."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name) and target.id == "_KNOWN":
+                value = node.value
+                if isinstance(value, (ast.Tuple, ast.List)):
+                    return tuple(
+                        elt.value
+                        for elt in value.elts
+                        if isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)
+                    )
+    return ()
+
+
+# ---------------------------------------------------------------------------
+# The per-module model
+
+
+class ModuleOpsModel:
+    """All four ops-contract surfaces of one parsed module."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.tree = tree
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        self.constants = module_string_constants(tree)
+        self.int_constants = module_int_constants(tree)
+        self.known_specs = known_fault_specs(tree)
+
+        # Function index: (class or None, name) -> fn nodes; id(fn) -> fn.
+        self._functions: Dict[Tuple[Optional[str], str], List[ast.AST]] = {}
+        self._fn_by_id: Dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._functions.setdefault(
+                    (self._nearest_class(node), node.name), []
+                ).append(node)
+                self._fn_by_id[id(node)] = node
+
+        self.metric_sites: List[MetricSite] = []
+        self.observe_sites: List[ObserveSite] = []
+        self.kv_sites: List[KVSite] = []
+        self.exit_sites: List[ExitSite] = []
+        self.fault_sites: List[FaultSpecSite] = []
+
+        self._bindings: Dict[str, Tuple[Optional[str], str]] = {}
+        self._collect_metric_sites()
+        self._collect_observe_sites()
+        self._collect_kv_sites()
+        self._collect_exit_sites()
+        self._collect_fault_sites()
+
+    # -- structure helpers ----------------------------------------------------
+    def _nearest_class(self, node: ast.AST) -> Optional[str]:
+        current = self._parents.get(id(node))
+        while current is not None:
+            if isinstance(current, ast.ClassDef):
+                return current.name
+            if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A class nested inside a function shadows; a method's
+                # nearest class is found before its enclosing function.
+                pass
+            current = self._parents.get(id(current))
+        return None
+
+    def enclosing_fn(self, node: ast.AST) -> Optional[ast.AST]:
+        current = self._parents.get(id(node))
+        while current is not None:
+            if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return current
+            current = self._parents.get(id(current))
+        return None
+
+    def _binding_key(self, expr: ast.AST, fn: Optional[ast.AST]) -> Optional[str]:
+        chain = dotted(expr)
+        if len(chain) == 2 and chain[0] == "self":
+            cls = self._nearest_class(expr)
+            if cls is None:
+                return None
+            return f"attr:{cls}.{chain[1]}"
+        if len(chain) == 1:
+            if fn is None:
+                return f"global:{chain[0]}"
+            return f"local:{id(fn)}:{chain[0]}"
+        return None
+
+    def _lookup_binding(
+        self, expr: ast.AST, fn: Optional[ast.AST]
+    ) -> Optional[Tuple[Optional[str], str]]:
+        key = self._binding_key(expr, fn)
+        if key is not None and key in self._bindings:
+            return self._bindings[key]
+        # A plain local that was never assigned locally may be a module name.
+        chain = dotted(expr)
+        if len(chain) == 1:
+            return self._bindings.get(f"global:{chain[0]}")
+        return None
+
+    # -- metric sites ----------------------------------------------------------
+    def _metric_ctor(self, node: ast.AST) -> Optional[Tuple[Optional[str], str]]:
+        """(pattern, kind) when `node` is a metric-creation call."""
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_CTOR_ATTRS
+        ):
+            return None
+        if not node.args:
+            return None
+        name_arg = node.args[0]
+        # Only string-shaped first arguments read as metric names; this is
+        # what keeps `collections.Counter(...)`-style homonyms out (those
+        # are capitalized anyway) and skips e.g. `mock.counter(5)`.
+        if not isinstance(
+            name_arg, (ast.Constant, ast.JoinedStr, ast.Name, ast.BinOp)
+        ):
+            return None
+        if isinstance(name_arg, ast.Constant) and not isinstance(
+            name_arg.value, str
+        ):
+            return None
+        return normalize_name(name_arg, self.constants), node.func.attr
+
+    def _collect_metric_sites(self) -> None:
+        for node in ast.walk(self.tree):
+            ctor = self._metric_ctor(node)
+            if ctor is None:
+                continue
+            pattern, kind = ctor
+            self.metric_sites.append(MetricSite(pattern, kind, node.lineno))
+            parent = self._parents.get(id(node))
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                key = self._binding_key(
+                    parent.targets[0], self.enclosing_fn(parent)
+                )
+                if key is not None:
+                    self._bindings[key] = (pattern, kind)
+
+    @staticmethod
+    def _label_keys(
+        call: ast.Call, method: str
+    ) -> Optional[Tuple[str, ...]]:
+        """The label-key set at one observe call: () when no labels are
+        passed, sorted keys for a dict literal, None (unknown) otherwise.
+        Signatures: inc(amount, labels) / dec(amount, labels) /
+        set(value, labels) / observe(value, labels)."""
+        labels: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg == "labels":
+                labels = kw.value
+        if labels is None and len(call.args) >= 2:
+            labels = call.args[1]
+        if labels is None or (
+            isinstance(labels, ast.Constant) and labels.value is None
+        ):
+            return ()
+        if isinstance(labels, ast.Dict) and all(
+            isinstance(k, ast.Constant) and isinstance(k.value, str)
+            for k in labels.keys
+        ):
+            return tuple(sorted(k.value for k in labels.keys))
+        return None
+
+    def _collect_observe_sites(self) -> None:
+        for node in ast.walk(self.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _OBSERVE_ATTRS
+            ):
+                continue
+            receiver = node.func.value
+            resolved = self._metric_ctor(receiver)  # chained: ctor().inc()
+            if resolved is None:
+                resolved = self._lookup_binding(
+                    receiver, self.enclosing_fn(node)
+                )
+            if resolved is None:
+                continue  # .set()/.inc() on a non-metric (Event, counters…)
+            pattern, kind = resolved
+            self.observe_sites.append(
+                ObserveSite(
+                    pattern,
+                    kind,
+                    node.func.attr,
+                    self._label_keys(node, node.func.attr),
+                    node.lineno,
+                )
+            )
+
+    # -- fleet-KV sites --------------------------------------------------------
+    def _collect_kv_sites(self) -> None:
+        for node in ast.walk(self.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.args
+            ):
+                continue
+            attr = node.func.attr
+            if attr in _KV_READ_ATTRS:
+                side = "read"
+            elif attr == "barrier":
+                side = "barrier"
+            elif attr in _KV_WRITE_ATTRS:
+                chain = dotted(node.func.value)
+                hint = (chain[-1] if chain else "").lower()
+                if not any(h in hint for h in _KV_RECEIVER_HINTS):
+                    continue  # queue.put(item) and friends
+                side = "write"
+            else:
+                continue
+            key_arg = node.args[0]
+            pattern = normalize_name(key_arg, self.constants)
+            if pattern is None and not isinstance(
+                key_arg, (ast.Name, ast.Attribute)
+            ):
+                # A non-name, non-normalizable key (a call, arithmetic):
+                # still a protocol site, still opaque.
+                pattern = None
+            self.kv_sites.append(KVSite(attr, side, pattern, node.lineno))
+
+    # -- hard-exit sites -------------------------------------------------------
+    def _collect_exit_sites(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            if chain == ["os", "_exit"]:
+                via = "os._exit"
+            elif chain == ["sys", "exit"]:
+                via = "sys.exit"
+            else:
+                continue
+            code_name: Optional[str] = None
+            code_value: Optional[int] = None
+            if node.args:
+                arg = node.args[0]
+                arg_chain = dotted(arg)
+                if arg_chain and arg_chain[-1].startswith("EXIT_CODE_"):
+                    code_name = arg_chain[-1]
+                    code_value = self.int_constants.get(code_name)
+                elif isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, int
+                ):
+                    code_value = arg.value
+            fn = self.enclosing_fn(node)
+            self.exit_sites.append(
+                ExitSite(via, code_name, code_value, node.lineno, id(fn) if fn else None)
+            )
+
+    # -- exit reachability -----------------------------------------------------
+    def _calls_in(
+        self, fn: ast.AST, before_line: Optional[int] = None
+    ) -> List[ast.Call]:
+        calls: List[ast.Call] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and (
+                before_line is None or node.lineno < before_line
+            ):
+                calls.append(node)
+        return calls
+
+    def flight_dump_reachable(self, site: ExitSite, depth: int = 3) -> bool:
+        """Whether a flight-record dump (`dump_flight_record` by any dotted
+        path) is statically reachable before this exit: among the calls
+        preceding it in the enclosing function, or inside a module-local /
+        self-method callee of one of those calls (to `depth` levels)."""
+        fn = self._fn_by_id.get(site.fn_id) if site.fn_id else None
+        if fn is None:
+            return False
+        return self._dump_in_calls(
+            self._calls_in(fn, before_line=site.lineno + 1),
+            self._nearest_class(fn),
+            depth,
+            seen=set(),
+        )
+
+    def _dump_in_calls(
+        self,
+        calls: Iterable[ast.Call],
+        cls: Optional[str],
+        depth: int,
+        seen: Set[int],
+    ) -> bool:
+        callees: List[Tuple[Optional[str], str]] = []
+        for call in calls:
+            chain = dotted(call.func)
+            if not chain:
+                continue
+            if chain[-1] == "dump_flight_record":
+                return True
+            if len(chain) == 2 and chain[0] == "self":
+                callees.append((cls, chain[1]))
+            elif len(chain) == 1:
+                callees.append((None, chain[0]))
+        if depth <= 0:
+            return False
+        for callee_cls, name in callees:
+            for fn in self._functions.get((callee_cls, name), []):
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                if self._dump_in_calls(
+                    self._calls_in(fn),
+                    self._nearest_class(fn),
+                    depth - 1,
+                    seen,
+                ):
+                    return True
+        return False
+
+    def fn_references(self, fn_name: str) -> Set[str]:
+        """All `EXIT_CODE_*`-shaped names referenced anywhere inside the
+        module's function(s) named `fn_name` (the run_supervised coverage
+        probe)."""
+        names: Set[str] = set()
+        for (cls, name), fns in self._functions.items():
+            if name != fn_name:
+                continue
+            for fn in fns:
+                for node in ast.walk(fn):
+                    chain = dotted(node) if isinstance(node, (ast.Name, ast.Attribute)) else []
+                    if chain and chain[-1].startswith("EXIT_CODE_"):
+                        names.add(chain[-1])
+        return names
+
+    # -- fault-spec sites ------------------------------------------------------
+    def _record_spec(self, node: ast.AST, lineno: int) -> None:
+        pattern = normalize_name(node, self.constants)
+        if pattern is None:
+            self.fault_sites.append(FaultSpecSite((), "<dynamic>", lineno, False))
+            return
+        names, complete = parse_fault_spec(pattern)
+        self.fault_sites.append(FaultSpecSite(names, pattern, lineno, complete))
+
+    def _collect_fault_sites(self) -> None:
+        for node in ast.walk(self.tree):
+            # faultinject.configure("<spec>") — the bare-name collision with
+            # observability.configure(config) is filtered by requiring a
+            # spec-shaped (string-normalizable) first argument.
+            if isinstance(node, ast.Call):
+                chain = dotted(node.func)
+                if (
+                    chain
+                    and chain[-1] == "configure"
+                    and node.args
+                    and normalize_name(node.args[0], self.constants) is not None
+                ):
+                    if len(chain) == 1 or "faultinject" in chain[:-1] or (
+                        len(chain) == 2 and chain[0] not in ("observability",)
+                    ):
+                        self._record_spec(node.args[0], node.lineno)
+                        continue
+                # monkeypatch.setenv("STOIX_TPU_FAULT", spec) / os.environ
+                # setdefault-style plumbing.
+                if (
+                    chain
+                    and chain[-1] in ("setenv", "setdefault")
+                    and len(node.args) >= 2
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == _FAULT_ENV_VAR
+                ):
+                    self._record_spec(node.args[1], node.lineno)
+                    continue
+            # {"STOIX_TPU_FAULT": spec} dict literals (env blocks).
+            if isinstance(node, ast.Dict):
+                for key, value in zip(node.keys, node.values):
+                    if (
+                        isinstance(key, ast.Constant)
+                        and key.value == _FAULT_ENV_VAR
+                        and value is not None
+                    ):
+                        self._record_spec(value, value.lineno)
+            # env["STOIX_TPU_FAULT"] = spec subscript assignment.
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if (
+                    isinstance(target, ast.Subscript)
+                    and isinstance(target.slice, ast.Constant)
+                    and target.slice.value == _FAULT_ENV_VAR
+                ):
+                    self._record_spec(node.value, node.lineno)
+            # "arch.fault_spec=<spec>" override literals (launcher/bench/
+            # soak job argv), including the spec-armed f-string form.
+            spec_from_override = None
+            if isinstance(node, (ast.Constant, ast.JoinedStr, ast.BinOp)):
+                parent = self._parents.get(id(node))
+                if isinstance(parent, (ast.Constant, ast.JoinedStr, ast.FormattedValue)):
+                    continue  # inner parts are handled via their container
+                normalized = normalize_name(node, self.constants)
+                if normalized is not None and "fault_spec=" in normalized:
+                    match = _FAULT_OVERRIDE.search(normalized)
+                    if match:
+                        spec_from_override = match.group(1)
+            if spec_from_override is not None:
+                names, complete = parse_fault_spec(spec_from_override)
+                self.fault_sites.append(
+                    FaultSpecSite(names, spec_from_override, node.lineno, complete)
+                )
+
+    # -- aggregation -----------------------------------------------------------
+    def summary(self) -> Dict[str, int]:
+        series = {s.pattern for s in self.metric_sites if s.pattern}
+        return {
+            "metric_sites": len(self.metric_sites),
+            "series": len(series),
+            "observe_sites": len(self.observe_sites),
+            "kv_writes": sum(1 for s in self.kv_sites if s.side == "write"),
+            "kv_reads": sum(1 for s in self.kv_sites if s.side == "read"),
+            "exit_sites": len(self.exit_sites),
+            "fault_sites": len(self.fault_sites),
+        }
+
+
+def for_context(ctx) -> ModuleOpsModel:
+    """The memoized per-file model (`FileContext.memo`), shared by every
+    STX019-023 check touching the same file."""
+    return ctx.memo("opsmodel", lambda: ModuleOpsModel(ctx.tree))
+
+
+def repo_summary(
+    paths: Optional[Sequence[str]] = None, repo: Optional[str] = None
+) -> Dict[str, int]:
+    """Aggregate model sizes over a path set (launcher --preflight-only's
+    ops-contracts row and the CLI's --statistics block): how many metric
+    series, KV patterns, exit sites, and fault-spec sites the model actually
+    sees — a silently-empty model (a refactor that renamed the idioms out
+    from under the AST patterns) becomes visible instead of green."""
+    from stoix_tpu.analysis import core as _core
+
+    repo = repo or _core.REPO
+    totals = {
+        "files": 0,
+        "metric_sites": 0,
+        "series": 0,
+        "observe_sites": 0,
+        "kv_writes": 0,
+        "kv_reads": 0,
+        "exit_sites": 0,
+        "fault_sites": 0,
+    }
+    series: Set[str] = set()
+    for path in _core.iter_py_files(paths or ["stoix_tpu"], repo):
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        totals["files"] += 1
+        model = ModuleOpsModel(tree)
+        for key, value in model.summary().items():
+            if key != "series":
+                totals[key] += value
+        series |= {s.pattern for s in model.metric_sites if s.pattern}
+    totals["series"] = len(series)
+    return totals

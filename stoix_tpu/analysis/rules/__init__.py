@@ -25,3 +25,8 @@ from stoix_tpu.analysis.rules import stx015_lock_blocking  # noqa: F401
 from stoix_tpu.analysis.rules import stx016_completion  # noqa: F401
 from stoix_tpu.analysis.rules import stx017_thread_lifecycle  # noqa: F401
 from stoix_tpu.analysis.rules import stx018_exit_codes  # noqa: F401
+from stoix_tpu.analysis.rules import stx019_metric_discipline  # noqa: F401
+from stoix_tpu.analysis.rules import stx020_kv_keyspace  # noqa: F401
+from stoix_tpu.analysis.rules import stx021_hard_exit  # noqa: F401
+from stoix_tpu.analysis.rules import stx022_fault_spec  # noqa: F401
+from stoix_tpu.analysis.rules import stx023_stale_crossref  # noqa: F401

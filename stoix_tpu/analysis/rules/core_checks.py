@@ -148,6 +148,11 @@ _REASON_REQUIRED = {
     "STX016",
     "STX017",
     "STX018",
+    "STX019",
+    "STX020",
+    "STX021",
+    "STX022",
+    "STX023",
 }
 _NOQA_DIRECTIVE = re.compile(r"#\s*noqa\b:?\s*([^#]*)", re.IGNORECASE)
 _NOQA_CODE = re.compile(r"[A-Z]+[0-9]+")

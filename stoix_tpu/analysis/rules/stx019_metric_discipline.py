@@ -1,0 +1,212 @@
+"""STX019 — metric naming/typing/label discipline over the opsmodel.
+
+The ~82 `stoix_tpu_*` series are the repo's operational API: dashboards,
+the /metricsz endpoint, bench assertions, and the fleet skew exporter all
+key on the *names*. Nothing type-checks a name, so the failure modes are
+silent: a counter created without `_total` breaks Prometheus conventions
+(and any rate() query written against the convention); the same name
+created as two different kinds in two modules raises TypeError only when
+both paths run in one process — in production, at 3am; two observe sites
+disagreeing on label keys split one logical series into disjoint
+un-joinable ones; and a name built dynamically is invisible to every grep
+and to this gate. Backed by `analysis/opsmodel.py` (docs/DESIGN.md §2.5):
+
+  * file-scoped: every creation-site name must normalize to a pattern
+    (module-level string constants resolve; f-string holes become `{}`)
+    matching the `stoix_tpu_<area>_<name>` charset; `_total` iff counter.
+  * tree-scoped: one name must keep ONE metric kind across the whole scan,
+    and every observe site of a series must use the same label-key set
+    (label dicts that are not literals are out of model — a documented
+    blind spot).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+from stoix_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    TreeContext,
+    register,
+)
+from stoix_tpu.analysis import opsmodel
+
+# `stoix_tpu_<area>_<name>`: at least two segments after the prefix,
+# lowercase/digit charset. Normalized `{}` holes count as one segment.
+_CHARSET = re.compile(r"^stoix_tpu_[a-z0-9]+(_[a-z0-9]+)+$")
+
+
+def _charset_ok(pattern: str) -> bool:
+    return bool(_CHARSET.match(pattern.replace("{}", "x")))
+
+
+def _check_file(rule: Rule, ctx: FileContext) -> List[Finding]:
+    model = opsmodel.for_context(ctx)
+    findings: List[Finding] = []
+    for site in model.metric_sites:
+        if ctx.noqa(site.lineno, rule.id):
+            continue
+        if site.pattern is None:
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    site.lineno,
+                    f"metric name at this {site.kind}() creation does not "
+                    f"normalize to a pattern — build names from literals, "
+                    f"f-strings, or module-level constants so the series "
+                    f"stays grep-able and lintable (STX019)",
+                )
+            )
+            continue
+        if not _charset_ok(site.pattern):
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    site.lineno,
+                    f"metric name '{site.pattern}' does not match the "
+                    f"stoix_tpu_<area>_<name> convention "
+                    f"(lowercase/digits, >=2 segments after the prefix) "
+                    f"(STX019)",
+                )
+            )
+        if site.kind == "counter" and not site.pattern.endswith("_total"):
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    site.lineno,
+                    f"counter '{site.pattern}' lacks the `_total` suffix — "
+                    f"rate() queries and the Prometheus convention key on "
+                    f"it (STX019)",
+                )
+            )
+        elif site.kind != "counter" and site.pattern.endswith("_total"):
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    site.lineno,
+                    f"{site.kind} '{site.pattern}' carries the `_total` "
+                    f"suffix reserved for counters (STX019)",
+                )
+            )
+    return findings
+
+
+def _check_tree(rule: Rule, tree_ctx: TreeContext) -> List[Finding]:
+    findings: List[Finding] = []
+    # pattern -> ordered [(rel, ctx, MetricSite)]; (rel, lineno) order makes
+    # "first declaration wins" deterministic across files.
+    creations: Dict[str, List[Tuple[str, FileContext, object]]] = {}
+    observes: Dict[str, List[Tuple[str, FileContext, object]]] = {}
+    for ctx in sorted(tree_ctx.files, key=lambda c: c.rel):
+        model = opsmodel.for_context(ctx)
+        for site in model.metric_sites:
+            if site.pattern is not None:
+                creations.setdefault(site.pattern, []).append(
+                    (ctx.rel, ctx, site)
+                )
+        for site in model.observe_sites:
+            if site.pattern is not None and site.label_keys is not None:
+                observes.setdefault(site.pattern, []).append(
+                    (ctx.rel, ctx, site)
+                )
+    for pattern, sites in creations.items():
+        canonical = sites[0][2].kind
+        for rel, ctx, site in sites[1:]:
+            if site.kind == canonical or ctx.noqa(site.lineno, rule.id):
+                continue
+            findings.append(
+                Finding(
+                    rule.id,
+                    rel,
+                    site.lineno,
+                    f"'{pattern}' created as {site.kind} here but as "
+                    f"{canonical} at {sites[0][0]}:{sites[0][2].lineno} — "
+                    f"one name, one metric kind, repo-wide (the registry "
+                    f"raises TypeError only when both paths meet in one "
+                    f"process) (STX019)",
+                )
+            )
+    for pattern, sites in observes.items():
+        canonical = sites[0][2].label_keys
+        for rel, ctx, site in sites[1:]:
+            if site.label_keys == canonical or ctx.noqa(site.lineno, rule.id):
+                continue
+            findings.append(
+                Finding(
+                    rule.id,
+                    rel,
+                    site.lineno,
+                    f"'{pattern}' observed with label keys "
+                    f"{list(site.label_keys)} here but "
+                    f"{list(canonical)} at "
+                    f"{sites[0][0]}:{sites[0][2].lineno} — disagreeing "
+                    f"label-key sets split one logical series into "
+                    f"un-joinable ones (STX019)",
+                )
+            )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX019",
+        order=105,
+        title="metric naming/typing/label discipline",
+        rationale="Metric names are the operational API dashboards and "
+        "bench assertions key on; nothing type-checks them, so a kind "
+        "conflict or label drift between two modules only surfaces when "
+        "both paths meet in one production process. The opsmodel makes "
+        "every creation/observe site comparable statically.",
+        check_file=_check_file,
+        check_tree=_check_tree,
+        flag_snippets=(
+            # Counter without `_total`.
+            "from stoix_tpu.observability import get_registry\n\n\n"
+            "def arm():\n"
+            '    get_registry().counter("stoix_tpu_loop_drops", "d").inc()\n',
+            # Charset violation: single segment after the prefix.
+            "from stoix_tpu.observability import get_registry\n\n\n"
+            "def arm(registry):\n"
+            '    registry.gauge("stoix_tpu_depth", "queue depth")\n',
+            # Non-normalizable name (built by a call).
+            "def arm(registry, name):\n"
+            '    registry.gauge("stoix_tpu_" + name.strip(), "h")\n',
+            # Kind conflict inside one module (tree half).
+            "def arm(registry):\n"
+            '    registry.gauge("stoix_tpu_loop_lag_seconds", "g")\n'
+            '    registry.counter("stoix_tpu_loop_lag_seconds", "c")\n',
+            # Label-key drift between two observe sites (tree half).
+            "def arm(registry):\n"
+            '    g = registry.gauge("stoix_tpu_fleet_age_seconds", "g")\n'
+            '    g.set(1.0, {"process": "0"})\n'
+            '    g.set(2.0, {"host": "0"})\n',
+        ),
+        clean_snippets=(
+            # The shipped idiom: counter with _total, f-string hole, one
+            # label-key set, constants resolve.
+            '_EVENTS = "stoix_tpu_compile_cache_events_total"\n\n\n'
+            "def arm(registry, k):\n"
+            "    registry.counter(_EVENTS, 'h').inc()\n"
+            '    c = registry.counter(f"stoix_tpu_loop_{k}_total", "h")\n'
+            '    c.inc(labels={"stage": "a"})\n'
+            '    c.inc(2.0, {"stage": "b"})\n'
+            '    registry.gauge("stoix_tpu_queue_depth", "d").set(1.0)\n',
+            # `.set()` on a non-metric binding is not an observe site.
+            "import threading\n\n\ndef arm():\n"
+            "    event = threading.Event()\n    event.set()\n",
+            # Dynamic label dicts are out of model, not violations.
+            "def arm(registry, labels):\n"
+            '    g = registry.gauge("stoix_tpu_fleet_age_seconds", "g")\n'
+            '    g.set(1.0, labels)\n'
+            '    g.set(2.0, {"process": "1"})\n'
+            '    g.set(3.0, {"process": "2"})\n',
+        ),
+    )
+)

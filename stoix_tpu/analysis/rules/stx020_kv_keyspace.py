@@ -1,0 +1,125 @@
+"""STX020 — the fleet-KV keyspace must pair writers with readers.
+
+The fleet coordination protocol is a tiny KV store with no schema: one
+module `put`s `hb/<pid>` and another polls it with `try_get`; the vote
+path `put`s `vote/<window>/<pid>` and `get_blocking`s every peer's; the
+ops-metrics aggregator round-trips `ometrics/<pid>`. The contract lives
+entirely in f-string key spelling, so a one-character drift between the
+writer and the reader produces no error anywhere — heartbeats age out and
+declare a partition, a vote blocks until its deadline, aggregate metrics
+silently show one host. Backed by `analysis/opsmodel.py` key patterns
+(f-string holes normalized to `{}`; docs/DESIGN.md §2.5), tree-scoped over
+`stoix_tpu/` only (`FakeFleetStore` traffic in tests is exempt by scope):
+
+  * a written pattern no reader matches anywhere is a dead write;
+  * a `get_blocking` on a pattern no writer matches is a
+    deadlock-until-timeout;
+  * generic transport wrappers whose key is a bare parameter, and
+    `barrier` rendezvous keys, are modeled but not contract-checked
+    (documented blind spots).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from stoix_tpu.analysis.core import Finding, Rule, TreeContext, register
+from stoix_tpu.analysis import opsmodel
+
+
+def _check_tree(rule: Rule, tree_ctx: TreeContext) -> List[Finding]:
+    prefix = "stoix_tpu" + os.sep
+    writes = []  # (pattern, rel, ctx, site)
+    reads = []
+    for ctx in sorted(tree_ctx.files, key=lambda c: c.rel):
+        if not ctx.rel.startswith(prefix):
+            continue
+        model = opsmodel.for_context(ctx)
+        for site in model.kv_sites:
+            if site.pattern is None:
+                continue
+            if site.side == "write":
+                writes.append((site.pattern, ctx.rel, ctx, site))
+            elif site.side == "read":
+                reads.append((site.pattern, ctx.rel, ctx, site))
+    findings: List[Finding] = []
+    for pattern, rel, ctx, site in writes:
+        if ctx.noqa(site.lineno, rule.id):
+            continue
+        if not any(opsmodel.patterns_match(pattern, r[0]) for r in reads):
+            findings.append(
+                Finding(
+                    rule.id,
+                    rel,
+                    site.lineno,
+                    f"dead write: KV pattern '{pattern}' is put here but "
+                    f"no try_get/get_blocking anywhere in stoix_tpu/ "
+                    f"matches it — either the reader drifted or the write "
+                    f"is vestigial traffic on the coordination store "
+                    f"(STX020)",
+                )
+            )
+    for pattern, rel, ctx, site in reads:
+        if site.op != "get_blocking" or ctx.noqa(site.lineno, rule.id):
+            continue
+        if not any(opsmodel.patterns_match(pattern, w[0]) for w in writes):
+            findings.append(
+                Finding(
+                    rule.id,
+                    rel,
+                    site.lineno,
+                    f"get_blocking on KV pattern '{pattern}' that no put "
+                    f"anywhere in stoix_tpu/ matches — this blocks until "
+                    f"its deadline every time (STX020)",
+                )
+            )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX020",
+        order=106,
+        title="fleet-KV writer/reader pairing",
+        rationale="The fleet protocol's schema is f-string key spelling; "
+        "a writer/reader drift produces no error, just a partition verdict "
+        "or a vote that blocks to its deadline. Pattern-matching both "
+        "sides statically catches the drift at lint time.",
+        check_tree=_check_tree,
+        flag_snippets=(
+            # Dead write: nobody reads the pattern.
+            "class Publisher:\n"
+            "    def publish(self, store, pid, blob):\n"
+            '        store.put(f"heartbeat/{pid}", blob)\n'
+            '        value = store.try_get(f"hb/{pid}")\n'
+            "        return value\n",
+            # get_blocking on a never-written pattern.
+            "class Voter:\n"
+            "    def collect(self, store, window, pid):\n"
+            '        store.put(f"vote/{window}/{pid}", "y")\n'
+            '        return store.get_blocking(f"ballot/{window}/{pid}")\n',
+        ),
+        clean_snippets=(
+            # Writer and reader agree (the shipped hb/vote idiom).
+            "class Coordinator:\n"
+            "    def beat(self, store, pid, blob):\n"
+            '        store.put(f"hb/{pid}", blob)\n'
+            "    def poll(self, store, peers):\n"
+            '        return [store.try_get(f"hb/{p}") for p in peers]\n',
+            # A literal read matches a holed write pattern.
+            "class Tracker:\n"
+            "    def publish(self, store, pid):\n"
+            '        store.put(f"ometrics/{pid}", "x")\n'
+            "    def scrape_self(self, store):\n"
+            '        return store.get_blocking("ometrics/0", timeout=1)\n',
+            # Generic transport wrappers (bare-parameter keys) and queue
+            # payload puts are out of scope.
+            "class Store:\n"
+            "    def put(self, key, value):\n"
+            "        self._backend.put(key, value)\n"
+            "    def enqueue(self, queue, item):\n"
+            "        queue.put(item)\n",
+        ),
+    )
+)

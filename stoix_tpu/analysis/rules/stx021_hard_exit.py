@@ -1,0 +1,175 @@
+"""STX021 — hard exits carrying recovery codes must leave evidence, and
+the supervisor must dispatch every registered code.
+
+The codes >= 86 (stall / fleet partition / state corruption / elastic
+resize; docs/DESIGN.md §2.6) are the supervised-recovery protocol: each
+names a failure the launcher reacts to, and each is diagnosed *post
+mortem* from the flight record the dying process dumps. An `os._exit`
+skips every finally/atexit, so the dump only happens if the exit path
+calls it explicitly — deleting that call breaks triage silently (the
+process still dies with the right code; the evidence just never lands).
+Backed by `analysis/opsmodel.py` exit sites (docs/DESIGN.md §2.5), scoped
+to `stoix_tpu/`:
+
+  * every exit site whose code resolves to >= 86 (via the module's own
+    `EXIT_CODE_*` constants or `resilience/exit_codes.py`) must have a
+    `dump_flight_record` call statically preceding it in the same
+    function, or inside a module-local / self-method callee of a
+    preceding call (depth-limited; dynamic codes like
+    `os._exit(self._exit_code)` are out of model — documented blind
+    spots);
+  * a module defining `run_supervised` must reference every registered
+    non-zero `EXIT_CODE_*` name inside it — `exit_codes.REGISTRY` is the
+    single source of truth, so registering a new code without teaching
+    the supervision dispatch about it is a lint error, not a 3am
+    surprise.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import os
+from typing import Dict, List
+
+from stoix_tpu.analysis.core import FileContext, Finding, Rule, register
+from stoix_tpu.analysis import opsmodel
+
+_HARD_EXIT_FLOOR = 86
+
+
+@functools.lru_cache(maxsize=8)
+def _registry_codes(repo: str) -> Dict[str, int]:
+    """EXIT_CODE_* name -> value from the canonical registry module."""
+    path = os.path.join(repo, "stoix_tpu", "resilience", "exit_codes.py")
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return {}
+    return {
+        name: value
+        for name, value in opsmodel.module_int_constants(tree).items()
+        if name.startswith("EXIT_CODE_")
+    }
+
+
+def _check_file(rule: Rule, ctx: FileContext) -> List[Finding]:
+    if not ctx.rel.startswith("stoix_tpu" + os.sep):
+        return []
+    model = opsmodel.for_context(ctx)
+    local_codes = {
+        name: value
+        for name, value in model.int_constants.items()
+        if name.startswith("EXIT_CODE_")
+    }
+    findings: List[Finding] = []
+    for site in model.exit_sites:
+        if ctx.noqa(site.lineno, rule.id):
+            continue
+        value = site.code_value
+        if value is None and site.code_name is not None:
+            value = local_codes.get(site.code_name)
+            if value is None:
+                value = _registry_codes(ctx.repo).get(site.code_name)
+        if value is None or value < _HARD_EXIT_FLOOR:
+            continue
+        if not model.flight_dump_reachable(site):
+            label = site.code_name or str(value)
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    site.lineno,
+                    f"{site.via}({label}) carries recovery code {value} "
+                    f"but no dump_flight_record call statically precedes "
+                    f"it in this function or its local callees — the "
+                    f"process dies with the right code and no evidence "
+                    f"(STX021)",
+                )
+            )
+    # Supervision coverage: run_supervised must name every registered
+    # non-zero code (handled-and-relaunched or explicitly final).
+    supervised_fns = [
+        node
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name == "run_supervised"
+    ]
+    if supervised_fns:
+        registry = local_codes or _registry_codes(ctx.repo)
+        required = {
+            name for name, value in registry.items() if value != 0
+        }
+        referenced = model.fn_references("run_supervised")
+        missing = sorted(required - referenced)
+        fn = supervised_fns[0]
+        if missing and not ctx.noqa(fn.lineno, rule.id):
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    fn.lineno,
+                    f"run_supervised does not dispatch "
+                    f"{', '.join(missing)} — every registered non-zero "
+                    f"exit code (exit_codes.REGISTRY is the source of "
+                    f"truth) must be named handled-or-final here "
+                    f"(STX021)",
+                )
+            )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX021",
+        order=107,
+        title="hard-exit flight-record + supervision coverage",
+        rationale="os._exit skips every finally, so the flight-record "
+        "dump the post-mortem depends on only happens if the exit path "
+        "calls it first; and a registered recovery code the supervisor "
+        "does not dispatch turns a designed recovery into an unexplained "
+        "final exit.",
+        check_file=_check_file,
+        flag_snippets=(
+            # The dump deleted from a corruption exit.
+            "import os\n\nEXIT_CODE_STATE_CORRUPTION = 88\n\n\n"
+            "def hook(exc_type, exc, tb):\n"
+            "    os._exit(EXIT_CODE_STATE_CORRUPTION)\n",
+            # run_supervised missing a registered code.
+            "EXIT_CODE_STALL = 86\nEXIT_CODE_FLEET_PARTITION = 87\n\n\n"
+            "def run_supervised(run, max_relaunches):\n"
+            "    while True:\n"
+            "        rc = run()\n"
+            "        if rc != EXIT_CODE_FLEET_PARTITION:\n"
+            "            return rc\n",
+        ),
+        clean_snippets=(
+            # Dump precedes the exit in the same function.
+            "import os\n\nfrom stoix_tpu.observability import flightrec\n\n"
+            "EXIT_CODE_STALL = 86\n\n\n"
+            "def shoot():\n"
+            '    flightrec.dump_flight_record(None, reason="stall")\n'
+            "    os._exit(EXIT_CODE_STALL)\n",
+            # Dump inside a preceding self-method callee (the fleet idiom).
+            "import os\n\nEXIT_CODE_FLEET_PARTITION = 87\n\n\n"
+            "class Fleet:\n"
+            "    def _evidence(self, reason):\n"
+            "        dump_flight_record(None, reason=reason)\n"
+            "    def _hard_exit(self):\n"
+            '        self._evidence("partition")\n'
+            "        os._exit(EXIT_CODE_FLEET_PARTITION)\n",
+            # Codes below the recovery floor need no flight record.
+            "import os\n\nEXIT_CODE_FAILURE = 1\n\n\n"
+            "def die():\n    os._exit(EXIT_CODE_FAILURE)\n",
+            # run_supervised naming the full local registry.
+            "EXIT_CODE_STALL = 86\nEXIT_CODE_FLEET_PARTITION = 87\n\n\n"
+            "def run_supervised(run, max_relaunches):\n"
+            "    final = {EXIT_CODE_STALL: 'stall — triage first'}\n"
+            "    while True:\n"
+            "        rc = run()\n"
+            "        if rc != EXIT_CODE_FLEET_PARTITION:\n"
+            "            return (rc, final.get(rc))\n",
+        ),
+    )
+)

@@ -1,0 +1,177 @@
+"""STX022 — the fault-spec vocabulary and its uses must agree, both ways.
+
+Fault injection is string-keyed: `faultinject._KNOWN` declares the
+vocabulary, and tests/bench/soak/launcher arm specs via
+`faultinject.configure("...")`, the `STOIX_TPU_FAULT` env var, or
+`arch.fault_spec=` overrides. Both directions fail silently today: a spec
+literal outside `_KNOWN` raises only when that code path actually runs
+(PR 12's `swap_poison` shipped inert and was a drive-time discovery), and
+a `_KNOWN` entry no test arms is a chaos drill that exists on paper only.
+Backed by `analysis/opsmodel.py` fault-spec sites (spec strings parsed
+from every arming form, constants resolved, dynamic name parts skipped;
+docs/DESIGN.md §2.5):
+
+  * file-scoped: every statically-parsable spec name at a use site must
+    be in the vocabulary (the module's own `_KNOWN` if it defines one,
+    else `resilience/faultinject.py`'s);
+  * tree-scoped: every `_KNOWN` entry must be armed by at least one
+    scanned test file — anchored at the `_KNOWN` entry so the fix site
+    is the vocabulary, not a grep. Skipped when the scan includes no
+    test files (a partial scan proves nothing about coverage).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import os
+from typing import List, Optional, Set, Tuple
+
+from stoix_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    TreeContext,
+    register,
+)
+from stoix_tpu.analysis import opsmodel
+
+_FAULTINJECT_REL = os.path.join("stoix_tpu", "resilience", "faultinject.py")
+
+
+@functools.lru_cache(maxsize=8)
+def _disk_vocabulary(repo: str) -> Tuple[str, ...]:
+    try:
+        with open(os.path.join(repo, _FAULTINJECT_REL)) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return ()
+    return opsmodel.known_fault_specs(tree)
+
+
+def _is_test_file(rel: str) -> bool:
+    return rel.startswith("tests" + os.sep) or os.path.basename(
+        rel
+    ).startswith("test_")
+
+
+def _check_file(rule: Rule, ctx: FileContext) -> List[Finding]:
+    model = opsmodel.for_context(ctx)
+    if not model.fault_sites:
+        return []
+    vocab = set(model.known_specs or _disk_vocabulary(ctx.repo))
+    if not vocab:
+        return []
+    findings: List[Finding] = []
+    for site in model.fault_sites:
+        if ctx.noqa(site.lineno, rule.id):
+            continue
+        unknown = sorted(set(site.names) - vocab)
+        for name in unknown:
+            findings.append(
+                Finding(
+                    rule.id,
+                    ctx.rel,
+                    site.lineno,
+                    f"fault spec '{name}' is not in faultinject._KNOWN — "
+                    f"this arms nothing and fails only when the path "
+                    f"runs (the inert-swap_poison class) (STX022)",
+                )
+            )
+    return findings
+
+
+def _known_entry_lines(ctx: FileContext) -> dict:
+    """spec name -> lineno of its `_KNOWN` tuple entry (anchor points)."""
+    lines = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name) and target.id == "_KNOWN":
+                value = node.value
+                if isinstance(value, (ast.Tuple, ast.List)):
+                    for elt in value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            lines[elt.value] = elt.lineno
+    return lines
+
+
+def _check_tree(rule: Rule, tree_ctx: TreeContext) -> List[Finding]:
+    vocab_ctx: Optional[FileContext] = None
+    covered: Set[str] = set()
+    any_tests = False
+    for ctx in sorted(tree_ctx.files, key=lambda c: c.rel):
+        model = opsmodel.for_context(ctx)
+        if model.known_specs and (
+            vocab_ctx is None or ctx.rel == _FAULTINJECT_REL
+        ):
+            vocab_ctx = ctx
+        if _is_test_file(ctx.rel):
+            any_tests = True
+            for site in model.fault_sites:
+                covered |= set(site.names)
+    if vocab_ctx is None or not any_tests:
+        return []
+    entry_lines = _known_entry_lines(vocab_ctx)
+    model = opsmodel.for_context(vocab_ctx)
+    findings: List[Finding] = []
+    for name in model.known_specs:
+        if name in covered:
+            continue
+        lineno = entry_lines.get(name, 1)
+        if vocab_ctx.noqa(lineno, rule.id):
+            continue
+        findings.append(
+            Finding(
+                rule.id,
+                vocab_ctx.rel,
+                lineno,
+                f"fault spec '{name}' is declared in _KNOWN but no test "
+                f"arms it — a chaos drill that exists on paper only "
+                f"(STX022)",
+            )
+        )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX022",
+        order=108,
+        title="fault-spec vocabulary/use agreement",
+        rationale="Fault injection is string-keyed with no compile-time "
+        "check in either direction: a typo'd spec arms nothing until the "
+        "drill runs, and a declared spec no test arms is untested chaos "
+        "machinery. Parsing every arming form statically closes both "
+        "gaps.",
+        check_file=_check_file,
+        check_tree=_check_tree,
+        flag_snippets=(
+            # Typo'd spec name at a use site (setenv form).
+            '_KNOWN = ("actor_crash", "queue_stall")\n\n\n'
+            "def test_drill(monkeypatch):\n"
+            '    monkeypatch.setenv("STOIX_TPU_FAULT", "actor_cras:3")\n',
+            # Unknown spec via an override literal (argv form).
+            '_KNOWN = ("host_stall",)\n\n\n'  # noqa: STX022 — fixture text, not an armed spec
+            "def job():\n"
+            '    return ["arch.fault_spec=host_stal:2,host_stall"]\n',
+        ),
+        clean_snippets=(
+            # Known names in every arming form; dynamic name parts and the
+            # null spec are out of model, not violations.
+            '_KNOWN = ("actor_crash", "host_stall", "shrink")\n'
+            'DRILL = "actor_crash:2,shrink"\n\n\n'
+            "def arm(monkeypatch, configure, stall_s, action, w):\n"
+            "    configure(DRILL)\n"
+            '    monkeypatch.setenv("STOIX_TPU_FAULT", "host_stall:1")\n'
+            '    env = {"STOIX_TPU_FAULT": "shrink:1"}\n'
+            '    argv = ["arch.fault_spec=~", "host_stall:%d" % stall_s]\n'
+            '    argv.append(f"arch.fault_spec={action}:{w}")\n'
+            "    return env, argv\n",
+            # No fault traffic at all.
+            "def test_nothing():\n    assert 1 + 1 == 2\n",
+        ),
+    )
+)

@@ -1,0 +1,173 @@
+"""STX023 — doc cross-references must resolve: §2.x -> docs/DESIGN.md,
+STXnnn -> the rule registry.
+
+The repo's docstrings and markdown cite design sections (`§2.6`) and lint
+rules (`STX018`) as load-bearing pointers — they are how a reader finds
+the contract a module implements. Sections get renumbered and rules get
+added; nothing checks the pointers, and PR 16 already fixed one stale ref
+by hand. Tree-scoped (docs/DESIGN.md §2.5):
+
+  * every `§2.<n>` reference in a scanned module/class/function docstring
+    must name a section heading that exists in `docs/DESIGN.md`;
+  * every `STX<nnn>` id in a docstring must be a registered rule;
+  * the same checks run over `README.md` and `docs/*.md` read from disk
+    (they are not part of the .py scan), anchored at the markdown line.
+
+String literals that are not docstrings (fixture snippets, messages) are
+out of scope on purpose — fixtures legitimately mention fake rule ids.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import glob
+import os
+import re
+from typing import Iterator, List, Set, Tuple
+
+from stoix_tpu.analysis.core import Finding, Rule, TreeContext, register
+
+_SECTION_REF = re.compile(r"§2\.(\d+)")
+_RULE_REF = re.compile(r"STX(\d{3})")
+_HEADING = re.compile(r"^#{2,4}\s+(?:§\s*)?2\.(\d+)\b")
+
+
+@functools.lru_cache(maxsize=8)
+def _design_sections(repo: str) -> Tuple[str, ...]:
+    """The `2.<n>` section numbers docs/DESIGN.md actually declares."""
+    path = os.path.join(repo, "docs", "DESIGN.md")
+    sections: Set[str] = set()
+    try:
+        with open(path) as f:
+            for line in f:
+                match = _HEADING.match(line)
+                if match:
+                    sections.add(match.group(1))
+    except OSError:
+        pass
+    return tuple(sorted(sections))
+
+
+def _registered_rule_ids() -> Set[str]:
+    from stoix_tpu.analysis.core import get_rules
+
+    return {rule.id for rule in get_rules()}
+
+
+def _docstrings(tree: ast.AST) -> Iterator[Tuple[int, str]]:
+    """(first lineno, text) of every module/class/function docstring."""
+    for node in ast.walk(tree):
+        if isinstance(
+            node,
+            (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+        ):
+            body = getattr(node, "body", [])
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                yield body[0].value.lineno, body[0].value.value
+
+
+def _ref_findings(
+    rule: Rule,
+    rel: str,
+    base_lineno: int,
+    text: str,
+    sections: Set[str],
+    rule_ids: Set[str],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for offset, line in enumerate(text.splitlines()):
+        lineno = base_lineno + offset
+        for match in _SECTION_REF.finditer(line):
+            if match.group(1) not in sections:
+                findings.append(
+                    Finding(
+                        rule.id,
+                        rel,
+                        lineno,
+                        f"stale cross-reference: §2.{match.group(1)} is "
+                        f"not a section heading in docs/DESIGN.md "
+                        f"(STX023)",
+                    )
+                )
+        for match in _RULE_REF.finditer(line):
+            if f"STX{match.group(1)}" not in rule_ids:
+                findings.append(
+                    Finding(
+                        rule.id,
+                        rel,
+                        lineno,
+                        f"stale cross-reference: STX{match.group(1)} is "
+                        f"not a registered analysis rule (STX023)",
+                    )
+                )
+    return findings
+
+
+def _check_tree(rule: Rule, tree_ctx: TreeContext) -> List[Finding]:
+    sections = set(_design_sections(tree_ctx.repo))
+    if not sections:
+        return []  # no DESIGN.md (a bare fixture repo) — nothing to check
+    rule_ids = _registered_rule_ids()
+    findings: List[Finding] = []
+    for ctx in sorted(tree_ctx.files, key=lambda c: c.rel):
+        for base_lineno, text in _docstrings(ctx.tree):
+            for finding in _ref_findings(
+                rule, ctx.rel, base_lineno, text, sections, rule_ids
+            ):
+                if not ctx.noqa(finding.line, rule.id):
+                    findings.append(finding)
+    # Markdown surfaces, read from disk (not part of the .py scan). Only
+    # curated docs — working notes (ISSUE/CHANGES/ROADMAP) narrate history
+    # and may cite sections that postdate or predate the current DESIGN.
+    md_paths = [os.path.join(tree_ctx.repo, "README.md")] + sorted(
+        glob.glob(os.path.join(tree_ctx.repo, "docs", "*.md"))
+    )
+    for path in md_paths:
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, tree_ctx.repo)
+        findings.extend(
+            _ref_findings(rule, rel, 1, text, sections, rule_ids)
+        )
+    return findings
+
+
+RULE = register(
+    Rule(
+        id="STX023",
+        order=109,
+        title="doc cross-references resolve",
+        rationale="Docstring and markdown pointers to design sections and "
+        "rule ids are how readers find the governing contract; sections "
+        "get renumbered and rules added, and a stale pointer misdirects "
+        "exactly when it matters. PR 16 fixed one such drift by hand — "
+        "this makes the class mechanical.",
+        check_tree=_check_tree,
+        flag_snippets=(
+            # A renumbered-away section reference.
+            '"""Window accounting (docs/DESIGN.md §2.99)."""\n\n'
+            "X = 1\n",
+            # An unregistered rule id in a function docstring.
+            "def gate():\n"
+            '    """Pinned by STX901 fixtures."""\n'
+            "    return 0\n",
+        ),
+        clean_snippets=(
+            # Live section + live rule id.
+            '"""Exit codes (docs/DESIGN.md §2.6), enforced by '
+            'STX018."""\n\nX = 1\n',
+            # Non-docstring strings may cite anything (fixture snippets).
+            "def fixtures():\n"
+            '    return "see §2.99 and STX901 for the bad case"\n',
+        ),
+    )
+)

@@ -201,6 +201,34 @@ def run_preflight_only(jobs: List[dict], changed_only: bool = False) -> int:
         )
     else:
         report.add("concurrency-model", "pass", t_detail)
+    # Ops-contract visibility (docs/DESIGN.md §2.5): same deal for the
+    # STX019-022 family — it sees only what the opsmodel sees, and a
+    # refactor that renamed `get_registry()`/the KV verbs/`os._exit` idioms
+    # out from under the AST patterns would green the gate forever. An
+    # empty model on a full scan is a preflight FAILURE.
+    from stoix_tpu.analysis import opsmodel
+
+    ostats = opsmodel.repo_summary(lint_paths or ["stoix_tpu"])
+    o_detail = (
+        f"{ostats['series']} metric series, {ostats['kv_writes']} KV "
+        f"write(s)/{ostats['kv_reads']} read(s), {ostats['exit_sites']} "
+        f"hard-exit site(s), {ostats['fault_sites']} fault-spec site(s) "
+        f"modeled"
+    )
+    if (
+        ostats["series"] == 0
+        and ostats["exit_sites"] == 0
+        and ostats["kv_writes"] == 0
+        and lint_paths is None
+    ):
+        report.add(
+            "ops-contracts", "fail",
+            f"EMPTY model on a full scan ({o_detail}) — the STX019-022 "
+            f"family is blind; the metric/KV/exit idioms no longer match "
+            f"the code",
+        )
+    else:
+        report.add("ops-contracts", "pass", o_detail)
     # The report IS this mode's output contract (CI / SLURM prolog logs
     # capture stdout), like bench.py's JSON lines.
     print(report.render())  # noqa: STX002 — --preflight-only's stdout contract
@@ -270,7 +298,14 @@ def run_supervised(
     Every OTHER exit code (clean 0, watchdog 86, crash 1) is final. Returns
     the final exit code."""
     from stoix_tpu.resilience import elastic as elastic_lib
-    from stoix_tpu.resilience.exit_codes import EXIT_CODE_ELASTIC_RESIZE
+    from stoix_tpu.resilience.exit_codes import (
+        EXIT_CODE_ELASTIC_RESIZE,
+        EXIT_CODE_FAILURE,
+        EXIT_CODE_OK,
+        EXIT_CODE_STALL,
+        EXIT_CODE_USAGE,
+        REGISTRY,
+    )
     from stoix_tpu.resilience.fleet import EXIT_CODE_FLEET_PARTITION
     from stoix_tpu.resilience.integrity import (
         EXIT_CODE_STATE_CORRUPTION,
@@ -282,6 +317,21 @@ def run_supervised(
     handled = {EXIT_CODE_FLEET_PARTITION, EXIT_CODE_STATE_CORRUPTION}
     if elastic:
         handled.add(EXIT_CODE_ELASTIC_RESIZE)
+    # Every registered code is dispatched here by NAME — relaunched (above)
+    # or explicitly final (below) — so registering a new recovery code
+    # without teaching this loop about it fails STX021's coverage check
+    # instead of surfacing as an unexplained final exit. The runtime half
+    # of the same contract: an rc in neither set can only be an
+    # UNREGISTERED code (signal deaths, scheduler kills), logged as such.
+    final_codes = {
+        EXIT_CODE_OK: "clean finish",
+        EXIT_CODE_FAILURE: "unrecoverable failure — a relaunch would replay it",
+        EXIT_CODE_USAGE: "usage error — operator input, not run health",
+        EXIT_CODE_STALL: "watchdog shot a wedged run — triage before retrying",
+        EXIT_CODE_ELASTIC_RESIZE: "elastic resize without --elastic — final",
+    }
+    uncovered = set(REGISTRY) - set(final_codes) - handled
+    assert not uncovered, f"unhandled registered exit codes: {sorted(uncovered)}"
     relaunches = 0
     extra: List[str] = []
     child_env = env
@@ -294,10 +344,16 @@ def run_supervised(
         # tests/test_opsplane.py).
         rc = subprocess.run(cmd + extra, env=child_env).returncode
         if rc not in handled:
+            disposition = final_codes.get(
+                rc,
+                "unregistered code (signal death or scheduler kill?)"
+                if rc not in REGISTRY
+                else REGISTRY[rc].meaning,
+            )
             if relaunches:
                 log.info(
-                    "[launcher] job finished (rc %d) after %d supervised "
-                    "relaunch(es)", rc, relaunches,
+                    "[launcher] job finished (rc %d: %s) after %d supervised "
+                    "relaunch(es)", rc, disposition, relaunches,
                 )
             return rc
         reason = {

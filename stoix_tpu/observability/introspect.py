@@ -5,7 +5,8 @@ metadata queries — they read allocator counters, they do not join the device
 stream, so polling never stalls a dispatched program) plus the process-wide
 live-buffer count (`jax.live_arrays()`), publishing gauges:
 
-    stoix_tpu_device_memory_bytes{device=..., kind=bytes_in_use|peak_bytes_in_use|...}
+    stoix_tpu_device_memory_bytes{device=..., kind=bytes_in_use|peak_bytes_in_use|...,
+                                  source=memory_stats|live_buffer_sum}
     stoix_tpu_device_live_buffers{}
     stoix_tpu_device_poll_errors_total{}
 
@@ -71,7 +72,10 @@ def sample_device_telemetry(registry: Optional[MetricsRegistry] = None) -> int:
         label_dev = str(device)
         for kind in _MEMORY_KINDS:
             if kind in stats:
-                mem_gauge.set(float(stats[kind]), {"device": label_dev, "kind": kind})
+                mem_gauge.set(
+                    float(stats[kind]),
+                    {"device": label_dev, "kind": kind, "source": "memory_stats"},
+                )
                 updated += 1
     try:
         live = jax.live_arrays()

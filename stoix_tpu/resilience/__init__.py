@@ -5,7 +5,7 @@ Zero-dependency, off-by-default-transparent. Four pillars:
   * **Divergence guards** (guards.py): `system.update_guard=off|skip|halt`
     wraps the gradient step of the PPO/IMPALA/DQN-family systems with
     non-finite detection on loss + global grad-norm; `skip` no-ops bad
-    updates (counter: `stoix_tpu_learner_skipped_updates`), `halt` raises
+    updates (counter: `stoix_tpu_learner_skipped_updates_total`), `halt` raises
     DivergenceError on the host naming step/loss/metric.
   * **Preemption-safe stop/resume** (preemption.py): SIGTERM/SIGINT request a
     graceful stop at the next window boundary; the Anakin runner drains its

@@ -11,7 +11,7 @@ GLOBAL GRAD-NORM, selected by `system.update_guard`:
          the signal is non-finite; the optimizer step-count still advances
          (a skipped batch is a consumed batch — bias-correction schedules
          keep moving); a `skipped_updates` flag rides the train metrics and
-         the host sums it into the `stoix_tpu_learner_skipped_updates`
+         the host sums it into the `stoix_tpu_learner_skipped_updates_total`
          counter
   halt   same in-jit selection (params stay finite for the emergency
          checkpoint), plus the host raises DivergenceError naming the step,
@@ -55,7 +55,7 @@ from stoix_tpu.resilience import faultinject
 from stoix_tpu.resilience.errors import DivergenceError
 
 VALID_MODES = ("off", "skip", "halt")
-SKIPPED_COUNTER = "stoix_tpu_learner_skipped_updates"
+SKIPPED_COUNTER = "stoix_tpu_learner_skipped_updates_total"
 
 
 def resolve_mode(config: Any) -> str:

@@ -347,6 +347,16 @@ class StateIntegritySentinel:
         def hook(exc_type, exc, tb):
             prev(exc_type, exc, tb)
             if isinstance(exc, StateCorruptionError):
+                # The quarantine path already dumped next to its record, but
+                # THIS is the one place that actually dies with rc 88, and
+                # os._exit skips every finally — so the exit path itself
+                # must leave the evidence (STX021). A re-dump only
+                # refreshes the ring snapshot.
+                flightrec.dump_flight_record(
+                    None,
+                    reason=f"state corruption: uncaught {exc_type.__name__}",
+                    exit_code=EXIT_CODE_STATE_CORRUPTION,
+                )
                 sys.stderr.flush()
                 os._exit(EXIT_CODE_STATE_CORRUPTION)
 

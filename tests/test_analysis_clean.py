@@ -49,11 +49,13 @@ def test_repo_lints_clean_json():
         assert set(f) == {"rule", "path", "line", "message", "severity"}
 
 
+@pytest.mark.slow
 def test_mesh_rules_clean_json():
     # The ISSUE 6 acceptance criterion, verbatim: the four sharding-layer
     # rules alone exit 0 on the shipped tree (a narrower, faster assertion
     # than the full gate, so a future full-gate allowlist change cannot
-    # silently waive them).
+    # silently waive them). Slow lane (tier-1 budget, PR 20): the not-slow
+    # full-gate test above runs the same scan with every rule selected.
     proc = subprocess.run(
         [
             sys.executable,
@@ -72,12 +74,14 @@ def test_mesh_rules_clean_json():
     assert proc.returncode == 0 and findings == [], findings
 
 
+@pytest.mark.slow
 def test_concurrency_rules_clean_json():
     # The ISSUE 13 acceptance criterion, verbatim: the five host-concurrency
     # rules (threadmodel-backed STX014-017 + the exit-code registry STX018)
     # alone exit 0 on the shipped tree — a narrower, faster assertion than
     # the full gate, so a future full-gate allowlist change cannot silently
-    # waive them.
+    # waive them. Slow lane (tier-1 budget, PR 20): the not-slow full-gate
+    # test above runs the same scan with every rule selected.
     proc = subprocess.run(
         [
             sys.executable,
@@ -85,6 +89,29 @@ def test_concurrency_rules_clean_json():
             "stoix_tpu.analysis",
             "--select",
             "STX014,STX015,STX016,STX017,STX018",
+            "--format",
+            "json",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    findings = json.loads(proc.stdout)
+    assert proc.returncode == 0 and findings == [], findings
+
+
+def test_ops_contract_rules_clean_json():
+    # The ISSUE 20 acceptance criterion, verbatim: the five ops-contract
+    # rules (opsmodel-backed STX019-022 + the cross-reference gate STX023)
+    # alone exit 0 on the shipped tree, so a future full-gate allowlist
+    # change cannot silently waive them.
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "stoix_tpu.analysis",
+            "--select",
+            "STX019,STX020,STX021,STX022,STX023",
             "--format",
             "json",
         ],

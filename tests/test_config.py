@@ -103,10 +103,11 @@ def test_real_tree_composes_all_defaults():
     for rel in defaults:
         cfg = config_lib.compose(root, rel, [])
         assert "arch" in cfg, rel
-        if cfg.arch.get("architecture_name") == "serve":
-            # The serving root (docs/DESIGN.md §2.8) deliberately composes NO
-            # system/network/env groups: the policy's network and observation
-            # spec come from the checkpoint's own saved training config.
+        if cfg.arch.get("architecture_name") in ("serve", "loop"):
+            # The serving root (docs/DESIGN.md §2.8) and the closed-loop root
+            # (§2.15) deliberately compose NO system/network/env groups: the
+            # policy's network and observation spec come from the checkpoint's
+            # own saved training config (each loop replica is a PolicyServer).
             assert "serve" in cfg.arch, rel
             continue
         assert "system" in cfg and "env" in cfg, rel

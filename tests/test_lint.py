@@ -33,7 +33,7 @@ def _ids(rule):
 
 @pytest.mark.parametrize("rule", get_rules(), ids=_ids)
 def test_rule_has_fixture_snippets(rule):
-    if rule.check_file is None:
+    if rule.check_file is None and not rule.flag_snippets:
         pytest.skip(f"{rule.id} is tree-scoped (dedicated tests below)")
     assert rule.flag_snippets, f"{rule.id} ships no must-flag fixture snippet"
     assert rule.clean_snippets, f"{rule.id} ships no near-miss fixture snippet"
@@ -41,7 +41,7 @@ def test_rule_has_fixture_snippets(rule):
 
 @pytest.mark.parametrize("rule", get_rules(), ids=_ids)
 def test_flag_snippets_flag(rule):
-    if rule.check_file is None:
+    if rule.check_file is None and not rule.flag_snippets:
         pytest.skip(f"{rule.id} is tree-scoped")
     for i, snippet in enumerate(rule.flag_snippets):
         findings = rule.run_on_source(snippet)
@@ -53,7 +53,7 @@ def test_flag_snippets_flag(rule):
 
 @pytest.mark.parametrize("rule", get_rules(), ids=_ids)
 def test_clean_snippets_stay_clean(rule):
-    if rule.check_file is None:
+    if rule.check_file is None and not rule.flag_snippets:
         pytest.skip(f"{rule.id} is tree-scoped")
     for i, snippet in enumerate(rule.clean_snippets):
         findings = [f for f in rule.run_on_source(snippet) if f.rule in rule.finding_ids]

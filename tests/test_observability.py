@@ -43,13 +43,13 @@ def test_labels_are_distinct_series_and_kind_conflicts_raise():
     gauge = registry.gauge("stoix_tpu_test_gauge")
     gauge.set(1.0, {"a": "x"})
     gauge.set(2.0, {"a": "y"})
-    gauge.set(3.0)  # unlabeled series
+    gauge.set(3.0)  # unlabeled series  # noqa: STX019 — deliberate label-split exercise
     assert gauge.value({"a": "x"}) == 1.0
     assert gauge.value({"a": "y"}) == 2.0
     assert gauge.value() == 3.0
     assert registry.series_count() == 3
     try:
-        registry.counter("stoix_tpu_test_gauge")
+        registry.counter("stoix_tpu_test_gauge")  # noqa: STX019 — deliberate kind-conflict exercise
         raise AssertionError("kind conflict should raise")
     except TypeError:
         pass
@@ -154,7 +154,7 @@ _PROM_SAMPLE = re.compile(
 def test_prometheus_text_parses_line_by_line():
     registry = MetricsRegistry()
     registry.counter("stoix_tpu_a_total", "a help").inc(3, {"actor": "0"})
-    registry.gauge("stoix_tpu_b").set(-1.5)
+    registry.gauge("stoix_tpu_test_b").set(-1.5)
     registry.histogram("stoix_tpu_c_seconds", buckets=(0.5,)).observe(0.1)
     text = obs.to_prometheus_text(registry)
     assert text.endswith("\n")
@@ -170,13 +170,13 @@ def test_prometheus_text_parses_line_by_line():
 
 def test_jsonl_writer_flattens_labels(tmp_path):
     registry = MetricsRegistry()
-    registry.gauge("stoix_tpu_depth").set(2.0, {"queue": "rollout", "actor": "1"})
+    registry.gauge("stoix_tpu_test_depth").set(2.0, {"queue": "rollout", "actor": "1"})
     writer = obs.JsonlMetricsWriter(str(tmp_path / "m.jsonl"))
     writer.write_snapshot(100, registry)
     writer.close()
     rows = [json.loads(l) for l in open(tmp_path / "m.jsonl")]
     assert rows[0]["t"] == 100
-    assert rows[0]["metrics"]["stoix_tpu_depth{actor=1,queue=rollout}"] == 2.0
+    assert rows[0]["metrics"]["stoix_tpu_test_depth{actor=1,queue=rollout}"] == 2.0
 
 
 # ----------------------------------------------------- health / sebulba

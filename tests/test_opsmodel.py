@@ -1,0 +1,511 @@
+"""Ops-contract static analysis (ISSUE 20): the opsmodel-backed rules
+STX019-STX023.
+
+Three layers, mirroring the PR 13 threadmodel precedent:
+
+  * **Seeded violations in copies of real modules** (the acceptance
+    criterion): each rule is proven live by mutating one ops invariant out
+    of a real module (introspect/guards/fleet/integrity/launcher/
+    faultinject) and catching it at the exact file:line — not just
+    synthetic fixtures. The unmodified copy must stay clean, so the seed is
+    the ONLY delta. Several seeds literally revert this PR's true-positive
+    fixes, so they double as the pinned regressions.
+  * **Targeted semantics**: name normalization (f-string holes, module
+    constants, %-format), KV pattern unification, flight-dump
+    reachability, REGISTRY-driven supervision coverage, fault-spec
+    parsing.
+  * **Model non-vacuity on the real tree** plus the `--statistics` row and
+    the launcher preflight ops-contracts row (which must FAIL on a
+    silently-empty model over a full scan).
+
+The registry-driven fixture replay in tests/test_lint.py auto-covers the
+five rules' flag/clean snippets (replayed here once more for
+self-containment); the repo-wide clean gate (incl. a --select STX019..023
+run) lives in tests/test_analysis_clean.py.
+"""
+
+import ast
+import os
+import re
+
+import pytest
+
+from stoix_tpu.analysis import core, get_rule, opsmodel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OPS_RULE_IDS = ("STX019", "STX020", "STX021", "STX022", "STX023")
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _line_of(source, needle, extra=0):
+    return source[: source.index(needle)].count("\n") + 1 + extra
+
+
+def _model(source):
+    return opsmodel.ModuleOpsModel(ast.parse(source))
+
+
+def _ctx(rel, source):
+    return core.FileContext(
+        repo=REPO,
+        path=os.path.join(REPO, rel),
+        rel=rel,
+        source=source,
+        lines=source.splitlines(),
+        tree=ast.parse(source),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Registry-driven fixture replay (also run by tests/test_lint.py).
+
+
+@pytest.mark.parametrize("rule_id", OPS_RULE_IDS)
+def test_flag_snippets_flag(rule_id):
+    rule = get_rule(rule_id)
+    assert rule.flag_snippets and rule.clean_snippets
+    for i, snippet in enumerate(rule.flag_snippets):
+        findings = rule.run_on_source(snippet)
+        assert any(f.rule in rule.finding_ids for f in findings), (
+            rule_id,
+            i,
+            [(f.rule, f.line, f.message) for f in findings],
+        )
+    for i, snippet in enumerate(rule.clean_snippets):
+        findings = [
+            f for f in rule.run_on_source(snippet) if f.rule in rule.finding_ids
+        ]
+        assert not findings, (rule_id, i, [(f.line, f.message) for f in findings])
+
+
+# ---------------------------------------------------------------------------
+# Seeded violations in copies of real modules — exact file:line.
+
+
+def test_stx019_counter_demoted_to_gauge_keeps_total_suffix_in_introspect_copy():
+    # Flip the poll-error counter to a gauge while keeping its `_total`
+    # name: the Prometheus-convention violation STX019 exists to catch.
+    rule = get_rule("STX019")
+    source = _read("stoix_tpu/observability/introspect.py")
+    rel = "stoix_tpu/observability/_introspect_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = "err_counter = registry.counter("
+    assert target in source
+    bad = source.replace(target, "err_counter = registry.gauge(", 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert [f.line for f in findings] == [_line_of(source, target)]
+    assert "_total" in findings[0].message and "gauge" in findings[0].message
+
+
+def test_stx019_label_drift_between_memory_sources_in_introspect_copy():
+    # Revert this PR's label fix: drop the `source` label from the
+    # live_buffer_sum estimate path so the two observe sites of
+    # stoix_tpu_device_memory_bytes disagree on label keys — one logical
+    # series split into un-joinable ones. Pinned regression.
+    rule = get_rule("STX019")
+    source = _read("stoix_tpu/observability/introspect.py")
+    rel = "stoix_tpu/observability/_introspect_copy.py"
+    drifted = '{"device": d, "kind": "bytes_in_use", "source": "live_buffer_sum"}'
+    assert drifted in source
+    bad = source.replace(drifted, '{"device": d, "kind": "bytes_in_use"}', 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    seeded_line = _line_of(source, "mem_gauge.set(\n                    nbytes")
+    assert [f.line for f in findings] == [seeded_line]
+    assert "label keys" in findings[0].message
+
+
+def test_stx019_guards_counter_rename_pinned_in_guards_copy():
+    # Revert this PR's rename: the divergence-guard counter without
+    # `_total` re-trips the convention check. Pinned regression.
+    rule = get_rule("STX019")
+    source = _read("stoix_tpu/resilience/guards.py")
+    rel = "stoix_tpu/resilience/_guards_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    bad = source.replace(
+        'SKIPPED_COUNTER = "stoix_tpu_learner_skipped_updates_total"',
+        'SKIPPED_COUNTER = "stoix_tpu_learner_skipped_updates"',
+        1,
+    )
+    findings = rule.run_on_source(bad, rel=rel)
+    assert [f.line for f in findings] == [
+        _line_of(source, "return get_registry().counter(")
+    ]
+    assert "lacks the `_total` suffix" in findings[0].message
+
+
+def test_stx019_cross_file_kind_conflict_between_real_module_copies():
+    # The same name created as gauge in one module and counter in another:
+    # the registry's runtime TypeError only fires when both paths meet in
+    # one process — the tree check catches it at lint time.
+    rule = get_rule("STX019")
+    guards_src = _read("stoix_tpu/resilience/guards.py")
+    intro_src = _read("stoix_tpu/observability/introspect.py").replace(
+        '"stoix_tpu_device_live_buffers"',
+        '"stoix_tpu_learner_skipped_updates_total"',
+        1,
+    )
+    tree_ctx = core.TreeContext(
+        REPO,
+        [
+            _ctx("stoix_tpu/observability/_introspect_copy.py", intro_src),
+            _ctx("stoix_tpu/resilience/_guards_copy.py", guards_src),
+        ],
+    )
+    findings = rule.check_tree(rule, tree_ctx)
+    conflict = [f for f in findings if "one name, one metric kind" in f.message]
+    # Files sort observability < resilience, so the gauge creation is
+    # canonical and the counter in the guards copy is the flagged site.
+    assert [(f.path, f.line) for f in conflict] == [
+        (
+            "stoix_tpu/resilience/_guards_copy.py",
+            _line_of(guards_src, "return get_registry().counter("),
+        )
+    ]
+
+
+def test_stx020_heartbeat_writer_drift_in_fleet_copy():
+    # Drift the monitor-loop heartbeat PUBLISH key one token away from the
+    # `hb/<pid>` the peer poll reads: a dead write — heartbeats age out and
+    # the fleet declares a partition with every process healthy.
+    rule = get_rule("STX020")
+    source = _read("stoix_tpu/resilience/fleet.py")
+    rel = "stoix_tpu/resilience/_fleet_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = 'self._backend.put(f"hb/{self.process_index}", str(seq))'
+    assert target in source
+    bad = source.replace(
+        target,
+        'self._backend.put(f"heartbeat/{self.process_index}", str(seq))',
+        1,
+    )
+    findings = rule.run_on_source(bad, rel=rel)
+    assert [f.line for f in findings] == [_line_of(source, target)]
+    assert "dead write" in findings[0].message
+    assert "heartbeat/{}" in findings[0].message
+
+
+def test_stx020_vote_reader_drift_blocks_to_deadline_in_fleet_copy():
+    # Drift the vote COLLECT key instead: get_blocking on a pattern no put
+    # matches blocks until its deadline on every window.
+    rule = get_rule("STX020")
+    source = _read("stoix_tpu/resilience/fleet.py")
+    target = 'self._backend.get_blocking(f"vote/{int(window_idx)}/{p}", deadline)'
+    assert target in source
+    bad = source.replace(
+        target,
+        'self._backend.get_blocking(f"ballot/{int(window_idx)}/{p}", deadline)',
+        1,
+    )
+    findings = rule.run_on_source(bad, rel="stoix_tpu/resilience/_fleet_copy.py")
+    # Both halves of the broken contract surface: the orphaned vote write
+    # AND the reader that now blocks to its deadline, each at its own line.
+    blocked = [f for f in findings if "blocks until its deadline" in f.message]
+    assert [f.line for f in blocked] == [_line_of(source, target)]
+    assert "'ballot/{}/{}'" in blocked[0].message
+    assert any("dead write" in f.message for f in findings)
+
+
+def test_stx021_deleted_dump_before_corruption_exit_in_integrity_copy():
+    # Revert this PR's fix: delete the flight-record dump from the
+    # excepthook's os._exit(88) path — the process dies with the right code
+    # and no evidence. Pinned regression.
+    rule = get_rule("STX021")
+    source = _read("stoix_tpu/resilience/integrity.py")
+    rel = "stoix_tpu/resilience/_integrity_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    dump = (
+        "                flightrec.dump_flight_record(\n"
+        "                    None,\n"
+        '                    reason=f"state corruption: uncaught {exc_type.__name__}",\n'
+        "                    exit_code=EXIT_CODE_STATE_CORRUPTION,\n"
+        "                )\n"
+    )
+    assert dump in source
+    bad = source.replace(dump, "", 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert findings and all(f.rule == "STX021" for f in findings)
+    assert [f.line for f in findings] == [
+        _line_of(bad, "os._exit(EXIT_CODE_STATE_CORRUPTION)")
+    ]
+    assert "no dump_flight_record" in findings[0].message
+
+
+def test_stx021_run_supervised_must_dispatch_every_registered_code():
+    # Drop the watchdog-stall row from run_supervised's final-code
+    # dispatch: a registered recovery code the supervisor no longer names.
+    # Pinned regression for this PR's dispatch-table fix.
+    rule = get_rule("STX021")
+    source = _read("stoix_tpu/launcher.py")
+    rel = "stoix_tpu/_launcher_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = (
+        '        EXIT_CODE_STALL: "watchdog shot a wedged run — triage '
+        'before retrying",\n'
+    )
+    assert target in source
+    bad = source.replace(target, "", 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert [f.line for f in findings] == [_line_of(bad, "def run_supervised(")]
+    assert "EXIT_CODE_STALL" in findings[0].message
+    assert "REGISTRY is the source of truth" in findings[0].message
+
+
+def test_stx022_typod_spec_arms_nothing_in_test_copy():
+    # One dropped character in a configure() literal: the drill arms
+    # nothing and fails only when the path runs (the inert-swap_poison
+    # class this rule exists for).
+    rule = get_rule("STX022")
+    source = _read("tests/test_resilience.py")
+    rel = "tests/_resilience_copy.py"
+    assert [f for f in rule.run_on_source(source, rel=rel) if f.rule == "STX022"] == []
+    target = 'faultinject.configure("replica_slow:40")'
+    assert target in source
+    bad = source.replace(target, 'faultinject.configure("replica_slw:40")', 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert [f.line for f in findings] == [_line_of(source, target)]
+    assert "'replica_slw'" in findings[0].message
+
+
+def test_stx022_unarmed_known_spec_flagged_at_vocabulary_entry():
+    # Plant a new spec in a copy of faultinject._KNOWN with no test arming
+    # it: the finding anchors at the _KNOWN tuple entry — the fix site is
+    # the vocabulary, not a grep.
+    rule = get_rule("STX022")
+    source = _read("stoix_tpu/resilience/faultinject.py")
+    target = '    "replica_slow",\n'
+    assert target in source
+    bad = source.replace(target, target + '    "chaos_monkey",\n', 1)
+    test_src = (
+        "from stoix_tpu.resilience import faultinject\n\n\n"
+        "def test_arm_everything():\n"
+        + "".join(
+            f'    faultinject.configure("{name}")\n'
+            for name in opsmodel.known_fault_specs(
+                ast.parse(_read("stoix_tpu/resilience/faultinject.py"))
+            )
+        )
+    )
+    tree_ctx = core.TreeContext(
+        REPO,
+        [
+            _ctx("stoix_tpu/resilience/_faultinject_copy.py", bad),
+            _ctx("tests/_drills_copy.py", test_src),
+        ],
+    )
+    findings = rule.check_tree(rule, tree_ctx)
+    assert [(f.path, f.line) for f in findings] == [
+        (
+            "stoix_tpu/resilience/_faultinject_copy.py",
+            _line_of(bad, '"chaos_monkey"'),
+        )
+    ]
+    assert "no test arms it" in findings[0].message
+
+
+def test_stx023_renumbered_section_ref_in_guards_copy():
+    # Renumber the guard module's design-section pointer to a section
+    # DESIGN.md does not declare: caught at the docstring line that cites
+    # it, not just somewhere in the file.
+    rule = get_rule("STX023")
+    source = _read("stoix_tpu/resilience/guards.py")
+    rel = "stoix_tpu/resilience/_guards_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    target = "docs/DESIGN.md §2.3"
+    assert target in source
+    bad = source.replace(target, "docs/DESIGN.md §2.97", 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert [f.line for f in findings] == [_line_of(source, target)]
+    assert "§2.97" in findings[0].message
+
+
+def test_stx023_unregistered_rule_id_in_docstring():
+    rule = get_rule("STX023")
+    source = _read("stoix_tpu/analysis/opsmodel.py")
+    rel = "stoix_tpu/analysis/_opsmodel_copy.py"
+    assert rule.run_on_source(source, rel=rel) == []
+    # Point the module docstring at a rule id that was never registered.
+    bad = source.replace("STX019", "STX919", 1)
+    findings = rule.run_on_source(bad, rel=rel)
+    assert [f.line for f in findings] == [_line_of(source, "STX019")]
+    assert "STX919" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Targeted opsmodel semantics.
+
+
+def test_metric_name_normalization_forms():
+    model = _model(
+        'PREFIX = "stoix_tpu_fleet"\n'
+        "def arm(registry, role, n):\n"
+        '    registry.gauge(f"{PREFIX}_{role}_depth", "h")\n'
+        '    registry.counter(PREFIX + "_drops_total", "h")\n'
+        '    registry.gauge("stoix_tpu_host_%02d_lag" % n, "h")\n'
+        '    registry.histogram(name_for(role) + "_secs", "h")\n'
+    )
+    patterns = {(s.kind, s.pattern) for s in model.metric_sites}
+    assert patterns == {
+        ("gauge", "stoix_tpu_fleet_{}_depth"),
+        ("counter", "stoix_tpu_fleet_drops_total"),
+        ("gauge", "stoix_tpu_host_{}_lag"),
+        # No literal skeleton survives a call-built part: pattern None,
+        # which STX019 flags as non-normalizable.
+        ("histogram", None),
+    }
+
+
+def test_kv_pattern_unification():
+    assert opsmodel.patterns_match("hb/{}", "hb/{}")
+    assert opsmodel.patterns_match("hb/{}", "hb/3")
+    assert opsmodel.patterns_match("ometrics/0", "ometrics/{}")
+    assert not opsmodel.patterns_match("hb/{}", "vote/{}")
+    assert not opsmodel.patterns_match("flags", "flags/{}")
+
+
+def test_fault_spec_parsing():
+    assert opsmodel.parse_fault_spec("~") == ((), True)
+    assert opsmodel.parse_fault_spec("") == ((), True)
+    assert opsmodel.parse_fault_spec("actor_crash:3, shrink") == (
+        ("actor_crash", "shrink"),
+        True,
+    )
+    names, complete = opsmodel.parse_fault_spec("{}:2,host_stall")
+    assert names == ("host_stall",) and not complete
+
+
+def test_flight_dump_reachability_through_local_callees():
+    source = (
+        "import os\n"
+        "EXIT_CODE_STALL = 86\n"
+        "def _evidence():\n"
+        "    dump_flight_record(None)\n"
+        "def shoot():\n"
+        "    _evidence()\n"
+        "    os._exit(EXIT_CODE_STALL)\n"
+        "def shoot_blind():\n"
+        "    os._exit(EXIT_CODE_STALL)\n"
+    )
+    model = _model(source)
+    assert len(model.exit_sites) == 2
+    covered, blind = sorted(model.exit_sites, key=lambda s: s.lineno)
+    assert model.flight_dump_reachable(covered)
+    assert not model.flight_dump_reachable(blind)
+    assert covered.code_name == "EXIT_CODE_STALL" and covered.code_value == 86
+
+
+def test_fn_references_sees_exit_code_names():
+    model = _model(
+        "def run_supervised(run):\n"
+        "    if run() == EXIT_CODE_STALL:\n"
+        "        return exit_codes.EXIT_CODE_FAILURE\n"
+    )
+    assert model.fn_references("run_supervised") == {
+        "EXIT_CODE_STALL",
+        "EXIT_CODE_FAILURE",
+    }
+
+
+def test_module_int_constants_exclude_bools():
+    tree = ast.parse("EXIT_CODE_OK = 0\nELASTIC = True\n")
+    assert opsmodel.module_int_constants(tree) == {"EXIT_CODE_OK": 0}
+
+
+# ---------------------------------------------------------------------------
+# Non-vacuity on the real tree: the numbers the preflight row rests on.
+
+
+def test_opsmodel_sees_the_real_ops_surfaces():
+    totals = opsmodel.repo_summary(["stoix_tpu"])
+    # The shipped tree has ~74 metric series, the hb/vote/ometrics KV
+    # round-trips, the watchdog/fleet/integrity hard exits, and the
+    # fault-injection arming sites. Generous floors: a refactor that
+    # renames the idioms out from under the model must trip this before
+    # the rule family silently goes blind.
+    assert totals["series"] >= 50, totals
+    assert totals["observe_sites"] >= 50, totals
+    assert totals["kv_writes"] >= 3 and totals["kv_reads"] >= 3, totals
+    assert totals["exit_sites"] >= 5, totals
+    assert totals["fault_sites"] >= 1, totals
+
+
+def test_faultinject_vocabulary_is_modeled():
+    model = _model(_read("stoix_tpu/resilience/faultinject.py"))
+    assert len(model.known_specs) >= 15
+    assert {"grow", "replica_slow", "swap_poison"} <= set(model.known_specs)
+
+
+# ---------------------------------------------------------------------------
+# The --statistics row and the preflight ops-contracts row.
+
+
+def test_statistics_block_includes_opsmodel_row(capsys):
+    from stoix_tpu.analysis.__main__ import print_statistics
+    from stoix_tpu.analysis import get_rules
+
+    print_statistics([], get_rules(), ["stoix_tpu/observability"])
+    err = capsys.readouterr().err
+    m = re.search(r"\[stats\] opsmodel: (\d+) metric series", err)
+    assert m and int(m.group(1)) > 0, err
+    assert "hard-exit site(s)" in err
+
+
+def _stub_preflight(monkeypatch):
+    from stoix_tpu import analysis
+    from stoix_tpu.resilience import preflight
+
+    def fake_run_preflight(configs=None, settings=None):
+        report = preflight.PreflightReport()
+        report.add("backend_probe", "pass", "stubbed")
+        return report
+
+    monkeypatch.setattr(preflight, "run_preflight", fake_run_preflight)
+    # The lint scan and thread-model row are not under test here; stub them
+    # so this stays in the not-slow lane.
+    monkeypatch.setattr(
+        analysis, "run_paths", lambda paths=None, with_tree_rules=True: ([], 214)
+    )
+    from stoix_tpu.analysis import threadmodel
+
+    monkeypatch.setattr(
+        threadmodel,
+        "repo_summary",
+        lambda paths=None, repo=None: {
+            "files": 214, "spawns": 17, "roots": 16, "locks": 35,
+            "shared": 1400, "obligations": 1,
+        },
+    )
+
+
+def test_preflight_reports_ops_contracts_row(monkeypatch, capsys):
+    from stoix_tpu import launcher
+
+    _stub_preflight(monkeypatch)
+    rc = launcher.run_preflight_only([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    m = re.search(r"ops-contracts\s+\[PASS\]\s+(\d+) metric series", out)
+    assert m and int(m.group(1)) > 0, out
+    assert "fault-spec site(s) modeled" in out
+
+
+def test_preflight_fails_on_silently_empty_ops_model(monkeypatch, capsys):
+    from stoix_tpu import launcher
+
+    _stub_preflight(monkeypatch)
+    monkeypatch.setattr(
+        opsmodel,
+        "repo_summary",
+        lambda paths=None, repo=None: {
+            "files": 214, "metric_sites": 0, "series": 0, "observe_sites": 0,
+            "kv_writes": 0, "kv_reads": 0, "exit_sites": 0, "fault_sites": 0,
+        },
+    )
+    rc = launcher.run_preflight_only([])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert re.search(r"ops-contracts\s+\[FAIL\]\s+EMPTY model", out), out

@@ -680,6 +680,24 @@ def test_injection_points_are_noops_without_a_plan():
     assert faultinject.ckpt_corrupt_armed() is False
 
 
+def test_grow_resize_fault_fires_once_at_its_window():
+    faultinject.configure("grow:2")
+    assert faultinject.maybe_resize(1) is None  # not its window yet
+    assert faultinject.maybe_resize(2) == "grow"
+    assert faultinject.maybe_resize(2) is None  # one-shot
+
+
+def test_replica_slow_straggles_replica_zero_only(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(faultinject.time, "sleep", lambda s: sleeps.append(s))
+    faultinject.configure("replica_slow:40")
+    faultinject.maybe_slow_replica(1)
+    assert sleeps == []  # only replica 0 is the straggler
+    faultinject.maybe_slow_replica(0)
+    faultinject.maybe_slow_replica(0)  # sustained, not one-shot
+    assert sleeps == [0.04, 0.04]
+
+
 def test_find_step_count_locates_optax_counter():
     import jax.numpy as jnp
     import optax

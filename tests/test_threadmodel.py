@@ -628,8 +628,8 @@ def test_preflight_reports_concurrency_model_row(monkeypatch, capsys):
 
 
 def test_preflight_fails_on_silently_empty_thread_model(monkeypatch, capsys):
-    from stoix_tpu import launcher
-    from stoix_tpu.analysis import threadmodel
+    from stoix_tpu import analysis, launcher
+    from stoix_tpu.analysis import opsmodel, threadmodel
     from stoix_tpu.resilience import preflight
 
     def fake_run_preflight(configs=None, settings=None):
@@ -638,6 +638,19 @@ def test_preflight_fails_on_silently_empty_thread_model(monkeypatch, capsys):
         return report
 
     monkeypatch.setattr(preflight, "run_preflight", fake_run_preflight)
+    # Stub the UNRELATED full-repo scans (lint + ops model, ~30s combined) so
+    # this not-slow test pays only for the thread-model contract under test.
+    monkeypatch.setattr(
+        analysis, "run_paths", lambda paths=None, with_tree_rules=True: ([], 214)
+    )
+    monkeypatch.setattr(
+        opsmodel,
+        "repo_summary",
+        lambda paths=None, repo=None: {
+            "files": 214, "metric_sites": 80, "series": 74, "observe_sites": 84,
+            "kv_writes": 5, "kv_reads": 5, "exit_sites": 11, "fault_sites": 7,
+        },
+    )
     monkeypatch.setattr(
         threadmodel,
         "repo_summary",
